@@ -1,0 +1,33 @@
+"""comfyui_distributed_tpu — a TPU-native distributed image-generation framework.
+
+A from-scratch re-design of the capabilities of ``formulake/comfyui-distributed``
+(reference mounted at /root/reference) for TPU hardware:
+
+- The reference fans a workflow out to N CUDA worker *processes* over HTTP and
+  gathers PNG-encoded results (reference ``distributed.py:1222-1459``,
+  ``web/gpupanel.js:836-941``).  Here the same capability is an SPMD program
+  over a :class:`jax.sharding.Mesh`: the batch axis is sharded over the
+  ``data`` mesh axis, per-participant seeds are ``fold_in``s of the replica
+  index, and "collection" is an XLA ``all_gather`` over ICI — tensors never
+  leave HBM as PNGs.
+- The reference's distributed tiled upscale (``distributed_upscale.py:38-704``)
+  becomes a ``shard_map`` over a tile axis with local halo extraction and a
+  vectorised feathered blend.
+- The reference's browser-side orchestrator, worker process manager and HTTP
+  control plane survive as a thin, UI-free control plane
+  (:mod:`comfyui_distributed_tpu.server`) plus a host process manager for
+  multi-host deployments (:mod:`comfyui_distributed_tpu.runtime`).
+
+Packages:
+    utils/     config, logging, image codecs, process + network helpers
+    parallel/  mesh runtime, collectives, sharding rules, ring attention
+    models/    diffusion models (UNet/VAE/CLIP), samplers, schedules, upscalers
+    ops/       workflow node library (ComfyUI-compatible op schemas)
+    workflow/  graph parser + executor + participant dispatcher
+    runtime/   job store, worker process manager, monitors
+    server/    aiohttp control/data plane
+"""
+
+__version__ = "0.1.0"
+
+from comfyui_distributed_tpu.utils.logging import log, debug_log  # noqa: F401

@@ -1,0 +1,1 @@
+"""Utility layer (mirrors the capability surface of reference ``utils/``)."""

@@ -1,0 +1,170 @@
+"""Cluster configuration.
+
+Capability parity with reference ``utils/config.py:1-50`` plus the endpoints'
+mutation semantics (``distributed.py:209-364``): a single JSON file holding
+master + worker definitions, settings, and managed-process state.  Extended
+with a ``mesh`` section (TPU topology) the reference has no analog for.
+
+Schema::
+
+    {
+      "master":  {"host": str|None, "port": int?, "extra_args": str?},
+      "workers": [{"id": str, "name": str, "host": str?, "port": int,
+                   "enabled": bool, "extra_args": str?}],
+      "settings": {"debug": bool, "auto_launch_workers": bool,
+                   "stop_workers_on_master_exit": bool},
+      "mesh":    {"axes": {"data": int, "tensor": int, "seq": int},
+                  "allow_cpu_fallback": bool},
+      "managed_processes": {name: {"pid": int, ...}}
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Any, Dict, Optional
+
+from comfyui_distributed_tpu.utils.logging import log, set_debug
+
+_lock = threading.RLock()
+
+CONFIG_ENV = "DISTRIBUTED_TPU_CONFIG"
+DEFAULT_CONFIG_NAME = "cluster_config.json"
+
+
+def default_config_path() -> str:
+    env = os.environ.get(CONFIG_ENV)
+    if env:
+        return env
+    return os.path.join(os.getcwd(), DEFAULT_CONFIG_NAME)
+
+
+def get_default_config() -> Dict[str, Any]:
+    """Default schema (reference ``get_default_config``, ``utils/config.py:10-20``)."""
+    return {
+        "master": {"host": None},
+        "workers": [],
+        "settings": {
+            "debug": False,
+            "auto_launch_workers": False,
+            "stop_workers_on_master_exit": True,
+        },
+        "mesh": {
+            "axes": {"data": -1, "tensor": 1, "seq": 1},  # -1: all devices
+            "allow_cpu_fallback": True,
+        },
+        "managed_processes": {},
+    }
+
+
+def _merge_defaults(cfg: Any) -> Dict[str, Any]:
+    base = get_default_config()
+    if not isinstance(cfg, dict):
+        return base
+    for key, val in base.items():
+        if isinstance(val, dict):
+            if not isinstance(cfg.get(key), dict):
+                cfg[key] = val
+            else:
+                for k2, v2 in val.items():
+                    cfg[key].setdefault(k2, v2)
+        elif key not in cfg or cfg[key] is None:
+            cfg[key] = val
+    return cfg
+
+
+def load_config(path: Optional[str] = None) -> Dict[str, Any]:
+    """Load (reference ``load_config``, ``utils/config.py:22-30``); missing or
+    corrupt files yield defaults rather than raising."""
+    path = path or default_config_path()
+    with _lock:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                cfg = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            cfg = get_default_config()
+        cfg = _merge_defaults(cfg)
+    set_debug(bool(cfg["settings"].get("debug", False)))
+    return cfg
+
+
+def save_config(cfg: Dict[str, Any], path: Optional[str] = None) -> None:
+    """Atomic write (reference ``save_config``, ``utils/config.py:32-40``)."""
+    path = path or default_config_path()
+    with _lock:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".cfg-", suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(cfg, f, indent=2)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    set_debug(bool(cfg.get("settings", {}).get("debug", False)))
+
+
+def ensure_config_exists(path: Optional[str] = None) -> str:
+    """Create the default config if absent (reference ``utils/config.py:42-50``)."""
+    path = path or default_config_path()
+    if not os.path.exists(path):
+        save_config(get_default_config(), path)
+        log(f"created default config at {path}")
+    return path
+
+
+# --- worker CRUD (semantics of reference distributed.py:209-364) -----------
+
+def upsert_worker(cfg: Dict[str, Any], worker: Dict[str, Any]) -> Dict[str, Any]:
+    """Insert or update a worker by id; a value of ``None`` deletes that field
+    (reference ``update_worker_endpoint``, ``distributed.py:209-278``)."""
+    wid = str(worker["id"])
+    workers = cfg.setdefault("workers", [])
+    for existing in workers:
+        if str(existing.get("id")) == wid:
+            for k, v in worker.items():
+                if v is None:
+                    existing.pop(k, None)
+                else:
+                    existing[k] = v
+            return existing
+    clean = {k: v for k, v in worker.items() if v is not None}
+    clean.setdefault("enabled", False)
+    workers.append(clean)
+    return clean
+
+
+def delete_worker(cfg: Dict[str, Any], worker_id: str) -> bool:
+    """Remove a worker by id (reference ``distributed.py:280-313``)."""
+    workers = cfg.setdefault("workers", [])
+    before = len(workers)
+    cfg["workers"] = [w for w in workers if str(w.get("id")) != str(worker_id)]
+    return len(cfg["workers"]) != before
+
+
+def update_setting(cfg: Dict[str, Any], key: str, value: Any) -> None:
+    """Set one settings key (reference ``distributed.py:315-337``)."""
+    cfg.setdefault("settings", {})[key] = value
+    if key == "debug":
+        set_debug(bool(value))
+
+
+def update_master(cfg: Dict[str, Any], **fields: Any) -> None:
+    """Update master host/port/extra_args (reference ``distributed.py:339-364``)."""
+    master = cfg.setdefault("master", {})
+    for k, v in fields.items():
+        if v is None:
+            master.pop(k, None)
+        else:
+            master[k] = v
+
+
+def enabled_workers(cfg: Dict[str, Any]) -> list:
+    return [w for w in cfg.get("workers", []) if w.get("enabled")]

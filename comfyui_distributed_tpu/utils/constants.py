@@ -1,0 +1,44 @@
+"""Timeouts, intervals and wire constants.
+
+Capability parity with reference ``utils/constants.py:5-34``: every timeout the
+reference exposes has an equivalent here, though several lose their reason to
+exist on TPU (in-program collectives cannot "time out per image"); they are
+kept for the HTTP control plane and the multi-host job path.
+"""
+
+# --- job collection (control-plane / multi-host HTTP path) -----------------
+WORKER_JOB_TIMEOUT = 10.0        # s to wait per image when draining a job queue
+JOB_COMPLETION_TIMEOUT = 60.0    # s overall for a remote participant's results
+TILE_COLLECTION_TIMEOUT = 60.0   # s overall for tile gathering
+TILE_WAIT_TIMEOUT = 30.0         # s per tile when draining the tile queue
+TILE_TRANSFER_TIMEOUT = 30.0     # s for a single tile HTTP transfer
+TILE_SEND_TIMEOUT = 60.0         # s client-side timeout when POSTing tiles
+QUEUE_INIT_TIMEOUT = 5.0         # s for queue creation on the server loop
+
+# --- transport retry --------------------------------------------------------
+SEND_MAX_RETRIES = 5
+SEND_BACKOFF_BASE = 0.5          # s; exponential, capped
+SEND_BACKOFF_CAP = 5.0
+
+# --- worker lifecycle -------------------------------------------------------
+PROCESS_TERMINATION_TIMEOUT = 5.0
+PROCESS_WAIT_TIMEOUT = 3.0
+WORKER_CHECK_INTERVAL = 2.0      # s between liveness polls
+STATUS_CHECK_INTERVAL = 5.0
+WORKER_STARTUP_DELAY = 2.0       # s before auto-launching workers
+MEMORY_CLEAR_DELAY = 0.5
+PREFLIGHT_TIMEOUT = 0.3          # s health probe before dispatch
+
+# --- IO ---------------------------------------------------------------------
+CHUNK_SIZE = 8192
+LOG_TAIL_BYTES = 65536
+
+# --- mesh defaults ----------------------------------------------------------
+DATA_AXIS = "data"       # replica fan-out (reference: one worker process each)
+TENSOR_AXIS = "tensor"   # intra-op model parallelism (no reference analog)
+SEQ_AXIS = "seq"         # sequence/context parallelism (ring attention)
+TILE_AXIS = DATA_AXIS    # tiles shard over the same physical axis as replicas
+
+# --- wire formats -----------------------------------------------------------
+TENSOR_WIRE_DTYPE = "float32"
+IMAGE_WIRE_FORMAT = "png"        # lossless, reference parity (compress_level=0)

@@ -1,0 +1,107 @@
+"""Image codecs: device arrays <-> PIL <-> PNG bytes.
+
+Capability parity with reference ``utils/image.py:8-24`` (``tensor_to_pil`` /
+``pil_to_tensor``) and the PNG wire marshalling in ``distributed.py:1262-1272``.
+The canonical in-framework layout is **NHWC float32 in [0, 1]** (TPU-friendly
+channels-last), matching the reference's ``[B, H, W, C]`` convention.
+
+On-mesh tensors never use this path — it exists only for IO edges (workflow
+LoadImage/SaveImage) and the multi-host HTTP data plane.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List, Union
+
+import numpy as np
+from PIL import Image
+
+
+def to_numpy(x) -> np.ndarray:
+    """Accept jax/torch/np arrays; return float32 ndarray."""
+    if hasattr(x, "detach"):  # torch
+        x = x.detach().cpu().numpy()
+    arr = np.asarray(x, dtype=np.float32)
+    return arr
+
+
+def ensure_bhwc(arr: np.ndarray) -> np.ndarray:
+    if arr.ndim == 3:
+        arr = arr[None]
+    if arr.ndim != 4:
+        raise ValueError(f"expected [B,H,W,C] or [H,W,C], got shape {arr.shape}")
+    return arr
+
+
+def tensor_to_pil(x, index: int = 0) -> Image.Image:
+    """[B,H,W,C] float in [0,1] -> PIL uint8 (reference ``utils/image.py:8-14``)."""
+    arr = ensure_bhwc(to_numpy(x))[index]
+    arr = np.clip(arr * 255.0 + 0.5, 0, 255).astype(np.uint8)
+    if arr.shape[-1] == 1:
+        arr = arr[..., 0]
+    return Image.fromarray(arr)
+
+
+def pil_to_tensor(img: Image.Image) -> np.ndarray:
+    """PIL -> [1,H,W,C] float32 in [0,1] (reference ``utils/image.py:16-21``)."""
+    if img.mode not in ("RGB", "RGBA", "L"):
+        img = img.convert("RGB")
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    return arr[None]
+
+
+def batch_to_pils(x) -> List[Image.Image]:
+    arr = ensure_bhwc(to_numpy(x))
+    return [tensor_to_pil(arr, i) for i in range(arr.shape[0])]
+
+
+def encode_png(x: Union[np.ndarray, Image.Image], compress_level: int = 0) -> bytes:
+    """Lossless PNG bytes (reference wire format, ``distributed.py:1262-1272``;
+    compress_level=0 trades size for CPU, as the reference does)."""
+    img = x if isinstance(x, Image.Image) else tensor_to_pil(x)
+    buf = io.BytesIO()
+    img.save(buf, format="PNG", compress_level=compress_level)
+    return buf.getvalue()
+
+
+def decode_png(data: bytes) -> np.ndarray:
+    """PNG bytes -> [1,H,W,C] float32 (reference ``distributed.py:1196-1204``)."""
+    img = Image.open(io.BytesIO(data))
+    img.load()
+    return pil_to_tensor(img)
+
+
+def encode_npz(x) -> bytes:
+    """Raw-tensor wire format — a lossless, dtype-preserving alternative the
+    reference lacks (PNG clamps to uint8); used for latents/metadata."""
+    buf = io.BytesIO()
+    np.savez_compressed(buf, data=to_numpy(x))
+    return buf.getvalue()
+
+
+def decode_npz(data: bytes) -> np.ndarray:
+    with np.load(io.BytesIO(data)) as z:
+        return z["data"]
+
+
+def resize_image(x, width: int, height: int, method: str = "lanczos") -> np.ndarray:
+    """Batched resize via PIL for parity with the reference's LANCZOS usage
+    (``distributed_upscale.py:505,583``; ImageScale node)."""
+    filters = {
+        "nearest": Image.NEAREST,
+        "nearest-exact": Image.NEAREST,
+        "bilinear": Image.BILINEAR,
+        "area": Image.BOX,
+        "bicubic": Image.BICUBIC,
+        "lanczos": Image.LANCZOS,
+    }
+    f = filters.get(method, Image.LANCZOS)
+    arr = ensure_bhwc(to_numpy(x))
+    out = []
+    for i in range(arr.shape[0]):
+        pil = tensor_to_pil(arr, i)
+        out.append(pil_to_tensor(pil.resize((width, height), f))[0])
+    return np.stack(out, axis=0)

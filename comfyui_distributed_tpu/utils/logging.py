@@ -1,0 +1,72 @@
+"""Structured logging.
+
+Capability parity with reference ``utils/logging.py:21-28`` (``log`` /
+``debug_log`` with a config-gated debug tier) but without the reference's
+read-the-config-file-on-every-call behaviour — debug state is a process-local
+flag refreshed by the config layer on load/save.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+_PREFIX = "[DistributedTPU]"
+
+_logger = logging.getLogger("comfyui_distributed_tpu")
+if not _logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter("%(message)s"))
+    _logger.addHandler(_h)
+    _logger.setLevel(logging.INFO)
+    _logger.propagate = False
+
+_debug_enabled = os.environ.get("DISTRIBUTED_TPU_DEBUG", "") not in ("", "0", "false")
+
+
+def set_debug(enabled: bool) -> None:
+    """Toggle the debug tier (called by the config layer on load/save)."""
+    global _debug_enabled
+    _debug_enabled = bool(enabled)
+
+
+def debug_enabled() -> bool:
+    return _debug_enabled
+
+
+def log(message: str) -> None:
+    """Always-on log line (reference ``log``, ``utils/logging.py:21-23``)."""
+    _logger.info("%s %s", _PREFIX, message)
+
+
+def debug_log(message: str) -> None:
+    """Debug-tier log line (reference ``debug_log``, ``utils/logging.py:25-28``)."""
+    if _debug_enabled:
+        _logger.info("%s [DEBUG] %s", _PREFIX, message)
+
+
+class Timer:
+    """Phase wall-clock timer — the observability the reference lacks (SURVEY §5).
+
+    Usage::
+
+        with Timer("gather") as t: ...
+        t.elapsed_s
+    """
+
+    def __init__(self, name: str, emit: bool = True):
+        self.name = name
+        self.emit = emit
+        self.elapsed_s = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed_s = time.perf_counter() - self._t0
+        if self.emit:
+            debug_log(f"phase[{self.name}] {self.elapsed_s * 1e3:.1f} ms")
+        return False

@@ -1,0 +1,136 @@
+"""Network + async helpers.
+
+Capability parity with reference ``utils/network.py:11-40`` (pooled client
+session, error responder) and ``utils/async_helpers.py:9-50``
+(sync->async bridge), plus the network-info / master-IP heuristics of
+reference ``distributed.py:93-207``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import socket
+import threading
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+
+_session: Optional[aiohttp.ClientSession] = None
+_session_lock = threading.Lock()
+
+
+async def get_client_session() -> aiohttp.ClientSession:
+    """Shared pooled session (reference ``utils/network.py:14-22``)."""
+    global _session
+    with _session_lock:
+        if _session is None or _session.closed:
+            connector = aiohttp.TCPConnector(limit=100, limit_per_host=30)
+            _session = aiohttp.ClientSession(connector=connector)
+        return _session
+
+
+async def cleanup_client_session() -> None:
+    global _session
+    if _session is not None and not _session.closed:
+        await _session.close()
+    _session = None
+
+
+def handle_api_error(request, error: Exception, status: int = 500):
+    """JSON error responder (reference ``utils/network.py:28-33``)."""
+    from aiohttp import web
+    log(f"API error on {getattr(request, 'path', '?')}: {error}")
+    return web.json_response({"status": "error", "message": str(error)},
+                             status=status)
+
+
+def run_async_in_loop(coro, loop: asyncio.AbstractEventLoop,
+                      timeout: Optional[float] = None):
+    """Run a coroutine on a foreign event loop from sync code and block for the
+    result (reference ``run_async_in_server_loop``,
+    ``utils/async_helpers.py:9-50``).  Raises if called *on* that loop's
+    thread, which would deadlock — the hazard SURVEY.md §5 flags."""
+    try:
+        running = asyncio.get_running_loop()
+    except RuntimeError:
+        running = None
+    if running is loop:
+        raise RuntimeError("run_async_in_loop called from the target loop; "
+                           "await the coroutine instead")
+    fut = asyncio.run_coroutine_threadsafe(coro, loop)
+    try:
+        return fut.result(timeout=timeout)
+    except concurrent.futures.TimeoutError:
+        fut.cancel()
+        raise TimeoutError(f"coroutine timed out after {timeout}s")
+
+
+# --- host IP discovery (reference distributed.py:93-207) --------------------
+
+def get_network_ips() -> List[str]:
+    """Enumerate candidate host IPs (reference ``get_network_ips``,
+    ``distributed.py:98-152``): getaddrinfo + UDP-connect trick."""
+    ips: List[str] = []
+    try:
+        for info in socket.getaddrinfo(socket.gethostname(), None,
+                                       family=socket.AF_INET):
+            ip = info[4][0]
+            if ip not in ips:
+                ips.append(ip)
+    except socket.gaierror:
+        pass
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            ip = s.getsockname()[0]
+            if ip not in ips:
+                ips.append(ip)
+        finally:
+            s.close()
+    except OSError:
+        pass
+    if "127.0.0.1" not in ips:
+        ips.append("127.0.0.1")
+    return ips
+
+
+def _private_rank(ip: str) -> int:
+    """Private-range preference (reference ``get_recommended_ip``,
+    ``distributed.py:154-207``): 192.168 > 10. > 172.16-31 > other > loopback."""
+    if ip.startswith("192.168."):
+        return 0
+    if ip.startswith("10."):
+        return 1
+    if ip.startswith("172."):
+        try:
+            second = int(ip.split(".")[1])
+            if 16 <= second <= 31:
+                return 2
+        except (IndexError, ValueError):
+            pass
+    if ip.startswith("127."):
+        return 9
+    return 5
+
+
+def get_recommended_ip() -> str:
+    ips = get_network_ips()
+    return sorted(ips, key=_private_rank)[0]
+
+
+def network_info() -> Dict[str, Any]:
+    ips = get_network_ips()
+    return {"ips": ips, "recommended_ip": get_recommended_ip(),
+            "hostname": socket.gethostname()}
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -1,0 +1,123 @@
+"""Process utilities.
+
+Capability parity with reference ``utils/process.py:9-37``: cross-platform
+liveness checks, graceful terminate->kill, python executable discovery, plus
+process-tree kill (reference ``distributed.py:929-1018``).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+try:
+    import psutil
+except ImportError:  # pragma: no cover
+    psutil = None
+
+from comfyui_distributed_tpu.utils.constants import (
+    PROCESS_TERMINATION_TIMEOUT,
+    PROCESS_WAIT_TIMEOUT,
+)
+from comfyui_distributed_tpu.utils.logging import debug_log
+
+
+def is_process_alive(pid: int) -> bool:
+    """Signal-0 liveness probe (reference ``utils/process.py:9-18``)."""
+    if pid is None or pid <= 0:
+        return False
+    if psutil is not None:
+        try:
+            p = psutil.Process(pid)
+            return p.is_running() and p.status() != psutil.STATUS_ZOMBIE
+        except psutil.Error:
+            return False
+    if sys.platform == "win32":  # os.kill(pid, 0) would TerminateProcess here
+        out = subprocess.run(["tasklist", "/FI", f"PID eq {pid}", "/NH"],
+                             capture_output=True, text=True, check=False)
+        return str(pid) in out.stdout
+    try:
+        os.kill(pid, 0)
+        return True
+    except PermissionError:
+        return True  # exists, owned by another user
+    except OSError:
+        return False
+
+
+def terminate_process(proc: subprocess.Popen,
+                      timeout: float = PROCESS_TERMINATION_TIMEOUT) -> None:
+    """Graceful terminate, then kill (reference ``utils/process.py:20-30``)."""
+    if proc.poll() is not None:
+        return
+    proc.terminate()
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=PROCESS_WAIT_TIMEOUT)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def kill_process_tree(pid: int, timeout: float = PROCESS_TERMINATION_TIMEOUT) -> bool:
+    """Children-first tree kill (reference ``_kill_process_tree``,
+    ``distributed.py:929-1018``): psutil path, then POSIX pkill fallback."""
+    if not is_process_alive(pid):
+        return True
+    if psutil is not None:
+        try:
+            parent = psutil.Process(pid)
+            children = parent.children(recursive=True)
+            for c in children:
+                try:
+                    c.terminate()
+                except psutil.Error:
+                    pass
+            try:
+                parent.terminate()
+            except psutil.Error:
+                pass
+            _, alive = psutil.wait_procs([parent] + children, timeout=timeout)
+            for p in alive:
+                try:
+                    p.kill()
+                except psutil.Error:
+                    pass
+            return True
+        except psutil.Error:
+            pass
+    # POSIX fallback (reference distributed.py:1010-1018)
+    try:
+        subprocess.run(["pkill", "-TERM", "-P", str(pid)], check=False)
+        os.kill(pid, signal.SIGTERM)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if not is_process_alive(pid):
+                return True
+            time.sleep(0.1)
+        os.kill(pid, signal.SIGKILL)
+    except OSError:
+        pass
+    return not is_process_alive(pid)
+
+
+def get_python_executable() -> str:
+    """Reference ``utils/process.py:32-37``."""
+    return sys.executable or "python3"
+
+
+def popen_detached(cmd, env=None, stdout=None, stderr=None,
+                   cwd: Optional[str] = None) -> subprocess.Popen:
+    """Start a child in its own session so master signals don't hit it
+    (reference ``distributed.py:729-744``)."""
+    debug_log(f"spawning: {' '.join(map(str, cmd))}")
+    return subprocess.Popen(
+        [str(c) for c in cmd], env=env, stdout=stdout, stderr=stderr,
+        cwd=cwd, start_new_session=True,
+    )

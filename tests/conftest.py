@@ -1,0 +1,31 @@
+"""Test harness: an 8-device virtual CPU mesh (SURVEY.md §4).
+
+The reference's multi-process browser+HTTP topology is untestable in CI; the
+TPU framework's collectives are testable single-process by forcing XLA to
+expose N host devices.  Env vars must be set before jax initializes a backend,
+hence this module-level block in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolated_config(tmp_path, monkeypatch):
+    """Point the config layer at a per-test temp file."""
+    monkeypatch.setenv("DISTRIBUTED_TPU_CONFIG",
+                       str(tmp_path / "cluster_config.json"))
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

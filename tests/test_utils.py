@@ -1,0 +1,138 @@
+"""Utils layer: config round-trip/CRUD, image codecs, logging, net helpers."""
+
+import asyncio
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.utils import config as cfg_mod
+from comfyui_distributed_tpu.utils import image as img_mod
+from comfyui_distributed_tpu.utils import net as net_mod
+from comfyui_distributed_tpu.utils.logging import Timer, debug_enabled
+
+
+class TestConfig:
+    def test_defaults_created(self):
+        path = cfg_mod.ensure_config_exists()
+        assert os.path.exists(path)
+        cfg = cfg_mod.load_config()
+        assert cfg["workers"] == []
+        assert cfg["settings"]["stop_workers_on_master_exit"] is True
+        assert "mesh" in cfg
+
+    def test_round_trip(self):
+        cfg = cfg_mod.get_default_config()
+        cfg["master"]["host"] = "10.0.0.5"
+        cfg_mod.save_config(cfg)
+        assert cfg_mod.load_config()["master"]["host"] == "10.0.0.5"
+
+    def test_corrupt_file_yields_defaults(self):
+        path = cfg_mod.default_config_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write("{not json")
+        cfg = cfg_mod.load_config()
+        assert cfg["workers"] == []
+
+    def test_upsert_worker_insert_update_delete(self):
+        cfg = cfg_mod.get_default_config()
+        cfg_mod.upsert_worker(cfg, {"id": "1", "name": "w1", "port": 8288})
+        assert len(cfg["workers"]) == 1
+        assert cfg["workers"][0]["enabled"] is False
+        # update + None removes field (reference upsert semantics)
+        cfg_mod.upsert_worker(cfg, {"id": "1", "enabled": True, "name": None})
+        assert cfg["workers"][0]["enabled"] is True
+        assert "name" not in cfg["workers"][0]
+        assert cfg_mod.delete_worker(cfg, "1") is True
+        assert cfg["workers"] == []
+        assert cfg_mod.delete_worker(cfg, "1") is False
+
+    def test_debug_setting_toggles_logging(self):
+        cfg = cfg_mod.get_default_config()
+        cfg_mod.update_setting(cfg, "debug", True)
+        assert debug_enabled() is True
+        cfg_mod.update_setting(cfg, "debug", False)
+        assert debug_enabled() is False
+
+    def test_enabled_workers(self):
+        cfg = cfg_mod.get_default_config()
+        cfg_mod.upsert_worker(cfg, {"id": "a", "port": 1, "enabled": True})
+        cfg_mod.upsert_worker(cfg, {"id": "b", "port": 2, "enabled": False})
+        assert [w["id"] for w in cfg_mod.enabled_workers(cfg)] == ["a"]
+
+
+class TestImage:
+    def test_png_round_trip(self, rng):
+        x = rng.random((2, 16, 24, 3), dtype=np.float32)
+        png = img_mod.encode_png(x[0:1])
+        back = img_mod.decode_png(png)
+        assert back.shape == (1, 16, 24, 3)
+        # uint8 quantization bound
+        assert np.abs(back - x[0:1]).max() <= 1.0 / 255.0 + 1e-6
+
+    def test_npz_round_trip_exact(self, rng):
+        x = rng.standard_normal((1, 8, 8, 4), dtype=np.float32)
+        assert np.array_equal(img_mod.decode_npz(img_mod.encode_npz(x)), x)
+
+    def test_pil_tensor_round_trip(self, rng):
+        x = rng.random((1, 10, 12, 3), dtype=np.float32)
+        pil = img_mod.tensor_to_pil(x)
+        back = img_mod.pil_to_tensor(pil)
+        assert back.shape == x.shape
+        assert np.abs(back - x).max() <= 1.0 / 255.0 + 1e-6
+
+    def test_resize(self, rng):
+        x = rng.random((2, 8, 8, 3), dtype=np.float32)
+        out = img_mod.resize_image(x, 16, 12, "lanczos")
+        assert out.shape == (2, 12, 16, 3)
+
+    def test_grayscale(self):
+        x = np.zeros((1, 4, 4, 1), dtype=np.float32)
+        pil = img_mod.tensor_to_pil(x)
+        assert img_mod.pil_to_tensor(pil).shape[-1] == 1
+
+
+class TestNet:
+    def test_recommended_ip_prefers_private(self, monkeypatch):
+        monkeypatch.setattr(net_mod, "get_network_ips",
+                            lambda: ["127.0.0.1", "8.8.8.8", "10.1.2.3",
+                                     "192.168.1.9", "172.20.0.2"])
+        assert net_mod.get_recommended_ip() == "192.168.1.9"
+
+    def test_network_info_has_loopback(self):
+        info = net_mod.network_info()
+        assert "127.0.0.1" in info["ips"]
+        assert info["recommended_ip"] in info["ips"]
+
+    def test_run_async_in_loop(self):
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        try:
+            async def coro():
+                return 41 + 1
+            assert net_mod.run_async_in_loop(coro(), loop, timeout=5) == 42
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(timeout=5)
+            loop.close()
+
+    def test_run_async_same_loop_raises(self):
+        async def outer():
+            loop = asyncio.get_running_loop()
+            async def coro():
+                return 1
+            c = coro()
+            with pytest.raises(RuntimeError):
+                net_mod.run_async_in_loop(c, loop)
+            c.close()
+        asyncio.run(outer())
+
+
+def test_timer_measures():
+    with Timer("x", emit=False) as t:
+        sum(range(1000))
+    assert t.elapsed_s >= 0
