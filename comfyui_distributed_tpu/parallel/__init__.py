@@ -1,0 +1,19 @@
+"""Mesh runtime, collectives and sharding rules.
+
+This package replaces the reference's entire L1-L3 stack (worker process
+manager + HTTP control/data planes, reference ``distributed.py:603-1218``)
+with an in-program device mesh: participants are mesh slots, fan-out is batch
+sharding, and gathering is an XLA collective over ICI.
+"""
+
+from comfyui_distributed_tpu.parallel.mesh import (  # noqa: F401
+    MeshRuntime,
+    build_mesh,
+    describe_devices,
+    get_runtime,
+)
+from comfyui_distributed_tpu.parallel.collectives import (  # noqa: F401
+    replica_seeds,
+    gather_batch,
+    shard_batch,
+)

@@ -1,0 +1,190 @@
+"""Mesh runtime: device/topology discovery and mesh construction.
+
+TPU-native replacement for the reference's worker topology.  Where the
+reference spawns one ComfyUI process per CUDA device and tracks them in
+``gpu_config.json`` (``WorkerProcessManager``, reference
+``distributed.py:603-1021``), a TPU slice exposes all local chips to one
+process; "cluster membership" becomes the shape of a
+:class:`jax.sharding.Mesh`.  The reference's *enabled workers* toggle maps to
+``data_parallel_size`` — how many mesh slots participate in a fan-out run.
+
+Axes (see ``utils/constants.py``):
+    data    replica fan-out + tile scatter (reference's worker axis)
+    tensor  intra-op model parallelism (no reference analog; TPU extension)
+    seq     sequence/context parallelism for ring attention
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from comfyui_distributed_tpu.utils.constants import DATA_AXIS, SEQ_AXIS, TENSOR_AXIS
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+
+AXIS_ORDER = (DATA_AXIS, TENSOR_AXIS, SEQ_AXIS)
+
+
+def describe_devices(devices: Optional[Sequence[jax.Device]] = None) -> Dict[str, Any]:
+    """Topology discovery — the TPU analog of the reference's worker/CUDA
+    enumeration (``CUDA_VISIBLE_DEVICES`` handling, reference
+    ``distributed.py:672-677``).  Reports platform, counts, per-device
+    metadata and multi-host process info."""
+    devices = list(devices) if devices is not None else jax.devices()
+    descr: List[Dict[str, Any]] = []
+    for d in devices:
+        entry: Dict[str, Any] = {
+            "id": d.id,
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", "unknown"),
+            "process_index": d.process_index,
+        }
+        coords = getattr(d, "coords", None)
+        if coords is not None:
+            entry["coords"] = tuple(coords)
+        descr.append(entry)
+    return {
+        "platform": devices[0].platform if devices else "none",
+        "num_devices": len(devices),
+        "num_local_devices": jax.local_device_count(),
+        "num_processes": jax.process_count(),
+        "process_index": jax.process_index(),
+        "devices": descr,
+    }
+
+
+def _resolve_axes(axes: Dict[str, int], n_devices: int) -> Dict[str, int]:
+    """Resolve -1 ("fill with remaining devices") and validate the product."""
+    resolved = {name: int(axes.get(name, 1)) for name in AXIS_ORDER}
+    fills = [n for n, v in resolved.items() if v == -1]
+    if len(fills) > 1:
+        raise ValueError(f"only one axis may be -1, got {fills}")
+    fixed = math.prod(v for v in resolved.values() if v != -1)
+    if fills:
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"fixed axes product {fixed} does not divide {n_devices} devices")
+        resolved[fills[0]] = n_devices // fixed
+    total = math.prod(resolved.values())
+    if total != n_devices:
+        raise ValueError(
+            f"mesh axes {resolved} use {total} devices, have {n_devices}")
+    return resolved
+
+
+def build_mesh(axes: Optional[Dict[str, int]] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Construct a named mesh over the available devices.
+
+    ``axes`` maps axis name -> size; ``-1`` means "all remaining devices"
+    (default: everything on the data axis, mirroring the reference's pure
+    data-parallel fan-out)."""
+    devices = list(devices) if devices is not None else jax.devices()
+    axes = dict(axes or {})
+    axes.setdefault(DATA_AXIS, -1)
+    resolved = _resolve_axes(axes, len(devices))
+    shape = tuple(resolved[name] for name in AXIS_ORDER)
+    arr = np.asarray(devices).reshape(shape)
+    debug_log(f"mesh axes={resolved} over {len(devices)} "
+              f"{devices[0].platform} device(s)")
+    return Mesh(arr, AXIS_ORDER)
+
+
+@dataclasses.dataclass
+class MeshRuntime:
+    """The live cluster object: mesh + participation state.
+
+    Capability parity with the reference's notion of "enabled workers"
+    (cluster membership lives in UI checkboxes, reference
+    ``gpupanel.js:110-116``): here membership is ``num_participants`` — how
+    many data-axis slots a fan-out run uses.  Slot 0 is the master
+    (ordering parity with reference ``distributed.py:1424-1438``)."""
+
+    mesh: Mesh
+    enabled: bool = True
+
+    @property
+    def data_size(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    @property
+    def num_participants(self) -> int:
+        return self.data_size if self.enabled else 1
+
+    def data_sharding(self, spec: Optional[P] = None) -> NamedSharding:
+        """Sharding with the leading (batch) dim over the data axis."""
+        return NamedSharding(self.mesh, spec if spec is not None else P(DATA_AXIS))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def status(self) -> Dict[str, Any]:
+        """Cluster status payload (feeds the control plane's /status route —
+        the analog of the reference's 2 s browser poll, ``gpupanel.js:1233``)."""
+        topo = describe_devices(list(self.mesh.devices.flat))
+        return {
+            "enabled": self.enabled,
+            "axes": {k: int(v) for k, v in self.mesh.shape.items()},
+            "num_participants": self.num_participants,
+            **topo,
+        }
+
+
+_runtime: Optional[MeshRuntime] = None
+_runtime_lock = threading.Lock()
+
+
+def get_runtime(axes: Optional[Dict[str, int]] = None,
+                refresh: bool = False) -> MeshRuntime:
+    """Process-wide mesh runtime singleton (the analog of the reference's
+    ``WorkerProcessManager`` singleton, ``distributed.py:1021``).
+
+    Passing ``axes`` that conflict with an existing runtime's mesh raises —
+    silently returning a differently-shaped mesh would let sharded programs
+    run on the wrong topology; use ``refresh=True`` to rebuild."""
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None or refresh:
+            _runtime = MeshRuntime(mesh=build_mesh(axes))
+        elif axes is not None:
+            want = _resolve_axes(dict(axes), len(list(_runtime.mesh.devices.flat)))
+            have = {k: int(v) for k, v in _runtime.mesh.shape.items()}
+            if want != have:
+                raise ValueError(
+                    f"mesh runtime already built with axes {have}, "
+                    f"requested {want}; pass refresh=True to rebuild")
+        return _runtime
+
+
+def set_runtime(rt: Optional[MeshRuntime]) -> None:
+    global _runtime
+    with _runtime_lock:
+        _runtime = rt
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Multi-host (pod) initialization over DCN — the analog of the
+    reference's *remote workers* (``README.md:169-202``), but via
+    ``jax.distributed`` instead of HTTP dispatch.  No-op when single-host
+    env vars are absent and no arguments are given."""
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("DTPU_COORDINATOR")
+    if coordinator_address is None:
+        return
+    num_processes = num_processes or int(os.environ.get("DTPU_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("DTPU_PROCESS_ID", "0"))
+    log(f"initializing multihost: coordinator={coordinator_address} "
+        f"procs={num_processes} id={process_id}")
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)

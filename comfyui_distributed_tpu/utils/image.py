@@ -88,8 +88,12 @@ def decode_npz(data: bytes) -> np.ndarray:
 
 
 def resize_image(x, width: int, height: int, method: str = "lanczos") -> np.ndarray:
-    """Batched resize via PIL for parity with the reference's LANCZOS usage
-    (``distributed_upscale.py:505,583``; ImageScale node)."""
+    """Batched float resize for parity with the reference's LANCZOS usage
+    (``distributed_upscale.py:505,583``; ImageScale node).
+
+    Resampling happens per-channel on 32-bit float PIL images ('F' mode), so
+    no uint8 quantization or [0,1] clipping is introduced — out-of-range
+    values (latents, lanczos overshoot) survive intact."""
     filters = {
         "nearest": Image.NEAREST,
         "nearest-exact": Image.NEAREST,
@@ -100,8 +104,11 @@ def resize_image(x, width: int, height: int, method: str = "lanczos") -> np.ndar
     }
     f = filters.get(method, Image.LANCZOS)
     arr = ensure_bhwc(to_numpy(x))
-    out = []
-    for i in range(arr.shape[0]):
-        pil = tensor_to_pil(arr, i)
-        out.append(pil_to_tensor(pil.resize((width, height), f))[0])
-    return np.stack(out, axis=0)
+    b, _, _, c = arr.shape
+    out = np.empty((b, height, width, c), dtype=np.float32)
+    for i in range(b):
+        for ch in range(c):
+            plane = Image.fromarray(arr[i, :, :, ch], mode="F")
+            out[i, :, :, ch] = np.asarray(
+                plane.resize((width, height), f), dtype=np.float32)
+    return out

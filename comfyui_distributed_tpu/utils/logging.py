@@ -23,13 +23,19 @@ if not _logger.handlers:
     _logger.setLevel(logging.INFO)
     _logger.propagate = False
 
-_debug_enabled = os.environ.get("DISTRIBUTED_TPU_DEBUG", "") not in ("", "0", "false")
+_ENV_DEBUG = os.environ.get("DISTRIBUTED_TPU_DEBUG")
+_env_forced = (_ENV_DEBUG is not None
+               and _ENV_DEBUG.strip().lower() not in ("", "0", "false", "no", "off"))
+_debug_enabled = _env_forced
 
 
 def set_debug(enabled: bool) -> None:
-    """Toggle the debug tier (called by the config layer on load/save)."""
+    """Toggle the debug tier (called by the config layer on load/save).
+
+    The DISTRIBUTED_TPU_DEBUG env var is an explicit user request and wins
+    over config-driven toggling — config can only *enable* on top of it."""
     global _debug_enabled
-    _debug_enabled = bool(enabled)
+    _debug_enabled = bool(enabled) or _env_forced
 
 
 def debug_enabled() -> bool:

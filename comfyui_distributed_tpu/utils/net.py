@@ -18,25 +18,33 @@ import aiohttp
 
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 
-_session: Optional[aiohttp.ClientSession] = None
+_sessions: Dict[int, aiohttp.ClientSession] = {}
 _session_lock = threading.Lock()
 
 
 async def get_client_session() -> aiohttp.ClientSession:
-    """Shared pooled session (reference ``utils/network.py:14-22``)."""
-    global _session
+    """Shared pooled session (reference ``utils/network.py:14-22``).
+
+    One session per event loop: an aiohttp session is bound to the loop that
+    created it, so caching a single global across loops would hand later
+    loops a session attached to a dead one."""
+    loop = asyncio.get_running_loop()
+    key = id(loop)
     with _session_lock:
-        if _session is None or _session.closed:
+        sess = _sessions.get(key)
+        if sess is None or sess.closed:
             connector = aiohttp.TCPConnector(limit=100, limit_per_host=30)
-            _session = aiohttp.ClientSession(connector=connector)
-        return _session
+            sess = aiohttp.ClientSession(connector=connector)
+            _sessions[key] = sess
+        return sess
 
 
 async def cleanup_client_session() -> None:
-    global _session
-    if _session is not None and not _session.closed:
-        await _session.close()
-    _session = None
+    loop = asyncio.get_running_loop()
+    with _session_lock:
+        sess = _sessions.pop(id(loop), None)
+    if sess is not None and not sess.closed:
+        await sess.close()
 
 
 def handle_api_error(request, error: Exception, status: int = 500):

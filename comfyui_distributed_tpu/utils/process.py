@@ -92,16 +92,39 @@ def kill_process_tree(pid: int, timeout: float = PROCESS_TERMINATION_TIMEOUT) ->
             return True
         except psutil.Error:
             pass
-    # POSIX fallback (reference distributed.py:1010-1018)
+    # POSIX fallback (reference distributed.py:1010-1018): enumerate the
+    # full descendant tree via ps, TERM everyone, escalate survivors to KILL.
+    def _descendants(root: int):
+        out: list = []
+        frontier = [root]
+        while frontier:
+            p = frontier.pop()
+            res = subprocess.run(["ps", "-o", "pid=", "--ppid", str(p)],
+                                 capture_output=True, text=True, check=False)
+            kids = [int(s) for s in res.stdout.split()]
+            out.extend(kids)
+            frontier.extend(kids)
+        return out
+
     try:
-        subprocess.run(["pkill", "-TERM", "-P", str(pid)], check=False)
-        os.kill(pid, signal.SIGTERM)
+        tree = _descendants(pid) + [pid]
+        for p in tree:
+            try:
+                os.kill(p, signal.SIGTERM)
+            except OSError:
+                pass
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if not is_process_alive(pid):
+            if not any(is_process_alive(p) for p in tree):
                 return True
             time.sleep(0.1)
-        os.kill(pid, signal.SIGKILL)
+        for p in tree:
+            if is_process_alive(p):
+                try:
+                    os.kill(p, signal.SIGKILL)
+                except OSError:
+                    pass
+        return not any(is_process_alive(p) for p in tree)
     except OSError:
         pass
     return not is_process_alive(pid)
