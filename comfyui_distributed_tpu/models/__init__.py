@@ -1,0 +1,7 @@
+"""Model zoo: diffusion backbones, text encoders, VAEs, samplers, schedules.
+
+The reference outsources 100% of its compute to ComfyUI's model stack
+(``common_ksampler``, VAE, CLIP — see SURVEY.md §7 "Hard parts"); this package
+is the from-scratch TPU-native equivalent: flax/linen modules in NHWC layout
+with bfloat16 compute, jit/scan-friendly samplers, and XLA-compiled schedules.
+"""

@@ -1,0 +1,116 @@
+"""CLIP text encoders (ViT-L/14 text tower + OpenCLIP bigG) in flax.
+
+The reference's CLIPTextEncode node is ComfyUI's torch CLIP
+(``workflows/distributed-txt2img.json`` nodes 5/6); this is the native
+equivalent producing the cross-attention ``context`` and (for SDXL) pooled
+embeddings.  Causal transformer, pre-LN, fp32 layernorms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    vocab_size: int = 49408
+    width: int = 768
+    layers: int = 12
+    heads: int = 12
+    max_length: int = 77
+    act: str = "quick_gelu"          # ViT-L; bigG uses "gelu"
+    # which hidden layer feeds cross-attention: -1 final, -2 penultimate
+    output_layer: int = -1
+    projection_dim: Optional[int] = None  # pooled-output projection (bigG)
+    dtype: Any = jnp.bfloat16
+
+
+CLIP_L_CONFIG = CLIPConfig()
+# SDXL pairs CLIP-L (penultimate) with OpenCLIP bigG (penultimate):
+CLIP_L_SDXL_CONFIG = dataclasses.replace(CLIP_L_CONFIG, output_layer=-2)
+OPEN_CLIP_BIGG_CONFIG = CLIPConfig(width=1280, layers=32, heads=20,
+                                   act="gelu", output_layer=-2,
+                                   projection_dim=1280)
+TINY_CLIP_CONFIG = CLIPConfig(vocab_size=4096, width=64, layers=2, heads=4,
+                              max_length=77)
+
+
+def _act(name: str):
+    if name == "quick_gelu":
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    return nn.gelu
+
+
+class CLIPLayer(nn.Module):
+    cfg: CLIPConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        B, N, C = h.shape
+        hd = cfg.width // cfg.heads
+        q = nn.Dense(cfg.width, dtype=cfg.dtype, name="q")(h)
+        k = nn.Dense(cfg.width, dtype=cfg.dtype, name="k")(h)
+        v = nn.Dense(cfg.width, dtype=cfg.dtype, name="v")(h)
+        q = q.reshape(B, N, cfg.heads, hd)
+        k = k.reshape(B, N, cfg.heads, hd)
+        v = v.reshape(B, N, cfg.heads, hd)
+        logits = jnp.einsum("bnhd,bmhd->bhnm", q, k,
+                            preferred_element_type=jnp.float32)
+        logits = logits / jnp.sqrt(jnp.float32(hd)) + mask
+        w = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhnm,bmhd->bnhd", w.astype(v.dtype), v)
+        attn = attn.reshape(B, N, cfg.width)
+        x = x + nn.Dense(cfg.width, dtype=cfg.dtype, name="proj")(attn)
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(cfg.width * 4, dtype=cfg.dtype, name="fc1")(h)
+        h = _act(self.cfg.act)(h)
+        h = nn.Dense(cfg.width, dtype=cfg.dtype, name="fc2")(h)
+        return x + h
+
+
+class CLIPTextModel(nn.Module):
+    cfg: CLIPConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """tokens: [B, max_length] int32.  Returns (hidden [B, N, width],
+        pooled [B, width or projection_dim])."""
+        cfg = self.cfg
+        B, N = tokens.shape
+        tok_emb = nn.Embed(cfg.vocab_size, cfg.width, name="token_embedding",
+                           dtype=cfg.dtype)(tokens)
+        pos_emb = self.param("position_embedding",
+                             nn.initializers.normal(0.01),
+                             (cfg.max_length, cfg.width))
+        x = tok_emb + pos_emb[None, :N, :].astype(cfg.dtype)
+
+        causal = jnp.triu(jnp.full((N, N), -jnp.inf, jnp.float32), k=1)
+        mask = causal[None, None, :, :]
+
+        hidden = []
+        for i in range(cfg.layers):
+            x = CLIPLayer(cfg, name=f"layers_{i}")(x, mask)
+            hidden.append(x)
+
+        # ln_final is shared: applied to the last layer for pooling and to the
+        # selected output layer (clip-skip reuses the same checkpoint weights,
+        # matching ComfyUI's behavior)
+        ln_final = nn.LayerNorm(dtype=jnp.float32, name="ln_final")
+        out = ln_final(hidden[cfg.output_layer])
+        final = out if cfg.output_layer == -1 else ln_final(hidden[-1])
+
+        # pooled: hidden state at the EOT token (highest token id position)
+        eot = jnp.argmax(tokens, axis=-1)
+        pooled = final[jnp.arange(B), eot]
+        if cfg.projection_dim is not None:
+            pooled = nn.Dense(cfg.projection_dim, use_bias=False,
+                              dtype=jnp.float32, name="text_projection")(pooled)
+        return out.astype(jnp.float32), pooled.astype(jnp.float32)

@@ -1,0 +1,52 @@
+"""Denoiser wrapper: UNet (eps/v prediction) -> k-diffusion interface.
+
+Bridges :mod:`comfyui_distributed_tpu.models.unet` to the samplers'
+``denoised = model(x, sigma)`` convention using the discrete VP schedule:
+the UNet input is pre-scaled by ``1/sqrt(sigma^2+1)`` and the timestep is the
+continuous index of sigma in the model table.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from comfyui_distributed_tpu.models.schedules import DiscreteSchedule
+
+
+def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
+                  prediction_type: str = "eps") -> Callable:
+    """Build ``model(x, sigma, context=..., y=...) -> denoised``.
+
+    ``apply_fn(params, x, timesteps, context, y)`` is the raw UNet.
+    """
+    log_sigmas = jnp.asarray(jnp.log(jnp.asarray(ds.sigmas)))
+
+    def t_from_sigma(sigma: jax.Array) -> jax.Array:
+        # piecewise-linear interp of log sigma into the table index, traced
+        log_s = jnp.log(jnp.maximum(sigma, 1e-10))
+        idx = jnp.searchsorted(log_sigmas, log_s, side="left")
+        idx = jnp.clip(idx, 1, log_sigmas.shape[0] - 1)
+        lo, hi = log_sigmas[idx - 1], log_sigmas[idx]
+        frac = (log_s - lo) / jnp.maximum(hi - lo, 1e-12)
+        return (idx - 1).astype(jnp.float32) + frac
+
+    def denoiser(x: jax.Array, sigma: jax.Array,
+                 context: Optional[jax.Array] = None,
+                 y: Optional[jax.Array] = None,
+                 **_: Any) -> jax.Array:
+        sigma = jnp.asarray(sigma, jnp.float32)
+        c_in = 1.0 / jnp.sqrt(sigma ** 2 + 1.0)
+        t = t_from_sigma(sigma)
+        ts = jnp.broadcast_to(t, (x.shape[0],))
+        eps_or_v = apply_fn(params, x * c_in, ts, context, y)
+        if prediction_type == "v":
+            # v-prediction: denoised = c_skip*x - c_out*v  (VP parameterization)
+            c_skip = 1.0 / (sigma ** 2 + 1.0)
+            c_out = sigma / jnp.sqrt(sigma ** 2 + 1.0)
+            return x * c_skip - eps_or_v * c_out
+        return x - eps_or_v * sigma
+
+    return denoiser

@@ -1,0 +1,208 @@
+"""Shared neural blocks (flax.linen, NHWC, bf16-friendly).
+
+TPU-first conventions used across the model zoo:
+- channels-last (NHWC) everywhere — XLA's native conv layout on TPU;
+- compute dtype bfloat16 by default with fp32 params and fp32 normalization
+  statistics (GroupNorm in fp32 to avoid bf16 variance underflow);
+- attention shaped as large batched matmuls for the MXU; heads stay a
+  separate dim so tensor-parallel sharding can split them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+def timestep_embedding(t: jax.Array, dim: int,
+                       max_period: float = 10000.0) -> jax.Array:
+    """Sinusoidal timestep embedding (DDPM convention)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.concatenate([emb, jnp.zeros_like(emb[:, :1])], axis=-1)
+    return emb
+
+
+class GroupNorm32(nn.Module):
+    """GroupNorm computed in fp32 regardless of compute dtype."""
+    num_groups: int = 32
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        orig = x.dtype
+        groups = min(self.num_groups, x.shape[-1])
+        while x.shape[-1] % groups:
+            groups -= 1
+        out = nn.GroupNorm(num_groups=groups, epsilon=self.epsilon,
+                           dtype=jnp.float32)(x.astype(jnp.float32))
+        return out.astype(orig)
+
+
+class Attention(nn.Module):
+    """Multi-head attention over flattened tokens.
+
+    Self-attention when ``context`` is None, cross-attention otherwise.
+    Shapes: q from ``x [B, N, C]``, k/v from ``context [B, M, Cc]``.
+    ``attn_impl`` selects the math: "xla" (fused by the compiler) or
+    "pallas" (custom flash kernel, ops/pallas/flash_attention.py).
+    """
+    num_heads: int
+    head_dim: Optional[int] = None
+    dtype: Dtype = jnp.bfloat16
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x: jax.Array,
+                 context: Optional[jax.Array] = None) -> jax.Array:
+        c = x.shape[-1]
+        hd = self.head_dim or c // self.num_heads
+        inner = hd * self.num_heads
+        ctx = x if context is None else context
+
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
+
+        B, N, _ = q.shape
+        M = k.shape[1]
+        q = q.reshape(B, N, self.num_heads, hd)
+        k = k.reshape(B, M, self.num_heads, hd)
+        v = v.reshape(B, M, self.num_heads, hd)
+
+        out = scaled_dot_product_attention(q, k, v, impl=self.attn_impl)
+        out = out.reshape(B, N, inner)
+        return nn.Dense(c, dtype=self.dtype, name="to_out")(out)
+
+
+def scaled_dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                                 impl: str = "xla") -> jax.Array:
+    """[B, N, H, D] attention. fp32 softmax accumulation."""
+    if impl == "pallas":
+        from comfyui_distributed_tpu.ops.pallas.flash_attention import (
+            flash_attention)
+        return flash_attention(q, k, v)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bnhd,bmhd->bhnm", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhnm,bmhd->bnhd", weights.astype(v.dtype), v)
+    return out
+
+
+class GEGLU(nn.Module):
+    dim_out: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = nn.Dense(self.dim_out * 2, dtype=self.dtype, name="proj")(x)
+        a, b = jnp.split(h, 2, axis=-1)
+        return a * nn.gelu(b)
+
+
+class FeedForward(nn.Module):
+    mult: int = 4
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        h = GEGLU(dim_out=c * self.mult, dtype=self.dtype, name="geglu")(x)
+        return nn.Dense(c, dtype=self.dtype, name="out")(h)
+
+
+class TransformerBlock(nn.Module):
+    """Self-attn -> cross-attn -> FF, pre-LN residuals (SD spatial
+    transformer block layout)."""
+    num_heads: int
+    dtype: Dtype = jnp.bfloat16
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
+        x = x + Attention(self.num_heads, dtype=self.dtype,
+                          attn_impl=self.attn_impl, name="attn1")(
+            nn.LayerNorm(dtype=jnp.float32, name="norm1")(x))
+        x = x + Attention(self.num_heads, dtype=self.dtype,
+                          attn_impl=self.attn_impl, name="attn2")(
+            nn.LayerNorm(dtype=jnp.float32, name="norm2")(x), context=context)
+        x = x + FeedForward(dtype=self.dtype, name="ff")(
+            nn.LayerNorm(dtype=jnp.float32, name="norm3")(x))
+        return x
+
+
+class SpatialTransformer(nn.Module):
+    """Project NHWC feature map to tokens, run transformer blocks with
+    text cross-attention, project back (SD UNet attention block)."""
+    num_heads: int
+    depth: int = 1
+    dtype: Dtype = jnp.bfloat16
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
+        B, H, W, C = x.shape
+        h = GroupNorm32(name="norm")(x)
+        h = nn.Dense(C, dtype=self.dtype, name="proj_in")(h)
+        h = h.reshape(B, H * W, C)
+        for i in range(self.depth):
+            h = TransformerBlock(self.num_heads, dtype=self.dtype,
+                                 attn_impl=self.attn_impl,
+                                 name=f"blocks_{i}")(h, context)
+        h = h.reshape(B, H, W, C)
+        h = nn.Dense(C, dtype=self.dtype, name="proj_out")(h)
+        return x + h
+
+
+class ResBlock(nn.Module):
+    """UNet residual block with timestep-embedding injection."""
+    out_channels: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, emb: jax.Array) -> jax.Array:
+        h = GroupNorm32(name="in_norm")(x)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
+                    name="in_conv")(h)
+        eproj = nn.Dense(self.out_channels, dtype=self.dtype,
+                         name="emb_proj")(nn.silu(emb))
+        h = h + eproj[:, None, None, :]
+        h = GroupNorm32(name="out_norm")(h)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
+                    name="out_conv")(h)
+        if x.shape[-1] != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype,
+                        name="skip")(x)
+        return x + h
+
+
+class Downsample(nn.Module):
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return nn.Conv(x.shape[-1], (3, 3), strides=(2, 2), padding=1,
+                       dtype=self.dtype, name="conv")(x)
+
+
+class Upsample(nn.Module):
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        B, H, W, C = x.shape
+        x = jax.image.resize(x, (B, H * 2, W * 2, C), method="nearest")
+        return nn.Conv(C, (3, 3), padding=1, dtype=self.dtype, name="conv")(x)

@@ -1,0 +1,299 @@
+"""Model families, virtual checkpoints, and the DiffusionPipeline bundle.
+
+The reference's CheckpointLoaderSimple hands back ComfyUI (MODEL, CLIP, VAE)
+objects; here the equivalent bundle is a :class:`DiffusionPipeline`.  When the
+named checkpoint file exists it is loaded (safetensors, torch key mapping —
+``checkpoints.py``); when it does not (zero-egress dev boxes, CI), parameters
+are **virtually initialized**: deterministic random init seeded from the
+checkpoint name, so every mesh host materializes identical weights without
+any file — the reference's "same models on all machines" requirement
+(``README.md:189-193``) satisfied by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from comfyui_distributed_tpu.models import clip as clip_mod
+from comfyui_distributed_tpu.models import samplers as smp
+from comfyui_distributed_tpu.models import schedules as sch
+from comfyui_distributed_tpu.models import unet as unet_mod
+from comfyui_distributed_tpu.models import vae as vae_mod
+from comfyui_distributed_tpu.models.denoiser import make_denoiser
+from comfyui_distributed_tpu.models.tokenizer import make_tokenizer
+from comfyui_distributed_tpu.models.upscalers import (
+    ESRGAN_4X_CONFIG,
+    TINY_RRDB_CONFIG,
+    RRDBNet,
+)
+from comfyui_distributed_tpu.utils.logging import log
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelFamily:
+    name: str
+    unet: unet_mod.UNetConfig
+    vae: vae_mod.VAEConfig
+    clips: Tuple[clip_mod.CLIPConfig, ...]
+    latent_channels: int = 4
+
+
+FAMILIES: Dict[str, ModelFamily] = {
+    "sd15": ModelFamily(
+        name="sd15",
+        unet=unet_mod.SD15_CONFIG,
+        vae=vae_mod.SD_VAE_CONFIG,
+        clips=(clip_mod.CLIP_L_CONFIG,),
+    ),
+    "sdxl": ModelFamily(
+        name="sdxl",
+        unet=unet_mod.SDXL_CONFIG,
+        vae=vae_mod.SDXL_VAE_CONFIG,
+        clips=(clip_mod.CLIP_L_SDXL_CONFIG, clip_mod.OPEN_CLIP_BIGG_CONFIG),
+    ),
+    "tiny": ModelFamily(
+        name="tiny",
+        unet=unet_mod.TINY_CONFIG,
+        vae=vae_mod.TINY_VAE_CONFIG,
+        clips=(clip_mod.TINY_CLIP_CONFIG,),
+    ),
+}
+
+FAMILY_ENV = "DTPU_DEFAULT_FAMILY"
+
+
+def detect_family(ckpt_name: str) -> str:
+    """Family from checkpoint-name heuristics; ``DTPU_DEFAULT_FAMILY``
+    overrides (tests/CI force 'tiny')."""
+    env = os.environ.get(FAMILY_ENV)
+    if env:
+        return env
+    lowered = ckpt_name.lower()
+    if "tiny" in lowered or "test" in lowered:
+        return "tiny"
+    if "xl" in lowered:
+        return "sdxl"
+    return "sd15"
+
+
+def _name_seed(name: str) -> int:
+    return int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little")
+
+
+class DiffusionPipeline:
+    """(MODEL, CLIP, VAE) bundle + tokenizer + schedule + jit caches."""
+
+    def __init__(self, name: str, family: ModelFamily,
+                 unet_params: Any, clip_params: List[Any], vae_params: Any,
+                 prediction_type: str = "eps"):
+        self.name = name
+        self.family = family
+        self.unet = unet_mod.UNet(family.unet)
+        self.clip_models = [clip_mod.CLIPTextModel(c) for c in family.clips]
+        self.vae = vae_mod.VAE(family.vae)
+        self.unet_params = unet_params
+        self.clip_params = clip_params
+        self.vae_params = vae_params
+        self.prediction_type = prediction_type
+        self.schedule = sch.make_discrete_schedule()
+        self.tokenizer = make_tokenizer(
+            vocab_size=min(c.vocab_size for c in family.clips))
+        self._jit_cache: Dict[Any, Any] = {}
+        self._lock = threading.Lock()
+
+    # --- text ---------------------------------------------------------------
+
+    def encode_prompt(self, texts: List[str]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Returns (context [B, 77, sum(widths)], pooled [B, pooled_dim]).
+        Multi-encoder families (SDXL) concatenate hidden widths; pooled comes
+        from the last encoder.  Token weights scale the hidden states around
+        the per-sequence mean (comfy-style emphasis)."""
+        ids = []
+        weights = []
+        for t in texts:
+            i, w = self.tokenizer.encode(t)
+            ids.append(i)
+            weights.append(w)
+        ids_arr = jnp.asarray(np.stack(ids))
+        w_arr = jnp.asarray(np.stack(weights))
+
+        outs, pooled = [], None
+        for m, p in zip(self.clip_models, self.clip_params):
+            fn = self._jitted(("clip", id(m)), partial(m.apply))
+            hidden, pool = fn({"params": p}, ids_arr)
+            mean = hidden.mean(axis=1, keepdims=True)
+            hidden = mean + (hidden - mean) * w_arr[..., None]
+            outs.append(hidden)
+            pooled = pool
+        return jnp.concatenate(outs, axis=-1), pooled
+
+    # --- latents ------------------------------------------------------------
+
+    def vae_encode(self, images: jnp.ndarray) -> jnp.ndarray:
+        fn = self._jitted("vae_enc", lambda p, x: self.vae.apply(
+            {"params": p}, x, method=self.vae.encode))
+        return fn(self.vae_params, images)
+
+    def vae_decode(self, latents: jnp.ndarray) -> jnp.ndarray:
+        fn = self._jitted("vae_dec", lambda p, z: self.vae.apply(
+            {"params": p}, z, method=self.vae.decode))
+        return fn(self.vae_params, latents)
+
+    # --- denoising ----------------------------------------------------------
+
+    def raw_unet_apply(self, params, x, t, context, y=None):
+        return self.unet.apply({"params": params}, x, t, context, y=y)
+
+    def denoiser(self):
+        return make_denoiser(self.raw_unet_apply, self.unet_params,
+                             self.schedule, self.prediction_type)
+
+    def sample(self, latents: jnp.ndarray, context: jnp.ndarray,
+               uncond_context: jnp.ndarray, seeds: jnp.ndarray,
+               steps: int, cfg: float, sampler_name: str, scheduler: str,
+               denoise: float = 1.0, y: Optional[jnp.ndarray] = None,
+               add_noise: bool = True) -> jnp.ndarray:
+        """Full ksampler: schedule -> noise -> scan-sampler -> latents.
+
+        ``seeds``: per-sample uint32 array [B] (replica offsets already
+        applied by the distributed layer)."""
+        sigmas = jnp.asarray(sch.compute_sigmas(
+            self.schedule, scheduler, steps, denoise))
+        keys = smp.sample_keys(seeds)  # raw host seeds keep 64-bit entropy
+        model = smp.cfg_denoiser(self.denoiser(), context, uncond_context, cfg)
+        if y is not None and cfg != 1.0:
+            y = jnp.concatenate([y, y], axis=0)
+
+        sampler = smp.get_sampler(sampler_name)
+        # init noise uses a reserved fold-in index so it never collides with
+        # per-step ancestral noise (steps count up from 0)
+        noise = smp.make_noise_fn(keys)(jnp.asarray(0x7FFFFFFF, jnp.uint32),
+                                        latents.shape[1:])
+        if add_noise:
+            if denoise >= 0.9999:
+                x = noise * sigmas[0]
+            else:
+                x = latents + noise * sigmas[0]
+        else:
+            x = latents
+        extra = {"y": y} if y is not None else {}
+        return sampler(model, x, sigmas, extra_args=extra, keys=keys)
+
+    # --- internals ----------------------------------------------------------
+
+    def _jitted(self, key, fn):
+        with self._lock:
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(fn)
+            return self._jit_cache[key]
+
+
+def _virtual_params(module, seed: int, *shaped_args) -> Any:
+    rng = jax.random.PRNGKey(seed)
+    variables = module.init(rng, *shaped_args)
+    return variables["params"]
+
+
+_pipeline_cache: Dict[str, DiffusionPipeline] = {}
+_pipeline_lock = threading.Lock()
+
+
+def load_pipeline(ckpt_name: str, models_dir: Optional[str] = None,
+                  family_name: Optional[str] = None) -> DiffusionPipeline:
+    """Load or virtually-initialize the named checkpoint (cached)."""
+    key = f"{ckpt_name}:{family_name or ''}"
+    with _pipeline_lock:
+        if key in _pipeline_cache:
+            return _pipeline_cache[key]
+
+    fam = FAMILIES[family_name or detect_family(ckpt_name)]
+    path = None
+    if models_dir:
+        cand = os.path.join(models_dir, ckpt_name.replace("\\", "/"))
+        if os.path.exists(cand):
+            path = cand
+
+    if path is not None:
+        from comfyui_distributed_tpu.models.checkpoints import load_checkpoint
+        unet_p, clip_ps, vae_p = load_checkpoint(path, fam)
+        log(f"loaded checkpoint {ckpt_name} ({fam.name}) from {path}")
+    else:
+        seed = _name_seed(ckpt_name)
+        lat = fam.latent_channels
+        ds = fam.vae.downscale
+        h = w = 8 * ds
+        ctx_dim = fam.unet.context_dim
+        x = jnp.zeros((1, h // ds, w // ds, lat))
+        ts = jnp.zeros((1,))
+        ctx = jnp.zeros((1, 77, ctx_dim))
+        unet_p = _virtual_params(unet_mod.UNet(fam.unet), seed, x, ts, ctx)
+        clip_ps = []
+        for i, ccfg in enumerate(fam.clips):
+            tok = jnp.zeros((1, ccfg.max_length), jnp.int32)
+            clip_ps.append(_virtual_params(
+                clip_mod.CLIPTextModel(ccfg), seed + 1 + i, tok))
+        img = jnp.zeros((1, h, w, 3))
+        vae_p = _virtual_params(vae_mod.VAE(fam.vae), seed + 100, img)
+        log(f"virtual checkpoint {ckpt_name!r} ({fam.name}): no file on disk, "
+            f"deterministic init (seed {seed})")
+
+    pipe = DiffusionPipeline(ckpt_name, fam, unet_p, clip_ps, vae_p)
+    with _pipeline_lock:
+        _pipeline_cache[key] = pipe
+    return pipe
+
+
+def clear_pipeline_cache() -> None:
+    """Free model memory (feeds the control plane's clear_memory route —
+    the reference's VRAM-clear endpoint, ``distributed.py:383-426``)."""
+    with _pipeline_lock:
+        _pipeline_cache.clear()
+
+
+# --- upscalers --------------------------------------------------------------
+
+_upscaler_cache: Dict[str, Tuple[RRDBNet, Any]] = {}
+
+
+def load_upscaler(model_name: str, models_dir: Optional[str] = None):
+    """UpscaleModelLoader equivalent: RRDB net + params (virtual when the
+    .pth is absent).  Returns (module, params, scale)."""
+    with _pipeline_lock:
+        if model_name in _upscaler_cache:
+            return _upscaler_cache[model_name]
+    lowered = model_name.lower()
+    if "tiny" in lowered or os.environ.get(FAMILY_ENV) == "tiny":
+        cfg = TINY_RRDB_CONFIG
+    else:
+        scale = 4
+        for s in (8, 4, 2, 1):
+            if f"{s}x" in lowered:
+                scale = s
+                break
+        cfg = dataclasses.replace(ESRGAN_4X_CONFIG, scale=scale)
+    net = RRDBNet(cfg)
+    path = None
+    if models_dir:
+        cand = os.path.join(models_dir, model_name.replace("\\", "/"))
+        if os.path.exists(cand):
+            path = cand
+    if path is not None:
+        from comfyui_distributed_tpu.models.checkpoints import load_upscaler_checkpoint
+        params = load_upscaler_checkpoint(path, cfg)
+    else:
+        params = _virtual_params(net, _name_seed(model_name),
+                                 jnp.zeros((1, 16, 16, 3)))
+        log(f"virtual upscaler {model_name!r} (scale {cfg.scale})")
+    entry = (net, params, cfg.scale)
+    with _pipeline_lock:
+        _upscaler_cache[model_name] = entry
+    return entry

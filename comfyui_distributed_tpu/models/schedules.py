@@ -1,0 +1,205 @@
+"""Noise schedules and sigma tables.
+
+The reference passes scheduler names ("normal", "karras", ...) straight into
+ComfyUI's sampler stack (KSampler widget values in
+``workflows/distributed-txt2img.json``; ``common_ksampler`` call at reference
+``distributed_upscale.py:521``).  This module provides those schedules
+natively: a discrete VP (DDPM) sigma table plus the step-schedule generators,
+all as plain numpy (they run once per job at trace time — only the denoise
+loop itself is compiled).
+
+Conventions: sigmas are returned **descending**, with a trailing 0.0, shape
+``[steps + 1]`` — the k-diffusion convention ComfyUI uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscreteSchedule:
+    """Discrete VP schedule: sigma_t = sqrt((1 - abar_t) / abar_t).
+
+    SD1.x/SDXL use scaled-linear betas in [0.00085, 0.012] over 1000 steps.
+    """
+
+    sigmas: np.ndarray          # ascending, [T]
+    alphas_cumprod: np.ndarray  # [T]
+
+    @property
+    def sigma_min(self) -> float:
+        return float(self.sigmas[0])
+
+    @property
+    def sigma_max(self) -> float:
+        return float(self.sigmas[-1])
+
+    def t_from_sigma(self, sigma: np.ndarray) -> np.ndarray:
+        """Continuous timestep index for a sigma via log-linear interp —
+        what gets fed to the UNet's timestep embedding."""
+        log_sigmas = np.log(self.sigmas)
+        log_s = np.log(np.maximum(np.asarray(sigma, dtype=np.float64), 1e-10))
+        return np.interp(log_s, log_sigmas, np.arange(len(self.sigmas)))
+
+    def sigma_from_t(self, t: np.ndarray) -> np.ndarray:
+        return np.interp(np.asarray(t, dtype=np.float64),
+                         np.arange(len(self.sigmas)), self.sigmas)
+
+
+def make_discrete_schedule(beta_schedule: str = "scaled_linear",
+                           beta_start: float = 0.00085,
+                           beta_end: float = 0.012,
+                           num_timesteps: int = 1000) -> DiscreteSchedule:
+    if beta_schedule == "linear":
+        betas = np.linspace(beta_start, beta_end, num_timesteps,
+                            dtype=np.float64)
+    elif beta_schedule == "scaled_linear":
+        betas = np.linspace(beta_start ** 0.5, beta_end ** 0.5, num_timesteps,
+                            dtype=np.float64) ** 2
+    elif beta_schedule == "cosine":
+        s = 0.008
+        ts = np.arange(num_timesteps + 1, dtype=np.float64) / num_timesteps
+        f = np.cos((ts + s) / (1 + s) * math.pi / 2) ** 2
+        abar = f / f[0]
+        betas = np.clip(1 - abar[1:] / abar[:-1], 0, 0.999)
+    else:
+        raise ValueError(f"unknown beta schedule {beta_schedule!r}")
+    abar = np.cumprod(1.0 - betas)
+    sigmas = np.sqrt((1 - abar) / abar)
+    return DiscreteSchedule(sigmas=sigmas.astype(np.float32),
+                            alphas_cumprod=abar.astype(np.float32))
+
+
+# --- step-schedule generators ----------------------------------------------
+
+def _append_zero(sigmas: np.ndarray) -> np.ndarray:
+    return np.concatenate([sigmas, [0.0]]).astype(np.float32)
+
+
+def normal_scheduler(ds: DiscreteSchedule, steps: int, sgm: bool = False) -> np.ndarray:
+    """Uniform in timestep space over the model's sigma table."""
+    start = ds.t_from_sigma(ds.sigma_max)
+    end = ds.t_from_sigma(ds.sigma_min)
+    if sgm:
+        ts = np.linspace(start, end, steps + 1)[:-1]
+    else:
+        ts = np.linspace(start, end, steps)
+    return _append_zero(ds.sigma_from_t(ts))
+
+
+def karras_scheduler(ds: DiscreteSchedule, steps: int, rho: float = 7.0) -> np.ndarray:
+    """Karras et al. 2022 rho-schedule."""
+    ramp = np.linspace(0, 1, steps)
+    min_r, max_r = ds.sigma_min ** (1 / rho), ds.sigma_max ** (1 / rho)
+    sigmas = (max_r + ramp * (min_r - max_r)) ** rho
+    return _append_zero(sigmas)
+
+
+def exponential_scheduler(ds: DiscreteSchedule, steps: int) -> np.ndarray:
+    sigmas = np.exp(np.linspace(math.log(ds.sigma_max),
+                                math.log(ds.sigma_min), steps))
+    return _append_zero(sigmas)
+
+
+def simple_scheduler(ds: DiscreteSchedule, steps: int) -> np.ndarray:
+    """Every (T/steps)-th entry of the model table, descending."""
+    ss = len(ds.sigmas) / steps
+    sigmas = [float(ds.sigmas[-(1 + int(i * ss))]) for i in range(steps)]
+    return _append_zero(np.asarray(sigmas))
+
+
+def ddim_uniform_scheduler(ds: DiscreteSchedule, steps: int) -> np.ndarray:
+    T = len(ds.sigmas)
+    ss = max(T // steps, 1)
+    timesteps = np.asarray(list(range(1, T + 1, ss))[:steps], dtype=np.int64)
+    sigmas = ds.sigmas[timesteps - 1][::-1]
+    return _append_zero(sigmas)
+
+
+def beta_scheduler(ds: DiscreteSchedule, steps: int,
+                   alpha: float = 0.6, beta: float = 0.6) -> np.ndarray:
+    """Beta-distribution spacing (comfy 'beta'); falls back to uniform
+    timesteps if scipy is unavailable."""
+    try:
+        import scipy.stats as st
+        ts = 1.0 - np.linspace(0, 1, steps, endpoint=False)
+        ts = st.beta.ppf(ts, alpha, beta)
+    except ImportError:  # pragma: no cover
+        ts = 1.0 - np.linspace(0, 1, steps, endpoint=False)
+    T = len(ds.sigmas)
+    idx = np.clip((ts * (T - 1)).round().astype(np.int64), 0, T - 1)
+    # dedupe while preserving order, keep descending sigma
+    seen, chosen = set(), []
+    for i in idx:
+        if int(i) not in seen:
+            seen.add(int(i))
+            chosen.append(int(i))
+    sigmas = ds.sigmas[np.asarray(chosen)]
+    return _append_zero(sigmas)
+
+
+def linear_quadratic_scheduler(ds: DiscreteSchedule, steps: int,
+                               threshold_noise: float = 0.025,
+                               linear_steps: Optional[int] = None) -> np.ndarray:
+    """Linear-then-quadratic denoising progress (comfy 'linear_quadratic'):
+    progress p(i) rises linearly to ``threshold_noise`` over the first
+    ``linear_steps``, then follows the quadratic that matches value and slope
+    there and reaches 1 at the final step.  Sigmas are (1 - p) * sigma_max."""
+    if steps == 1:
+        return _append_zero(np.asarray([ds.sigma_max]))
+    L = linear_steps if linear_steps is not None else steps // 2
+    L = int(np.clip(L, 1, steps - 1))
+    i = np.arange(steps + 1, dtype=np.float64)
+    slope = threshold_noise / L
+    # quadratic a*u^2 + slope*u + threshold_noise on u = i - L, with p(steps)=1
+    u_end = steps - L
+    a = (1.0 - threshold_noise - slope * u_end) / (u_end ** 2)
+    u = i - L
+    p = np.where(i <= L, slope * i, a * u ** 2 + slope * u + threshold_noise)
+    sigmas = (1.0 - p[:-1]) * ds.sigma_max
+    return _append_zero(sigmas)
+
+
+def kl_optimal_scheduler(ds: DiscreteSchedule, steps: int) -> np.ndarray:
+    """AYS 'KL-optimal' spacing (arctan interpolation), Sabour et al. 2024."""
+    t = np.linspace(0, 1, steps)
+    sigmas = np.tan((1 - t) * math.atan(ds.sigma_max)
+                    + t * math.atan(ds.sigma_min))
+    return _append_zero(sigmas)
+
+
+SCHEDULERS: Dict[str, Callable[[DiscreteSchedule, int], np.ndarray]] = {
+    "normal": normal_scheduler,
+    "karras": karras_scheduler,
+    "exponential": exponential_scheduler,
+    "sgm_uniform": lambda ds, n: normal_scheduler(ds, n, sgm=True),
+    "simple": simple_scheduler,
+    "ddim_uniform": ddim_uniform_scheduler,
+    "beta": beta_scheduler,
+    "linear_quadratic": linear_quadratic_scheduler,
+    "kl_optimal": kl_optimal_scheduler,
+}
+
+SCHEDULER_NAMES = tuple(SCHEDULERS.keys())
+
+
+def compute_sigmas(ds: DiscreteSchedule, scheduler: str, steps: int,
+                   denoise: float = 1.0) -> np.ndarray:
+    """Full sigma sequence for a run; ``denoise < 1`` truncates to the final
+    fraction of steps — img2img semantics matching the reference's tiled
+    refine (``denoise`` widget, reference ``distributed_upscale.py:50-79``)."""
+    if scheduler not in SCHEDULERS:
+        raise ValueError(f"unknown scheduler {scheduler!r}; "
+                         f"available: {SCHEDULER_NAMES}")
+    if denoise >= 0.9999:
+        return SCHEDULERS[scheduler](ds, steps)
+    if denoise <= 0.0:
+        return np.asarray([0.0], dtype=np.float32)
+    total = max(int(steps / denoise), steps)
+    full = SCHEDULERS[scheduler](ds, total)
+    return full[-(steps + 1):]

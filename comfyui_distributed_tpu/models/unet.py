@@ -1,0 +1,150 @@
+"""Diffusion UNet (SD1.x / SDXL families), flax NHWC.
+
+The denoise backbone the reference borrows from ComfyUI (its KSampler executes
+a torch UNet; see SURVEY.md §7 — "the sampler/VAE stack itself" is the biggest
+new code).  Configurable to the SD1.5 and SDXL layouts used by the reference
+workflows' checkpoints (``workflows/distributed-txt2img.json`` loads an SDXL
+checkpoint), plus a tiny config for tests.
+
+Model convention: eps-prediction by default; the sampler-side
+:class:`comfyui_distributed_tpu.models.denoiser.Denoiser` wraps it into the
+k-diffusion ``denoised = f(x, sigma)`` form.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from comfyui_distributed_tpu.models.layers import (
+    Downsample,
+    GroupNorm32,
+    ResBlock,
+    SpatialTransformer,
+    Upsample,
+    timestep_embedding,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    model_channels: int = 320
+    channel_mult: Tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    # transformer depth per level; 0 = no attention at that level
+    transformer_depth: Tuple[int, ...] = (1, 1, 1, 0)
+    context_dim: int = 768
+    num_head_channels: int = 64
+    num_heads: Optional[int] = None  # fixed head count overrides head_channels
+    # SDXL class/vector conditioning (text-emb pooled + size conds)
+    adm_in_channels: Optional[int] = None
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "xla"
+    prediction_type: str = "eps"  # "eps" | "v"
+
+    @property
+    def num_levels(self) -> int:
+        return len(self.channel_mult)
+
+
+# SD1.5 uses a fixed 8 heads at every resolution (not head_channels=64)
+SD15_CONFIG = UNetConfig(num_heads=8)
+
+SDXL_CONFIG = UNetConfig(
+    channel_mult=(1, 2, 4),
+    transformer_depth=(0, 2, 10),
+    context_dim=2048,
+    adm_in_channels=2816,
+)
+
+TINY_CONFIG = UNetConfig(
+    model_channels=32,
+    channel_mult=(1, 2),
+    num_res_blocks=1,
+    transformer_depth=(1, 1),
+    context_dim=64,
+    num_head_channels=16,
+)
+
+
+class UNet(nn.Module):
+    cfg: UNetConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, timesteps: jax.Array,
+                 context: jax.Array, y: Optional[jax.Array] = None) -> jax.Array:
+        """x: [B,H,W,C_in] latent; timesteps: [B]; context: [B,M,Cc] text
+        tokens; y: [B, adm_in] optional vector conditioning (SDXL)."""
+        cfg = self.cfg
+        ch = cfg.model_channels
+        time_dim = ch * 4
+
+        emb = timestep_embedding(timesteps, ch)
+        emb = nn.Dense(time_dim, dtype=cfg.dtype, name="time_fc1")(emb)
+        emb = nn.Dense(time_dim, dtype=cfg.dtype, name="time_fc2")(nn.silu(emb))
+        if cfg.adm_in_channels is not None:
+            if y is None:
+                y = jnp.zeros((x.shape[0], cfg.adm_in_channels), x.dtype)
+            lab = nn.Dense(time_dim, dtype=cfg.dtype, name="label_fc1")(y)
+            lab = nn.Dense(time_dim, dtype=cfg.dtype,
+                           name="label_fc2")(nn.silu(lab))
+            emb = emb + lab
+
+        def heads(c: int) -> int:
+            if cfg.num_heads is not None:
+                return cfg.num_heads
+            return max(c // cfg.num_head_channels, 1)
+
+        h = nn.Conv(ch, (3, 3), padding=1, dtype=cfg.dtype, name="conv_in")(x)
+        skips = [h]
+
+        # down path
+        for level, mult in enumerate(cfg.channel_mult):
+            out_ch = ch * mult
+            for i in range(cfg.num_res_blocks):
+                h = ResBlock(out_ch, dtype=cfg.dtype,
+                             name=f"down_{level}_res_{i}")(h, emb)
+                if cfg.transformer_depth[level] > 0:
+                    h = SpatialTransformer(
+                        heads(out_ch), depth=cfg.transformer_depth[level],
+                        dtype=cfg.dtype, attn_impl=cfg.attn_impl,
+                        name=f"down_{level}_attn_{i}")(h, context)
+                skips.append(h)
+            if level != cfg.num_levels - 1:
+                h = Downsample(dtype=cfg.dtype, name=f"down_{level}_ds")(h)
+                skips.append(h)
+
+        # middle
+        mid_ch = ch * cfg.channel_mult[-1]
+        h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_0")(h, emb)
+        h = SpatialTransformer(
+            heads(mid_ch), depth=max(cfg.transformer_depth[-1], 1),
+            dtype=cfg.dtype, attn_impl=cfg.attn_impl, name="mid_attn")(h, context)
+        h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_1")(h, emb)
+
+        # up path
+        for level in reversed(range(cfg.num_levels)):
+            out_ch = ch * cfg.channel_mult[level]
+            for i in range(cfg.num_res_blocks + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = ResBlock(out_ch, dtype=cfg.dtype,
+                             name=f"up_{level}_res_{i}")(h, emb)
+                if cfg.transformer_depth[level] > 0:
+                    h = SpatialTransformer(
+                        heads(out_ch), depth=cfg.transformer_depth[level],
+                        dtype=cfg.dtype, attn_impl=cfg.attn_impl,
+                        name=f"up_{level}_attn_{i}")(h, context)
+            if level != 0:
+                h = Upsample(dtype=cfg.dtype, name=f"up_{level}_us")(h)
+
+        h = GroupNorm32(name="out_norm")(h)
+        h = nn.silu(h)
+        h = nn.Conv(cfg.out_channels, (3, 3), padding=1, dtype=jnp.float32,
+                    name="conv_out")(h)
+        return h.astype(jnp.float32)

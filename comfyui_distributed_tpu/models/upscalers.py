@@ -1,0 +1,87 @@
+"""Single-image super-resolution models (ESRGAN/RRDB family), flax NHWC.
+
+The reference delegates to ComfyUI's UpscaleModelLoader +
+ImageUpscaleWithModel (``workflows/distributed-upscale.json`` nodes 14/15,
+feeding UltimateSDUpscaleDistributed); this is the native equivalent.  The
+RRDB architecture covers the common ``4x*.pth`` ESRGAN-style checkpoints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class RRDBConfig:
+    num_features: int = 64
+    num_blocks: int = 23
+    growth: int = 32
+    scale: int = 4
+    dtype: Any = jnp.bfloat16
+
+
+ESRGAN_4X_CONFIG = RRDBConfig()
+TINY_RRDB_CONFIG = RRDBConfig(num_features=16, num_blocks=2, growth=8, scale=2)
+
+
+class DenseBlock(nn.Module):
+    growth: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        feats = [x]
+        for i in range(4):
+            h = nn.Conv(self.growth, (3, 3), padding=1, dtype=self.dtype,
+                        name=f"conv{i}")(jnp.concatenate(feats, axis=-1))
+            feats.append(nn.leaky_relu(h, 0.2))
+        out = nn.Conv(x.shape[-1], (3, 3), padding=1, dtype=self.dtype,
+                      name="conv4")(jnp.concatenate(feats, axis=-1))
+        return x + out * 0.2
+
+
+class RRDB(nn.Module):
+    growth: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = x
+        for i in range(3):
+            h = DenseBlock(self.growth, dtype=self.dtype, name=f"db{i}")(h)
+        return x + h * 0.2
+
+
+class RRDBNet(nn.Module):
+    cfg: RRDBConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        """x: [B,H,W,3] in [0,1] -> [B, H*scale, W*scale, 3]."""
+        cfg = self.cfg
+        fea = nn.Conv(cfg.num_features, (3, 3), padding=1, dtype=cfg.dtype,
+                      name="conv_first")(x)
+        h = fea
+        for i in range(cfg.num_blocks):
+            h = RRDB(cfg.growth, dtype=cfg.dtype, name=f"rrdb_{i}")(h)
+        h = nn.Conv(cfg.num_features, (3, 3), padding=1, dtype=cfg.dtype,
+                    name="trunk_conv")(h)
+        h = fea + h
+        n_up = {1: 0, 2: 1, 4: 2, 8: 3}[cfg.scale]
+        for i in range(n_up):
+            B, H, W, C = h.shape
+            h = jax.image.resize(h, (B, H * 2, W * 2, C), method="nearest")
+            h = nn.leaky_relu(
+                nn.Conv(cfg.num_features, (3, 3), padding=1, dtype=cfg.dtype,
+                        name=f"up_{i}")(h), 0.2)
+        h = nn.leaky_relu(
+            nn.Conv(cfg.num_features, (3, 3), padding=1, dtype=cfg.dtype,
+                    name="hr_conv")(h), 0.2)
+        out = nn.Conv(3, (3, 3), padding=1, dtype=jnp.float32,
+                      name="conv_last")(h)
+        return jnp.clip(out.astype(jnp.float32), 0.0, 1.0)

@@ -1,0 +1,156 @@
+"""KL-regularized VAE (SD latent codec), flax NHWC.
+
+The reference calls ComfyUI's VAE for every tile round-trip
+(``VAEEncode``/``VAEDecode`` inside ``process_tile``, reference
+``distributed_upscale.py:516-541``); this is the native equivalent.
+Images are NHWC in [0,1] at the op boundary; internally mapped to [-1,1].
+Latents are NHWC with ``latent_channels`` channels, scaled by
+``scaling_factor`` (0.18215 SD1.x, 0.13025 SDXL).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from comfyui_distributed_tpu.models.layers import GroupNorm32
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    base_channels: int = 128
+    channel_mult: Tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    latent_channels: int = 4
+    scaling_factor: float = 0.18215
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def downscale(self) -> int:
+        return 2 ** (len(self.channel_mult) - 1)
+
+
+SD_VAE_CONFIG = VAEConfig()
+SDXL_VAE_CONFIG = VAEConfig(scaling_factor=0.13025)
+TINY_VAE_CONFIG = VAEConfig(base_channels=16, channel_mult=(1, 2),
+                            num_res_blocks=1)
+
+
+class VAEResBlock(nn.Module):
+    out_channels: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = nn.silu(GroupNorm32(name="norm1")(x))
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
+                    name="conv1")(h)
+        h = nn.silu(GroupNorm32(name="norm2")(h))
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
+                    name="conv2")(h)
+        if x.shape[-1] != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype,
+                        name="skip")(x)
+        return x + h
+
+
+class VAEAttnBlock(nn.Module):
+    """Single-head spatial self-attention at the bottleneck."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        B, H, W, C = x.shape
+        h = GroupNorm32(name="norm")(x)
+        q = nn.Dense(C, dtype=self.dtype, name="q")(h).reshape(B, H * W, C)
+        k = nn.Dense(C, dtype=self.dtype, name="k")(h).reshape(B, H * W, C)
+        v = nn.Dense(C, dtype=self.dtype, name="v")(h).reshape(B, H * W, C)
+        logits = jnp.einsum("bnc,bmc->bnm", q, k,
+                            preferred_element_type=jnp.float32)
+        w = jax.nn.softmax(logits / jnp.sqrt(jnp.float32(C)), axis=-1)
+        out = jnp.einsum("bnm,bmc->bnc", w.astype(v.dtype), v)
+        out = nn.Dense(C, dtype=self.dtype,
+                       name="proj_out")(out.reshape(B, H, W, C))
+        return x + out
+
+
+class Encoder(nn.Module):
+    cfg: VAEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        h = nn.Conv(cfg.base_channels, (3, 3), padding=1, dtype=cfg.dtype,
+                    name="conv_in")(x)
+        for level, mult in enumerate(cfg.channel_mult):
+            out_ch = cfg.base_channels * mult
+            for i in range(cfg.num_res_blocks):
+                h = VAEResBlock(out_ch, dtype=cfg.dtype,
+                                name=f"down_{level}_res_{i}")(h)
+            if level != len(cfg.channel_mult) - 1:
+                h = nn.Conv(out_ch, (3, 3), strides=(2, 2), padding=1,
+                            dtype=cfg.dtype, name=f"down_{level}_ds")(h)
+        h = VAEResBlock(h.shape[-1], dtype=cfg.dtype, name="mid_res_0")(h)
+        h = VAEAttnBlock(dtype=cfg.dtype, name="mid_attn")(h)
+        h = VAEResBlock(h.shape[-1], dtype=cfg.dtype, name="mid_res_1")(h)
+        h = nn.silu(GroupNorm32(name="out_norm")(h))
+        return nn.Conv(2 * cfg.latent_channels, (3, 3), padding=1,
+                       dtype=jnp.float32, name="conv_out")(h).astype(jnp.float32)
+
+
+class Decoder(nn.Module):
+    cfg: VAEConfig
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        ch = cfg.base_channels * cfg.channel_mult[-1]
+        h = nn.Conv(ch, (3, 3), padding=1, dtype=cfg.dtype, name="conv_in")(z)
+        h = VAEResBlock(ch, dtype=cfg.dtype, name="mid_res_0")(h)
+        h = VAEAttnBlock(dtype=cfg.dtype, name="mid_attn")(h)
+        h = VAEResBlock(ch, dtype=cfg.dtype, name="mid_res_1")(h)
+        for level in reversed(range(len(cfg.channel_mult))):
+            out_ch = cfg.base_channels * cfg.channel_mult[level]
+            for i in range(cfg.num_res_blocks + 1):
+                h = VAEResBlock(out_ch, dtype=cfg.dtype,
+                                name=f"up_{level}_res_{i}")(h)
+            if level != 0:
+                B, H, W, C = h.shape
+                h = jax.image.resize(h, (B, H * 2, W * 2, C), method="nearest")
+                h = nn.Conv(C, (3, 3), padding=1, dtype=cfg.dtype,
+                            name=f"up_{level}_us")(h)
+        h = nn.silu(GroupNorm32(name="out_norm")(h))
+        return nn.Conv(3, (3, 3), padding=1, dtype=jnp.float32,
+                       name="conv_out")(h).astype(jnp.float32)
+
+
+class VAE(nn.Module):
+    """Full autoencoder with encode/decode methods (images [0,1] <-> scaled
+    latents)."""
+    cfg: VAEConfig
+
+    def setup(self):
+        self.encoder = Encoder(self.cfg, name="encoder")
+        self.decoder = Decoder(self.cfg, name="decoder")
+
+    def encode(self, images: jax.Array,
+               key: Optional[jax.Array] = None) -> jax.Array:
+        x = images * 2.0 - 1.0
+        moments = self.encoder(x)
+        mean, logvar = jnp.split(moments, 2, axis=-1)
+        if key is not None:
+            std = jnp.exp(0.5 * jnp.clip(logvar, -30.0, 20.0))
+            mean = mean + std * jax.random.normal(key, mean.shape)
+        return mean * self.cfg.scaling_factor
+
+    def decode(self, latents: jax.Array) -> jax.Array:
+        z = latents / self.cfg.scaling_factor
+        x = self.decoder(z)
+        return jnp.clip((x + 1.0) / 2.0, 0.0, 1.0)
+
+    def __call__(self, images: jax.Array) -> jax.Array:
+        return self.decode(self.encode(images))

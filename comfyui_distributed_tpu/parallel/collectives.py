@@ -42,11 +42,10 @@ def replica_seeds(base_seed: int, num_replicas: int,
 
 def sample_keys(seeds: jnp.ndarray) -> jnp.ndarray:
     """Fold per-sample indices into per-replica seeds so each image in a
-    replica's sub-batch gets an independent stream."""
-    idx = jnp.arange(seeds.shape[0], dtype=jnp.uint32)
-    keys = jax.vmap(lambda s, i: jax.random.fold_in(
-        jax.random.PRNGKey(s.astype(jnp.uint32)), i))(seeds, idx)
-    return keys
+    replica's sub-batch gets an independent stream (canonical impl lives
+    with the samplers)."""
+    from comfyui_distributed_tpu.models.samplers import sample_keys as _sk
+    return _sk(seeds)
 
 
 def shard_batch(x: Any, mesh: Mesh, spec: Optional[P] = None) -> jax.Array:

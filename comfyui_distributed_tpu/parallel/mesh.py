@@ -154,7 +154,9 @@ def get_runtime(axes: Optional[Dict[str, int]] = None,
         if _runtime is None or refresh:
             _runtime = MeshRuntime(mesh=build_mesh(axes))
         elif axes is not None:
-            want = _resolve_axes(dict(axes), len(list(_runtime.mesh.devices.flat)))
+            requested = dict(axes)
+            requested.setdefault(DATA_AXIS, -1)  # same default build_mesh uses
+            want = _resolve_axes(requested, len(list(_runtime.mesh.devices.flat)))
             have = {k: int(v) for k, v in _runtime.mesh.shape.items()}
             if want != have:
                 raise ValueError(

@@ -18,31 +18,34 @@ import aiohttp
 
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 
-_sessions: Dict[int, aiohttp.ClientSession] = {}
+import weakref
+
+_sessions: "weakref.WeakKeyDictionary[asyncio.AbstractEventLoop, aiohttp.ClientSession]" = (
+    weakref.WeakKeyDictionary())
 _session_lock = threading.Lock()
 
 
 async def get_client_session() -> aiohttp.ClientSession:
     """Shared pooled session (reference ``utils/network.py:14-22``).
 
-    One session per event loop: an aiohttp session is bound to the loop that
-    created it, so caching a single global across loops would hand later
-    loops a session attached to a dead one."""
+    One session per event loop, keyed weakly by the loop object itself: an
+    aiohttp session is bound to the loop that created it, and id()-keying
+    would alias a dead loop's session onto a new loop allocated at the same
+    address."""
     loop = asyncio.get_running_loop()
-    key = id(loop)
     with _session_lock:
-        sess = _sessions.get(key)
+        sess = _sessions.get(loop)
         if sess is None or sess.closed:
             connector = aiohttp.TCPConnector(limit=100, limit_per_host=30)
             sess = aiohttp.ClientSession(connector=connector)
-            _sessions[key] = sess
+            _sessions[loop] = sess
         return sess
 
 
 async def cleanup_client_session() -> None:
     loop = asyncio.get_running_loop()
     with _session_lock:
-        sess = _sessions.pop(id(loop), None)
+        sess = _sessions.pop(loop, None)
     if sess is not None and not sess.closed:
         await sess.close()
 

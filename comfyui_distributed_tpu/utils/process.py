@@ -93,15 +93,26 @@ def kill_process_tree(pid: int, timeout: float = PROCESS_TERMINATION_TIMEOUT) ->
         except psutil.Error:
             pass
     # POSIX fallback (reference distributed.py:1010-1018): enumerate the
-    # full descendant tree via ps, TERM everyone, escalate survivors to KILL.
+    # full descendant tree via one portable `ps -Ao pid=,ppid=` snapshot
+    # (works on Linux and BSD/macOS, unlike GNU-only --ppid), TERM everyone,
+    # escalate survivors to KILL.
     def _descendants(root: int):
+        res = subprocess.run(["ps", "-Ao", "pid=,ppid="],
+                             capture_output=True, text=True, check=False)
+        children: dict = {}
+        for line in res.stdout.splitlines():
+            parts = line.split()
+            if len(parts) == 2:
+                try:
+                    c, p = int(parts[0]), int(parts[1])
+                except ValueError:
+                    continue
+                children.setdefault(p, []).append(c)
         out: list = []
         frontier = [root]
         while frontier:
             p = frontier.pop()
-            res = subprocess.run(["ps", "-o", "pid=", "--ppid", str(p)],
-                                 capture_output=True, text=True, check=False)
-            kids = [int(s) for s in res.stdout.split()]
+            kids = children.get(p, [])
             out.extend(kids)
             frontier.extend(kids)
         return out

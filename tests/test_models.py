@@ -1,0 +1,174 @@
+"""Model zoo: UNet/VAE/CLIP shapes, tokenizer weighting, pipeline bundle."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import registry, tokenizer as tok_mod
+from comfyui_distributed_tpu.models.clip import TINY_CLIP_CONFIG, CLIPTextModel
+from comfyui_distributed_tpu.models.unet import TINY_CONFIG, UNet
+from comfyui_distributed_tpu.models.upscalers import TINY_RRDB_CONFIG, RRDBNet
+from comfyui_distributed_tpu.models.vae import TINY_VAE_CONFIG, VAE
+
+
+@pytest.fixture(autouse=True)
+def tiny_family(monkeypatch):
+    monkeypatch.setenv(registry.FAMILY_ENV, "tiny")
+    yield
+
+
+class TestUNet:
+    def test_forward_shape_and_dtype(self):
+        unet = UNet(TINY_CONFIG)
+        x = jnp.zeros((2, 8, 8, 4))
+        ts = jnp.zeros((2,))
+        ctx = jnp.zeros((2, 77, 64))
+        params = unet.init(jax.random.PRNGKey(0), x, ts, ctx)["params"]
+        out = unet.apply({"params": params}, x, ts, ctx)
+        assert out.shape == (2, 8, 8, 4)
+        assert out.dtype == jnp.float32
+
+    def test_odd_spatial_dims_multiple_of_downscale(self):
+        unet = UNet(TINY_CONFIG)
+        x = jnp.zeros((1, 16, 8, 4))
+        params = unet.init(jax.random.PRNGKey(0), x, jnp.zeros((1,)),
+                           jnp.zeros((1, 77, 64)))["params"]
+        out = unet.apply({"params": params}, x, jnp.zeros((1,)),
+                         jnp.zeros((1, 77, 64)))
+        assert out.shape == x.shape
+
+
+class TestVAE:
+    def test_encode_decode_round_trip_shapes(self):
+        vae = VAE(TINY_VAE_CONFIG)
+        img = jnp.zeros((1, 16, 16, 3))
+        params = vae.init(jax.random.PRNGKey(0), img)["params"]
+        lat = vae.apply({"params": params}, img, method=vae.encode)
+        assert lat.shape == (1, 8, 8, 4)  # downscale 2 for tiny config
+        dec = vae.apply({"params": params}, lat, method=vae.decode)
+        assert dec.shape == img.shape
+        assert float(jnp.min(dec)) >= 0.0 and float(jnp.max(dec)) <= 1.0
+
+    def test_encode_stochastic_with_key(self):
+        vae = VAE(TINY_VAE_CONFIG)
+        img = jnp.ones((1, 16, 16, 3)) * 0.5
+        params = vae.init(jax.random.PRNGKey(0), img)["params"]
+        a = vae.apply({"params": params}, img, jax.random.PRNGKey(1),
+                      method=vae.encode)
+        b = vae.apply({"params": params}, img, method=vae.encode)
+        assert a.shape == b.shape
+
+
+class TestCLIP:
+    def test_hidden_and_pooled(self):
+        m = CLIPTextModel(TINY_CLIP_CONFIG)
+        toks = jnp.zeros((2, 77), jnp.int32).at[:, 0].set(10)
+        params = m.init(jax.random.PRNGKey(0), toks)["params"]
+        hidden, pooled = m.apply({"params": params}, toks)
+        assert hidden.shape == (2, 77, 64)
+        assert pooled.shape == (2, 64)
+
+
+class TestTokenizer:
+    def test_weight_parsing(self):
+        p = tok_mod.parse_weighted_prompt
+        assert p("plain text") == [("plain text", 1.0)]
+        frags = p("a (cat) dog")
+        assert ("cat", pytest.approx(1.1)) in [(t, w) for t, w in frags]
+        frags = p("a ((cat))")
+        assert any(abs(w - 1.21) < 1e-6 for _, w in frags)
+        frags = p("[down] up")
+        assert any(abs(w - 1 / 1.1) < 1e-6 for _, w in frags)
+        frags = p("(exact:1.5)")
+        assert frags == [("exact", 1.5)]
+
+    def test_unbalanced_is_literal(self):
+        frags = tok_mod.parse_weighted_prompt("smile :) and (open")
+        joined = "".join(t for t, _ in frags)
+        assert "smile :)" in joined and "open" in joined
+
+    def test_hash_tokenizer_stable_and_padded(self):
+        t = tok_mod.HashTokenizer(vocab_size=4096)
+        ids1, w1 = t.encode("hello world")
+        ids2, _ = t.encode("hello world")
+        assert np.array_equal(ids1, ids2)
+        assert ids1.shape == (77,)
+        assert ids1[0] == t.start
+        assert t.end in ids1
+        assert w1.shape == (77,)
+
+    def test_weights_reach_tokens(self):
+        t = tok_mod.HashTokenizer(vocab_size=4096)
+        _, w = t.encode("a (strong:2.0) word")
+        assert 2.0 in w.tolist()
+
+
+class TestPipeline:
+    def test_virtual_pipeline_deterministic(self):
+        registry.clear_pipeline_cache()
+        p1 = registry.load_pipeline("anything.safetensors")
+        leaf1 = jax.tree_util.tree_leaves(p1.unet_params)[0]
+        registry.clear_pipeline_cache()
+        p2 = registry.load_pipeline("anything.safetensors")
+        leaf2 = jax.tree_util.tree_leaves(p2.unet_params)[0]
+        assert np.array_equal(np.asarray(leaf1), np.asarray(leaf2))
+        registry.clear_pipeline_cache()
+
+    def test_pipeline_cached(self):
+        a = registry.load_pipeline("x.safetensors")
+        b = registry.load_pipeline("x.safetensors")
+        assert a is b
+
+    def test_encode_prompt_shapes(self):
+        p = registry.load_pipeline("x.safetensors")
+        ctx, pooled = p.encode_prompt(["a cat", "a dog"])
+        assert ctx.shape == (2, 77, 64)
+        assert pooled.shape == (2, 64)
+
+    def test_full_txt2img_sample(self):
+        """End-to-end tiny pipeline: prompt -> latents -> sample -> decode."""
+        p = registry.load_pipeline("x.safetensors")
+        ctx, _ = p.encode_prompt(["a cat"])
+        unc, _ = p.encode_prompt([""])
+        lat = jnp.zeros((1, 8, 8, 4))
+        seeds = jnp.asarray([42], jnp.uint32)
+        out = p.sample(lat, ctx, unc, seeds, steps=3, cfg=3.0,
+                       sampler_name="euler", scheduler="normal")
+        assert out.shape == lat.shape
+        assert np.all(np.isfinite(np.asarray(out)))
+        img = p.vae_decode(out)
+        assert img.shape == (1, 16, 16, 3)
+
+    def test_seed_determinism_and_divergence(self):
+        p = registry.load_pipeline("x.safetensors")
+        ctx, _ = p.encode_prompt(["a cat"])
+        unc, _ = p.encode_prompt([""])
+        lat = jnp.zeros((2, 8, 8, 4))
+        s_a = jnp.asarray([7, 8], jnp.uint32)
+        a = p.sample(lat, ctx[:1].repeat(2, 0), unc[:1].repeat(2, 0), s_a,
+                     steps=2, cfg=1.0, sampler_name="euler_ancestral",
+                     scheduler="normal")
+        b = p.sample(lat, ctx[:1].repeat(2, 0), unc[:1].repeat(2, 0), s_a,
+                     steps=2, cfg=1.0, sampler_name="euler_ancestral",
+                     scheduler="normal")
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        # the two samples inside the batch differ (different seeds)
+        assert not np.allclose(np.asarray(a)[0], np.asarray(a)[1])
+
+
+class TestUpscaler:
+    def test_rrdb_scale(self):
+        net = RRDBNet(TINY_RRDB_CONFIG)
+        x = jnp.zeros((1, 8, 8, 3))
+        params = net.init(jax.random.PRNGKey(0), x)["params"]
+        out = net.apply({"params": params}, x)
+        assert out.shape == (1, 16, 16, 3)
+
+    def test_registry_upscaler_virtual(self):
+        net, params, scale = registry.load_upscaler("tiny_2x.pth")
+        assert scale == 2
+        out = net.apply({"params": params}, jnp.zeros((1, 4, 4, 3)))
+        assert out.shape == (1, 8, 8, 3)
