@@ -37,7 +37,7 @@ OPEN_CLIP_BIGG_CONFIG = CLIPConfig(width=1280, layers=32, heads=20,
                                    act="gelu", output_layer=-2,
                                    projection_dim=1280)
 TINY_CLIP_CONFIG = CLIPConfig(vocab_size=4096, width=64, layers=2, heads=4,
-                              max_length=77)
+                              max_length=77, dtype=jnp.float32)
 
 
 def _act(name: str):

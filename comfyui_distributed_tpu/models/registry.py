@@ -158,35 +158,60 @@ class DiffusionPipeline:
                              self.schedule, self.prediction_type)
 
     def sample(self, latents: jnp.ndarray, context: jnp.ndarray,
-               uncond_context: jnp.ndarray, seeds: jnp.ndarray,
+               uncond_context: jnp.ndarray, seeds,
                steps: int, cfg: float, sampler_name: str, scheduler: str,
                denoise: float = 1.0, y: Optional[jnp.ndarray] = None,
-               add_noise: bool = True) -> jnp.ndarray:
+               add_noise: bool = True, sample_idx=None) -> jnp.ndarray:
         """Full ksampler: schedule -> noise -> scan-sampler -> latents.
 
-        ``seeds``: per-sample uint32 array [B] (replica offsets already
-        applied by the distributed layer)."""
+        ``seeds``: per-sample host seed array [B] (64-bit ok; replica offsets
+        already applied by the distributed layer).  ``sample_idx``: optional
+        per-sample fold-in indices (replica-local positions in SPMD runs).
+        The denoise loop is jit-compiled and cached per static config."""
         sigmas = jnp.asarray(sch.compute_sigmas(
             self.schedule, scheduler, steps, denoise))
-        keys = smp.sample_keys(seeds)  # raw host seeds keep 64-bit entropy
-        model = smp.cfg_denoiser(self.denoiser(), context, uncond_context, cfg)
-        if y is not None and cfg != 1.0:
-            y = jnp.concatenate([y, y], axis=0)
+        keys = smp.sample_keys(seeds, sample_idx)
 
-        sampler = smp.get_sampler(sampler_name)
-        # init noise uses a reserved fold-in index so it never collides with
-        # per-step ancestral noise (steps count up from 0)
-        noise = smp.make_noise_fn(keys)(jnp.asarray(0x7FFFFFFF, jnp.uint32),
-                                        latents.shape[1:])
-        if add_noise:
-            if denoise >= 0.9999:
-                x = noise * sigmas[0]
-            else:
-                x = latents + noise * sigmas[0]
-        else:
-            x = latents
-        extra = {"y": y} if y is not None else {}
-        return sampler(model, x, sigmas, extra_args=extra, keys=keys)
+        static_key = ("sample", sampler_name, scheduler, steps, float(cfg),
+                      float(denoise), bool(add_noise), y is not None,
+                      tuple(latents.shape), tuple(context.shape))
+
+        def make_core():
+            full_denoise = denoise >= 0.9999
+            has_y = y is not None
+            cfg_scale = float(cfg)
+            sampler = smp.get_sampler(sampler_name)
+
+            def core(unet_params, latents, context, uncond_context, keys,
+                     sigmas, y_in):
+                den = make_denoiser(self.raw_unet_apply, unet_params,
+                                    self.schedule, self.prediction_type)
+                model = smp.cfg_denoiser(den, context, uncond_context,
+                                         cfg_scale)
+                y2 = y_in
+                if has_y and cfg_scale != 1.0:
+                    y2 = jnp.concatenate([y_in, y_in], axis=0)
+                # init noise uses a reserved fold-in index so it never
+                # collides with per-step ancestral noise (steps from 0)
+                noise = smp.make_noise_fn(keys)(
+                    jnp.asarray(0x7FFFFFFF, jnp.uint32), latents.shape[1:])
+                if add_noise:
+                    x = noise * sigmas[0] if full_denoise \
+                        else latents + noise * sigmas[0]
+                else:
+                    x = latents
+                extra = {"y": y2} if has_y else {}
+                return sampler(model, x, sigmas, extra_args=extra, keys=keys)
+
+            return jax.jit(core)
+
+        with self._lock:
+            if static_key not in self._jit_cache:
+                self._jit_cache[static_key] = make_core()
+            core = self._jit_cache[static_key]
+        y_arg = y if y is not None else jnp.zeros((latents.shape[0], 1))
+        return core(self.unet_params, latents, context, uncond_context,
+                    keys, sigmas, y_arg)
 
     # --- internals ----------------------------------------------------------
 

@@ -29,9 +29,14 @@ import jax.numpy as jnp
 Model = Callable[..., jax.Array]  # model(x, sigma, **extra) -> denoised
 
 
-def sample_keys(seeds) -> jax.Array:
-    """Per-sample PRNG keys from per-sample seeds: fold the batch index into
-    each seed so replicas sharing a seed still get distinct streams.
+def sample_keys(seeds, idx=None) -> jax.Array:
+    """Per-sample PRNG keys from per-sample seeds: fold a per-sample index
+    into each seed so rows sharing a seed still get distinct streams.
+
+    ``idx`` defaults to the global batch position; the distributed layer
+    passes *replica-local* indices instead, so two replicas given the same
+    seed produce identical sub-batches (reference parity: a run without a
+    DistributedSeed node yields duplicate images on every participant).
 
     Accepts 64-bit host seeds (numpy/python ints) without collision: the high
     word is folded in separately, so seeds differing by 2^32 stay distinct
@@ -45,7 +50,10 @@ def sample_keys(seeds) -> jax.Array:
         s = _np.asarray(seeds, dtype=_np.uint64)
         lo = jnp.asarray((s & _np.uint64(0xFFFFFFFF)).astype(_np.uint32))
         hi = jnp.asarray((s >> _np.uint64(32)).astype(_np.uint32))
-    idx = jnp.arange(lo.shape[0], dtype=jnp.uint32)
+    if idx is None:
+        idx = jnp.arange(lo.shape[0], dtype=jnp.uint32)
+    else:
+        idx = jnp.asarray(idx).astype(jnp.uint32)
     return jax.vmap(lambda l, h, i: jax.random.fold_in(
         jax.random.fold_in(jax.random.PRNGKey(l), h), i))(lo, hi, idx)
 

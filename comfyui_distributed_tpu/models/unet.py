@@ -70,6 +70,7 @@ TINY_CONFIG = UNetConfig(
     transformer_depth=(1, 1),
     context_dim=64,
     num_head_channels=16,
+    dtype=jnp.float32,  # deterministic CPU tests; real families use bf16
 )
 
 

@@ -37,7 +37,7 @@ class VAEConfig:
 SD_VAE_CONFIG = VAEConfig()
 SDXL_VAE_CONFIG = VAEConfig(scaling_factor=0.13025)
 TINY_VAE_CONFIG = VAEConfig(base_channels=16, channel_mult=(1, 2),
-                            num_res_blocks=1)
+                            num_res_blocks=1, dtype=jnp.float32)
 
 
 class VAEResBlock(nn.Module):

@@ -1,0 +1,17 @@
+"""Workflow node library (ComfyUI-compatible op surface).
+
+Node classes keep the reference's type names, widget order and hidden-input
+schemas (so the two reference workflow JSONs parse unchanged), but execute on
+the TPU mesh: fan-out is batch sharding, collection is an XLA gather.
+"""
+
+from comfyui_distributed_tpu.ops.base import (  # noqa: F401
+    NODE_CLASS_MAPPINGS,
+    OpContext,
+    get_op,
+    register_op,
+)
+# importing the modules registers their ops
+from comfyui_distributed_tpu.ops import basic  # noqa: F401,E402
+from comfyui_distributed_tpu.ops import distributed  # noqa: F401,E402
+from comfyui_distributed_tpu.ops import tiled_upscale  # noqa: F401,E402

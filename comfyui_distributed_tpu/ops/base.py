@@ -1,0 +1,119 @@
+"""Op protocol, registry and execution context.
+
+Parity notes: each op mirrors a reference node's schema —
+``WIDGETS`` encodes ComfyUI's widget order (including the ``control``
+slots like "randomize" that occupy a position but carry no input), and
+``HIDDEN`` lists the hidden inputs the reference's browser dispatcher
+injects (``gpupanel.js:1074-1177``); here the dispatcher module injects the
+same names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# sentinel for widget slots that are UI chrome (control_after_generate)
+CONTROL = "__control__"
+
+
+@dataclasses.dataclass
+class Conditioning:
+    """CLIP encoding result (comfy CONDITIONING)."""
+    context: Any          # [1, T, C]
+    pooled: Any = None    # [1, P]
+
+
+@dataclasses.dataclass
+class SeedValue:
+    """INT seed that knows whether it came from a DistributedSeed node.
+
+    Reference semantics: master passes the seed through, worker ``i`` uses
+    ``seed + i + 1`` (``distributed.py:1491-1514``).  In SPMD mode this
+    becomes a per-replica offset applied by the KSampler; a plain int seed
+    replicates identically on every participant, exactly like a reference
+    run without a DistributedSeed node."""
+    base: int
+    distributed: bool = False
+
+    def __index__(self) -> int:
+        return int(self.base)
+
+
+@dataclasses.dataclass
+class OpContext:
+    """Per-run execution context (what ComfyUI spreads across PromptServer,
+    hidden inputs and folder_paths)."""
+    runtime: Any = None                # MeshRuntime
+    models_dir: Optional[str] = None
+    input_dir: Optional[str] = None
+    output_dir: Optional[str] = None
+    fanout: int = 1                    # data-parallel replicas for this run
+    # distributed identity (hidden-input defaults for all ops)
+    is_worker: bool = False
+    worker_id: str = ""
+    master_url: str = ""
+    enabled_worker_ids: str = "[]"
+    # data plane (master mode): job store with asyncio queues + loop
+    job_store: Any = None
+    server_loop: Any = None
+    # collected artifacts
+    saved_images: List[np.ndarray] = dataclasses.field(default_factory=list)
+    node_timings: Dict[str, float] = dataclasses.field(default_factory=dict)
+    interrupt_event: Any = None
+
+    def check_interrupt(self):
+        if self.interrupt_event is not None and self.interrupt_event.is_set():
+            raise InterruptedError("execution interrupted")
+
+
+class Op:
+    """Base class for workflow ops.
+
+    Class attributes:
+        TYPE: node class name (matches reference NODE_CLASS_MAPPINGS key)
+        WIDGETS: widget names in UI order (CONTROL for chrome slots)
+        DEFAULTS: default values for optional widgets
+        HIDDEN: hidden input names this op accepts
+        OUTPUT_NODE: terminal node (executed even with no consumers)
+    """
+
+    TYPE = ""
+    WIDGETS: List[str] = []
+    DEFAULTS: Dict[str, Any] = {}
+    HIDDEN: List[str] = []
+    OUTPUT_NODE = False
+
+    def execute(self, ctx: OpContext, **inputs) -> Tuple:
+        raise NotImplementedError
+
+
+NODE_CLASS_MAPPINGS: Dict[str, type] = {}
+_registry_lock = threading.Lock()
+
+
+def register_op(cls: type) -> type:
+    with _registry_lock:
+        NODE_CLASS_MAPPINGS[cls.TYPE] = cls
+    return cls
+
+
+def get_op(type_name: str) -> Op:
+    try:
+        cls = NODE_CLASS_MAPPINGS[type_name]
+    except KeyError:
+        raise KeyError(
+            f"unknown node type {type_name!r}; known: "
+            f"{sorted(NODE_CLASS_MAPPINGS)}") from None
+    return cls()
+
+
+def as_image_array(x) -> np.ndarray:
+    """Normalize IMAGE values to numpy [B,H,W,C] float32."""
+    arr = np.asarray(x, dtype=np.float32)
+    if arr.ndim == 3:
+        arr = arr[None]
+    return arr

@@ -1,0 +1,281 @@
+"""Standard workflow ops: loaders, conditioning, latents, sampling, images.
+
+Schemas mirror ComfyUI node surfaces used by the reference workflows
+(``workflows/distributed-txt2img.json``, ``distributed-upscale.json``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from comfyui_distributed_tpu.models import registry
+from comfyui_distributed_tpu.ops.base import (
+    CONTROL,
+    Conditioning,
+    Op,
+    OpContext,
+    SeedValue,
+    as_image_array,
+    register_op,
+)
+from comfyui_distributed_tpu.parallel import collectives as coll
+from comfyui_distributed_tpu.utils.image import (
+    pil_to_tensor,
+    resize_image,
+    tensor_to_pil,
+)
+from comfyui_distributed_tpu.utils.logging import Timer, debug_log
+
+
+@register_op
+class CheckpointLoaderSimple(Op):
+    """-> (MODEL, CLIP, VAE); all three views of one DiffusionPipeline."""
+    TYPE = "CheckpointLoaderSimple"
+    WIDGETS = ["ckpt_name"]
+
+    def execute(self, ctx: OpContext, ckpt_name: str):
+        pipe = registry.load_pipeline(ckpt_name, models_dir=ctx.models_dir)
+        return (pipe, pipe, pipe)
+
+
+@register_op
+class CLIPTextEncode(Op):
+    TYPE = "CLIPTextEncode"
+    WIDGETS = ["text"]
+
+    def execute(self, ctx: OpContext, clip, text: str):
+        context, pooled = clip.encode_prompt([text])
+        return (Conditioning(context=context, pooled=pooled),)
+
+
+@register_op
+class EmptyLatentImage(Op):
+    """Zero latent batch; in a distributed run the batch expands to
+    ``batch_size * fanout`` — the SPMD analog of every participant creating
+    its own batch (reference: implied scaling images = (1+N) x batch,
+    ``gpupanel.js:806-808``)."""
+    TYPE = "EmptyLatentImage"
+    WIDGETS = ["width", "height", "batch_size"]
+    DEFAULTS = {"width": 512, "height": 512, "batch_size": 1}
+
+    def execute(self, ctx: OpContext, width: int, height: int,
+                batch_size: int = 1):
+        total = int(batch_size) * max(ctx.fanout, 1)
+        lat = np.zeros((total, height // 8, width // 8, 4), np.float32)
+        return ({"samples": lat, "local_batch": int(batch_size),
+                 "fanout": max(ctx.fanout, 1)},)
+
+
+@register_op
+class KSampler(Op):
+    """Denoise loop.  Seed semantics (reference ``distributed.py:1491-1514``):
+    a SeedValue from DistributedSeed applies +replica offsets; a plain int
+    replicates the same stream on every replica."""
+    TYPE = "KSampler"
+    WIDGETS = ["seed", CONTROL, "steps", "cfg", "sampler_name", "scheduler",
+               "denoise"]
+    DEFAULTS = {"denoise": 1.0}
+
+    def execute(self, ctx: OpContext, model, seed, steps, cfg, sampler_name,
+                scheduler, positive: Conditioning, negative: Conditioning,
+                latent_image, denoise: float = 1.0):
+        ctx.check_interrupt()
+        lat = np.asarray(latent_image["samples"], np.float32)
+        fanout = int(latent_image.get("fanout", 1))
+        total = lat.shape[0]
+        local_b = int(latent_image.get("local_batch", total // max(fanout, 1)))
+
+        if isinstance(seed, SeedValue):
+            base, distributed = seed.base, seed.distributed
+        else:
+            base, distributed = int(seed), False
+
+        if fanout > 1 and distributed:
+            seeds = coll.replica_seeds(base, fanout, local_b)
+        else:
+            seeds = np.full((total,), np.uint64(base), np.uint64)
+        local_idx = np.tile(np.arange(local_b, dtype=np.uint32),
+                            max(fanout, 1))[:total]
+
+        ctx_arr = jnp.repeat(positive.context, total, axis=0)
+        unc_arr = jnp.repeat(negative.context, total, axis=0)
+        y = None
+        if model.family.unet.adm_in_channels is not None:
+            y = _sdxl_vector_cond(model, positive, total,
+                                  lat.shape[1] * 8, lat.shape[2] * 8)
+
+        lat_dev = lat
+        if fanout > 1 and ctx.runtime is not None:
+            mesh = ctx.runtime.mesh
+            lat_dev = coll.shard_batch(lat, mesh)
+            ctx_arr = coll.shard_batch(ctx_arr, mesh)
+            unc_arr = coll.shard_batch(unc_arr, mesh)
+            if y is not None:
+                y = coll.shard_batch(y, mesh)
+
+        with Timer(f"ksampler[{sampler_name}x{steps}]"):
+            out = model.sample(
+                jnp.asarray(lat_dev), ctx_arr, unc_arr, seeds,
+                steps=int(steps), cfg=float(cfg),
+                sampler_name=str(sampler_name), scheduler=str(scheduler),
+                denoise=float(denoise), y=y,
+                sample_idx=local_idx)
+        return ({"samples": out, "local_batch": local_b, "fanout": fanout},)
+
+
+def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,
+                      height: int, width: int):
+    """SDXL ADM vector: pooled text emb + size conditioning embeddings."""
+    from comfyui_distributed_tpu.models.layers import timestep_embedding
+    pooled = cond.pooled
+    if pooled is None:
+        pooled = jnp.zeros((1, 1280))
+    sizes = jnp.asarray([[height, width, 0, 0, height, width]], jnp.float32)
+    emb = timestep_embedding(sizes.reshape(-1), 256).reshape(1, -1)
+    vec = jnp.concatenate([pooled, emb], axis=-1)
+    want = pipe.family.unet.adm_in_channels
+    if vec.shape[-1] < want:
+        vec = jnp.pad(vec, ((0, 0), (0, want - vec.shape[-1])))
+    vec = vec[:, :want]
+    return jnp.repeat(vec, batch, axis=0)
+
+
+@register_op
+class VAEDecode(Op):
+    TYPE = "VAEDecode"
+
+    def execute(self, ctx: OpContext, samples, vae):
+        ctx.check_interrupt()
+        with Timer("vae_decode"):
+            img = vae.vae_decode(jnp.asarray(samples["samples"]))
+        meta = {k: samples[k] for k in ("local_batch", "fanout")
+                if k in samples}
+        return (ImageBatch(img, **meta),)
+
+
+@register_op
+class VAEEncode(Op):
+    TYPE = "VAEEncode"
+
+    def execute(self, ctx: OpContext, pixels, vae):
+        img = jnp.asarray(as_image_array(pixels))
+        with Timer("vae_encode"):
+            lat = vae.vae_encode(img)
+        return ({"samples": lat},)
+
+
+class ImageBatch(np.ndarray):
+    """IMAGE ndarray carrying fan-out metadata through image-space ops."""
+
+    def __new__(cls, arr, local_batch: Optional[int] = None,
+                fanout: int = 1):
+        obj = np.asarray(arr, dtype=np.float32).view(cls)
+        obj.local_batch = local_batch
+        obj.fanout = fanout
+        return obj
+
+    def __array_finalize__(self, obj):
+        if obj is not None:
+            self.local_batch = getattr(obj, "local_batch", None)
+            self.fanout = getattr(obj, "fanout", 1)
+
+
+@register_op
+class LoadImage(Op):
+    TYPE = "LoadImage"
+    WIDGETS = ["image", CONTROL]  # second widget is the upload button slot
+
+    def execute(self, ctx: OpContext, image: str):
+        from PIL import Image
+        path = image
+        if ctx.input_dir and not os.path.isabs(path):
+            path = os.path.join(ctx.input_dir, image)
+        if os.path.exists(path):
+            arr = pil_to_tensor(Image.open(path))
+        else:
+            # zero-egress fallback: deterministic gradient test card
+            debug_log(f"LoadImage: {image!r} not found, synthesizing 512x512")
+            h = w = 512
+            yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+            arr = np.stack([xx / w, yy / h, (xx + yy) / (h + w)],
+                           axis=-1)[None]
+        mask = 1.0 - arr[..., 3] if arr.shape[-1] == 4 else \
+            np.zeros(arr.shape[:3], np.float32)
+        return (arr[..., :3], mask)
+
+
+@register_op
+class ImageScale(Op):
+    TYPE = "ImageScale"
+    WIDGETS = ["upscale_method", "width", "height", "crop"]
+    DEFAULTS = {"crop": "disabled"}
+
+    def execute(self, ctx: OpContext, image, upscale_method: str,
+                width: int, height: int, crop: str = "disabled"):
+        arr = as_image_array(image)
+        if crop == "center":
+            b, h, w, c = arr.shape
+            ratio = max(width / w, height / h)
+            iw, ih = round(w * ratio), round(h * ratio)
+            arr = resize_image(arr, iw, ih, upscale_method)
+            x0 = (iw - width) // 2
+            y0 = (ih - height) // 2
+            arr = arr[:, y0:y0 + height, x0:x0 + width, :]
+        else:
+            arr = resize_image(arr, int(width), int(height), upscale_method)
+        return (arr,)
+
+
+@register_op
+class UpscaleModelLoader(Op):
+    TYPE = "UpscaleModelLoader"
+    WIDGETS = ["model_name"]
+
+    def execute(self, ctx: OpContext, model_name: str):
+        return (registry.load_upscaler(model_name, models_dir=ctx.models_dir),)
+
+
+@register_op
+class ImageUpscaleWithModel(Op):
+    TYPE = "ImageUpscaleWithModel"
+
+    def execute(self, ctx: OpContext, upscale_model, image):
+        net, params, scale = upscale_model
+        arr = as_image_array(image)
+        with Timer(f"sr_upscale[x{scale}]"):
+            out = net.apply({"params": params}, jnp.asarray(arr))
+        return (np.asarray(out),)
+
+
+@register_op
+class PreviewImage(Op):
+    TYPE = "PreviewImage"
+    OUTPUT_NODE = True
+
+    def execute(self, ctx: OpContext, images):
+        arr = as_image_array(images)
+        ctx.saved_images.extend(list(arr))
+        return ()
+
+
+@register_op
+class SaveImage(Op):
+    TYPE = "SaveImage"
+    WIDGETS = ["filename_prefix"]
+    DEFAULTS = {"filename_prefix": "DistributedTPU"}
+    OUTPUT_NODE = True
+
+    def execute(self, ctx: OpContext, images,
+                filename_prefix: str = "DistributedTPU"):
+        arr = as_image_array(images)
+        ctx.saved_images.extend(list(arr))
+        if ctx.output_dir:
+            os.makedirs(ctx.output_dir, exist_ok=True)
+            for i in range(arr.shape[0]):
+                tensor_to_pil(arr, i).save(os.path.join(
+                    ctx.output_dir, f"{filename_prefix}_{i:05d}.png"))
+        return ()

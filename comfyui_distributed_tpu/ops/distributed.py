@@ -1,0 +1,182 @@
+"""Distributed ops: DistributedSeed + DistributedCollector.
+
+Reference: ``distributed.py:1462-1514`` (seed) and ``:1222-1459``
+(collector).  Three execution modes:
+
+1. **SPMD (mesh) mode** — the default single-process path: the batch was
+   expanded over the data axis by EmptyLatentImage, seeds got per-replica
+   offsets in KSampler, and collection is simply fetching the (already
+   replica-major-ordered) batch to host.  No serialization, no queues, no
+   timeouts — the XLA program *is* the data plane.
+2. **Worker (HTTP) mode** — multi-host parity path: PNG-POST every image to
+   the master's ``/distributed/job_complete`` (reference
+   ``send_image_to_master``, ``distributed.py:1254-1279``).
+3. **Master (HTTP) mode** — drain the per-job asyncio queue with timeouts,
+   order master-first then by worker id, concatenate (reference
+   ``execute`` master branch, ``distributed.py:1292-1459``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List
+
+import numpy as np
+
+from comfyui_distributed_tpu.ops.base import (
+    CONTROL,
+    Op,
+    OpContext,
+    SeedValue,
+    as_image_array,
+    register_op,
+)
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils.image import encode_png
+from comfyui_distributed_tpu.utils.logging import Timer, debug_log, log
+from comfyui_distributed_tpu.utils.net import get_client_session, run_async_in_loop
+
+
+def parse_worker_index(worker_id: str) -> int:
+    """'worker_3' -> 3 (reference parses the same string form,
+    ``distributed.py:1500-1505``)."""
+    try:
+        return int(str(worker_id).rsplit("_", 1)[-1])
+    except (ValueError, IndexError):
+        return 0
+
+
+@register_op
+class DistributedSeed(Op):
+    """Master passes the seed through; worker ``i`` gets ``seed + i + 1``.
+    In SPMD mode it returns a SeedValue that tells KSampler to apply
+    per-replica offsets (replica 0 = master = base seed)."""
+    TYPE = "DistributedSeed"
+    WIDGETS = ["seed", CONTROL]
+    HIDDEN = ["is_worker", "worker_id"]
+
+    def execute(self, ctx: OpContext, seed,
+                is_worker=None, worker_id=None):
+        base = int(seed)
+        is_worker = ctx.is_worker if is_worker is None else is_worker
+        worker_id = ctx.worker_id if worker_id is None else worker_id
+        if is_worker:
+            offset = parse_worker_index(worker_id) + 1
+            debug_log(f"DistributedSeed worker {worker_id}: "
+                      f"{base} -> {base + offset}")
+            return (SeedValue(base + offset, distributed=False),)
+        return (SeedValue(base, distributed=True),)
+
+
+@register_op
+class DistributedCollector(Op):
+    TYPE = "DistributedCollector"
+    # worker_batch_size is accepted for schema parity; completion is driven
+    # by per-worker is_last flags, not expected counts (reference
+    # distributed.py:1366-1368 does the same).
+    HIDDEN = ["multi_job_id", "is_worker", "master_url",
+              "enabled_worker_ids", "worker_batch_size", "worker_id",
+              "pass_through"]
+
+    def execute(self, ctx: OpContext, images, multi_job_id="",
+                is_worker=None, master_url="", enabled_worker_ids="[]",
+                worker_batch_size=1, worker_id="", pass_through=False):
+        arr = as_image_array(images)
+        if pass_through:
+            # downstream of a distributed upscaler: tiles were already
+            # collected there (reference gpupanel.js:1146-1154)
+            return (arr,)
+        is_worker = ctx.is_worker if is_worker is None else is_worker
+
+        if is_worker and (master_url or ctx.master_url):
+            self._send_to_master(ctx, arr, multi_job_id,
+                                 master_url or ctx.master_url,
+                                 worker_id or ctx.worker_id)
+            return (arr,)
+
+        if multi_job_id and ctx.job_store is not None:
+            gathered = self._collect_http(ctx, arr, multi_job_id,
+                                          enabled_worker_ids)
+            return (gathered,)
+
+        # SPMD mode: batch already replica-major (master first) by
+        # construction — ordering parity with distributed.py:1424-1438
+        with Timer("collector_gather"):
+            out = np.asarray(arr)
+        if getattr(images, "fanout", 1) > 1:
+            debug_log(f"collector: gathered {out.shape[0]} images from "
+                      f"{images.fanout} mesh replicas")
+        return (out,)
+
+    # --- worker HTTP path ---------------------------------------------------
+
+    def _send_to_master(self, ctx: OpContext, arr: np.ndarray,
+                        multi_job_id: str, master_url: str, worker_id: str):
+        async def send_all():
+            session = await get_client_session()
+            for i in range(arr.shape[0]):
+                png = encode_png(arr[i:i + 1])
+                import aiohttp
+                form = aiohttp.FormData()
+                form.add_field("multi_job_id", multi_job_id)
+                form.add_field("worker_id", str(worker_id))
+                form.add_field("image_index", str(i))
+                form.add_field("is_last", "true" if i == arr.shape[0] - 1
+                               else "false")
+                form.add_field("image", png, filename=f"img_{i}.png",
+                               content_type="image/png")
+                url = f"{master_url}/distributed/job_complete"
+                async with session.post(
+                        url, data=form,
+                        timeout=aiohttp.ClientTimeout(
+                            total=C.TILE_SEND_TIMEOUT)) as resp:
+                    resp.raise_for_status()
+
+        if ctx.server_loop is not None:
+            run_async_in_loop(send_all(), ctx.server_loop,
+                              timeout=C.JOB_COMPLETION_TIMEOUT)
+        else:
+            asyncio.run(send_all())
+        log(f"worker {worker_id}: sent {arr.shape[0]} images for job "
+            f"{multi_job_id}")
+
+    # --- master HTTP path ---------------------------------------------------
+
+    def _collect_http(self, ctx: OpContext, master_images: np.ndarray,
+                      multi_job_id: str, enabled_worker_ids: str):
+        worker_ids = [str(w) for w in json.loads(enabled_worker_ids or "[]")]
+
+        async def drain():
+            q = await ctx.job_store.get_queue(multi_job_id)
+            results: Dict[str, List] = {}
+            done = set()
+            while len(done) < len(worker_ids):
+                try:
+                    item = await asyncio.wait_for(
+                        q.get(), timeout=C.WORKER_JOB_TIMEOUT)
+                except asyncio.TimeoutError:
+                    missing = set(worker_ids) - done
+                    log(f"collector: timeout, missing workers {missing}; "
+                        f"continuing with partial results")
+                    break
+                wid = str(item["worker_id"])
+                results.setdefault(wid, []).append(
+                    (item.get("image_index", 0), item["tensor"]))
+                if item.get("is_last"):
+                    done.add(wid)
+            return results
+
+        with Timer("collector_http_drain"):
+            results = run_async_in_loop(
+                drain(), ctx.server_loop,
+                timeout=C.JOB_COMPLETION_TIMEOUT + 5)
+
+        ordered = [master_images]
+        for wid in sorted(results, key=lambda w: (parse_worker_index(w), w)):
+            imgs = [t for _, t in sorted(results[wid], key=lambda p: p[0])]
+            ordered.extend(np.asarray(t, np.float32) for t in imgs)
+        out = np.concatenate([as_image_array(o) for o in ordered], axis=0)
+        log(f"collector: combined {out.shape[0]} images "
+            f"(master {master_images.shape[0]} + {len(results)} workers)")
+        return out

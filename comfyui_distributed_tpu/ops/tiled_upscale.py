@@ -1,0 +1,339 @@
+"""UltimateSDUpscaleDistributed: scatter/gather tiled SD refinement.
+
+Reference: ``distributed_upscale.py:38-704``.  Same node schema (widget order
+``[seed, control, steps, cfg, sampler_name, scheduler, denoise, tile_width,
+tile_height, padding, mask_blur, force_uniform_tiles]``) and the same
+capability set, executed three ways:
+
+- **SPMD (mesh) mode** — the TPU-native path: the tile batch is padded to a
+  multiple of the mesh's data-axis size and sharded across it; every device
+  refines its tile shard *as one batched VAE+sampler call* (large MXU
+  matmuls instead of the reference's per-tile Python loop), then tiles are
+  gathered and feather-blended in deterministic index order.  Tile
+  assignment needs no communication — the same property the reference
+  exploits when master and workers recompute the partition independently
+  (``distributed_upscale.py:143-147``).
+- **Worker (HTTP) mode** — refines its contiguous range
+  (``partition_tiles`` parity) and POSTs tiles to the master with retry
+  and exponential backoff (``send_tile_to_master :606-665``).
+- **Master (HTTP) mode** — refines its range, drains the tile queue with
+  timeouts, blends whatever arrived (partial-results-on-timeout semantics,
+  ``distributed_upscale.py:448-452``).
+
+Per-tile seed is ``seed + tile_idx`` (``:380``), so results are independent
+of which participant processed a tile — the distributed and single-device
+paths are bit-identical oracles of each other.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from comfyui_distributed_tpu.ops import tiling
+from comfyui_distributed_tpu.ops.base import (
+    CONTROL,
+    Conditioning,
+    Op,
+    OpContext,
+    as_image_array,
+    register_op,
+)
+from comfyui_distributed_tpu.parallel import collectives as coll
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils.image import decode_png, encode_png, resize_image
+from comfyui_distributed_tpu.utils.logging import Timer, debug_log, log
+from comfyui_distributed_tpu.utils.net import get_client_session, run_async_in_loop
+
+
+@register_op
+class UltimateSDUpscaleDistributed(Op):
+    TYPE = "UltimateSDUpscaleDistributed"
+    WIDGETS = ["seed", CONTROL, "steps", "cfg", "sampler_name", "scheduler",
+               "denoise", "tile_width", "tile_height", "padding", "mask_blur",
+               "force_uniform_tiles"]
+    DEFAULTS = {"steps": 20, "cfg": 8.0, "denoise": 0.5, "tile_width": 512,
+                "tile_height": 512, "padding": 32, "mask_blur": 8,
+                "force_uniform_tiles": True}
+    # tile_indices is accepted-but-unused, mirroring the reference schema
+    # ("Unused - kept for compatibility", distributed_upscale.py:77):
+    # workers always recompute their partition from (enabled_worker_ids,
+    # worker_id) — assignment needs no communication.
+    HIDDEN = ["multi_job_id", "is_worker", "master_url",
+              "enabled_worker_ids", "worker_id", "tile_indices"]
+
+    def execute(self, ctx: OpContext, upscaled_image, model,
+                positive: Conditioning, negative: Conditioning, vae,
+                seed, steps, cfg, sampler_name, scheduler, denoise,
+                tile_width, tile_height, padding, mask_blur,
+                force_uniform_tiles=True, multi_job_id="", is_worker=None,
+                master_url="", enabled_worker_ids="[]", worker_id="",
+                tile_indices=""):
+        ctx.check_interrupt()
+        image = as_image_array(upscaled_image)
+        tile_w = tiling.round_to_multiple(int(tile_width))
+        tile_h = tiling.round_to_multiple(int(tile_height))
+        seed = int(seed)
+        params = dict(seed=seed, steps=int(steps), cfg=float(cfg),
+                      sampler_name=str(sampler_name),
+                      scheduler=str(scheduler), denoise=float(denoise),
+                      tile_w=tile_w, tile_h=tile_h, padding=int(padding),
+                      mask_blur=int(mask_blur))
+        is_worker = ctx.is_worker if is_worker is None else is_worker
+
+        if multi_job_id and is_worker:
+            return self._run_worker(ctx, image, model, positive, negative,
+                                    params, multi_job_id,
+                                    master_url or ctx.master_url,
+                                    worker_id or ctx.worker_id,
+                                    enabled_worker_ids)
+        if multi_job_id:
+            return self._run_master_http(ctx, image, model, positive,
+                                         negative, params, multi_job_id,
+                                         enabled_worker_ids)
+        return self._run_spmd(ctx, image, model, positive, negative, params)
+
+    # --- shared refinement core --------------------------------------------
+
+    def _refine_batch(self, ctx: OpContext, pipe, tiles: np.ndarray,
+                      tile_indices: Sequence[int], positive: Conditioning,
+                      negative: Conditioning, p: Dict[str, Any],
+                      shard: bool = False) -> np.ndarray:
+        """VAE-encode -> sample(denoise) -> decode a [N, th, tw, C] tile
+        batch.  Per-tile seed = seed + tile_idx with a fixed fold index so
+        results are layout-independent."""
+        n = tiles.shape[0]
+        seeds = np.asarray([p["seed"] + int(t) for t in tile_indices],
+                           np.uint64)
+        idx = np.zeros((n,), np.uint32)  # each tile is its own batch-of-1
+        ctx_arr = jnp.repeat(positive.context, n, axis=0)
+        unc_arr = jnp.repeat(negative.context, n, axis=0)
+        tiles_dev = jnp.asarray(tiles)
+        if shard and ctx.runtime is not None:
+            mesh = ctx.runtime.mesh
+            tiles_dev = coll.shard_batch(tiles, mesh)
+            ctx_arr = coll.shard_batch(np.asarray(ctx_arr), mesh)
+            unc_arr = coll.shard_batch(np.asarray(unc_arr), mesh)
+        lat = pipe.vae_encode(tiles_dev)
+        out_lat = pipe.sample(
+            lat, ctx_arr, unc_arr, seeds,
+            steps=p["steps"], cfg=p["cfg"], sampler_name=p["sampler_name"],
+            scheduler=p["scheduler"], denoise=p["denoise"],
+            add_noise=True, sample_idx=idx)
+        return np.asarray(pipe.vae_decode(out_lat))
+
+    def _blend_all(self, image: np.ndarray,
+                   refined: Dict[int, np.ndarray],
+                   all_tiles: List[Tuple[int, int]],
+                   p: Dict[str, Any]) -> np.ndarray:
+        """Deterministic index-order feathered blend of refined tiles into a
+        copy of the base image (timed-out/missing tiles keep base pixels —
+        the reference's partial-result semantics)."""
+        h, w = image.shape[1:3]
+        tw, th, pad = p["tile_w"], p["tile_h"], p["padding"]
+        canvas = image[0].copy()
+        full_w, full_h = tw + 2 * pad, th + 2 * pad
+        for tile_idx in sorted(refined):
+            x, y = all_tiles[tile_idx]
+            x1, y1, x2, y2 = tiling.extraction_region(x, y, tw, th, pad, w, h)
+            tile = refined[tile_idx]
+            if pad > 0:
+                # back to full padded-window size, then crop the clamped
+                # extraction region (reference resizes to extracted size)
+                tile = resize_image(tile[None], full_w, full_h)[0]
+                ox = x1 - (x - pad)
+                oy = y1 - (y - pad)
+                tile = tile[oy:oy + (y2 - y1), ox:ox + (x2 - x1), :]
+            canvas = tiling.blend_tile(
+                canvas, tile, x1, y1, (x, y), tw, th,
+                (x2 - x1, y2 - y1), p["mask_blur"])
+        return np.clip(canvas, 0.0, 1.0)[None]
+
+    # --- SPMD path ----------------------------------------------------------
+
+    def _run_spmd(self, ctx: OpContext, image: np.ndarray, pipe,
+                  positive, negative, p) -> Tuple:
+        h, w = image.shape[1:3]
+        all_tiles = tiling.calculate_tiles(w, h, p["tile_w"], p["tile_h"])
+        total = len(all_tiles)
+        d = max(ctx.fanout, 1)
+        padded_total = coll.pad_to_multiple(total, d) if d > 1 else total
+        positions = list(all_tiles) + [all_tiles[0]] * (padded_total - total)
+        indices = list(range(total)) + [0] * (padded_total - total)
+
+        log(f"tiled upscale: {total} tiles ({w}x{h}, {p['tile_w']}x"
+            f"{p['tile_h']}+{p['padding']}) over {d} mesh slot(s)"
+            + (f", padded to {padded_total}" if padded_total != total else ""))
+        with Timer("tile_extract"):
+            tiles = tiling.extract_tiles(image, positions, p["tile_w"],
+                                         p["tile_h"], p["padding"])
+        with Timer("tile_refine"):
+            refined = self._refine_batch(ctx, pipe, tiles, indices,
+                                         positive, negative, p,
+                                         shard=(d > 1))
+        with Timer("tile_blend"):
+            out = self._blend_all(
+                image, {i: refined[k] for k, i in enumerate(indices)
+                        if k < total}, all_tiles, p)
+        return (out,)
+
+    # --- worker HTTP path ---------------------------------------------------
+
+    def _run_worker(self, ctx: OpContext, image, pipe, positive, negative,
+                    p, multi_job_id, master_url, worker_id,
+                    enabled_worker_ids) -> Tuple:
+        h, w = image.shape[1:3]
+        all_tiles = tiling.calculate_tiles(w, h, p["tile_w"], p["tile_h"])
+        workers = [str(x) for x in json.loads(enabled_worker_ids or "[]")]
+        try:
+            w_index = workers.index(str(worker_id))
+        except ValueError:
+            log(f"tiled upscale worker: {worker_id!r} not in enabled list "
+                f"{workers}; nothing to do")
+            return (image,)
+        parts = tiling.partition_tiles(len(all_tiles), len(workers))
+        mine = parts[1 + w_index]
+        if not mine:
+            return (image,)
+        debug_log(f"worker {worker_id}: tiles {mine[0]}..{mine[-1]}")
+        tiles = tiling.extract_tiles(image, [all_tiles[i] for i in mine],
+                                     p["tile_w"], p["tile_h"], p["padding"])
+        refined = self._refine_batch(ctx, pipe, tiles, mine,
+                                     positive, negative, p)
+        self._send_tiles(ctx, refined, mine, all_tiles, p, multi_job_id,
+                         master_url, worker_id, (w, h))
+        return (image,)
+
+    def _send_tiles(self, ctx: OpContext, refined: np.ndarray,
+                    indices: Sequence[int], all_tiles, p, multi_job_id,
+                    master_url, worker_id, img_size) -> None:
+        w, h = img_size
+
+        async def send_all():
+            import aiohttp
+            session = await get_client_session()
+            for k, tile_idx in enumerate(indices):
+                x, y = all_tiles[tile_idx]
+                x1, y1, x2, y2 = tiling.extraction_region(
+                    x, y, p["tile_w"], p["tile_h"], p["padding"], w, h)
+                form = aiohttp.FormData()
+                form.add_field("multi_job_id", multi_job_id)
+                form.add_field("worker_id", str(worker_id))
+                form.add_field("tile_idx", str(tile_idx))
+                form.add_field("x", str(x1))
+                form.add_field("y", str(y1))
+                form.add_field("extracted_width", str(x2 - x1))
+                form.add_field("extracted_height", str(y2 - y1))
+                form.add_field("padding", str(p["padding"]))
+                form.add_field("is_last",
+                               "true" if k == len(indices) - 1 else "false")
+                form.add_field("tile", encode_png(refined[k:k + 1]),
+                               filename=f"tile_{tile_idx}.png",
+                               content_type="image/png")
+                # 5-attempt exponential backoff; retry 404 (queue-not-ready
+                # race) — reference distributed_upscale.py:618-665
+                delay = C.SEND_BACKOFF_BASE
+                for attempt in range(C.SEND_MAX_RETRIES):
+                    try:
+                        async with session.post(
+                                f"{master_url}/distributed/tile_complete",
+                                data=form, timeout=aiohttp.ClientTimeout(
+                                    total=C.TILE_TRANSFER_TIMEOUT)) as resp:
+                            if resp.status == 200:
+                                break
+                            body = await resp.text()
+                            raise RuntimeError(
+                                f"tile_complete {resp.status}: {body[:100]}")
+                    except Exception as e:
+                        if attempt == C.SEND_MAX_RETRIES - 1:
+                            raise
+                        debug_log(f"tile send retry {attempt + 1}: {e}")
+                        await asyncio.sleep(delay)
+                        delay = min(delay * 2, C.SEND_BACKOFF_CAP)
+
+        if ctx.server_loop is not None:
+            run_async_in_loop(send_all(), ctx.server_loop,
+                              timeout=C.TILE_SEND_TIMEOUT * len(indices))
+        else:
+            asyncio.run(send_all())
+        log(f"worker {worker_id}: sent {len(indices)} tiles for "
+            f"{multi_job_id}")
+
+    # --- master HTTP path ---------------------------------------------------
+
+    def _run_master_http(self, ctx: OpContext, image, pipe, positive,
+                         negative, p, multi_job_id,
+                         enabled_worker_ids) -> Tuple:
+        h, w = image.shape[1:3]
+        all_tiles = tiling.calculate_tiles(w, h, p["tile_w"], p["tile_h"])
+        workers = [str(x) for x in json.loads(enabled_worker_ids or "[]")]
+        if not workers:
+            return self._run_spmd(ctx, image, pipe, positive, negative, p)
+        parts = tiling.partition_tiles(len(all_tiles), len(workers))
+        mine = parts[0]
+        active_workers = sum(1 for part in parts[1:] if part)
+
+        refined: Dict[int, np.ndarray] = {}
+        if mine:
+            tiles = tiling.extract_tiles(image,
+                                         [all_tiles[i] for i in mine],
+                                         p["tile_w"], p["tile_h"],
+                                         p["padding"])
+            out = self._refine_batch(ctx, pipe, tiles, mine,
+                                     positive, negative, p)
+            refined.update({i: out[k] for k, i in enumerate(mine)})
+
+        if active_workers and ctx.job_store is not None:
+            collected = self._collect_tiles(ctx, multi_job_id, active_workers)
+            for tile_idx, item in collected.items():
+                # worker tiles arrive at extracted size; store at window size
+                refined[int(tile_idx)] = self._worker_tile_to_window(
+                    item, all_tiles[int(tile_idx)], p, (w, h))
+        return (self._blend_all(image, refined, all_tiles, p),)
+
+    def _worker_tile_to_window(self, item, pos, p, img_size) -> np.ndarray:
+        """Re-inflate an extracted-size worker tile to the uniform padded
+        window (edge-replicated) so _blend_all can treat all tiles alike."""
+        w, h = img_size
+        x, y = pos
+        tw, th, pad = p["tile_w"], p["tile_h"], p["padding"]
+        x1, y1, x2, y2 = tiling.extraction_region(x, y, tw, th, pad, w, h)
+        tile = np.asarray(item["tensor"], np.float32)
+        if tile.ndim == 4:
+            tile = tile[0]
+        want_w, want_h = x2 - x1, y2 - y1
+        if (tile.shape[1], tile.shape[0]) != (want_w, want_h):
+            tile = resize_image(tile[None], want_w, want_h)[0]
+        ox, oy = x1 - (x - pad), y1 - (y - pad)
+        full_h, full_w = th + 2 * pad, tw + 2 * pad
+        return np.pad(tile, ((oy, full_h - oy - want_h),
+                             (ox, full_w - ox - want_w), (0, 0)),
+                      mode="edge")
+
+    def _collect_tiles(self, ctx: OpContext, multi_job_id: str,
+                       num_workers: int) -> Dict[int, Any]:
+        async def drain():
+            q = await ctx.job_store.get_tile_queue(multi_job_id)
+            collected: Dict[int, Any] = {}
+            done = set()
+            while len(done) < num_workers:
+                try:
+                    item = await asyncio.wait_for(
+                        q.get(), timeout=C.TILE_WAIT_TIMEOUT)
+                except asyncio.TimeoutError:
+                    log("tiled upscale master: timeout waiting for tiles; "
+                        "blending partial results")
+                    break
+                collected[int(item["tile_idx"])] = item
+                if item.get("is_last"):
+                    done.add(str(item["worker_id"]))
+            await ctx.job_store.remove_tile_queue(multi_job_id)
+            return collected
+
+        with Timer("tile_collect"):
+            return run_async_in_loop(drain(), ctx.server_loop,
+                                     timeout=C.TILE_COLLECTION_TIMEOUT)

@@ -1,0 +1,155 @@
+"""Tile grid math, masks and feathered blending (pure host-side helpers).
+
+Semantic parity with the reference's tile pipeline
+(``distributed_upscale.py:329-365, 464-605``):
+
+- row-major grid at tile-size steps (``calculate_tiles :468``);
+- contiguous range partition, master-first with remainder spread
+  (``_get_worker_tiles :329``, ``_get_master_tiles :359``);
+- padded extraction resized to tile size for processing
+  (``extract_tile_with_padding :480``);
+- blurred-rectangle mask + alpha composite at the extraction position
+  (``create_tile_mask :543``, ``blend_tile :564``).
+
+The SPMD path replaces the clamped variable-size extraction with a
+fixed-size window over an edge-replicated padded image so every tile has a
+static shape (XLA requirement); the single-device and distributed paths share
+this code, so they remain bit-identical oracles for each other (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+from PIL import Image, ImageDraw, ImageFilter
+
+from comfyui_distributed_tpu.utils.image import resize_image
+
+
+def round_to_multiple(value: int, multiple: int = 8) -> int:
+    """Reference ``round_to_multiple`` (``distributed_upscale.py:464-466``)."""
+    return round(value / multiple) * multiple
+
+
+def calculate_tiles(image_width: int, image_height: int,
+                    tile_width: int, tile_height: int
+                    ) -> List[Tuple[int, int]]:
+    """Row-major (x, y) grid positions (``distributed_upscale.py:468-478``)."""
+    return [(x, y)
+            for y in range(0, image_height, tile_height)
+            for x in range(0, image_width, tile_width)]
+
+
+def partition_tiles(total_tiles: int, num_workers: int
+                    ) -> List[List[int]]:
+    """Contiguous tile-index ranges for [master, worker_0, ... worker_N-1].
+
+    Exactly the reference's distribution math (``_get_master_tiles :359``,
+    ``_get_worker_tiles :329``): everyone gets ``total // (N+1)``; the master
+    takes one extra if there is any remainder; workers with index < rem-1
+    take one extra each."""
+    n_parts = num_workers + 1
+    per = total_tiles // n_parts
+    rem = total_tiles % n_parts
+    master_count = per + (1 if rem > 0 else 0)
+    parts = [list(range(0, min(master_count, total_tiles)))]
+    for i in range(num_workers):
+        start = master_count + i * per
+        if i < rem - 1:
+            start += i
+            end = start + per + 1
+        else:
+            start += max(rem - 1, 0)
+            end = start + per
+        end = min(end, total_tiles)
+        start = min(start, total_tiles)
+        parts.append(list(range(start, end)))
+    return parts
+
+
+def extraction_region(x: int, y: int, tile_w: int, tile_h: int,
+                      padding: int, width: int, height: int
+                      ) -> Tuple[int, int, int, int]:
+    """Clamped padded extraction bounds (x1, y1, x2, y2) — reference
+    ``extract_tile_with_padding`` (``distributed_upscale.py:480-497``)."""
+    x1 = max(0, x - padding)
+    y1 = max(0, y - padding)
+    x2 = min(width, x + tile_w + padding)
+    y2 = min(height, y + tile_h + padding)
+    return x1, y1, x2, y2
+
+
+def pad_image_for_tiles(image: np.ndarray, tile_w: int, tile_h: int,
+                        padding: int) -> Tuple[np.ndarray, int, int]:
+    """Edge-replicate pad so every grid tile has a full static-size
+    ``(tile+2*padding)`` window (the XLA-friendly equivalent of the
+    reference's clamped variable-size extraction)."""
+    b, h, w, c = image.shape
+    n_cols = -(-w // tile_w)
+    n_rows = -(-h // tile_h)
+    pad_r = n_cols * tile_w - w + padding
+    pad_b = n_rows * tile_h - h + padding
+    padded = np.pad(image, ((0, 0), (padding, pad_b), (padding, pad_r),
+                            (0, 0)), mode="edge")
+    return padded, padding, padding  # offsets of original (0,0) in padded
+
+
+def extract_tiles(image: np.ndarray, positions: Sequence[Tuple[int, int]],
+                  tile_w: int, tile_h: int, padding: int,
+                  resize_method: str = "lanczos") -> np.ndarray:
+    """Extract fixed-size padded windows for the given positions and resize
+    to processing size (tile_w, tile_h).  Returns [N, tile_h, tile_w, C]."""
+    padded, ox, oy = pad_image_for_tiles(image, tile_w, tile_h, padding)
+    windows = []
+    for (x, y) in positions:
+        x1 = x + ox - padding
+        y1 = y + oy - padding
+        win = padded[0, y1:y1 + tile_h + 2 * padding,
+                     x1:x1 + tile_w + 2 * padding, :]
+        windows.append(win)
+    stack = np.stack(windows, axis=0)
+    if padding > 0:
+        stack = resize_image(stack, tile_w, tile_h, resize_method)
+    return stack.astype(np.float32)
+
+
+def create_tile_mask(image_width: int, image_height: int, x: int, y: int,
+                     tile_w: int, tile_h: int, mask_blur: int) -> np.ndarray:
+    """Blurred white rectangle, full-image size, float [H, W] in [0, 1]
+    (reference ``create_tile_mask``, ``distributed_upscale.py:543-562`` —
+    PIL GaussianBlur for identical feathering)."""
+    mask = Image.new("L", (image_width, image_height), 0)
+    ImageDraw.Draw(mask).rectangle(
+        [x, y, x + tile_w, y + tile_h], fill=255)
+    if mask_blur > 0:
+        mask = mask.filter(ImageFilter.GaussianBlur(mask_blur))
+    return np.asarray(mask, dtype=np.float32) / 255.0
+
+
+def blend_tile(canvas: np.ndarray, tile: np.ndarray, x: int, y: int,
+               tile_pos: Tuple[int, int], tile_w: int, tile_h: int,
+               extracted_size: Tuple[int, int], mask_blur: int,
+               resize_method: str = "lanczos") -> np.ndarray:
+    """Alpha-composite one processed tile into the full-size canvas.
+
+    ``(x, y)`` is the extraction position, ``tile_pos`` the grid position the
+    mask rectangle sits at — mirroring the reference's blend call
+    (``distributed_upscale.py:386-390``: mask at grid pos, paste at extract
+    pos).  canvas: [H, W, C]; tile: [th, tw, C]."""
+    h, w, _ = canvas.shape
+    ew, eh = extracted_size
+    if (tile.shape[1], tile.shape[0]) != (ew, eh):
+        tile = resize_image(tile[None], ew, eh, resize_method)[0]
+    mask = create_tile_mask(w, h, tile_pos[0], tile_pos[1],
+                            tile_w, tile_h, mask_blur)
+    # effective alpha is the mask restricted to the pasted region (PIL's
+    # putalpha+paste dance, distributed_upscale.py:589-600)
+    x2 = min(x + ew, w)
+    y2 = min(y + eh, h)
+    region_mask = mask[y:y2, x:x2, None]
+    region_tile = tile[: y2 - y, : x2 - x, :]
+    out = canvas.copy()
+    out[y:y2, x:x2, :] = (region_tile * region_mask
+                          + canvas[y:y2, x:x2, :] * (1.0 - region_mask))
+    return out

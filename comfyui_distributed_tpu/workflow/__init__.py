@@ -1,0 +1,10 @@
+"""Workflow engine: ComfyUI-format graph parsing, execution, dispatch."""
+
+from comfyui_distributed_tpu.workflow.graph import (  # noqa: F401
+    Graph,
+    parse_workflow,
+)
+from comfyui_distributed_tpu.workflow.executor import (  # noqa: F401
+    ExecutionResult,
+    WorkflowExecutor,
+)

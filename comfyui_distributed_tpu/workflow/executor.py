@@ -1,0 +1,105 @@
+"""Workflow executor: topo-ordered op execution over the mesh runtime.
+
+The replacement for ComfyUI's graph executor plus the reference's
+browser-side fan-out (``gpupanel.js:836-941``): where the reference dispatches
+a pruned copy of the graph to every worker process, this executor runs the
+graph once and lets the distributed ops expand/shard the batch over the mesh
+(SPMD mode).  The HTTP worker/master modes reuse the same executor with
+different context flags — the dispatcher module prepares those graphs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from comfyui_distributed_tpu.ops.base import Op, OpContext, get_op
+from comfyui_distributed_tpu.workflow.graph import Graph, parse_workflow
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+
+DISTRIBUTED_TYPES = ("DistributedCollector", "UltimateSDUpscaleDistributed")
+
+
+@dataclasses.dataclass
+class ExecutionResult:
+    outputs: Dict[str, Tuple]            # node id -> op outputs
+    images: List[np.ndarray]             # all Preview/Save collected images
+    timings: Dict[str, float]            # node id -> seconds
+    total_s: float = 0.0
+
+    @property
+    def image_batch(self) -> Optional[np.ndarray]:
+        if not self.images:
+            return None
+        return np.stack(self.images, axis=0)
+
+
+class WorkflowExecutor:
+    def __init__(self, ctx: Optional[OpContext] = None):
+        self.ctx = ctx or OpContext()
+
+    def _decide_fanout(self, graph: Graph) -> int:
+        """Distributed path only when the graph contains a distributed node
+        and this process is the master — mirroring the browser interceptor's
+        routing condition (reference ``gpupanel.js:826-833``)."""
+        if self.ctx.is_worker:
+            return 1
+        if not graph.find_by_type(*DISTRIBUTED_TYPES):
+            return 1
+        if self.ctx.runtime is None:
+            return 1
+        return max(self.ctx.runtime.num_participants, 1)
+
+    def execute(self, workflow: Any,
+                hidden: Optional[Dict[str, Dict[str, Any]]] = None
+                ) -> ExecutionResult:
+        """Run a workflow (path/JSON/dict/Graph).  ``hidden`` optionally maps
+        node id -> hidden-input overrides (the dispatcher's injections)."""
+        graph = workflow if isinstance(workflow, Graph) \
+            else parse_workflow(workflow)
+        hidden = hidden or {}
+        # fresh per-run collection state (assign, don't clear — prior
+        # ExecutionResults keep their own lists)
+        self.ctx.saved_images = []
+        self.ctx.fanout = self._decide_fanout(graph)
+        if self.ctx.fanout > 1:
+            log(f"distributed run: fan-out x{self.ctx.fanout} over mesh "
+                f"data axis")
+
+        outputs: Dict[str, Tuple] = {}
+        timings: Dict[str, float] = {}
+        t_start = time.perf_counter()
+
+        for nid in graph.topo_order():
+            node = graph.nodes[nid]
+            op = get_op(node.class_type)
+            kwargs: Dict[str, Any] = {}
+            for name, value in node.inputs.items():
+                if name == "__widgets__":
+                    continue
+                if isinstance(value, (list, tuple)) and len(value) == 2 \
+                        and not isinstance(value[0], (list, dict)) \
+                        and isinstance(value[1], int) \
+                        and str(value[0]) in graph.nodes:
+                    src, slot = str(value[0]), int(value[1])
+                    kwargs[name] = outputs[src][slot]
+                else:
+                    kwargs[name] = value
+            # hidden inputs: graph-embedded first, then per-run overrides
+            for hname, hval in {**node.hidden,
+                                **hidden.get(nid, {})}.items():
+                if hname in op.HIDDEN:
+                    kwargs[hname] = hval
+            debug_log(f"exec node {nid} ({node.class_type})")
+            t0 = time.perf_counter()
+            outputs[nid] = op.execute(self.ctx, **kwargs)
+            timings[nid] = time.perf_counter() - t0
+
+        total = time.perf_counter() - t_start
+        self.ctx.node_timings.update(timings)
+        return ExecutionResult(outputs=outputs,
+                               images=list(self.ctx.saved_images),
+                               timings=timings, total_s=total)

@@ -1,0 +1,192 @@
+"""Workflow graph parsing.
+
+Accepts both ComfyUI JSON forms so the reference's workflow files run
+unchanged (BASELINE.json: "the existing distributed-txt2img and
+distributed-upscale workflows run unchanged"):
+
+- **UI format** (what ``workflows/*.json`` are): ``{nodes: [...], links:
+  [...]}`` with positional ``widgets_values`` — widget order comes from each
+  op's ``WIDGETS`` declaration (including control slots like "randomize").
+- **API format** (what the reference's browser dispatcher POSTs to
+  ``/prompt``): ``{node_id: {class_type, inputs: {...}}}`` where link inputs
+  are ``[src_id, slot]`` pairs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from comfyui_distributed_tpu.ops.base import CONTROL, NODE_CLASS_MAPPINGS
+
+Link = Tuple[str, int]  # (source node id, output slot)
+
+
+@dataclasses.dataclass
+class Node:
+    id: str
+    class_type: str
+    inputs: Dict[str, Any]          # name -> literal or Link
+    hidden: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def link_inputs(self) -> Dict[str, Link]:
+        return {k: tuple(v) for k, v in self.inputs.items() if _is_link(v)}
+
+
+def _is_link(v: Any) -> bool:
+    return (isinstance(v, (list, tuple)) and len(v) == 2
+            and isinstance(v[1], int) and not isinstance(v[0], (list, dict)))
+
+
+@dataclasses.dataclass
+class Graph:
+    nodes: Dict[str, Node]
+
+    def to_api_format(self) -> Dict[str, Any]:
+        out = {}
+        for nid, n in self.nodes.items():
+            entry: Dict[str, Any] = {"class_type": n.class_type,
+                                     "inputs": dict(n.inputs)}
+            if n.hidden:
+                entry["hidden"] = dict(n.hidden)
+            out[nid] = entry
+        return out
+
+    def find_by_type(self, *types: str) -> List[str]:
+        return [nid for nid, n in self.nodes.items()
+                if n.class_type in types]
+
+    def consumers(self, node_id: str) -> List[str]:
+        out = []
+        for nid, n in self.nodes.items():
+            for v in n.inputs.values():
+                if _is_link(v) and str(v[0]) == str(node_id):
+                    out.append(nid)
+                    break
+        return out
+
+    def topo_order(self) -> List[str]:
+        """Dependency order; raises on cycles."""
+        state: Dict[str, int] = {}
+        order: List[str] = []
+
+        def visit(nid: str):
+            st = state.get(nid, 0)
+            if st == 1:
+                raise ValueError(f"workflow graph has a cycle at node {nid}")
+            if st == 2:
+                return
+            state[nid] = 1
+            node = self.nodes.get(nid)
+            if node is None:
+                raise KeyError(f"node {nid} referenced but not defined")
+            for src, _slot in node.link_inputs().values():
+                visit(str(src))
+            state[nid] = 2
+            order.append(nid)
+
+        for nid in self.nodes:
+            visit(nid)
+        return order
+
+
+def _widgets_to_inputs(class_type: str,
+                       widgets_values: Optional[list]) -> Dict[str, Any]:
+    cls = NODE_CLASS_MAPPINGS.get(class_type)
+    inputs: Dict[str, Any] = {}
+    if cls is None:
+        return {"__widgets__": widgets_values}
+    if cls.DEFAULTS:
+        inputs.update(cls.DEFAULTS)
+    if not widgets_values:
+        return inputs
+    if isinstance(widgets_values, dict):
+        inputs.update(widgets_values)
+        return inputs
+    names = cls.WIDGETS
+    for name, value in zip(names, widgets_values):
+        if name != CONTROL:
+            inputs[name] = value
+    return inputs
+
+
+def parse_ui_format(doc: Dict[str, Any]) -> Graph:
+    links: Dict[int, Tuple[str, int, str]] = {}
+    for l in doc.get("links", []) or []:
+        # [link_id, src_node, src_slot, dst_node, dst_slot, type]
+        links[int(l[0])] = (str(l[1]), int(l[2]), str(l[5]) if len(l) > 5
+                            else "")
+
+    raw_nodes = {str(n["id"]): n for n in doc.get("nodes", [])}
+    bypassed = {nid for nid, n in raw_nodes.items() if n.get("mode") == 4}
+    muted = {nid for nid, n in raw_nodes.items() if n.get("mode") == 2}
+
+    def resolve(src: str, slot: int, want_type: str) -> Optional[Tuple[str, int]]:
+        """Follow bypassed nodes to their type-matching upstream input
+        (ComfyUI bypass semantics: inputs pass through to same-typed
+        outputs).  Muted nodes terminate the link."""
+        seen = set()
+        while src in bypassed:
+            if src in seen:
+                return None
+            seen.add(src)
+            n = raw_nodes[src]
+            outs = n.get("outputs", []) or []
+            otype = (outs[slot].get("type", want_type)
+                     if slot < len(outs) else want_type)
+            nxt = None
+            for inp in n.get("inputs", []) or []:
+                lid = inp.get("link")
+                if lid is not None and int(lid) in links \
+                        and inp.get("type", "") == otype:
+                    nxt = links[int(lid)]
+                    break
+            if nxt is None:
+                return None
+            src, slot = nxt[0], nxt[1]
+        if src in muted:
+            return None
+        return src, slot
+
+    nodes: Dict[str, Node] = {}
+    for nid, n in raw_nodes.items():
+        if nid in bypassed or nid in muted:
+            continue
+        inputs = _widgets_to_inputs(n["type"], n.get("widgets_values"))
+        for inp in n.get("inputs", []) or []:
+            link_id = inp.get("link")
+            if link_id is not None and int(link_id) in links:
+                src, slot, ltype = links[int(link_id)]
+                resolved = resolve(src, slot, inp.get("type", ltype))
+                if resolved is not None:
+                    inputs[inp["name"]] = [resolved[0], resolved[1]]
+        nodes[nid] = Node(id=nid, class_type=n["type"], inputs=inputs)
+    return Graph(nodes=nodes)
+
+
+def parse_api_format(doc: Dict[str, Any]) -> Graph:
+    nodes: Dict[str, Node] = {}
+    for nid, entry in doc.items():
+        cls = NODE_CLASS_MAPPINGS.get(entry["class_type"])
+        inputs = dict(cls.DEFAULTS) if cls and cls.DEFAULTS else {}
+        raw = dict(entry.get("inputs", {}))
+        for k, v in raw.items():
+            inputs[k] = [str(v[0]), int(v[1])] if _is_link(v) else v
+        nodes[str(nid)] = Node(id=str(nid), class_type=entry["class_type"],
+                               inputs=inputs,
+                               hidden=dict(entry.get("hidden", {})))
+    return Graph(nodes=nodes)
+
+
+def parse_workflow(doc: Union[str, Dict[str, Any]]) -> Graph:
+    """Parse a workflow from a JSON string/path/dict, either format."""
+    if isinstance(doc, str):
+        if doc.lstrip().startswith("{"):
+            doc = json.loads(doc)
+        else:
+            with open(doc, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+    if "nodes" in doc and isinstance(doc.get("nodes"), list):
+        return parse_ui_format(doc)
+    return parse_api_format(doc)

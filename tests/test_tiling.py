@@ -1,0 +1,107 @@
+"""Tile math parity invariants + blend properties (SURVEY.md §4 unit list)."""
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.ops import tiling
+
+
+class TestGrid:
+    def test_round_to_multiple(self):
+        assert tiling.round_to_multiple(512) == 512
+        assert tiling.round_to_multiple(500) == 496  # python round(): 62.5->62
+        assert tiling.round_to_multiple(515) == 512
+        assert tiling.round_to_multiple(517) == 520
+
+    def test_calculate_tiles_row_major(self):
+        assert tiling.calculate_tiles(1024, 512, 512, 512) == \
+            [(0, 0), (512, 0)]
+        assert tiling.calculate_tiles(1024, 1024, 512, 512) == \
+            [(0, 0), (512, 0), (0, 512), (512, 512)]
+
+    def test_calculate_tiles_ragged_edge(self):
+        # 1000px with 512 tiles -> positions 0 and 512 (edge tile hangs over)
+        tiles = tiling.calculate_tiles(1000, 512, 512, 512)
+        assert tiles == [(0, 0), (512, 0)]
+
+
+class TestPartition:
+    @pytest.mark.parametrize("total,workers", [
+        (10, 2), (11, 2), (12, 3), (7, 3), (4, 7), (1, 3), (64, 7), (256, 63),
+    ])
+    def test_partition_invariants(self, total, workers):
+        """Partition of [0, total): disjoint, contiguous, master-first,
+        concatenation in order reconstructs range(total)."""
+        parts = tiling.partition_tiles(total, workers)
+        assert len(parts) == workers + 1
+        flat = [i for part in parts for i in part]
+        assert flat == list(range(total))
+        for part in parts:
+            if part:
+                assert part == list(range(part[0], part[-1] + 1))
+
+    def test_reference_examples(self):
+        # worked examples matching the reference's arithmetic
+        # (_get_master_tiles/_get_worker_tiles, distributed_upscale.py:329-365)
+        parts = tiling.partition_tiles(10, 2)
+        assert parts[0] == [0, 1, 2, 3]          # master: per+1 (rem>0)
+        assert parts[1] == [4, 5, 6]
+        assert parts[2] == [7, 8, 9]
+        parts = tiling.partition_tiles(12, 3)     # rem = 0
+        assert [len(p) for p in parts] == [3, 3, 3, 3]
+
+    def test_more_workers_than_tiles(self):
+        parts = tiling.partition_tiles(2, 7)
+        flat = [i for p in parts for i in p]
+        assert flat == [0, 1]
+
+
+class TestExtraction:
+    def test_extraction_region_clamped(self):
+        assert tiling.extraction_region(0, 0, 64, 64, 16, 256, 256) == \
+            (0, 0, 80, 80)
+        assert tiling.extraction_region(192, 192, 64, 64, 16, 256, 256) == \
+            (176, 176, 256, 256)
+
+    def test_extract_tiles_static_shape(self, rng):
+        img = rng.random((1, 100, 130, 3), dtype=np.float32)
+        positions = tiling.calculate_tiles(130, 100, 64, 64)
+        tiles = tiling.extract_tiles(img, positions, 64, 64, 16)
+        assert tiles.shape == (len(positions), 64, 64, 3)
+
+    def test_extract_no_padding_exact_content(self, rng):
+        img = rng.random((1, 128, 128, 3), dtype=np.float32)
+        tiles = tiling.extract_tiles(img, [(0, 0), (64, 64)], 64, 64, 0)
+        assert np.allclose(tiles[0], img[0, :64, :64])
+        assert np.allclose(tiles[1], img[0, 64:, 64:])
+
+
+class TestMaskBlend:
+    def test_mask_shape_and_range(self):
+        m = tiling.create_tile_mask(128, 96, 32, 32, 64, 32, 8)
+        assert m.shape == (96, 128)
+        assert 0.0 <= m.min() and m.max() <= 1.0
+        assert m[48, 64] > 0.9           # tile interior ~white (blur=8)
+        assert m[5, 5] < 0.01            # far corner black
+
+    def test_mask_no_blur_is_binary(self):
+        m = tiling.create_tile_mask(64, 64, 16, 16, 32, 32, 0)
+        assert set(np.unique(m)).issubset({0.0, 1.0})
+
+    def test_blend_identity_outside_mask(self, rng):
+        canvas = rng.random((96, 96, 3), dtype=np.float32)
+        tile = rng.random((32, 32, 3), dtype=np.float32)
+        out = tiling.blend_tile(canvas, tile, 32, 32, (32, 32), 32, 32,
+                                (32, 32), mask_blur=0)
+        assert np.allclose(out[:32, :, :], canvas[:32, :, :])  # untouched rows
+        assert np.allclose(out[32:64, 32:64, :], tile)         # replaced
+
+    def test_blend_feather_interpolates(self, rng):
+        canvas = np.zeros((96, 96, 3), np.float32)
+        tile = np.ones((32, 32, 3), np.float32)
+        out = tiling.blend_tile(canvas, tile, 32, 32, (32, 32), 32, 32,
+                                (32, 32), mask_blur=4)
+        center = out[48, 48, 0]
+        edge = out[33, 48, 0]
+        assert center > 0.95
+        assert 0.0 < edge <= 1.0

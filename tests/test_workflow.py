@@ -1,0 +1,181 @@
+"""Workflow engine: parsing the reference JSONs, execution, SPMD fan-out."""
+
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import registry
+from comfyui_distributed_tpu.ops.base import OpContext
+from comfyui_distributed_tpu.parallel import mesh as mesh_mod
+from comfyui_distributed_tpu.workflow import WorkflowExecutor, parse_workflow
+
+TXT2IMG = "/root/reference/workflows/distributed-txt2img.json"
+UPSCALE = "/root/reference/workflows/distributed-upscale.json"
+
+
+@pytest.fixture(autouse=True)
+def tiny_family(monkeypatch):
+    monkeypatch.setenv(registry.FAMILY_ENV, "tiny")
+    yield
+
+
+@pytest.fixture
+def ctx():
+    return OpContext(runtime=mesh_mod.MeshRuntime(mesh=mesh_mod.build_mesh()))
+
+
+class TestParse:
+    def test_txt2img_parses(self):
+        g = parse_workflow(TXT2IMG)
+        assert len(g.nodes) == 9
+        ks = g.nodes["8"]
+        assert ks.class_type == "KSampler"
+        # widget mapping: [seed, control, steps, cfg, sampler, scheduler, den]
+        assert ks.inputs["steps"] == 20
+        assert ks.inputs["cfg"] == 6
+        assert ks.inputs["sampler_name"] == "euler"
+        assert ks.inputs["scheduler"] == "normal"
+        assert ks.inputs["denoise"] == 1
+        # seed widget overridden by link from DistributedSeed (node 4)
+        assert ks.inputs["seed"] == ["4", 0]
+        assert g.nodes["9"].inputs["width"] == 512
+
+    def test_upscale_parses(self):
+        g = parse_workflow(UPSCALE)
+        assert len(g.nodes) == 9
+        up = g.nodes["13"]
+        assert up.inputs["tile_width"] == 512
+        assert up.inputs["padding"] == 32
+        assert up.inputs["mask_blur"] == 16
+        assert up.inputs["force_uniform_tiles"] is True
+        assert abs(up.inputs["denoise"] - 0.24) < 1e-6
+        assert up.inputs["upscaled_image"] == ["17", 0]
+
+    def test_topo_order(self):
+        g = parse_workflow(TXT2IMG)
+        order = g.topo_order()
+        assert order.index("7") < order.index("8")   # ckpt before sampler
+        assert order.index("8") < order.index("1")   # sampler before decode
+        assert order.index("2") < order.index("3")   # collector before preview
+
+    def test_cycle_detection(self):
+        g = parse_workflow(json.dumps({
+            "a": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["b", 0], "vae": ["b", 1]}},
+            "b": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["a", 0], "vae": ["a", 1]}},
+        }))
+        with pytest.raises(ValueError, match="cycle"):
+            g.topo_order()
+
+    def test_bypassed_node_passes_through(self):
+        """Mode-4 (bypass) nodes are removed with links rewired through
+        type-matching inputs — ComfyUI bypass semantics."""
+        doc = json.load(open(TXT2IMG))
+        for n in doc["nodes"]:
+            if n["type"] == "DistributedCollector":
+                n["mode"] = 4
+        g = parse_workflow(doc)
+        assert "2" not in g.nodes
+        # PreviewImage (3) now feeds directly from VAEDecode (1)
+        assert g.nodes["3"].inputs["images"] == ["1", 0]
+
+    def test_muted_node_drops_link(self):
+        doc = json.load(open(TXT2IMG))
+        for n in doc["nodes"]:
+            if n["type"] == "DistributedSeed":
+                n["mode"] = 2
+        g = parse_workflow(doc)
+        assert "4" not in g.nodes
+        # KSampler keeps its widget seed; the dead link is dropped
+        assert isinstance(g.nodes["8"].inputs["seed"], int)
+
+    def test_api_format_round_trip(self):
+        g = parse_workflow(TXT2IMG)
+        api = g.to_api_format()
+        g2 = parse_workflow(json.dumps(api))
+        assert set(g2.nodes) == set(g.nodes)
+        assert g2.nodes["8"].inputs["steps"] == 20
+
+
+def _scaled_txt2img(width=64, height=64, steps=2, batch=1):
+    """Reference txt2img graph with sizes/steps scaled for CPU tests."""
+    g = parse_workflow(TXT2IMG)
+    g.nodes["9"].inputs.update(width=width, height=height, batch_size=batch)
+    g.nodes["8"].inputs.update(steps=steps)
+    return g
+
+
+class TestTxt2ImgE2E:
+    def test_fanout_produces_replica_batch(self, ctx):
+        res = WorkflowExecutor(ctx).execute(_scaled_txt2img())
+        # 8 mesh slots x batch 1, collected master-first
+        assert len(res.images) == 8
+        imgs = np.stack(res.images)
+        assert imgs.shape == (8, 16, 16, 3)  # tiny VAE upscales latent x2
+        # distributed seed => every replica's image differs
+        for i in range(1, 8):
+            assert not np.allclose(imgs[0], imgs[i]), f"replica {i} == master"
+
+    def test_determinism(self, ctx):
+        r1 = WorkflowExecutor(ctx).execute(_scaled_txt2img())
+        ctx2 = OpContext(runtime=ctx.runtime)
+        r2 = WorkflowExecutor(ctx2).execute(_scaled_txt2img())
+        assert np.allclose(np.stack(r1.images), np.stack(r2.images))
+
+    def test_plain_seed_replicates_identically(self, ctx):
+        """Without DistributedSeed all participants produce the same images
+        (reference parity: seed fan-out is what makes replicas differ)."""
+        g = _scaled_txt2img()
+        g.nodes["8"].inputs["seed"] = 1234  # break link, plain int
+        res = WorkflowExecutor(ctx).execute(g)
+        imgs = np.stack(res.images)
+        assert imgs.shape[0] == 8
+        for i in range(1, 8):
+            assert np.allclose(imgs[0], imgs[i], atol=1e-5)
+
+    def test_worker_mode_no_fanout(self):
+        """Worker processes run the graph without batch expansion."""
+        ctx = OpContext(runtime=mesh_mod.MeshRuntime(mesh=mesh_mod.build_mesh()),
+                        is_worker=True, worker_id="worker_2")
+        res = WorkflowExecutor(ctx).execute(_scaled_txt2img())
+        assert len(res.images) == 1
+
+    def test_timings_recorded(self, ctx):
+        res = WorkflowExecutor(ctx).execute(_scaled_txt2img())
+        assert set(res.timings) == set(parse_workflow(TXT2IMG).nodes)
+        assert res.total_s > 0
+
+
+def _scaled_upscale(tile=32, padding=8, blur=2, steps=1):
+    g = parse_workflow(UPSCALE)
+    g.nodes["12"].inputs["image"] = "__missing__.png"   # synthetic test card
+    g.nodes["17"].inputs.update(width=64, height=64)
+    g.nodes["13"].inputs.update(steps=steps, tile_width=tile,
+                                tile_height=tile, padding=padding,
+                                mask_blur=blur)
+    return g
+
+
+class TestUpscaleE2E:
+    def test_distributed_tiled_upscale(self, ctx):
+        res = WorkflowExecutor(ctx).execute(_scaled_upscale())
+        assert len(res.images) == 1
+        out = res.images[0]
+        assert out.shape == (64, 64, 3)
+        assert np.isfinite(out).all()
+
+    def test_spmd_matches_single_device_oracle(self, ctx):
+        """Golden test (SURVEY.md §4): the distributed path must match the
+        single-device path — same per-tile seeds, same blend order."""
+        res_d = WorkflowExecutor(ctx).execute(_scaled_upscale())
+        ctx_s = OpContext(runtime=ctx.runtime)
+        ctx_s.runtime.enabled = False  # num_participants -> 1
+        try:
+            res_s = WorkflowExecutor(ctx_s).execute(_scaled_upscale())
+        finally:
+            ctx.runtime.enabled = True
+        np.testing.assert_allclose(res_d.images[0], res_s.images[0],
+                                   atol=2e-3)
