@@ -1,0 +1,115 @@
+"""Command-line interface.
+
+The headless equivalent of the reference's browser UI panel (L6): serve the
+control plane, run workflows, inspect the mesh, manage workers.
+
+  python -m comfyui_distributed_tpu.cli serve  [--port 8288]
+  python -m comfyui_distributed_tpu.cli worker --port 8289
+  python -m comfyui_distributed_tpu.cli run workflow.json [--out dir]
+  python -m comfyui_distributed_tpu.cli devices
+  python -m comfyui_distributed_tpu.cli status [--url http://...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def cmd_serve(args) -> int:
+    from comfyui_distributed_tpu.server.app import ServerState, serve
+    state = ServerState(config_path=args.config, is_worker=False,
+                        models_dir=args.models_dir)
+    from comfyui_distributed_tpu.runtime.manager import install_exit_hooks
+    install_exit_hooks(state.manager)
+    serve(host=args.host, port=args.port, state=state)
+    return 0
+
+
+def cmd_worker(args) -> int:
+    from comfyui_distributed_tpu.server.app import ServerState, serve
+    state = ServerState(config_path=args.config, is_worker=True,
+                        models_dir=args.models_dir)
+    serve(host=args.host, port=args.port, state=state, auto_launch=False)
+    return 0
+
+
+def cmd_run(args) -> int:
+    from comfyui_distributed_tpu.ops.base import OpContext
+    from comfyui_distributed_tpu.parallel.mesh import get_runtime
+    from comfyui_distributed_tpu.workflow import WorkflowExecutor
+    ctx = OpContext(runtime=get_runtime(), models_dir=args.models_dir,
+                    input_dir=args.input_dir,
+                    output_dir=args.out or os.path.join(os.getcwd(), "output"))
+    res = WorkflowExecutor(ctx).execute(args.workflow)
+    from comfyui_distributed_tpu.utils.image import tensor_to_pil
+    os.makedirs(ctx.output_dir, exist_ok=True)
+    import numpy as np
+    for i, img in enumerate(res.images):
+        tensor_to_pil(np.asarray(img)[None]).save(
+            os.path.join(ctx.output_dir, f"run_{i:05d}.png"))
+    print(json.dumps({
+        "images": len(res.images),
+        "total_s": round(res.total_s, 3),
+        "timings": {k: round(v, 3) for k, v in res.timings.items()},
+        "output_dir": ctx.output_dir,
+    }))
+    return 0
+
+
+def cmd_devices(args) -> int:
+    from comfyui_distributed_tpu.parallel.mesh import describe_devices
+    print(json.dumps(describe_devices(), indent=2))
+    return 0
+
+
+def cmd_status(args) -> int:
+    import urllib.request
+    with urllib.request.urlopen(f"{args.url}/distributed/status",
+                                timeout=5) as r:
+        print(r.read().decode())
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="comfyui_distributed_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--config", default=None)
+        p.add_argument("--models-dir", default=os.environ.get("DTPU_MODELS"))
+
+    p = sub.add_parser("serve", help="run the master control plane")
+    common(p)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8288)
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("worker", help="run a worker server")
+    common(p)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, required=True)
+    p.set_defaults(fn=cmd_worker)
+
+    p = sub.add_parser("run", help="execute a workflow JSON")
+    common(p)
+    p.add_argument("workflow")
+    p.add_argument("--out", default=None)
+    p.add_argument("--input-dir", default=None)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("devices", help="show device topology")
+    p.set_defaults(fn=cmd_devices)
+
+    p = sub.add_parser("status", help="query a running server")
+    p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.set_defaults(fn=cmd_status)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

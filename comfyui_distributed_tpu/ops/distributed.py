@@ -151,20 +151,24 @@ class DistributedCollector(Op):
             q = await ctx.job_store.get_queue(multi_job_id)
             results: Dict[str, List] = {}
             done = set()
-            while len(done) < len(worker_ids):
-                try:
-                    item = await asyncio.wait_for(
-                        q.get(), timeout=C.WORKER_JOB_TIMEOUT)
-                except asyncio.TimeoutError:
-                    missing = set(worker_ids) - done
-                    log(f"collector: timeout, missing workers {missing}; "
-                        f"continuing with partial results")
-                    break
-                wid = str(item["worker_id"])
-                results.setdefault(wid, []).append(
-                    (item.get("image_index", 0), item["tensor"]))
-                if item.get("is_last"):
-                    done.add(wid)
+            try:
+                while len(done) < len(worker_ids):
+                    try:
+                        item = await asyncio.wait_for(
+                            q.get(), timeout=C.WORKER_JOB_TIMEOUT)
+                    except asyncio.TimeoutError:
+                        missing = set(worker_ids) - done
+                        log(f"collector: timeout, missing workers {missing}; "
+                            f"continuing with partial results")
+                        break
+                    wid = str(item["worker_id"])
+                    results.setdefault(wid, []).append(
+                        (item.get("image_index", 0), item["tensor"]))
+                    if item.get("is_last"):
+                        done.add(wid)
+            finally:
+                # drop the queue so late arrivals can't accumulate forever
+                await ctx.job_store.remove_job(multi_job_id)
             return results
 
         with Timer("collector_http_drain"):

@@ -106,24 +106,31 @@ class UltimateSDUpscaleDistributed(Op):
         """VAE-encode -> sample(denoise) -> decode a [N, th, tw, C] tile
         batch.  Per-tile seed = seed + tile_idx with a fixed fold index so
         results are layout-independent."""
+        from comfyui_distributed_tpu.ops.basic import _sdxl_vector_cond
         n = tiles.shape[0]
         seeds = np.asarray([p["seed"] + int(t) for t in tile_indices],
                            np.uint64)
         idx = np.zeros((n,), np.uint32)  # each tile is its own batch-of-1
         ctx_arr = jnp.repeat(positive.context, n, axis=0)
         unc_arr = jnp.repeat(negative.context, n, axis=0)
+        y = None
+        if pipe.family.unet.adm_in_channels is not None:
+            y = _sdxl_vector_cond(pipe, positive, n,
+                                  tiles.shape[1], tiles.shape[2])
         tiles_dev = jnp.asarray(tiles)
         if shard and ctx.runtime is not None:
             mesh = ctx.runtime.mesh
             tiles_dev = coll.shard_batch(tiles, mesh)
             ctx_arr = coll.shard_batch(np.asarray(ctx_arr), mesh)
             unc_arr = coll.shard_batch(np.asarray(unc_arr), mesh)
+            if y is not None:
+                y = coll.shard_batch(np.asarray(y), mesh)
         lat = pipe.vae_encode(tiles_dev)
         out_lat = pipe.sample(
             lat, ctx_arr, unc_arr, seeds,
             steps=p["steps"], cfg=p["cfg"], sampler_name=p["sampler_name"],
             scheduler=p["scheduler"], denoise=p["denoise"],
-            add_noise=True, sample_idx=idx)
+            add_noise=True, sample_idx=idx, y=y)
         return np.asarray(pipe.vae_decode(out_lat))
 
     def _blend_all(self, image: np.ndarray,

@@ -1,0 +1,6 @@
+"""Runtime services: job store, worker process manager, monitors."""
+
+from comfyui_distributed_tpu.runtime.jobs import JobStore  # noqa: F401
+from comfyui_distributed_tpu.runtime.manager import (  # noqa: F401
+    WorkerProcessManager,
+)

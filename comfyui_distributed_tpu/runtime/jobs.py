@@ -1,0 +1,86 @@
+"""Per-job result queues (the HTTP data plane's buffer).
+
+Capability parity with the reference's queue stores: image jobs
+(``distributed.py:1125-1133``) and tile jobs
+(``distributed_upscale.py:27-34``) — per-job ``asyncio.Queue``s created
+*before* dispatch (the prepare-before-dispatch protocol that closes the
+result/startup race, ``distributed.py:366-381``).  The reference attaches
+these to ComfyUI's PromptServer to survive module reloads; here the store is
+owned by the server app directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+
+class JobStore:
+    """Image-job and tile-job queues, asyncio-locked."""
+
+    def __init__(self) -> None:
+        self._jobs: Dict[str, asyncio.Queue] = {}
+        self._tile_jobs: Dict[str, asyncio.Queue] = {}
+        self._lock = asyncio.Lock()
+        self._tile_lock = asyncio.Lock()
+
+    # --- image jobs (reference distributed.py:1125-1218) -------------------
+
+    async def prepare_job(self, multi_job_id: str) -> None:
+        async with self._lock:
+            if multi_job_id not in self._jobs:
+                self._jobs[multi_job_id] = asyncio.Queue()
+
+    async def get_queue(self, multi_job_id: str) -> asyncio.Queue:
+        async with self._lock:
+            if multi_job_id not in self._jobs:
+                self._jobs[multi_job_id] = asyncio.Queue()
+            return self._jobs[multi_job_id]
+
+    async def has_job(self, multi_job_id: str) -> bool:
+        async with self._lock:
+            return multi_job_id in self._jobs
+
+    async def put_result(self, multi_job_id: str, item: Dict[str, Any],
+                         require_existing: bool = True) -> bool:
+        """Queue a worker result; ``require_existing`` mirrors the 404
+        behavior for unknown jobs (``distributed.py:1190-1194``)."""
+        async with self._lock:
+            q = self._jobs.get(multi_job_id)
+            if q is None:
+                if require_existing:
+                    return False
+                q = self._jobs[multi_job_id] = asyncio.Queue()
+        await q.put(item)
+        return True
+
+    async def remove_job(self, multi_job_id: str) -> None:
+        async with self._lock:
+            self._jobs.pop(multi_job_id, None)
+
+    # --- tile jobs (reference distributed_upscale.py:27-34, 711-760) -------
+
+    async def get_tile_queue(self, multi_job_id: str) -> asyncio.Queue:
+        async with self._tile_lock:
+            if multi_job_id not in self._tile_jobs:
+                self._tile_jobs[multi_job_id] = asyncio.Queue()
+            return self._tile_jobs[multi_job_id]
+
+    async def has_tile_job(self, multi_job_id: str) -> bool:
+        async with self._tile_lock:
+            return multi_job_id in self._tile_jobs
+
+    async def put_tile(self, multi_job_id: str, item: Dict[str, Any]) -> bool:
+        q = await self.get_tile_queue(multi_job_id)
+        await q.put(item)
+        return True
+
+    async def remove_tile_queue(self, multi_job_id: str) -> None:
+        async with self._tile_lock:
+            self._tile_jobs.pop(multi_job_id, None)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "image_jobs": sorted(self._jobs),
+            "tile_jobs": sorted(self._tile_jobs),
+        }

@@ -1,0 +1,257 @@
+"""Worker process manager.
+
+Capability parity with the reference's ``WorkerProcessManager``
+(``distributed.py:603-1021``): spawn worker server processes, daily log
+files with session headers, PID persistence in the config file,
+revive-or-purge on restart, process-tree kill, cleanup-on-exit hooks and
+delayed auto-launch.
+
+On TPU a "worker" is not one-process-per-chip (the mesh handles local chips);
+managed workers exist for multi-host deployments and CPU staging — each runs
+``python -m comfyui_distributed_tpu.cli worker --port N``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import datetime
+import os
+import signal
+import subprocess
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+from comfyui_distributed_tpu.utils import config as cfg_mod
+from comfyui_distributed_tpu.utils import process as proc
+from comfyui_distributed_tpu.utils.constants import WORKER_STARTUP_DELAY
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+
+MASTER_PID_ENV = "DTPU_MASTER_PID"
+
+
+class WorkerProcessManager:
+    """Singleton-ish manager for locally spawned worker processes."""
+
+    def __init__(self, config_path: Optional[str] = None,
+                 log_dir: Optional[str] = None,
+                 models_dir: Optional[str] = None):
+        self.config_path = config_path
+        self.models_dir = models_dir
+        self.log_dir = log_dir or os.path.join(os.getcwd(), "logs", "workers")
+        self.processes: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self.load_processes()
+
+    # --- launch (reference launch_worker :667, build_launch_command :644) --
+
+    def build_launch_command(self, worker: Dict[str, Any]) -> List[str]:
+        cmd = [proc.get_python_executable(), "-m",
+               "comfyui_distributed_tpu.cli", "worker",
+               "--port", str(worker["port"])]
+        if self.config_path:
+            cmd.extend(["--config", self.config_path])
+        if self.models_dir:
+            cmd.extend(["--models-dir", self.models_dir])
+        extra = worker.get("extra_args")
+        if extra:
+            cmd.extend(str(extra).split())
+        return cmd
+
+    def _log_file(self, name: str) -> str:
+        os.makedirs(self.log_dir, exist_ok=True)
+        day = datetime.date.today().strftime("%Y%m%d")
+        return os.path.join(self.log_dir, f"{name}_{day}.log")
+
+    def launch_worker(self, worker: Dict[str, Any],
+                      stop_on_master_exit: bool = True) -> Dict[str, Any]:
+        wid = str(worker["id"])
+        with self._lock:
+            existing = self.processes.get(wid)
+            if existing and proc.is_process_alive(existing.get("pid", -1)):
+                raise RuntimeError(
+                    f"worker {wid} already running (pid {existing['pid']})")
+
+        env = dict(os.environ)
+        env[MASTER_PID_ENV] = str(os.getpid())
+        cmd = self.build_launch_command(worker)
+        if stop_on_master_exit:
+            # wrap with the master-death monitor (reference worker_monitor.py)
+            cmd = [proc.get_python_executable(), "-m",
+                   "comfyui_distributed_tpu.runtime.monitor",
+                   "--master-pid", str(os.getpid()), "--"] + cmd
+
+        log_path = self._log_file(worker.get("name", wid))
+        logf = open(log_path, "a", encoding="utf-8")
+        logf.write(f"\n=== session {datetime.datetime.now().isoformat()} "
+                   f"cmd={' '.join(cmd)} ===\n")
+        logf.flush()
+        p = proc.popen_detached(cmd, env=env, stdout=logf, stderr=logf)
+        entry = {
+            "pid": p.pid,
+            "process": p,
+            "log_file": log_path,
+            "started_at": datetime.datetime.now().isoformat(),
+            "config": {k: v for k, v in worker.items() if k != "process"},
+            "launching": True,
+        }
+        with self._lock:
+            self.processes[wid] = entry
+        self.save_processes()
+        log(f"launched worker {wid} (pid {p.pid}, port {worker['port']}, "
+            f"log {log_path})")
+        return {k: v for k, v in entry.items() if k != "process"}
+
+    # --- stop (reference stop_worker :768) ---------------------------------
+
+    def stop_worker(self, worker_id: str) -> bool:
+        wid = str(worker_id)
+        with self._lock:
+            entry = self.processes.pop(wid, None)
+        if entry is None:
+            return False
+        pid = entry.get("pid")
+        ok = proc.kill_process_tree(pid) if pid else True
+        self.save_processes()
+        log(f"stopped worker {wid} (pid {pid})")
+        return ok
+
+    def clear_launching(self, worker_id: str) -> None:
+        with self._lock:
+            if str(worker_id) in self.processes:
+                self.processes[str(worker_id)]["launching"] = False
+
+    def get_managed_workers(self) -> Dict[str, Dict[str, Any]]:
+        """Liveness-annotated snapshot (reference ``get_managed_workers
+        :828``)."""
+        out = {}
+        with self._lock:
+            items = list(self.processes.items())
+        for wid, entry in items:
+            out[wid] = {
+                "pid": entry.get("pid"),
+                "alive": proc.is_process_alive(entry.get("pid", -1)),
+                "launching": entry.get("launching", False),
+                "started_at": entry.get("started_at"),
+                "log_file": entry.get("log_file"),
+                "config": entry.get("config", {}),
+            }
+        return out
+
+    def cleanup_all(self) -> None:
+        """Stop every managed worker (reference ``cleanup_all :848``)."""
+        with self._lock:
+            wids = list(self.processes)
+        for wid in wids:
+            self.stop_worker(wid)
+
+    # --- persistence (reference load/save_processes :861-904) --------------
+
+    def load_processes(self) -> None:
+        cfg = cfg_mod.load_config(self.config_path)
+        managed = cfg.get("managed_processes", {}) or {}
+        revived, purged = 0, 0
+        with self._lock:
+            for wid, entry in managed.items():
+                pid = entry.get("pid")
+                if pid and proc.is_process_alive(pid):
+                    self.processes[str(wid)] = dict(entry)
+                    revived += 1
+                else:
+                    purged += 1
+        if revived or purged:
+            log(f"managed workers: revived {revived}, purged {purged} stale")
+        if purged:
+            self.save_processes()
+
+    def save_processes(self) -> None:
+        cfg = cfg_mod.load_config(self.config_path)
+        with self._lock:
+            cfg["managed_processes"] = {
+                wid: {k: v for k, v in entry.items() if k != "process"}
+                for wid, entry in self.processes.items()
+            }
+        cfg_mod.save_config(cfg, self.config_path)
+
+    # --- log tail (reference get_worker_log_endpoint :525-599) -------------
+
+    def tail_log(self, worker_id: str, max_bytes: int = 65536) -> str:
+        with self._lock:
+            entry = self.processes.get(str(worker_id))
+        path = entry.get("log_file") if entry else None
+        if not path or not os.path.exists(path):
+            raise FileNotFoundError(f"no log for worker {worker_id}")
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(max(0, size - max_bytes))
+            return f.read().decode("utf-8", errors="replace")
+
+
+_manager: Optional[WorkerProcessManager] = None
+_manager_lock = threading.Lock()
+
+
+def get_manager() -> WorkerProcessManager:
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = WorkerProcessManager()
+        return _manager
+
+
+def auto_launch_workers(manager: WorkerProcessManager,
+                        delay: float = WORKER_STARTUP_DELAY) -> threading.Timer:
+    """Delayed auto-launch of enabled local workers (reference
+    ``delayed_auto_launch``/``auto_launch_workers``,
+    ``distributed.py:1024-1092``).  Skips remote workers and ones already
+    running; returns the timer so callers/tests can cancel it."""
+
+    def run():
+        cfg = cfg_mod.load_config(manager.config_path)
+        if not cfg["settings"].get("auto_launch_workers"):
+            return
+        for w in cfg_mod.enabled_workers(cfg):
+            if w.get("host") not in (None, "", "localhost", "127.0.0.1"):
+                continue  # remote workers are never auto-launched
+            wid = str(w["id"])
+            entry = manager.processes.get(wid)
+            if entry and proc.is_process_alive(entry.get("pid", -1)):
+                continue
+            try:
+                manager.launch_worker(
+                    w, stop_on_master_exit=cfg["settings"].get(
+                        "stop_workers_on_master_exit", True))
+            except RuntimeError as e:
+                debug_log(f"auto-launch {wid}: {e}")
+
+    t = threading.Timer(delay, run)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def install_exit_hooks(manager: WorkerProcessManager) -> None:
+    """atexit + signal handlers stopping managed workers when the master
+    exits (reference ``cleanup_on_exit`` + handlers,
+    ``distributed.py:1097-1123``)."""
+
+    def cleanup(*_a):
+        cfg = cfg_mod.load_config(manager.config_path)
+        if cfg["settings"].get("stop_workers_on_master_exit", True):
+            manager.cleanup_all()
+
+    atexit.register(cleanup)
+    for sig in (signal.SIGINT, signal.SIGTERM, signal.SIGHUP):
+        try:
+            prev = signal.getsignal(sig)
+
+            def handler(signum, frame, _prev=prev):
+                cleanup()
+                if callable(_prev):
+                    _prev(signum, frame)
+                else:
+                    sys.exit(128 + signum)
+
+            signal.signal(sig, handler)
+        except (ValueError, OSError):  # non-main thread
+            pass

@@ -1,0 +1,64 @@
+"""Master-death monitor: wrapper executable for managed workers.
+
+Capability parity with reference ``worker_monitor.py:1-129``: spawns the real
+worker command, polls the master PID every 2 s, and kills the worker (tree)
+when the master dies; forwards termination signals for clean teardown.
+
+Usage: ``python -m comfyui_distributed_tpu.runtime.monitor
+--master-pid <pid> -- <worker command...>``
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import subprocess
+import sys
+import time
+
+from comfyui_distributed_tpu.utils.constants import WORKER_CHECK_INTERVAL
+from comfyui_distributed_tpu.utils.process import (
+    is_process_alive,
+    kill_process_tree,
+    terminate_process,
+)
+
+
+def monitor_and_run(master_pid: int, cmd: list) -> int:
+    child = subprocess.Popen(cmd)
+
+    def cleanup(signum=None, _frame=None):
+        kill_process_tree(child.pid)
+        sys.exit(0 if signum is None else 128 + signum)
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, cleanup)
+
+    while True:
+        code = child.poll()
+        if code is not None:
+            return code  # worker exited on its own: propagate
+        if not is_process_alive(master_pid):
+            print(f"[monitor] master {master_pid} died; stopping worker "
+                  f"{child.pid}", file=sys.stderr)
+            terminate_process(child)
+            kill_process_tree(child.pid)
+            return 0
+        time.sleep(WORKER_CHECK_INTERVAL)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--master-pid", type=int, required=True)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no worker command given")
+    return monitor_and_run(args.master_pid, cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

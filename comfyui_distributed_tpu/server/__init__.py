@@ -1,0 +1,3 @@
+"""HTTP control + data plane (aiohttp)."""
+
+from comfyui_distributed_tpu.server.app import build_app, ServerState  # noqa: F401

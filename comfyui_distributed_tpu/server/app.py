@@ -1,0 +1,445 @@
+"""aiohttp application: the reference's full route surface plus TPU-native
+status/metrics.
+
+Route inventory (capability parity with reference ``distributed.py:49-599,
+1135-1218`` and ``distributed_upscale.py:711-760``; SURVEY.md §2 #5-#8,
+#13, #15, #22-#24):
+
+  control plane
+    GET  /distributed/config                 full config
+    POST /distributed/config/update_worker   upsert (None deletes field)
+    POST /distributed/config/delete_worker
+    POST /distributed/config/update_setting
+    POST /distributed/config/update_master
+    GET  /distributed/network_info           host IPs + recommended master IP
+    POST /distributed/clear_memory           drop model/jit caches, gc
+    POST /distributed/launch_worker          process manager
+    POST /distributed/stop_worker
+    GET  /distributed/managed_workers
+    GET  /distributed/worker_log             backwards log tail
+    POST /distributed/worker/clear_launching
+    GET  /distributed/queue_status           does a tile job queue exist
+    POST /distributed/prepare_job            create queue before dispatch
+    POST /distributed/load_image             base64 input staging
+    GET  /distributed/status                 mesh topology + runtime (new)
+    GET  /distributed/metrics                counters/timings (new)
+
+  data plane
+    POST /distributed/job_complete           multipart PNG -> image queue
+    POST /distributed/tile_complete          multipart PNG -> tile queue
+
+  ComfyUI-compatible worker surface (what the reference's workers expose)
+    GET  /prompt        {"exec_info": {"queue_remaining": N}}
+    POST /prompt        queue a workflow for execution
+    POST /interrupt     stop the running job
+    POST /upload/image  receive staged input images
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+from aiohttp import web
+
+from comfyui_distributed_tpu.ops.base import OpContext
+from comfyui_distributed_tpu.runtime.jobs import JobStore
+from comfyui_distributed_tpu.runtime.manager import (
+    WorkerProcessManager,
+    auto_launch_workers,
+)
+from comfyui_distributed_tpu.utils import config as cfg_mod
+from comfyui_distributed_tpu.utils import net as net_mod
+from comfyui_distributed_tpu.utils.constants import LOG_TAIL_BYTES
+from comfyui_distributed_tpu.utils.image import decode_png, encode_png
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+from comfyui_distributed_tpu.workflow.executor import WorkflowExecutor
+
+
+class ServerState:
+    """Everything the handlers share: config path, job store, process
+    manager, the execution queue and its worker thread."""
+
+    def __init__(self, config_path: Optional[str] = None,
+                 is_worker: bool = False,
+                 input_dir: Optional[str] = None,
+                 output_dir: Optional[str] = None,
+                 models_dir: Optional[str] = None,
+                 start_exec_thread: bool = True):
+        self.config_path = config_path
+        self.is_worker = is_worker
+        self.input_dir = input_dir or os.path.join(os.getcwd(), "input")
+        self.output_dir = output_dir or os.path.join(os.getcwd(), "output")
+        self.models_dir = models_dir
+        self.jobs = JobStore()
+        self.manager = WorkerProcessManager(config_path=config_path,
+                                            models_dir=models_dir)
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.interrupt_event = threading.Event()
+        self.metrics: Dict[str, Any] = {
+            "prompts_executed": 0, "prompts_failed": 0,
+            "images_received": 0, "tiles_received": 0,
+            "last_execution_s": None,
+        }
+        self._queue: List[Dict[str, Any]] = []
+        self._queue_lock = threading.Lock()
+        self._queue_event = threading.Event()
+        self._running = False
+        self._history: Dict[str, Any] = {}
+        self._id_counter = itertools.count()
+        if start_exec_thread:
+            t = threading.Thread(target=self._exec_loop, daemon=True,
+                                 name="dtpu-exec")
+            t.start()
+
+    # --- execution queue (ComfyUI /prompt semantics) -----------------------
+
+    def queue_remaining(self) -> int:
+        with self._queue_lock:
+            return len(self._queue) + (1 if self._running else 0)
+
+    def enqueue_prompt(self, prompt: Dict[str, Any], client_id: str) -> str:
+        pid = f"p_{int(time.time() * 1000)}_{next(self._id_counter)}"
+        with self._queue_lock:
+            self._queue.append({"id": pid, "prompt": prompt,
+                                "client_id": client_id})
+        self._queue_event.set()
+        return pid
+
+    def _exec_loop(self) -> None:
+        from comfyui_distributed_tpu.parallel.mesh import get_runtime
+        while True:
+            self._queue_event.wait()
+            with self._queue_lock:
+                if not self._queue:
+                    self._queue_event.clear()
+                    continue
+                item = self._queue.pop(0)
+                self._running = True
+            self.interrupt_event.clear()
+            t0 = time.perf_counter()
+            try:
+                ctx = OpContext(
+                    runtime=get_runtime(),
+                    models_dir=self.models_dir,
+                    input_dir=self.input_dir,
+                    output_dir=self.output_dir,
+                    is_worker=self.is_worker,
+                    job_store=self.jobs,
+                    server_loop=self.loop,
+                    interrupt_event=self.interrupt_event,
+                )
+                res = WorkflowExecutor(ctx).execute(item["prompt"])
+                self._history[item["id"]] = {
+                    "status": "success",
+                    "images": len(res.images),
+                    "duration_s": res.total_s,
+                }
+                self.metrics["prompts_executed"] += 1
+                self.metrics["last_execution_s"] = res.total_s
+            except Exception as e:  # noqa: BLE001 - survive bad prompts
+                log(f"prompt {item['id']} failed: {type(e).__name__}: {e}")
+                self._history[item["id"]] = {"status": "error",
+                                             "error": str(e)}
+                self.metrics["prompts_failed"] += 1
+            finally:
+                with self._queue_lock:
+                    self._running = False
+                debug_log(f"prompt {item['id']} done in "
+                          f"{time.perf_counter() - t0:.2f}s")
+
+
+def build_app(state: Optional[ServerState] = None) -> web.Application:
+    state = state or ServerState()
+    app = web.Application(client_max_size=512 * 1024 * 1024)
+    app["state"] = state
+
+    async def on_startup(app):
+        state.loop = asyncio.get_running_loop()
+
+    async def on_cleanup(app):
+        await net_mod.cleanup_client_session()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    r = app.router
+
+    def ok(payload: Any = None, **kw):
+        body = {"status": "ok"}
+        if payload is not None:
+            body.update(payload)
+        body.update(kw)
+        return web.json_response(body)
+
+    # --- config CRUD (reference distributed.py:49-364) ---------------------
+
+    async def get_config(request):
+        return web.json_response(cfg_mod.load_config(state.config_path))
+
+    async def update_worker(request):
+        data = await request.json()
+        if "id" not in data:
+            return web.json_response({"error": "missing worker id"},
+                                     status=400)
+        cfg = cfg_mod.load_config(state.config_path)
+        worker = cfg_mod.upsert_worker(cfg, data)
+        cfg_mod.save_config(cfg, state.config_path)
+        return ok({"worker": worker})
+
+    async def delete_worker(request):
+        data = await request.json()
+        cfg = cfg_mod.load_config(state.config_path)
+        if not cfg_mod.delete_worker(cfg, str(data.get("id"))):
+            return web.json_response({"error": "worker not found"},
+                                     status=404)
+        cfg_mod.save_config(cfg, state.config_path)
+        return ok()
+
+    async def update_setting(request):
+        data = await request.json()
+        if "key" not in data:
+            return web.json_response({"error": "missing key"}, status=400)
+        cfg = cfg_mod.load_config(state.config_path)
+        cfg_mod.update_setting(cfg, data["key"], data.get("value"))
+        cfg_mod.save_config(cfg, state.config_path)
+        return ok()
+
+    async def update_master(request):
+        data = await request.json()
+        cfg = cfg_mod.load_config(state.config_path)
+        cfg_mod.update_master(cfg, **{k: data.get(k) for k in
+                                      ("host", "port", "extra_args")})
+        cfg_mod.save_config(cfg, state.config_path)
+        return ok()
+
+    # --- info / lifecycle ---------------------------------------------------
+
+    async def network_info(request):
+        return web.json_response(net_mod.network_info())
+
+    async def status(request):
+        from comfyui_distributed_tpu.parallel.mesh import get_runtime
+        # first call may initialize the JAX backend (seconds on real TPU) —
+        # keep it off the event loop so the data plane stays responsive
+        loop = asyncio.get_running_loop()
+        st = await loop.run_in_executor(None,
+                                        lambda: get_runtime().status())
+        st["jobs"] = state.jobs.snapshot()
+        st["queue_remaining"] = state.queue_remaining()
+        st["is_worker"] = state.is_worker
+        return web.json_response(st)
+
+    async def metrics(request):
+        return web.json_response(state.metrics)
+
+    async def clear_memory(request):
+        import gc
+
+        import jax
+
+        from comfyui_distributed_tpu.models import registry
+        registry.clear_pipeline_cache()
+        jax.clear_caches()
+        for _ in range(3):
+            gc.collect()
+        log("cleared model/jit caches")
+        return ok()
+
+    async def launch_worker(request):
+        data = await request.json()
+        cfg = cfg_mod.load_config(state.config_path)
+        worker = next((w for w in cfg["workers"]
+                       if str(w.get("id")) == str(data.get("id"))), None)
+        if worker is None:
+            return web.json_response({"error": "worker not found"},
+                                     status=404)
+        try:
+            entry = state.manager.launch_worker(
+                worker, stop_on_master_exit=cfg["settings"].get(
+                    "stop_workers_on_master_exit", True))
+        except RuntimeError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return ok({"worker": entry})
+
+    async def stop_worker(request):
+        data = await request.json()
+        if not state.manager.stop_worker(str(data.get("id"))):
+            return web.json_response({"error": "not managed"}, status=404)
+        return ok()
+
+    async def managed_workers(request):
+        return web.json_response(state.manager.get_managed_workers())
+
+    async def worker_log(request):
+        wid = request.query.get("id", "")
+        try:
+            text = state.manager.tail_log(wid, max_bytes=int(
+                request.query.get("bytes", LOG_TAIL_BYTES)))
+        except FileNotFoundError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.json_response({"log": text})
+
+    async def clear_launching(request):
+        data = await request.json()
+        state.manager.clear_launching(str(data.get("id")))
+        return ok()
+
+    # --- job data plane -----------------------------------------------------
+
+    async def prepare_job(request):
+        data = await request.json()
+        mj = data.get("multi_job_id")
+        if not mj:
+            return web.json_response({"error": "missing multi_job_id"},
+                                     status=400)
+        await state.jobs.prepare_job(mj)
+        debug_log(f"prepared job {mj}")
+        return ok()
+
+    async def queue_status(request):
+        mj = request.query.get("multi_job_id", "")
+        exists = await state.jobs.has_tile_job(mj) or \
+            await state.jobs.has_job(mj)
+        return web.json_response({"exists": exists})
+
+    async def job_complete(request):
+        form = await request.post()
+        mj = form.get("multi_job_id", "")
+        img_field = form.get("image")
+        if not mj or img_field is None:
+            return web.json_response({"error": "missing fields"}, status=400)
+        tensor = decode_png(img_field.file.read())
+        item = {
+            "worker_id": form.get("worker_id", ""),
+            "image_index": int(form.get("image_index", 0)),
+            "is_last": str(form.get("is_last", "false")).lower() == "true",
+            "tensor": tensor,
+        }
+        if not await state.jobs.put_result(mj, item):
+            # unknown job -> 404 so the worker's retry loop backs off
+            return web.json_response({"error": f"unknown job {mj}"},
+                                     status=404)
+        state.metrics["images_received"] += 1
+        return ok()
+
+    async def tile_complete(request):
+        form = await request.post()
+        mj = form.get("multi_job_id", "")
+        tile_field = form.get("tile")
+        if not mj or tile_field is None:
+            return web.json_response({"error": "missing fields"}, status=400)
+        item = {
+            "worker_id": form.get("worker_id", ""),
+            "tile_idx": int(form.get("tile_idx", 0)),
+            "x": int(form.get("x", 0)),
+            "y": int(form.get("y", 0)),
+            "extracted_width": int(form.get("extracted_width", 0)),
+            "extracted_height": int(form.get("extracted_height", 0)),
+            "padding": int(form.get("padding", 0)),
+            "is_last": str(form.get("is_last", "false")).lower() == "true",
+            "tensor": decode_png(tile_field.file.read()),
+        }
+        await state.jobs.put_tile(mj, item)
+        state.metrics["tiles_received"] += 1
+        return ok()
+
+    async def load_image(request):
+        """Input-image staging for remote workers (reference
+        ``distributed.py:1135-1173``): name -> base64 PNG."""
+        data = await request.json()
+        name = str(data.get("image_name", ""))
+        safe = os.path.normpath(name).lstrip(os.sep)
+        if safe.startswith(".."):
+            return web.json_response({"error": "bad path"}, status=400)
+        path = os.path.join(state.input_dir, safe)
+        if not os.path.exists(path):
+            return web.json_response({"error": f"not found: {name}"},
+                                     status=404)
+        with open(path, "rb") as f:
+            b64 = base64.b64encode(f.read()).decode()
+        return web.json_response({"image_data": b64, "name": name})
+
+    # --- ComfyUI-compatible worker surface ---------------------------------
+
+    async def get_prompt(request):
+        return web.json_response(
+            {"exec_info": {"queue_remaining": state.queue_remaining()}})
+
+    async def post_prompt(request):
+        data = await request.json()
+        prompt = data.get("prompt")
+        if not isinstance(prompt, dict) or not prompt:
+            return web.json_response({"error": "missing prompt"}, status=400)
+        try:
+            pid = state.enqueue_prompt(prompt,
+                                       data.get("client_id", "unknown"))
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"prompt_id": pid,
+                                  "number": state.queue_remaining()})
+
+    async def interrupt(request):
+        state.interrupt_event.set()
+        log("interrupt requested")
+        return ok()
+
+    async def upload_image(request):
+        form = await request.post()
+        img = form.get("image")
+        if img is None:
+            return web.json_response({"error": "missing image"}, status=400)
+        os.makedirs(state.input_dir, exist_ok=True)
+        name = os.path.basename(img.filename or "upload.png")
+        with open(os.path.join(state.input_dir, name), "wb") as f:
+            f.write(img.file.read())
+        return web.json_response({"name": name, "subfolder": "",
+                                  "type": "input"})
+
+    async def history(request):
+        return web.json_response(state._history)
+
+    r.add_get("/distributed/config", get_config)
+    r.add_post("/distributed/config/update_worker", update_worker)
+    r.add_post("/distributed/config/delete_worker", delete_worker)
+    r.add_post("/distributed/config/update_setting", update_setting)
+    r.add_post("/distributed/config/update_master", update_master)
+    r.add_get("/distributed/network_info", network_info)
+    r.add_get("/distributed/status", status)
+    r.add_get("/distributed/metrics", metrics)
+    r.add_post("/distributed/clear_memory", clear_memory)
+    r.add_post("/distributed/launch_worker", launch_worker)
+    r.add_post("/distributed/stop_worker", stop_worker)
+    r.add_get("/distributed/managed_workers", managed_workers)
+    r.add_get("/distributed/worker_log", worker_log)
+    r.add_post("/distributed/worker/clear_launching", clear_launching)
+    r.add_post("/distributed/prepare_job", prepare_job)
+    r.add_get("/distributed/queue_status", queue_status)
+    r.add_post("/distributed/job_complete", job_complete)
+    r.add_post("/distributed/tile_complete", tile_complete)
+    r.add_post("/distributed/load_image", load_image)
+    r.add_get("/prompt", get_prompt)
+    r.add_post("/prompt", post_prompt)
+    r.add_post("/interrupt", interrupt)
+    r.add_post("/upload/image", upload_image)
+    r.add_get("/history", history)
+    return app
+
+
+def serve(host: str = "0.0.0.0", port: int = 8288,
+          state: Optional[ServerState] = None,
+          auto_launch: bool = True) -> None:
+    """Blocking server entry point."""
+    state = state or ServerState()
+    app = build_app(state)
+    if auto_launch and not state.is_worker:
+        auto_launch_workers(state.manager)
+    role = "worker" if state.is_worker else "master"
+    log(f"{role} server listening on {host}:{port}")
+    web.run_app(app, host=host, port=port, print=None)

@@ -1,0 +1,214 @@
+"""Participant dispatcher: per-participant graph rewriting and fan-out.
+
+The native replacement for the reference's browser-side orchestrator
+(``web/gpupanel.js`` L5): the same rewrite semantics, minus the browser.
+Used by the HTTP multi-host mode — the single-host SPMD path needs none of
+this (the executor fans out via the mesh), which is exactly the point of the
+TPU-native design.
+
+Rewrite rules (parity with ``_prepareApiPromptForParticipant``,
+``gpupanel.js:1074-1177``):
+- workers get the graph pruned to the connected component of the distributed
+  nodes (bidirectional reachability, ``findCollectorConnectedNodes :987``);
+- DistributedSeed nodes: ``is_worker``, ``worker_id="worker_<idx>"``;
+- DistributedCollector nodes: ``multi_job_id`` + ``is_worker``; master adds
+  ``enabled_worker_ids``, workers add ``master_url`` + ``worker_id``; when a
+  distributed upscaler is upstream the collector becomes ``pass_through``
+  (``:1146-1154``);
+- UltimateSDUpscaleDistributed nodes: ``multi_job_id`` + ``is_worker`` +
+  ``enabled_worker_ids`` on BOTH sides (workers need the list for tile
+  math), workers add ``master_url`` + ``worker_id`` (``:1157-1174``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import aiohttp
+
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+from comfyui_distributed_tpu.utils.net import get_client_session
+from comfyui_distributed_tpu.workflow.graph import Graph, Node
+
+SEED_TYPES = ("DistributedSeed",)
+COLLECTOR_TYPES = ("DistributedCollector",)
+UPSCALER_TYPES = ("UltimateSDUpscaleDistributed",)
+DISTRIBUTED_TYPES = COLLECTOR_TYPES + UPSCALER_TYPES
+
+
+def connected_component(graph: Graph, roots: List[str]) -> set:
+    """Bidirectional reachability from the root nodes (reference BFS over
+    links both directions, ``gpupanel.js:987-1037``)."""
+    # adjacency both ways
+    adj: Dict[str, set] = {nid: set() for nid in graph.nodes}
+    for nid, node in graph.nodes.items():
+        for src, _ in node.link_inputs().values():
+            src = str(src)
+            if src in adj:
+                adj[nid].add(src)
+                adj[src].add(nid)
+    seen = set()
+    frontier = [r for r in roots if r in adj]
+    while frontier:
+        cur = frontier.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        frontier.extend(adj[cur] - seen)
+    return seen
+
+
+def prune_for_worker(graph: Graph) -> Graph:
+    """Workers execute only the distributed connected component
+    (``pruneWorkflowForWorker``, ``gpupanel.js:1045-1071``)."""
+    roots = graph.find_by_type(*DISTRIBUTED_TYPES)
+    if not roots:
+        return graph
+    keep = connected_component(graph, roots)
+    nodes = {nid: copy.deepcopy(n) for nid, n in graph.nodes.items()
+             if nid in keep}
+    # drop dangling links to pruned nodes
+    for n in nodes.values():
+        for name, val in list(n.inputs.items()):
+            if isinstance(val, (list, tuple)) and len(val) == 2 \
+                    and str(val[0]) not in nodes:
+                del n.inputs[name]
+    return Graph(nodes=nodes)
+
+
+def has_upstream_type(graph: Graph, node_id: str, types: Tuple[str, ...],
+                      _seen: Optional[set] = None) -> bool:
+    """True if any transitive input is of one of ``types``
+    (``_hasUpstreamNode``, ``gpupanel.js:1199-1231``)."""
+    _seen = _seen if _seen is not None else set()
+    if node_id in _seen:
+        return False
+    _seen.add(node_id)
+    node = graph.nodes.get(node_id)
+    if node is None:
+        return False
+    for src, _ in node.link_inputs().values():
+        src = str(src)
+        up = graph.nodes.get(src)
+        if up is None:
+            continue
+        if up.class_type in types:
+            return True
+        if has_upstream_type(graph, src, types, _seen):
+            return True
+    return False
+
+
+def make_job_id_map(graph: Graph, prefix: Optional[str] = None
+                    ) -> Dict[str, str]:
+    """One multi_job_id per distributed node:
+    ``exec_<timestamp>_<node_id>`` (``gpupanel.js:856-858``)."""
+    prefix = prefix or f"exec_{int(time.time() * 1000)}"
+    return {nid: f"{prefix}_{nid}"
+            for nid in graph.find_by_type(*DISTRIBUTED_TYPES)}
+
+
+def prepare_for_participant(graph: Graph, participant: str,
+                            job_id_map: Dict[str, str],
+                            enabled_worker_ids: List[str],
+                            master_url: str = "",
+                            worker_index: int = 0,
+                            batch_size: int = 1) -> Graph:
+    """Deep-copied, hidden-input-injected graph for one participant.
+
+    ``participant``: "master" or "worker"; workers also get pruned."""
+    import json as _json
+    is_worker = participant == "worker"
+    g = prune_for_worker(graph) if is_worker else \
+        Graph(nodes={nid: copy.deepcopy(n) for nid, n in graph.nodes.items()})
+    worker_id = f"worker_{worker_index}"
+    ids_json = _json.dumps([str(w) for w in enabled_worker_ids])
+
+    for nid, node in g.nodes.items():
+        h = node.hidden
+        if node.class_type in SEED_TYPES:
+            h["is_worker"] = is_worker
+            if is_worker:
+                h["worker_id"] = worker_id
+        elif node.class_type in COLLECTOR_TYPES:
+            if has_upstream_type(g, nid, UPSCALER_TYPES):
+                h["pass_through"] = True
+                continue
+            h["multi_job_id"] = job_id_map.get(nid, "")
+            h["is_worker"] = is_worker
+            if is_worker:
+                h["master_url"] = master_url
+                h["worker_id"] = worker_id
+                h["worker_batch_size"] = batch_size
+            else:
+                h["enabled_worker_ids"] = ids_json
+        elif node.class_type in UPSCALER_TYPES:
+            h["multi_job_id"] = job_id_map.get(nid, "")
+            h["is_worker"] = is_worker
+            h["enabled_worker_ids"] = ids_json  # both sides need tile math
+            if is_worker:
+                h["master_url"] = master_url
+                h["worker_id"] = worker_id
+    return g
+
+
+# --- network fan-out (master side) -----------------------------------------
+
+def worker_url(worker: Dict[str, Any]) -> str:
+    host = worker.get("host") or "127.0.0.1"
+    return f"http://{host}:{worker['port']}"
+
+
+async def preflight_check(workers: List[Dict[str, Any]],
+                          timeout: float = C.PREFLIGHT_TIMEOUT
+                          ) -> List[Dict[str, Any]]:
+    """300 ms GET /prompt per worker; offline workers are dropped from the
+    run (``performPreflightCheck``, ``gpupanel.js:1470-1517``)."""
+    session = await get_client_session()
+
+    async def probe(w):
+        try:
+            async with session.get(
+                    worker_url(w) + "/prompt",
+                    timeout=aiohttp.ClientTimeout(total=timeout)) as r:
+                return w if r.status == 200 else None
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            return None
+
+    t0 = time.perf_counter()
+    alive = [w for w in await asyncio.gather(*(probe(w) for w in workers))
+             if w is not None]
+    debug_log(f"preflight: {len(alive)}/{len(workers)} workers alive "
+              f"({(time.perf_counter() - t0) * 1e3:.0f} ms)")
+    return alive
+
+
+async def dispatch_to_worker(worker: Dict[str, Any], graph: Graph,
+                             client_id: str = "dtpu-master") -> Dict[str, Any]:
+    """POST the prepared prompt to a worker's /prompt
+    (``_dispatchToWorker``, ``gpupanel.js:1313-1362``)."""
+    session = await get_client_session()
+    payload = {"prompt": graph.to_api_format(), "client_id": client_id}
+    async with session.post(
+            worker_url(worker) + "/prompt", json=payload,
+            timeout=aiohttp.ClientTimeout(total=30)) as r:
+        body = await r.json()
+        if r.status != 200:
+            raise RuntimeError(f"worker {worker.get('id')} rejected prompt: "
+                               f"{body}")
+        return body
+
+
+async def prepare_job_on(url: str, multi_job_id: str) -> None:
+    """Create the result queue before dispatch so worker results can't race
+    master startup (``prepare_job_endpoint``, ``distributed.py:366-381``)."""
+    session = await get_client_session()
+    async with session.post(f"{url}/distributed/prepare_job",
+                            json={"multi_job_id": multi_job_id},
+                            timeout=aiohttp.ClientTimeout(total=5)) as r:
+        if r.status != 200:
+            raise RuntimeError(f"prepare_job failed: {r.status}")

@@ -1,0 +1,114 @@
+"""Dispatcher graph-rewrite parity (gpupanel.js semantics)."""
+
+import json
+
+import pytest
+
+from comfyui_distributed_tpu.workflow import parse_workflow
+from comfyui_distributed_tpu.workflow import dispatcher as dsp
+from comfyui_distributed_tpu.workflow.graph import Graph, Node
+
+TXT2IMG = "/root/reference/workflows/distributed-txt2img.json"
+UPSCALE = "/root/reference/workflows/distributed-upscale.json"
+
+
+class TestPrune:
+    def test_connected_graph_kept_whole(self):
+        g = parse_workflow(TXT2IMG)
+        pruned = dsp.prune_for_worker(g)
+        assert set(pruned.nodes) == set(g.nodes)
+
+    def test_disconnected_branch_pruned(self):
+        g = parse_workflow(TXT2IMG)
+        # an island node with no links to the distributed component
+        g.nodes["99"] = Node(id="99", class_type="EmptyLatentImage",
+                             inputs={"width": 8, "height": 8,
+                                     "batch_size": 1})
+        pruned = dsp.prune_for_worker(g)
+        assert "99" not in pruned.nodes
+        assert "2" in pruned.nodes  # collector stays
+
+    def test_prune_does_not_mutate_original(self):
+        g = parse_workflow(TXT2IMG)
+        before = json.dumps(g.to_api_format(), sort_keys=True, default=str)
+        dsp.prune_for_worker(g)
+        assert json.dumps(g.to_api_format(), sort_keys=True,
+                          default=str) == before
+
+
+class TestInjection:
+    def test_master_injection(self):
+        g = parse_workflow(TXT2IMG)
+        jm = dsp.make_job_id_map(g, prefix="exec_t")
+        out = dsp.prepare_for_participant(g, "master", jm, ["worker_0",
+                                                            "worker_1"])
+        seed = out.nodes["4"].hidden
+        assert seed["is_worker"] is False
+        coll = out.nodes["2"].hidden
+        assert coll["multi_job_id"] == "exec_t_2"
+        assert json.loads(coll["enabled_worker_ids"]) == ["worker_0",
+                                                          "worker_1"]
+        assert "master_url" not in coll
+
+    def test_worker_injection(self):
+        g = parse_workflow(TXT2IMG)
+        jm = dsp.make_job_id_map(g, prefix="exec_t")
+        out = dsp.prepare_for_participant(
+            g, "worker", jm, ["worker_0", "worker_1"],
+            master_url="http://10.0.0.1:8288", worker_index=1, batch_size=4)
+        seed = out.nodes["4"].hidden
+        assert seed["is_worker"] is True
+        assert seed["worker_id"] == "worker_1"
+        coll = out.nodes["2"].hidden
+        assert coll["master_url"] == "http://10.0.0.1:8288"
+        assert coll["worker_batch_size"] == 4
+        assert "enabled_worker_ids" not in coll
+
+    def test_upscaler_injection_both_sides(self):
+        g = parse_workflow(UPSCALE)
+        jm = dsp.make_job_id_map(g)
+        m = dsp.prepare_for_participant(g, "master", jm, ["worker_0"])
+        w = dsp.prepare_for_participant(g, "worker", jm, ["worker_0"],
+                                        master_url="http://m:1", worker_index=0)
+        # workers need the enabled list for tile math (gpupanel.js:1157-1174)
+        assert json.loads(m.nodes["13"].hidden["enabled_worker_ids"]) == \
+            ["worker_0"]
+        assert json.loads(w.nodes["13"].hidden["enabled_worker_ids"]) == \
+            ["worker_0"]
+        assert w.nodes["13"].hidden["master_url"] == "http://m:1"
+
+    def test_collector_downstream_of_upscaler_passthrough(self):
+        """A collector fed (transitively) by a distributed upscaler becomes
+        pass_through (gpupanel.js:1146-1154)."""
+        g = parse_workflow(UPSCALE)
+        g.nodes["20"] = Node(id="20", class_type="DistributedCollector",
+                             inputs={"images": ["13", 0]})
+        g.nodes["10"].inputs["images"] = ["20", 0]
+        jm = dsp.make_job_id_map(g)
+        out = dsp.prepare_for_participant(g, "master", jm, ["worker_0"])
+        assert out.nodes["20"].hidden.get("pass_through") is True
+        assert "multi_job_id" not in out.nodes["20"].hidden
+
+    def test_job_id_map(self):
+        g = parse_workflow(TXT2IMG)
+        jm = dsp.make_job_id_map(g)
+        assert set(jm) == {"2"}
+        assert jm["2"].endswith("_2")
+        assert jm["2"].startswith("exec_")
+
+
+class TestUpstream:
+    def test_has_upstream_type(self):
+        g = parse_workflow(UPSCALE)
+        # preview (10) is downstream of the upscaler (13)
+        assert dsp.has_upstream_type(g, "10",
+                                     ("UltimateSDUpscaleDistributed",))
+        assert not dsp.has_upstream_type(g, "13",
+                                         ("UltimateSDUpscaleDistributed",))
+
+    def test_cycle_safe(self):
+        g = Graph(nodes={
+            "a": Node(id="a", class_type="X", inputs={"i": ["b", 0]}),
+            "b": Node(id="b", class_type="X", inputs={"i": ["a", 0]}),
+        })
+        assert not dsp.has_upstream_type(g, "a", ("Y",))

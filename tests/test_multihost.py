@@ -1,0 +1,136 @@
+"""Multi-host HTTP mode end-to-end: real master + worker server processes.
+
+Exercises the reference's full distributed-generation call stack (SURVEY.md
+§3.2) with no browser: dispatcher rewrites, prepare-before-dispatch, worker
+execution, PNG-over-HTTP gather, master-first ordering."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from comfyui_distributed_tpu.utils.net import find_free_port
+from comfyui_distributed_tpu.workflow import parse_workflow
+from comfyui_distributed_tpu.workflow import dispatcher as dsp
+
+TXT2IMG = "/root/reference/workflows/distributed-txt2img.json"
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_up(port, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            _get(f"http://127.0.0.1:{port}/prompt", timeout=2)
+            return
+        except Exception:
+            time.sleep(0.5)
+    raise TimeoutError(f"server on {port} never came up")
+
+
+@pytest.fixture
+def servers(tmp_path):
+    env = {
+        **os.environ,
+        "PYTHONPATH": "/root/repo",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "DTPU_DEFAULT_FAMILY": "tiny",
+        "DISTRIBUTED_TPU_CONFIG": str(tmp_path / "cfg.json"),
+    }
+    mport, wport = find_free_port(), find_free_port()
+    logs = [open(tmp_path / "master.log", "w"),
+            open(tmp_path / "worker.log", "w")]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "comfyui_distributed_tpu.cli", "serve",
+             "--host", "127.0.0.1", "--port", str(mport)],
+            env=env, cwd=str(tmp_path), stdout=logs[0], stderr=logs[0]),
+        subprocess.Popen(
+            [sys.executable, "-m", "comfyui_distributed_tpu.cli", "worker",
+             "--host", "127.0.0.1", "--port", str(wport)],
+            env=env, cwd=str(tmp_path), stdout=logs[1], stderr=logs[1]),
+    ]
+    try:
+        _wait_up(mport)
+        _wait_up(wport)
+        yield mport, wport, tmp_path
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in logs:
+            f.close()
+
+
+@pytest.mark.integration
+def test_parallel_generation_over_http(servers):
+    mport, wport, tmp_path = servers
+    master_url = f"http://127.0.0.1:{mport}"
+
+    g = parse_workflow(TXT2IMG)
+    g.nodes["9"].inputs.update(width=64, height=64, batch_size=1)
+    g.nodes["8"].inputs.update(steps=1)
+
+    # the reference dispatch protocol (gpupanel.js:836-941)
+    job_map = dsp.make_job_id_map(g, prefix="exec_test")
+    for mj in job_map.values():
+        _post(f"{master_url}/distributed/prepare_job", {"multi_job_id": mj})
+
+    worker_ids = ["worker_0"]
+    worker_graph = dsp.prepare_for_participant(
+        g, "worker", job_map, worker_ids, master_url=master_url,
+        worker_index=0)
+    master_graph = dsp.prepare_for_participant(
+        g, "master", job_map, worker_ids)
+
+    # embed hidden inputs into API inputs, as the reference's JS does
+    def to_prompt(graph):
+        api = graph.to_api_format()
+        for entry in api.values():
+            entry["inputs"].update(entry.pop("hidden", {}))
+        return api
+
+    wr = _post(f"http://127.0.0.1:{wport}/prompt",
+               {"prompt": to_prompt(worker_graph), "client_id": "test"})
+    mr = _post(f"{master_url}/prompt",
+               {"prompt": to_prompt(master_graph), "client_id": "test"})
+
+    deadline = time.time() + 240
+    done = {}
+    while time.time() < deadline:
+        hist = _get(f"{master_url}/history")
+        if mr["prompt_id"] in hist:
+            done = hist[mr["prompt_id"]]
+            break
+        time.sleep(1.0)
+    assert done, "master prompt never completed"
+    assert done["status"] == "success", done
+    # master's 1 image + worker's 1 image, gathered over HTTP
+    assert done["images"] == 2
+
+    metrics = _get(f"{master_url}/distributed/metrics")
+    assert metrics["images_received"] >= 1
+
+    whist = _get(f"http://127.0.0.1:{wport}/history")
+    assert whist[wr["prompt_id"]]["status"] == "success"

@@ -1,0 +1,114 @@
+"""Worker process manager + master-death monitor."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from comfyui_distributed_tpu.runtime import manager as mgr_mod
+from comfyui_distributed_tpu.utils import config as cfg_mod
+from comfyui_distributed_tpu.utils.process import is_process_alive
+
+SLEEPER = [sys.executable, "-c", "import time; time.sleep(60)"]
+
+
+@pytest.fixture
+def manager(tmp_path, monkeypatch):
+    m = mgr_mod.WorkerProcessManager(
+        config_path=str(tmp_path / "cfg.json"),
+        log_dir=str(tmp_path / "logs"))
+    # don't spawn real worker servers in unit tests
+    monkeypatch.setattr(m, "build_launch_command", lambda w: list(SLEEPER))
+    yield m
+    m.cleanup_all()
+
+
+class TestManager:
+    def test_launch_tracks_and_stops(self, manager):
+        entry = manager.launch_worker({"id": "w1", "name": "t", "port": 1},
+                                      stop_on_master_exit=False)
+        assert is_process_alive(entry["pid"])
+        managed = manager.get_managed_workers()
+        assert managed["w1"]["alive"] is True
+        assert managed["w1"]["launching"] is True
+        manager.clear_launching("w1")
+        assert manager.get_managed_workers()["w1"]["launching"] is False
+        assert manager.stop_worker("w1") is True
+        assert not is_process_alive(entry["pid"])
+        assert manager.stop_worker("w1") is False
+
+    def test_double_launch_conflict(self, manager):
+        manager.launch_worker({"id": "w1", "port": 1},
+                              stop_on_master_exit=False)
+        with pytest.raises(RuntimeError, match="already running"):
+            manager.launch_worker({"id": "w1", "port": 1},
+                                  stop_on_master_exit=False)
+
+    def test_pid_persistence_revive_and_purge(self, manager, tmp_path):
+        entry = manager.launch_worker({"id": "w1", "port": 1},
+                                      stop_on_master_exit=False)
+        cfg = cfg_mod.load_config(str(tmp_path / "cfg.json"))
+        assert cfg["managed_processes"]["w1"]["pid"] == entry["pid"]
+        # stale entry purged on load
+        cfg["managed_processes"]["dead"] = {"pid": 999999}
+        cfg_mod.save_config(cfg, str(tmp_path / "cfg.json"))
+        m2 = mgr_mod.WorkerProcessManager(
+            config_path=str(tmp_path / "cfg.json"),
+            log_dir=str(tmp_path / "logs"))
+        assert "w1" in m2.processes          # revived (alive)
+        assert "dead" not in m2.processes    # purged
+        m2.processes.pop("w1", None)         # owner is `manager` fixture
+
+    def test_log_written_and_tailed(self, manager):
+        manager.launch_worker({"id": "w1", "name": "logtest", "port": 1},
+                              stop_on_master_exit=False)
+        text = manager.tail_log("w1")
+        assert "=== session" in text
+        with pytest.raises(FileNotFoundError):
+            manager.tail_log("nope")
+
+    def test_auto_launch_respects_settings(self, manager, tmp_path):
+        cfg = cfg_mod.load_config(str(tmp_path / "cfg.json"))
+        cfg_mod.upsert_worker(cfg, {"id": "w1", "port": 1, "enabled": True})
+        cfg_mod.upsert_worker(cfg, {"id": "remote", "port": 2,
+                                    "enabled": True, "host": "10.0.0.9"})
+        cfg_mod.update_setting(cfg, "auto_launch_workers", True)
+        cfg_mod.save_config(cfg, str(tmp_path / "cfg.json"))
+        t = mgr_mod.auto_launch_workers(manager, delay=0.01)
+        t.join(timeout=5)
+        time.sleep(0.2)
+        managed = manager.get_managed_workers()
+        assert "w1" in managed        # local enabled -> launched
+        assert "remote" not in managed  # remote never auto-launched
+
+
+class TestMonitor:
+    def test_monitor_kills_worker_when_master_dies(self, tmp_path):
+        """Full wrapper flow (reference worker_monitor.py:92-103): fake
+        master dies -> monitor terminates the worker and exits."""
+        fake_master = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(2)"])
+        mon = subprocess.Popen(
+            [sys.executable, "-m",
+             "comfyui_distributed_tpu.runtime.monitor",
+             "--master-pid", str(fake_master.pid), "--"] + SLEEPER,
+            env={**os.environ, "PYTHONPATH": "/root/repo"})
+        try:
+            fake_master.wait(timeout=10)
+            mon.wait(timeout=15)
+            assert mon.returncode == 0
+        finally:
+            if mon.poll() is None:
+                mon.kill()
+
+    def test_monitor_propagates_worker_exit(self, tmp_path):
+        mon = subprocess.Popen(
+            [sys.executable, "-m",
+             "comfyui_distributed_tpu.runtime.monitor",
+             "--master-pid", str(os.getpid()), "--",
+             sys.executable, "-c", "import sys; sys.exit(7)"],
+            env={**os.environ, "PYTHONPATH": "/root/repo"})
+        mon.wait(timeout=15)
+        assert mon.returncode == 7

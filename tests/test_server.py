@@ -1,0 +1,277 @@
+"""Control/data-plane HTTP API (reference route surface, SURVEY.md §2)."""
+
+import asyncio
+import base64
+import json
+import os
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from comfyui_distributed_tpu.models import registry
+from comfyui_distributed_tpu.server.app import ServerState, build_app
+from comfyui_distributed_tpu.utils.image import decode_png, encode_png
+
+
+@pytest.fixture(autouse=True)
+def tiny_family(monkeypatch):
+    monkeypatch.setenv(registry.FAMILY_ENV, "tiny")
+    yield
+
+
+def run_with_client(fn, tmp_path, **state_kw):
+    """Spin the app in a private event loop and run the async test body."""
+    async def go():
+        state = ServerState(
+            config_path=str(tmp_path / "cfg.json"),
+            input_dir=str(tmp_path / "input"),
+            output_dir=str(tmp_path / "output"),
+            **state_kw)
+        app = build_app(state)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await fn(client, state)
+        finally:
+            await client.close()
+    return asyncio.run(go())
+
+
+class TestConfigRoutes:
+    def test_config_crud(self, tmp_path):
+        async def body(client, state):
+            r = await client.get("/distributed/config")
+            assert r.status == 200
+            assert (await r.json())["workers"] == []
+
+            r = await client.post("/distributed/config/update_worker",
+                                  json={"id": "w1", "name": "n", "port": 9000,
+                                        "enabled": True})
+            assert r.status == 200
+            r = await client.post("/distributed/config/update_worker",
+                                  json={"id": "w1", "name": None})
+            cfg = await (await client.get("/distributed/config")).json()
+            assert "name" not in cfg["workers"][0]
+
+            r = await client.post("/distributed/config/update_setting",
+                                  json={"key": "debug", "value": True})
+            assert r.status == 200
+            r = await client.post("/distributed/config/update_master",
+                                  json={"host": "1.2.3.4"})
+            cfg = await (await client.get("/distributed/config")).json()
+            assert cfg["master"]["host"] == "1.2.3.4"
+            assert cfg["settings"]["debug"] is True
+
+            r = await client.post("/distributed/config/delete_worker",
+                                  json={"id": "w1"})
+            assert r.status == 200
+            r = await client.post("/distributed/config/delete_worker",
+                                  json={"id": "w1"})
+            assert r.status == 404
+
+            r = await client.post("/distributed/config/update_worker",
+                                  json={"name": "no id"})
+            assert r.status == 400
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+
+class TestInfoRoutes:
+    def test_network_info_status_metrics(self, tmp_path):
+        async def body(client, state):
+            info = await (await client.get("/distributed/network_info")).json()
+            assert "recommended_ip" in info
+            st = await (await client.get("/distributed/status")).json()
+            assert st["num_devices"] == 8
+            assert st["queue_remaining"] == 0
+            m = await (await client.get("/distributed/metrics")).json()
+            assert m["prompts_executed"] == 0
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+    def test_clear_memory(self, tmp_path):
+        async def body(client, state):
+            r = await client.post("/distributed/clear_memory")
+            assert r.status == 200
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+
+class TestDataPlane:
+    def test_prepare_then_job_complete(self, tmp_path, rng):
+        async def body(client, state):
+            r = await client.post("/distributed/prepare_job",
+                                  json={"multi_job_id": "j1"})
+            assert r.status == 200
+
+            img = rng.random((1, 8, 8, 3)).astype(np.float32)
+            import aiohttp
+            form = aiohttp.FormData()
+            form.add_field("multi_job_id", "j1")
+            form.add_field("worker_id", "worker_0")
+            form.add_field("image_index", "0")
+            form.add_field("is_last", "true")
+            form.add_field("image", encode_png(img), filename="i.png",
+                           content_type="image/png")
+            r = await client.post("/distributed/job_complete", data=form)
+            assert r.status == 200
+
+            q = await state.jobs.get_queue("j1")
+            item = q.get_nowait()
+            assert item["worker_id"] == "worker_0"
+            assert item["is_last"] is True
+            assert item["tensor"].shape == (1, 8, 8, 3)
+            np.testing.assert_allclose(item["tensor"], img, atol=1 / 255 + 1e-6)
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+    def test_job_complete_unknown_job_404(self, tmp_path, rng):
+        async def body(client, state):
+            import aiohttp
+            form = aiohttp.FormData()
+            form.add_field("multi_job_id", "nope")
+            form.add_field("image", encode_png(
+                rng.random((1, 4, 4, 3)).astype(np.float32)),
+                filename="i.png", content_type="image/png")
+            r = await client.post("/distributed/job_complete", data=form)
+            assert r.status == 404
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+    def test_tile_complete_and_queue_status(self, tmp_path, rng):
+        async def body(client, state):
+            r = await client.get("/distributed/queue_status",
+                                 params={"multi_job_id": "t1"})
+            assert (await r.json())["exists"] is False
+
+            import aiohttp
+            form = aiohttp.FormData()
+            form.add_field("multi_job_id", "t1")
+            form.add_field("worker_id", "worker_0")
+            form.add_field("tile_idx", "3")
+            form.add_field("x", "64")
+            form.add_field("y", "0")
+            form.add_field("extracted_width", "96")
+            form.add_field("extracted_height", "96")
+            form.add_field("is_last", "true")
+            form.add_field("tile", encode_png(
+                rng.random((1, 8, 8, 3)).astype(np.float32)),
+                filename="t.png", content_type="image/png")
+            r = await client.post("/distributed/tile_complete", data=form)
+            assert r.status == 200
+
+            r = await client.get("/distributed/queue_status",
+                                 params={"multi_job_id": "t1"})
+            assert (await r.json())["exists"] is True
+            q = await state.jobs.get_tile_queue("t1")
+            item = q.get_nowait()
+            assert item["tile_idx"] == 3 and item["x"] == 64
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+    def test_load_image_staging(self, tmp_path, rng):
+        async def body(client, state):
+            os.makedirs(state.input_dir, exist_ok=True)
+            img = rng.random((1, 8, 8, 3)).astype(np.float32)
+            with open(os.path.join(state.input_dir, "x.png"), "wb") as f:
+                f.write(encode_png(img))
+            r = await client.post("/distributed/load_image",
+                                  json={"image_name": "x.png"})
+            assert r.status == 200
+            data = await r.json()
+            back = decode_png(base64.b64decode(data["image_data"]))
+            assert back.shape == (1, 8, 8, 3)
+
+            r = await client.post("/distributed/load_image",
+                                  json={"image_name": "missing.png"})
+            assert r.status == 404
+            r = await client.post("/distributed/load_image",
+                                  json={"image_name": "../../etc/passwd"})
+            assert r.status in (400, 404)
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+    def test_upload_image(self, tmp_path, rng):
+        async def body(client, state):
+            import aiohttp
+            form = aiohttp.FormData()
+            form.add_field("image", encode_png(
+                rng.random((1, 4, 4, 3)).astype(np.float32)),
+                filename="up.png", content_type="image/png")
+            r = await client.post("/upload/image", data=form)
+            assert r.status == 200
+            assert os.path.exists(os.path.join(state.input_dir, "up.png"))
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+
+class TestPromptSurface:
+    def test_get_prompt_health(self, tmp_path):
+        async def body(client, state):
+            r = await client.get("/prompt")
+            assert (await r.json())["exec_info"]["queue_remaining"] == 0
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+    def test_post_prompt_executes(self, tmp_path):
+        """Full /prompt -> exec queue -> history flow with a tiny graph."""
+        prompt = {
+            "7": {"class_type": "CheckpointLoaderSimple",
+                  "inputs": {"ckpt_name": "tiny.safetensors"}},
+            "5": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "cat", "clip": ["7", 1]}},
+            "6": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "", "clip": ["7", 1]}},
+            "9": {"class_type": "EmptyLatentImage",
+                  "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+            "8": {"class_type": "KSampler",
+                  "inputs": {"model": ["7", 0], "positive": ["5", 0],
+                             "negative": ["6", 0], "latent_image": ["9", 0],
+                             "seed": 1, "steps": 1, "cfg": 1.0,
+                             "sampler_name": "euler", "scheduler": "normal",
+                             "denoise": 1.0}},
+            "1": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["8", 0], "vae": ["7", 2]}},
+            "3": {"class_type": "PreviewImage",
+                  "inputs": {"images": ["1", 0]}},
+        }
+
+        async def body(client, state):
+            r = await client.post("/prompt", json={"prompt": prompt,
+                                                   "client_id": "t"})
+            assert r.status == 200
+            pid = (await r.json())["prompt_id"]
+            for _ in range(1800):  # generous: exec thread may be compiling
+                hist = await (await client.get("/history")).json()
+                if pid in hist:
+                    assert hist[pid]["status"] == "success", hist[pid]
+                    assert hist[pid]["images"] == 1
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("prompt never finished")
+            m = await (await client.get("/distributed/metrics")).json()
+            assert m["prompts_executed"] == 1
+        run_with_client(body, tmp_path, start_exec_thread=True)
+
+    def test_post_prompt_missing(self, tmp_path):
+        async def body(client, state):
+            r = await client.post("/prompt", json={})
+            assert r.status == 400
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+    def test_interrupt(self, tmp_path):
+        async def body(client, state):
+            r = await client.post("/interrupt")
+            assert r.status == 200
+            assert state.interrupt_event.is_set()
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+
+class TestLifecycleRoutes:
+    def test_launch_unknown_worker_404(self, tmp_path):
+        async def body(client, state):
+            r = await client.post("/distributed/launch_worker",
+                                  json={"id": "zzz"})
+            assert r.status == 404
+            r = await client.post("/distributed/stop_worker",
+                                  json={"id": "zzz"})
+            assert r.status == 404
+            r = await client.get("/distributed/worker_log",
+                                 params={"id": "zzz"})
+            assert r.status == 404
+            r = await client.get("/distributed/managed_workers")
+            assert await r.json() == {}
+        run_with_client(body, tmp_path, start_exec_thread=False)
