@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.json): **SDXL 1024px images/sec/chip** — full
+txt2img on the native pipeline (CLIP encode -> 20-step CFG denoise loop ->
+VAE decode), virtual weights (deterministic random init; the reference
+publishes no numbers and no checkpoints ship in this image, SURVEY.md §6).
+
+``vs_baseline`` is 1.0 by definition: the reference publishes **zero**
+performance numbers (``/root/reference/README.md`` is qualitative only;
+BASELINE.json ``published: {}``), so there is no external number to ratio
+against; cross-round BENCH_r{N}.json values are the comparable series.
+
+Env/flags let CI run a smaller config (``--family tiny``) without changing
+the metric name printed for the flagship run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--family", default="sdxl", choices=["sdxl", "sd15", "tiny"])
+    p.add_argument("--height", type=int, default=1024)
+    p.add_argument("--width", type=int, default=1024)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--cfg", type=float, default=7.5)
+    p.add_argument("--sampler", default="euler")
+    p.add_argument("--scheduler", default="karras")
+    p.add_argument("--repeats", type=int, default=3)
+    return p.parse_args()
+
+
+def bf16_params(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16)
+        if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, tree)
+
+
+def main():
+    args = parse_args()
+    from comfyui_distributed_tpu.models.registry import load_pipeline
+
+    dev = jax.devices()[0]
+    print(f"[bench] platform={dev.platform} kind="
+          f"{getattr(dev, 'device_kind', '?')} family={args.family} "
+          f"{args.width}x{args.height} steps={args.steps} batch={args.batch}",
+          file=sys.stderr)
+
+    if args.family == "tiny":
+        args.height = min(args.height, 128)
+        args.width = min(args.width, 128)
+
+    t0 = time.time()
+    pipe = load_pipeline("bench.ckpt", family_name=args.family)
+    # bf16 weight storage: the UNet computes in bf16 anyway, and fp32 SDXL
+    # weights (10.3 GB) would crowd a 16 GB v5e chip
+    pipe.unet_params = bf16_params(pipe.unet_params)
+    pipe.clip_params = [bf16_params(p) for p in pipe.clip_params]
+    print(f"[bench] init {time.time()-t0:.1f}s", file=sys.stderr)
+
+    B = args.batch
+    ds = pipe.family.vae.downscale
+    lat = jnp.zeros((B, args.height // ds, args.width // ds,
+                     pipe.family.latent_channels), jnp.float32)
+    prompts = ["a photograph of an astronaut riding a horse"] * B
+    context, pooled = pipe.encode_prompt(prompts)
+    uncond, _ = pipe.encode_prompt([""] * B)
+    y = None
+    if pipe.family.unet.adm_in_channels:
+        extra = pipe.family.unet.adm_in_channels - pooled.shape[-1]
+        y = jnp.concatenate(
+            [pooled, jnp.zeros((B, extra), pooled.dtype)], axis=-1)
+    seeds = np.arange(B, dtype=np.uint64) + 42
+
+    def run():
+        z = pipe.sample(lat, context, uncond, seeds, steps=args.steps,
+                        cfg=args.cfg, sampler_name=args.sampler,
+                        scheduler=args.scheduler, y=y)
+        img = pipe.vae_decode(z)
+        img.block_until_ready()
+        return img
+
+    t0 = time.time()
+    run()  # compile + first batch
+    print(f"[bench] compile+first {time.time()-t0:.1f}s", file=sys.stderr)
+
+    t0 = time.time()
+    for _ in range(args.repeats):
+        run()
+    elapsed = time.time() - t0
+    n_chips = 1  # bench runs single-chip; scaling measured via dryrun/mesh tests
+    ips = (B * args.repeats) / elapsed / n_chips
+    print(f"[bench] {args.repeats}x batch={B}: {elapsed:.2f}s "
+          f"-> {ips:.4f} img/s/chip", file=sys.stderr)
+
+    metric = (f"{args.family}_{args.width}x{args.height}_"
+              f"{args.steps}step_images_per_sec_per_chip")
+    print(json.dumps({
+        "metric": metric,
+        "value": round(ips, 4),
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,569 @@
+"""Checkpoint interop: torch SD/SDXL single-file weights <-> flax param trees.
+
+The reference delegates checkpoint loading to ComfyUI's CheckpointLoaderSimple
+(node 4 in ``/root/reference/workflows/distributed-txt2img.json``) and simply
+requires the same files on every machine (``/root/reference/README.md:
+189-193``).  Here the equivalent is a bidirectional converter for the
+standard single-file SD checkpoint layout (safetensors or torch pickle):
+
+- ``model.diffusion_model.*``            <-> :class:`..models.unet.UNet`
+- ``first_stage_model.*``                <-> :class:`..models.vae.VAE`
+- ``cond_stage_model.transformer.*``     <-> CLIP-L (SD1.x, HF layout)
+- ``conditioner.embedders.0.transformer.*`` <-> CLIP-L (SDXL)
+- ``conditioner.embedders.1.model.*``    <-> OpenCLIP bigG (SDXL)
+
+Conversions are pure layout transforms: conv kernels OIHW <-> HWIO, linear
+weights transposed, norm ``weight`` <-> ``scale``, OpenCLIP's packed
+``in_proj_weight`` split into q/k/v.  The same mapping tables drive both
+directions (one ``_run_*`` walk per model, load/export mappers), so
+round-tripping is exact by construction.  Weights load as fp32 numpy; dtype
+policy (bf16 compute) is applied by the modules at apply time.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from comfyui_distributed_tpu.models.clip import CLIPConfig
+from comfyui_distributed_tpu.models.unet import UNetConfig
+from comfyui_distributed_tpu.models.vae import VAEConfig
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+
+Params = Dict[str, Any]
+
+
+# --- state-dict IO ----------------------------------------------------------
+
+def load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read a checkpoint file into {torch_key: fp32 numpy}."""
+    if path.endswith(".safetensors"):
+        from safetensors import safe_open
+        out: Dict[str, np.ndarray] = {}
+        with safe_open(path, framework="np") as f:
+            for k in f.keys():
+                out[k] = _to_f32_np(f.get_tensor(k))
+        return out
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(sd, dict) and "state_dict" in sd:
+        sd = sd["state_dict"]
+    return {k: _to_f32_np(v) for k, v in sd.items()}
+
+
+def save_state_dict(sd: Dict[str, np.ndarray], path: str) -> None:
+    from safetensors.numpy import save_file
+    save_file({k: np.ascontiguousarray(v) for k, v in sd.items()}, path)
+
+
+def _to_f32_np(t: Any) -> np.ndarray:
+    try:
+        import torch
+        if isinstance(t, torch.Tensor):
+            return t.detach().to(torch.float32).cpu().numpy()
+    except ImportError:  # pragma: no cover
+        pass
+    arr = np.asarray(t)
+    if arr.dtype == np.float16 or str(arr.dtype) == "bfloat16":
+        arr = arr.astype(np.float32)
+    return arr
+
+
+# --- tensor layout transforms ----------------------------------------------
+
+def t_conv(w: np.ndarray) -> np.ndarray:
+    """torch conv OIHW -> flax HWIO."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def t_conv_inv(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (3, 2, 0, 1))
+
+
+def t_lin(w: np.ndarray) -> np.ndarray:
+    """torch linear [out, in] <-> flax kernel [in, out]."""
+    return np.transpose(w)
+
+
+def _set(tree: Params, path: str, value: np.ndarray) -> None:
+    parts = path.split("/")
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def _get_path(tree: Params, path: str) -> Optional[np.ndarray]:
+    node: Any = tree
+    for p in path.split("/"):
+        if not isinstance(node, dict) or p not in node:
+            return None
+        node = node[p]
+    return np.asarray(node)
+
+
+# --- mappers: one mapping walk, two directions ------------------------------
+
+class _LoadMapper:
+    """torch state dict -> flax tree."""
+
+    def __init__(self, sd: Dict[str, np.ndarray], prefix: str):
+        self.sd = sd
+        self.prefix = prefix
+        self.tree: Params = {}
+        self.missing: List[str] = []
+
+    def _get(self, key: str) -> Optional[np.ndarray]:
+        return self.sd.get(self.prefix + key)
+
+    def _pair(self, tkey: str, fpath: str, wtrans, wname: str = "kernel",
+              bias: bool = True, required: bool = True) -> None:
+        w = self._get(tkey + ".weight")
+        if w is None:
+            if required:
+                self.missing.append(self.prefix + tkey)
+            return
+        _set(self.tree, f"{fpath}/{wname}", wtrans(w))
+        if bias:
+            b = self._get(tkey + ".bias")
+            if b is not None:
+                _set(self.tree, fpath + "/bias", b)
+
+    def conv(self, tkey, fpath):
+        self._pair(tkey, fpath, t_conv)
+
+    def conv_optional(self, tkey, fpath):
+        self._pair(tkey, fpath, t_conv, required=False)
+
+    def conv_as_dense(self, tkey, fpath):
+        def tr(w):
+            return t_lin(w[:, :, 0, 0] if w.ndim == 4 else w)
+        self._pair(tkey, fpath, tr)
+
+    def linear(self, tkey, fpath, bias=True):
+        self._pair(tkey, fpath, t_lin, bias=bias)
+
+    def norm(self, tkey, fpath):
+        self._pair(tkey, fpath, lambda w: w, wname="scale")
+
+    def raw(self, tkey, fpath, transform=None):
+        w = self._get(tkey)
+        if w is None:
+            self.missing.append(self.prefix + tkey)
+            return
+        _set(self.tree, fpath, transform(w) if transform else w)
+
+    def packed_qkv(self, tkey: str, fpath: str, width: int) -> None:
+        """OpenCLIP ``attn.in_proj_weight`` [3W, W] -> q/k/v Dense."""
+        w = self._get(tkey + ".in_proj_weight")
+        b = self._get(tkey + ".in_proj_bias")
+        if w is None:
+            self.missing.append(self.prefix + tkey + ".in_proj_weight")
+            return
+        for j, name in enumerate(("q", "k", "v")):
+            _set(self.tree, f"{fpath}/{name}/kernel",
+                 t_lin(w[j * width:(j + 1) * width]))
+            if b is not None:
+                _set(self.tree, f"{fpath}/{name}/bias",
+                     b[j * width:(j + 1) * width])
+
+    def projection(self, tkey: str, fpath: str) -> None:
+        """OpenCLIP text_projection: plain [W, P] param (x @ P) or nn.Linear."""
+        if self._get(tkey + ".weight") is not None:
+            self.linear(tkey, fpath, bias=False)
+        else:
+            self.raw(tkey, fpath + "/kernel")
+
+    def finish(self, what: str) -> Params:
+        if self.missing:
+            raise KeyError(f"{what} checkpoint missing {len(self.missing)} "
+                           f"keys, first: {self.missing[:5]}")
+        return self.tree
+
+
+class _ExportMapper:
+    """flax tree -> torch state dict (inverse transforms, same walk)."""
+
+    def __init__(self, tree: Params, prefix: str):
+        self.tree = tree
+        self.prefix = prefix
+        self.sd: Dict[str, np.ndarray] = {}
+        self.missing: List[str] = []
+
+    def _pair(self, tkey, fpath, wtrans, wname="kernel", bias=True,
+              required=True):
+        w = _get_path(self.tree, f"{fpath}/{wname}")
+        if w is None:
+            if required:
+                self.missing.append(fpath)
+            return
+        self.sd[self.prefix + tkey + ".weight"] = wtrans(w)
+        if bias:
+            b = _get_path(self.tree, fpath + "/bias")
+            if b is not None:
+                self.sd[self.prefix + tkey + ".bias"] = b
+
+    def conv(self, tkey, fpath):
+        self._pair(tkey, fpath, t_conv_inv)
+
+    def conv_optional(self, tkey, fpath):
+        self._pair(tkey, fpath, t_conv_inv, required=False)
+
+    def conv_as_dense(self, tkey, fpath):
+        # always exports the linear form (what SDXL-style checkpoints use)
+        self._pair(tkey, fpath, t_lin)
+
+    def linear(self, tkey, fpath, bias=True):
+        self._pair(tkey, fpath, t_lin, bias=bias)
+
+    def norm(self, tkey, fpath):
+        self._pair(tkey, fpath, lambda w: w, wname="scale")
+
+    def raw(self, tkey, fpath, transform=None):
+        w = _get_path(self.tree, fpath)
+        if w is None:
+            self.missing.append(fpath)
+            return
+        self.sd[self.prefix + tkey] = transform(w) if transform else w
+
+    def packed_qkv(self, tkey, fpath, width):
+        ws, bs = [], []
+        for name in ("q", "k", "v"):
+            w = _get_path(self.tree, f"{fpath}/{name}/kernel")
+            if w is None:
+                self.missing.append(f"{fpath}/{name}")
+                return
+            ws.append(t_lin(w))
+            b = _get_path(self.tree, f"{fpath}/{name}/bias")
+            if b is not None:
+                bs.append(b)
+        self.sd[self.prefix + tkey + ".in_proj_weight"] = np.concatenate(ws, 0)
+        if len(bs) == 3:
+            self.sd[self.prefix + tkey + ".in_proj_bias"] = np.concatenate(bs, 0)
+
+    def projection(self, tkey, fpath):
+        self.raw(tkey, fpath + "/kernel")
+
+    def finish(self, what: str) -> Dict[str, np.ndarray]:
+        if self.missing:
+            raise KeyError(f"{what} export missing {len(self.missing)} "
+                           f"params, first: {self.missing[:5]}")
+        return self.sd
+
+
+def _groupnorm(m, tkey: str, fpath: str) -> None:
+    # GroupNorm32 wraps an anonymous nn.GroupNorm
+    m.norm(tkey, fpath + "/GroupNorm_0")
+
+
+# --- UNet walk ---------------------------------------------------------------
+
+def _map_resblock(m, tkey: str, fpath: str) -> None:
+    _groupnorm(m, f"{tkey}.in_layers.0", f"{fpath}/in_norm")
+    m.conv(f"{tkey}.in_layers.2", f"{fpath}/in_conv")
+    m.linear(f"{tkey}.emb_layers.1", f"{fpath}/emb_proj")
+    _groupnorm(m, f"{tkey}.out_layers.0", f"{fpath}/out_norm")
+    m.conv(f"{tkey}.out_layers.3", f"{fpath}/out_conv")
+    m.conv_optional(f"{tkey}.skip_connection", f"{fpath}/skip")
+
+
+def _map_spatial_transformer(m, tkey: str, fpath: str, depth: int) -> None:
+    _groupnorm(m, f"{tkey}.norm", f"{fpath}/norm")
+    m.conv_as_dense(f"{tkey}.proj_in", f"{fpath}/proj_in")
+    for j in range(depth):
+        b = f"{tkey}.transformer_blocks.{j}"
+        fb = f"{fpath}/blocks_{j}"
+        for attn in ("attn1", "attn2"):
+            m.linear(f"{b}.{attn}.to_q", f"{fb}/{attn}/to_q", bias=False)
+            m.linear(f"{b}.{attn}.to_k", f"{fb}/{attn}/to_k", bias=False)
+            m.linear(f"{b}.{attn}.to_v", f"{fb}/{attn}/to_v", bias=False)
+            m.linear(f"{b}.{attn}.to_out.0", f"{fb}/{attn}/to_out")
+        m.norm(f"{b}.norm1", f"{fb}/norm1")
+        m.norm(f"{b}.norm2", f"{fb}/norm2")
+        m.norm(f"{b}.norm3", f"{fb}/norm3")
+        m.linear(f"{b}.ff.net.0.proj", f"{fb}/ff/geglu/proj")
+        m.linear(f"{b}.ff.net.2", f"{fb}/ff/out")
+    m.conv_as_dense(f"{tkey}.proj_out", f"{fpath}/proj_out")
+
+
+def _run_unet(m, cfg: UNetConfig):
+    """Walk the LDM UNet layout (torch ``input_blocks.N`` enumeration) against
+    this framework's level/index names (``models/unet.py``)."""
+    m.linear("time_embed.0", "time_fc1")
+    m.linear("time_embed.2", "time_fc2")
+    if cfg.adm_in_channels is not None:
+        m.linear("label_emb.0.0", "label_fc1")
+        m.linear("label_emb.0.2", "label_fc2")
+    m.conv("input_blocks.0.0", "conv_in")
+
+    L = cfg.num_levels
+    idx = 1
+    for level in range(L):
+        for i in range(cfg.num_res_blocks):
+            _map_resblock(m, f"input_blocks.{idx}.0", f"down_{level}_res_{i}")
+            if cfg.transformer_depth[level] > 0:
+                _map_spatial_transformer(
+                    m, f"input_blocks.{idx}.1", f"down_{level}_attn_{i}",
+                    cfg.transformer_depth[level])
+            idx += 1
+        if level != L - 1:
+            m.conv(f"input_blocks.{idx}.0.op", f"down_{level}_ds/conv")
+            idx += 1
+
+    _map_resblock(m, "middle_block.0", "mid_res_0")
+    _map_spatial_transformer(m, "middle_block.1", "mid_attn",
+                             max(cfg.transformer_depth[-1], 1))
+    _map_resblock(m, "middle_block.2", "mid_res_1")
+
+    idx = 0
+    for level in reversed(range(L)):
+        for i in range(cfg.num_res_blocks + 1):
+            _map_resblock(m, f"output_blocks.{idx}.0", f"up_{level}_res_{i}")
+            sub = 1
+            if cfg.transformer_depth[level] > 0:
+                _map_spatial_transformer(
+                    m, f"output_blocks.{idx}.{sub}", f"up_{level}_attn_{i}",
+                    cfg.transformer_depth[level])
+                sub += 1
+            if level != 0 and i == cfg.num_res_blocks:
+                m.conv(f"output_blocks.{idx}.{sub}.conv", f"up_{level}_us/conv")
+            idx += 1
+
+    _groupnorm(m, "out.0", "out_norm")
+    m.conv("out.2", "conv_out")
+    return m.finish("UNet")
+
+
+# --- VAE walk ----------------------------------------------------------------
+
+def _map_vae_resblock(m, tkey: str, fpath: str) -> None:
+    _groupnorm(m, f"{tkey}.norm1", f"{fpath}/norm1")
+    m.conv(f"{tkey}.conv1", f"{fpath}/conv1")
+    _groupnorm(m, f"{tkey}.norm2", f"{fpath}/norm2")
+    m.conv(f"{tkey}.conv2", f"{fpath}/conv2")
+    m.conv_optional(f"{tkey}.nin_shortcut", f"{fpath}/skip")
+
+
+def _map_vae_attn(m, tkey: str, fpath: str) -> None:
+    _groupnorm(m, f"{tkey}.norm", f"{fpath}/norm")
+    # torch stores q/k/v/proj_out as 1x1 convs; our block uses Dense
+    for name in ("q", "k", "v", "proj_out"):
+        m.conv_as_dense(f"{tkey}.{name}", f"{fpath}/{name}")
+
+
+def _run_vae(m, cfg: VAEConfig):
+    L = len(cfg.channel_mult)
+    m.conv("encoder.conv_in", "encoder/conv_in")
+    for level in range(L):
+        for i in range(cfg.num_res_blocks):
+            _map_vae_resblock(m, f"encoder.down.{level}.block.{i}",
+                              f"encoder/down_{level}_res_{i}")
+        if level != L - 1:
+            m.conv(f"encoder.down.{level}.downsample.conv",
+                   f"encoder/down_{level}_ds")
+    _map_vae_resblock(m, "encoder.mid.block_1", "encoder/mid_res_0")
+    _map_vae_attn(m, "encoder.mid.attn_1", "encoder/mid_attn")
+    _map_vae_resblock(m, "encoder.mid.block_2", "encoder/mid_res_1")
+    _groupnorm(m, "encoder.norm_out", "encoder/out_norm")
+    m.conv("encoder.conv_out", "encoder/conv_out")
+
+    m.conv("decoder.conv_in", "decoder/conv_in")
+    _map_vae_resblock(m, "decoder.mid.block_1", "decoder/mid_res_0")
+    _map_vae_attn(m, "decoder.mid.attn_1", "decoder/mid_attn")
+    _map_vae_resblock(m, "decoder.mid.block_2", "decoder/mid_res_1")
+    # torch decoder.up is indexed by resolution level (up.0 = full res)
+    for level in range(L):
+        for i in range(cfg.num_res_blocks + 1):
+            _map_vae_resblock(m, f"decoder.up.{level}.block.{i}",
+                              f"decoder/up_{level}_res_{i}")
+        if level != 0:
+            m.conv(f"decoder.up.{level}.upsample.conv",
+                   f"decoder/up_{level}_us")
+    _groupnorm(m, "decoder.norm_out", "decoder/out_norm")
+    m.conv("decoder.conv_out", "decoder/conv_out")
+
+    m.conv("quant_conv", "quant_conv")
+    m.conv("post_quant_conv", "post_quant_conv")
+    return m.finish("VAE")
+
+
+# --- CLIP walks --------------------------------------------------------------
+
+def _run_clip_hf(m, cfg: CLIPConfig):
+    """HF CLIPTextModel layout (SD1.x ``cond_stage_model.transformer`` and
+    SDXL's first embedder)."""
+    m.raw("embeddings.token_embedding.weight", "token_embedding/embedding")
+    m.raw("embeddings.position_embedding.weight", "position_embedding")
+    for i in range(cfg.layers):
+        t, f = f"encoder.layers.{i}", f"layers_{i}"
+        m.norm(f"{t}.layer_norm1", f"{f}/ln1")
+        m.linear(f"{t}.self_attn.q_proj", f"{f}/q")
+        m.linear(f"{t}.self_attn.k_proj", f"{f}/k")
+        m.linear(f"{t}.self_attn.v_proj", f"{f}/v")
+        m.linear(f"{t}.self_attn.out_proj", f"{f}/proj")
+        m.norm(f"{t}.layer_norm2", f"{f}/ln2")
+        m.linear(f"{t}.mlp.fc1", f"{f}/fc1")
+        m.linear(f"{t}.mlp.fc2", f"{f}/fc2")
+    m.norm("final_layer_norm", "ln_final")
+    return m.finish("CLIP")
+
+
+def _run_openclip(m, cfg: CLIPConfig):
+    """OpenCLIP text-tower layout (SDXL's bigG embedder)."""
+    m.raw("token_embedding.weight", "token_embedding/embedding")
+    m.raw("positional_embedding", "position_embedding")
+    for i in range(cfg.layers):
+        t, f = f"transformer.resblocks.{i}", f"layers_{i}"
+        m.norm(f"{t}.ln_1", f"{f}/ln1")
+        m.packed_qkv(f"{t}.attn", f, cfg.width)
+        m.linear(f"{t}.attn.out_proj", f"{f}/proj")
+        m.norm(f"{t}.ln_2", f"{f}/ln2")
+        m.linear(f"{t}.mlp.c_fc", f"{f}/fc1")
+        m.linear(f"{t}.mlp.c_proj", f"{f}/fc2")
+    m.norm("ln_final", "ln_final")
+    if cfg.projection_dim is not None:
+        m.projection("text_projection", "text_projection")
+    return m.finish("OpenCLIP")
+
+
+# --- top level ---------------------------------------------------------------
+
+UNET_PREFIX = "model.diffusion_model."
+VAE_PREFIX = "first_stage_model."
+CLIP_PREFIX_SD15 = "cond_stage_model.transformer.text_model."
+CLIP_PREFIXES_SDXL = ("conditioner.embedders.0.transformer.text_model.",
+                      "conditioner.embedders.1.model.")
+
+
+def _clip_prefixes(family) -> List[str]:
+    if len(family.clips) == 1:
+        return [CLIP_PREFIX_SD15]
+    return list(CLIP_PREFIXES_SDXL)
+
+
+def convert_state_dict(sd: Dict[str, np.ndarray],
+                       family) -> Tuple[Params, List[Params], Params]:
+    unet = _run_unet(_LoadMapper(sd, UNET_PREFIX), family.unet)
+    vae = _run_vae(_LoadMapper(sd, VAE_PREFIX), family.vae)
+    clips: List[Params] = []
+    for ccfg, prefix in zip(family.clips, _clip_prefixes(family)):
+        run = _run_clip_hf if "transformer.text_model" in prefix \
+            else _run_openclip
+        clips.append(run(_LoadMapper(sd, prefix), ccfg))
+    return unet, clips, vae
+
+
+def load_checkpoint(path: str, family) -> Tuple[Params, List[Params], Params]:
+    """Load a single-file SD checkpoint into (unet, [clips], vae) param trees
+    matching ``registry.ModelFamily`` module layouts."""
+    sd = load_state_dict(path)
+    debug_log(f"checkpoint {os.path.basename(path)}: {len(sd)} tensors")
+    unet, clips, vae = convert_state_dict(sd, family)
+    log(f"converted checkpoint {os.path.basename(path)} "
+        f"({family.name}): unet/vae/{len(clips)} clip towers")
+    return unet, clips, vae
+
+
+def export_state_dict(unet: Params, clips: List[Params], vae: Params,
+                      family) -> Dict[str, np.ndarray]:
+    """flax param trees -> torch-layout state dict (interop back to the
+    reference's ecosystem: a checkpoint exported here loads in ComfyUI)."""
+    sd: Dict[str, np.ndarray] = {}
+    sd.update(_run_unet(_ExportMapper(unet, UNET_PREFIX), family.unet))
+    sd.update(_run_vae(_ExportMapper(vae, VAE_PREFIX), family.vae))
+    for ccfg, tree, prefix in zip(family.clips, clips, _clip_prefixes(family)):
+        run = _run_clip_hf if "transformer.text_model" in prefix \
+            else _run_openclip
+        sd.update(run(_ExportMapper(tree, prefix), ccfg))
+    return sd
+
+
+def save_checkpoint(path: str, unet: Params, clips: List[Params], vae: Params,
+                    family) -> None:
+    save_state_dict(export_state_dict(unet, clips, vae, family), path)
+
+
+# --- ESRGAN/RRDB upscalers ---------------------------------------------------
+#
+# The ``4x*.pth`` files the reference's UpscaleModelLoader consumes
+# (``workflows/distributed-upscale.json`` node 14) ship in three naming
+# schemes; all normalize onto models/upscalers.py's layout
+# (conv_first / rrdb_{i}/db{j}/conv{k} / trunk_conv / up_{i} / hr_conv /
+# conv_last).
+
+def _rrdb_key_norm(sd: Dict[str, np.ndarray]) -> Dict[str, str]:
+    """Map torch keys -> canonical Real-ESRGAN-style names."""
+    if any(k.startswith("model.1.sub.") for k in sd):  # old ESRGAN arch
+        out = {}
+        nb = max(int(k.split(".")[3]) for k in sd
+                 if k.startswith("model.1.sub.") and k.split(".")[3].isdigit())
+        for k in sd:
+            parts = k.split(".")
+            if k.startswith("model.0."):
+                out[k] = f"conv_first.{parts[-1]}"
+            elif k.startswith(f"model.1.sub.{nb}."):
+                out[k] = f"trunk_conv.{parts[-1]}"
+            elif k.startswith("model.1.sub."):
+                i, rdb, conv = parts[3], parts[4], parts[5]
+                out[k] = f"body.{i}.{rdb}.{conv}.{parts[-1]}"
+            elif k.startswith("model.3."):
+                out[k] = f"upconv1.{parts[-1]}"
+            elif k.startswith("model.6."):
+                out[k] = f"upconv2.{parts[-1]}"
+            elif k.startswith("model.8."):
+                out[k] = f"HRconv.{parts[-1]}"
+            elif k.startswith("model.10."):
+                out[k] = f"conv_last.{parts[-1]}"
+        return out
+    # new-arch (xinntao ESRGAN: RRDB_trunk) and Real-ESRGAN (body/conv_body)
+    out = {}
+    for k in sd:
+        nk = (k.replace("RRDB_trunk.", "body.")
+               .replace("conv_body.", "trunk_conv.")
+               .replace("conv_up1.", "upconv1.")
+               .replace("conv_up2.", "upconv2.")
+               .replace("conv_hr.", "HRconv."))
+        out[k] = nk
+    return out
+
+
+def load_upscaler_checkpoint(path: str, cfg) -> Params:
+    """ESRGAN/RRDB ``.pth``/``.safetensors`` -> RRDBNet flax params."""
+    sd = load_state_dict(path)
+    norm = _rrdb_key_norm(sd)
+    canon = {norm[k]: v for k, v in sd.items() if k in norm}
+    tree: Params = {}
+
+    def conv(tkeys, fpath: str) -> None:
+        """Map the first present torch-key variant onto ``fpath``."""
+        tkeys = (tkeys,) if isinstance(tkeys, str) else tkeys
+        for tkey in tkeys:
+            w = canon.get(tkey + ".weight")
+            if w is not None:
+                _set(tree, fpath + "/kernel", t_conv(w))
+                b = canon.get(tkey + ".bias")
+                if b is not None:
+                    _set(tree, fpath + "/bias", b)
+                return
+        raise KeyError(f"upscaler checkpoint missing any of {tkeys} "
+                       f"(have e.g. {sorted(canon)[:3]})")
+
+    conv("conv_first", "conv_first")
+    for i in range(cfg.num_blocks):
+        for j in range(3):
+            for k in range(5):
+                # Real-ESRGAN uses rdb1, xinntao/old-arch use RDB1
+                conv((f"body.{i}.rdb{j + 1}.conv{k + 1}",
+                      f"body.{i}.RDB{j + 1}.conv{k + 1}"),
+                     f"rrdb_{i}/db{j}/conv{k}")
+    conv("trunk_conv", "trunk_conv")
+    n_up = {1: 0, 2: 1, 4: 2, 8: 3}[cfg.scale]
+    for i in range(n_up):
+        conv(f"upconv{i + 1}", f"up_{i}")
+    conv("HRconv", "hr_conv")
+    conv("conv_last", "conv_last")
+    log(f"loaded upscaler checkpoint {os.path.basename(path)} "
+        f"(scale {cfg.scale}, {cfg.num_blocks} blocks)")
+    return tree

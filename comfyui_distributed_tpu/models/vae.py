@@ -136,11 +136,18 @@ class VAE(nn.Module):
     def setup(self):
         self.encoder = Encoder(self.cfg, name="encoder")
         self.decoder = Decoder(self.cfg, name="decoder")
+        # 1x1 moment/latent projections — part of the SD VAE weight layout
+        # (torch keys ``quant_conv``/``post_quant_conv``), kept so real
+        # checkpoints load losslessly (models/checkpoints.py)
+        self.quant_conv = nn.Conv(2 * self.cfg.latent_channels, (1, 1),
+                                  dtype=jnp.float32, name="quant_conv")
+        self.post_quant_conv = nn.Conv(self.cfg.latent_channels, (1, 1),
+                                       dtype=jnp.float32, name="post_quant_conv")
 
     def encode(self, images: jax.Array,
                key: Optional[jax.Array] = None) -> jax.Array:
         x = images * 2.0 - 1.0
-        moments = self.encoder(x)
+        moments = self.quant_conv(self.encoder(x))
         mean, logvar = jnp.split(moments, 2, axis=-1)
         if key is not None:
             std = jnp.exp(0.5 * jnp.clip(logvar, -30.0, 20.0))
@@ -149,7 +156,7 @@ class VAE(nn.Module):
 
     def decode(self, latents: jax.Array) -> jax.Array:
         z = latents / self.cfg.scaling_factor
-        x = self.decoder(z)
+        x = self.decoder(self.post_quant_conv(z))
         return jnp.clip((x + 1.0) / 2.0, 0.0, 1.0)
 
     def __call__(self, images: jax.Array) -> jax.Array:

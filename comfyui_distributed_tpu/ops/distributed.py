@@ -35,7 +35,7 @@ from comfyui_distributed_tpu.ops.base import (
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils.image import encode_png
 from comfyui_distributed_tpu.utils.logging import Timer, debug_log, log
-from comfyui_distributed_tpu.utils.net import get_client_session, run_async_in_loop
+from comfyui_distributed_tpu.utils.net import post_form_with_retry, run_async_in_loop
 
 
 def parse_worker_index(worker_id: str) -> int:
@@ -114,24 +114,26 @@ class DistributedCollector(Op):
     def _send_to_master(self, ctx: OpContext, arr: np.ndarray,
                         multi_job_id: str, master_url: str, worker_id: str):
         async def send_all():
-            session = await get_client_session()
             for i in range(arr.shape[0]):
                 png = encode_png(arr[i:i + 1])
-                import aiohttp
-                form = aiohttp.FormData()
-                form.add_field("multi_job_id", multi_job_id)
-                form.add_field("worker_id", str(worker_id))
-                form.add_field("image_index", str(i))
-                form.add_field("is_last", "true" if i == arr.shape[0] - 1
-                               else "false")
-                form.add_field("image", png, filename=f"img_{i}.png",
-                               content_type="image/png")
-                url = f"{master_url}/distributed/job_complete"
-                async with session.post(
-                        url, data=form,
-                        timeout=aiohttp.ClientTimeout(
-                            total=C.TILE_SEND_TIMEOUT)) as resp:
-                    resp.raise_for_status()
+
+                def make_form(i=i, png=png):
+                    import aiohttp
+                    form = aiohttp.FormData()
+                    form.add_field("multi_job_id", multi_job_id)
+                    form.add_field("worker_id", str(worker_id))
+                    form.add_field("image_index", str(i))
+                    form.add_field("is_last", "true" if i == arr.shape[0] - 1
+                                   else "false")
+                    form.add_field("image", png, filename=f"img_{i}.png",
+                                   content_type="image/png")
+                    return form
+
+                # retry with backoff — absorbs transient master stalls and
+                # the prepare-race 404 exactly like the tile path
+                await post_form_with_retry(
+                    f"{master_url}/distributed/job_complete", make_form,
+                    timeout=C.TILE_SEND_TIMEOUT, what="job_complete")
 
         if ctx.server_loop is not None:
             run_async_in_loop(send_all(), ctx.server_loop,
@@ -151,11 +153,22 @@ class DistributedCollector(Op):
             q = await ctx.job_store.get_queue(multi_job_id)
             results: Dict[str, List] = {}
             done = set()
+            # deadline inside the loop: hitting it still returns the partial
+            # batch (parity with reference distributed.py:1372-1412); an
+            # outer cancellation would discard it
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + C.JOB_COMPLETION_TIMEOUT
             try:
                 while len(done) < len(worker_ids):
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        log(f"collector: collection deadline, missing "
+                            f"{set(worker_ids) - done}; continuing partial")
+                        break
                     try:
                         item = await asyncio.wait_for(
-                            q.get(), timeout=C.WORKER_JOB_TIMEOUT)
+                            q.get(), timeout=min(C.WORKER_JOB_TIMEOUT,
+                                                 remaining))
                     except asyncio.TimeoutError:
                         missing = set(worker_ids) - done
                         log(f"collector: timeout, missing workers {missing}; "
@@ -172,9 +185,10 @@ class DistributedCollector(Op):
             return results
 
         with Timer("collector_http_drain"):
+            # outer timeout is a backstop; the in-loop deadline governs
             results = run_async_in_loop(
                 drain(), ctx.server_loop,
-                timeout=C.JOB_COMPLETION_TIMEOUT + 5)
+                timeout=C.JOB_COMPLETION_TIMEOUT + 2 * C.WORKER_JOB_TIMEOUT)
 
         ordered = [master_images]
         for wid in sorted(results, key=lambda w: (parse_worker_index(w), w)):

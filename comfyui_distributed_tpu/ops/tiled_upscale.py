@@ -47,7 +47,7 @@ from comfyui_distributed_tpu.parallel import collectives as coll
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils.image import decode_png, encode_png, resize_image
 from comfyui_distributed_tpu.utils.logging import Timer, debug_log, log
-from comfyui_distributed_tpu.utils.net import get_client_session, run_async_in_loop
+from comfyui_distributed_tpu.utils.net import post_form_with_retry, run_async_in_loop
 
 
 @register_op
@@ -221,46 +221,36 @@ class UltimateSDUpscaleDistributed(Op):
         w, h = img_size
 
         async def send_all():
-            import aiohttp
-            session = await get_client_session()
             for k, tile_idx in enumerate(indices):
                 x, y = all_tiles[tile_idx]
                 x1, y1, x2, y2 = tiling.extraction_region(
                     x, y, p["tile_w"], p["tile_h"], p["padding"], w, h)
-                form = aiohttp.FormData()
-                form.add_field("multi_job_id", multi_job_id)
-                form.add_field("worker_id", str(worker_id))
-                form.add_field("tile_idx", str(tile_idx))
-                form.add_field("x", str(x1))
-                form.add_field("y", str(y1))
-                form.add_field("extracted_width", str(x2 - x1))
-                form.add_field("extracted_height", str(y2 - y1))
-                form.add_field("padding", str(p["padding"]))
-                form.add_field("is_last",
-                               "true" if k == len(indices) - 1 else "false")
-                form.add_field("tile", encode_png(refined[k:k + 1]),
-                               filename=f"tile_{tile_idx}.png",
-                               content_type="image/png")
-                # 5-attempt exponential backoff; retry 404 (queue-not-ready
-                # race) — reference distributed_upscale.py:618-665
-                delay = C.SEND_BACKOFF_BASE
-                for attempt in range(C.SEND_MAX_RETRIES):
-                    try:
-                        async with session.post(
-                                f"{master_url}/distributed/tile_complete",
-                                data=form, timeout=aiohttp.ClientTimeout(
-                                    total=C.TILE_TRANSFER_TIMEOUT)) as resp:
-                            if resp.status == 200:
-                                break
-                            body = await resp.text()
-                            raise RuntimeError(
-                                f"tile_complete {resp.status}: {body[:100]}")
-                    except Exception as e:
-                        if attempt == C.SEND_MAX_RETRIES - 1:
-                            raise
-                        debug_log(f"tile send retry {attempt + 1}: {e}")
-                        await asyncio.sleep(delay)
-                        delay = min(delay * 2, C.SEND_BACKOFF_CAP)
+                png = encode_png(refined[k:k + 1])
+
+                def make_form(k=k, tile_idx=tile_idx, x1=x1, y1=y1,
+                              x2=x2, y2=y2, png=png):
+                    import aiohttp
+                    form = aiohttp.FormData()
+                    form.add_field("multi_job_id", multi_job_id)
+                    form.add_field("worker_id", str(worker_id))
+                    form.add_field("tile_idx", str(tile_idx))
+                    form.add_field("x", str(x1))
+                    form.add_field("y", str(y1))
+                    form.add_field("extracted_width", str(x2 - x1))
+                    form.add_field("extracted_height", str(y2 - y1))
+                    form.add_field("padding", str(p["padding"]))
+                    form.add_field("is_last", "true" if k == len(indices) - 1
+                                   else "false")
+                    form.add_field("tile", png,
+                                   filename=f"tile_{tile_idx}.png",
+                                   content_type="image/png")
+                    return form
+
+                # exponential backoff incl. 404 (queue-not-ready race) —
+                # reference distributed_upscale.py:618-665
+                await post_form_with_retry(
+                    f"{master_url}/distributed/tile_complete", make_form,
+                    timeout=C.TILE_TRANSFER_TIMEOUT, what="tile_complete")
 
         if ctx.server_loop is not None:
             run_async_in_loop(send_all(), ctx.server_loop,
@@ -283,6 +273,15 @@ class UltimateSDUpscaleDistributed(Op):
         parts = tiling.partition_tiles(len(all_tiles), len(workers))
         mine = parts[0]
         active_workers = sum(1 for part in parts[1:] if part)
+
+        # pre-create the tile queue BEFORE refining our own range: workers
+        # may finish first, and put_tile requires an existing queue (the
+        # reference pre-inits in IS_CHANGED for the same race,
+        # distributed_upscale.py:85-105)
+        if active_workers and ctx.job_store is not None \
+                and ctx.server_loop is not None:
+            run_async_in_loop(ctx.job_store.get_tile_queue(multi_job_id),
+                              ctx.server_loop, timeout=C.QUEUE_INIT_TIMEOUT)
 
         refined: Dict[int, np.ndarray] = {}
         if mine:
@@ -327,20 +326,39 @@ class UltimateSDUpscaleDistributed(Op):
             q = await ctx.job_store.get_tile_queue(multi_job_id)
             collected: Dict[int, Any] = {}
             done = set()
-            while len(done) < num_workers:
-                try:
-                    item = await asyncio.wait_for(
-                        q.get(), timeout=C.TILE_WAIT_TIMEOUT)
-                except asyncio.TimeoutError:
-                    log("tiled upscale master: timeout waiting for tiles; "
-                        "blending partial results")
-                    break
-                collected[int(item["tile_idx"])] = item
-                if item.get("is_last"):
-                    done.add(str(item["worker_id"]))
-            await ctx.job_store.remove_tile_queue(multi_job_id)
+            # overall deadline enforced INSIDE the loop so hitting it still
+            # returns (and blends) everything collected so far — an outer
+            # cancellation would discard the partial results the timeout
+            # semantics exist to save (reference distributed_upscale.py:
+            # 448-452)
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + C.TILE_COLLECTION_TIMEOUT
+            try:
+                while len(done) < num_workers:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        log("tiled upscale master: collection deadline; "
+                            "blending partial results")
+                        break
+                    try:
+                        item = await asyncio.wait_for(
+                            q.get(), timeout=min(C.TILE_WAIT_TIMEOUT,
+                                                 remaining))
+                    except asyncio.TimeoutError:
+                        log("tiled upscale master: timeout waiting for tiles; "
+                            "blending partial results")
+                        break
+                    collected[int(item["tile_idx"])] = item
+                    if item.get("is_last"):
+                        done.add(str(item["worker_id"]))
+            finally:
+                # always drop the queue — including on cancellation — so
+                # late posts 404 instead of feeding an orphan queue
+                await ctx.job_store.remove_tile_queue(multi_job_id)
             return collected
 
         with Timer("tile_collect"):
-            return run_async_in_loop(drain(), ctx.server_loop,
-                                     timeout=C.TILE_COLLECTION_TIMEOUT)
+            # outer timeout is a backstop only; the deadline above governs
+            return run_async_in_loop(
+                drain(), ctx.server_loop,
+                timeout=C.TILE_COLLECTION_TIMEOUT + 2 * C.TILE_WAIT_TIMEOUT)

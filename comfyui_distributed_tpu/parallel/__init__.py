@@ -17,3 +17,10 @@ from comfyui_distributed_tpu.parallel.collectives import (  # noqa: F401
     gather_batch,
     shard_batch,
 )
+from comfyui_distributed_tpu.parallel.sharding import (  # noqa: F401
+    batch_shardings,
+    params_shardings,
+)
+
+# parallel.train (optax optimizer stack) is imported lazily by callers —
+# inference-only deployments shouldn't pay for or depend on it.

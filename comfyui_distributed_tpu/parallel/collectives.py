@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from comfyui_distributed_tpu.utils.constants import DATA_AXIS
 
@@ -72,10 +72,10 @@ def all_gather_data(x: jax.Array, mesh: Mesh) -> jax.Array:
     each other's results)."""
     def f(shard):
         return jax.lax.all_gather(shard, DATA_AXIS, axis=0, tiled=True)
-    # check_rep=False: replication over the unused tensor/seq axes (size 1)
+    # check_vma=False: replication over the unused tensor/seq axes (size 1)
     # can't be statically inferred by shard_map's rep checker.
     return shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS),
-                     out_specs=P(), check_rep=False)(x)
+                     out_specs=P(), check_vma=False)(x)
 
 
 def psum_data(x: jax.Array, mesh: Mesh) -> jax.Array:
@@ -84,7 +84,7 @@ def psum_data(x: jax.Array, mesh: Mesh) -> jax.Array:
     def f(shard):
         return jax.lax.psum(shard, DATA_AXIS)
     return shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(),
-                     check_rep=False)(x)
+                     check_vma=False)(x)
 
 
 def pad_to_multiple(n: int, m: int) -> int:

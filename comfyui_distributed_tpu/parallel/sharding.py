@@ -1,0 +1,102 @@
+"""Sharding rules: how params and activations lay out over the mesh.
+
+The reference has no model parallelism — every participant holds a full model
+copy (its README requires identical checkpoints on all machines,
+``/root/reference/README.md:189-193``).  On TPU, tensor parallelism is nearly
+free to offer because it is *layout, not code*: we annotate parameter and
+activation shardings with :class:`jax.sharding.NamedSharding` and GSPMD
+inserts the collectives.  This module centralises those annotations:
+
+- **dp** — batch dims over the ``data`` axis (the reference's worker axis);
+- **tp** — weight matrices over the ``tensor`` axis (output-feature dim of
+  large kernels; megatron-style column split, with XLA choosing the matching
+  row splits/reductions);
+- **sp** — token/sequence dims over the ``seq`` axis (context tensors and
+  attention inputs; ring attention in :mod:`.ring` keeps the shards resident).
+
+Rules are shape-driven rather than name-driven so they apply uniformly to any
+flax param tree (UNet, CLIP, VAE) without per-module tables.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from comfyui_distributed_tpu.utils.constants import DATA_AXIS, SEQ_AXIS, TENSOR_AXIS
+
+# Don't bother sharding tensors smaller than this many elements: the gather
+# traffic would cost more than the HBM saved.
+MIN_SHARD_ELEMENTS = 2 ** 11
+
+
+def param_spec(path: str, shape: tuple, tensor_size: int,
+               min_elements: int = MIN_SHARD_ELEMENTS) -> P:
+    """PartitionSpec for one parameter leaf.
+
+    Megatron-style column parallelism by shape heuristic: shard the trailing
+    (output-feature) dim of rank>=2 kernels over ``tensor`` when divisible;
+    fall back to the second-to-last (input-feature) dim; replicate biases,
+    norm scales, and anything too small to be worth the traffic.
+    """
+    if tensor_size <= 1 or len(shape) < 2:
+        return P()
+    n = 1
+    for d in shape:
+        n *= d
+    if n < min_elements:
+        return P()
+    none_prefix = [None] * (len(shape) - 1)
+    if shape[-1] % tensor_size == 0:
+        return P(*none_prefix, TENSOR_AXIS)
+    if shape[-2] % tensor_size == 0:
+        return P(*none_prefix[:-1], TENSOR_AXIS, None)
+    return P()
+
+
+def params_shardings(params: Any, mesh: Mesh,
+                     min_elements: int = MIN_SHARD_ELEMENTS) -> Any:
+    """NamedSharding tree matching ``params`` — tp over ``tensor``, replicated
+    over ``data``/``seq`` (dp keeps full replicas, exactly the reference's
+    every-worker-loads-the-checkpoint model, just within one program)."""
+    tensor_size = mesh.shape[TENSOR_AXIS]
+
+    def leaf(path, x):
+        spec = param_spec(jax.tree_util.keystr(path), tuple(x.shape),
+                          tensor_size, min_elements)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def batch_spec(ndim: int, seq_dim: Optional[int] = None) -> P:
+    """Activation spec: dim 0 over ``data``; optionally one dim over ``seq``
+    (for token axes — sequence parallelism)."""
+    parts = [DATA_AXIS] + [None] * (ndim - 1)
+    if seq_dim is not None and 0 < seq_dim < ndim:
+        parts[seq_dim] = SEQ_AXIS
+    return P(*parts)
+
+
+def batch_shardings(tree: Any, mesh: Mesh, seq_dims: Optional[dict] = None) -> Any:
+    """NamedSharding tree for a batch pytree (dict of arrays).  ``seq_dims``
+    maps top-level key -> which dim is the token axis (sp)."""
+    seq_dims = seq_dims or {}
+
+    def leaf(path, x):
+        key = path[0].key if path and hasattr(path[0], "key") else None
+        return NamedSharding(mesh, batch_spec(x.ndim, seq_dims.get(key)))
+
+    return jax.tree_util.tree_map_with_path(leaf, tree)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def apply_shardings(tree: Any, shardings: Any) -> Any:
+    """device_put a pytree onto its sharding tree."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), tree, shardings)

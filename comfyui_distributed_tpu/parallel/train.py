@@ -1,0 +1,148 @@
+"""Sharded diffusion training step (dp + tp + sp over one mesh).
+
+The reference is inference-only — there is no training code anywhere in
+``/root/reference`` (SURVEY.md §2) — but a TPU framework whose model zoo is
+native (rather than borrowed from ComfyUI) needs a way to produce and
+fine-tune those weights.  This module is the canonical "full training step":
+eps/v-prediction denoising MSE on the discrete VP schedule
+(:mod:`comfyui_distributed_tpu.models.schedules`), optax optimizer, jitted
+once over the whole mesh with explicit :class:`NamedSharding`s:
+
+- batch dims over ``data`` (dp — the axis the reference fans workers over),
+- weight matrices over ``tensor`` (tp, rules in :mod:`.sharding`),
+- context token axis over ``seq`` (sp),
+
+and GSPMD inserts the gradient ``psum``s / weight ``all_gather``s over ICI.
+The same step compiles unchanged from 1 chip to a multi-host pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from comfyui_distributed_tpu.models.schedules import DiscreteSchedule
+from comfyui_distributed_tpu.parallel import sharding as shd
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 1e-4
+    weight_decay: float = 1e-2
+    b1: float = 0.9
+    b2: float = 0.999
+    max_grad_norm: float = 1.0
+    prediction_type: str = "eps"  # "eps" | "v"
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adamw(cfg.learning_rate, b1=cfg.b1, b2=cfg.b2,
+                    weight_decay=cfg.weight_decay),
+    )
+
+
+def diffusion_loss(apply_fn: Callable, params: Any, batch: Dict[str, jax.Array],
+                   key: jax.Array, ds: DiscreteSchedule,
+                   prediction_type: str = "eps") -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Denoising MSE on the discrete VP forward process.
+
+    ``x_t = sqrt(abar_t) x0 + sqrt(1-abar_t) eps`` with t ~ U[0, T); the UNet
+    (which predicts eps in the model's native scaled space — the same
+    convention the inference-side :mod:`..models.denoiser` inverts) is asked
+    to recover ``eps`` (or ``v = sqrt(abar) eps - sqrt(1-abar) x0``).
+    """
+    x0 = batch["latents"].astype(jnp.float32)
+    context = batch["context"]
+    y = batch.get("y")
+    B = x0.shape[0]
+    T = len(ds.alphas_cumprod)
+    abar = jnp.asarray(ds.alphas_cumprod)
+
+    k_t, k_eps = jax.random.split(key)
+    t = jax.random.randint(k_t, (B,), 0, T)
+    eps = jax.random.normal(k_eps, x0.shape, dtype=jnp.float32)
+    a = abar[t].reshape((B,) + (1,) * (x0.ndim - 1))
+    x_t = jnp.sqrt(a) * x0 + jnp.sqrt(1.0 - a) * eps
+
+    pred = apply_fn(params, x_t, t.astype(jnp.float32), context, y)
+    pred = pred.astype(jnp.float32)
+    if prediction_type == "v":
+        target = jnp.sqrt(a) * eps - jnp.sqrt(1.0 - a) * x0
+    else:
+        target = eps
+    loss = jnp.mean((pred - target) ** 2)
+    return loss, {"loss": loss, "mean_t": jnp.mean(t.astype(jnp.float32))}
+
+
+def make_train_step(apply_fn: Callable, ds: DiscreteSchedule,
+                    cfg: Optional[TrainConfig] = None) -> Tuple[Callable, optax.GradientTransformation]:
+    """Build the (un-jitted) train step + its optimizer.
+
+    ``apply_fn(params, x, timesteps, context, y) -> eps_or_v`` is the raw
+    UNet apply (same signature the inference denoiser wraps).
+    Step signature: ``(params, opt_state, batch, key) ->
+    (params, opt_state, metrics)``.
+    """
+    cfg = cfg or TrainConfig()
+    tx = make_optimizer(cfg)
+
+    def step(params, opt_state, batch, key):
+        (loss, metrics), grads = jax.value_and_grad(
+            lambda p: diffusion_loss(apply_fn, p, batch, key, ds,
+                                     cfg.prediction_type),
+            has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, metrics
+
+    return step, tx
+
+
+def shard_train_step(step: Callable, mesh: Mesh, params: Any, opt_state: Any,
+                     batch: Dict[str, Any],
+                     seq_dims: Optional[Dict[str, int]] = None,
+                     min_shard_elements: int = shd.MIN_SHARD_ELEMENTS) -> Tuple[Callable, Any, Any, Dict[str, Any]]:
+    """Jit ``step`` over ``mesh`` with dp/tp/sp shardings and place the state.
+
+    Returns ``(jitted_step, params, opt_state, batch)`` with every argument
+    already device_put onto its sharding so the first call doesn't pay a
+    relayout.  ``seq_dims`` marks token axes for sp (default: dim 1 of
+    ``context``).
+    """
+    seq_dims = {"context": 1} if seq_dims is None else seq_dims
+    p_shard = shd.params_shardings(params, mesh, min_shard_elements)
+    # optimizer state mirrors param leaves where shapes match; scalars
+    # (step counters, clip state) replicate.
+    def opt_leaf(x):
+        if hasattr(x, "shape") and len(getattr(x, "shape", ())) >= 2:
+            return shd.NamedSharding(mesh, shd.param_spec(
+                "", tuple(x.shape), mesh.shape[shd.TENSOR_AXIS],
+                min_shard_elements))
+        return shd.replicated(mesh)
+    o_shard = jax.tree_util.tree_map(opt_leaf, opt_state)
+    b_shard = shd.batch_shardings(batch, mesh, seq_dims)
+    k_shard = shd.replicated(mesh)
+
+    jitted = jax.jit(step,
+                     in_shardings=(p_shard, o_shard, b_shard, k_shard),
+                     out_shardings=(p_shard, o_shard, None),
+                     donate_argnums=(0, 1))
+    params = shd.apply_shardings(params, p_shard)
+    opt_state = shd.apply_shardings(opt_state, o_shard)
+    batch = shd.apply_shardings(batch, b_shard)
+    return jitted, params, opt_state, batch
+
+
+def train_state_bytes(params: Any) -> int:
+    return int(sum(np.prod(x.shape) * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(params)))

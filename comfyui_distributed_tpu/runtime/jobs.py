@@ -60,6 +60,15 @@ class JobStore:
 
     # --- tile jobs (reference distributed_upscale.py:27-34, 711-760) -------
 
+    async def prepare_tile_job(self, multi_job_id: str) -> None:
+        """Pre-create a tile queue at dispatch time (the reference does this
+        at prompt-validation via IS_CHANGED, ``distributed_upscale.py:
+        85-105``) — workers can finish their tiles before the master's
+        executor even reaches the upscale node."""
+        async with self._tile_lock:
+            if multi_job_id not in self._tile_jobs:
+                self._tile_jobs[multi_job_id] = asyncio.Queue()
+
     async def get_tile_queue(self, multi_job_id: str) -> asyncio.Queue:
         async with self._tile_lock:
             if multi_job_id not in self._tile_jobs:
@@ -70,8 +79,19 @@ class JobStore:
         async with self._tile_lock:
             return multi_job_id in self._tile_jobs
 
-    async def put_tile(self, multi_job_id: str, item: Dict[str, Any]) -> bool:
-        q = await self.get_tile_queue(multi_job_id)
+    async def put_tile(self, multi_job_id: str, item: Dict[str, Any],
+                       require_existing: bool = True) -> bool:
+        """Queue a worker tile.  ``require_existing`` keeps late posts (after
+        the master timed out and removed the queue) from resurrecting an
+        orphan queue that would hold decoded tensors forever — the caller
+        returns 404 and the worker's retry loop backs off, mirroring the
+        image path (reference 404-retry, ``distributed_upscale.py:640-654``)."""
+        async with self._tile_lock:
+            q = self._tile_jobs.get(multi_job_id)
+            if q is None:
+                if require_existing:
+                    return False
+                q = self._tile_jobs[multi_job_id] = asyncio.Queue()
         await q.put(item)
         return True
 

@@ -17,7 +17,6 @@ import atexit
 import datetime
 import os
 import signal
-import subprocess
 import sys
 import threading
 from typing import Any, Dict, List, Optional
@@ -68,25 +67,42 @@ class WorkerProcessManager:
         wid = str(worker["id"])
         with self._lock:
             existing = self.processes.get(wid)
-            if existing and proc.is_process_alive(existing.get("pid", -1)):
+            if existing and (existing.get("pid") is None  # launch in flight
+                             or proc.is_process_alive(existing.get("pid", -1))):
                 raise RuntimeError(
                     f"worker {wid} already running (pid {existing['pid']})")
+            # reserve the slot before releasing the lock so a concurrent
+            # launch (auto-launch timer vs HTTP endpoint) can't double-spawn
+            self.processes[wid] = {"pid": None, "launching": True}
 
-        env = dict(os.environ)
-        env[MASTER_PID_ENV] = str(os.getpid())
-        cmd = self.build_launch_command(worker)
-        if stop_on_master_exit:
-            # wrap with the master-death monitor (reference worker_monitor.py)
-            cmd = [proc.get_python_executable(), "-m",
-                   "comfyui_distributed_tpu.runtime.monitor",
-                   "--master-pid", str(os.getpid()), "--"] + cmd
+        try:
+            env = dict(os.environ)
+            env[MASTER_PID_ENV] = str(os.getpid())
+            cmd = self.build_launch_command(worker)
+            if stop_on_master_exit:
+                # wrap with the master-death monitor (reference
+                # worker_monitor.py)
+                cmd = [proc.get_python_executable(), "-m",
+                       "comfyui_distributed_tpu.runtime.monitor",
+                       "--master-pid", str(os.getpid()), "--"] + cmd
 
-        log_path = self._log_file(worker.get("name", wid))
-        logf = open(log_path, "a", encoding="utf-8")
-        logf.write(f"\n=== session {datetime.datetime.now().isoformat()} "
-                   f"cmd={' '.join(cmd)} ===\n")
-        logf.flush()
-        p = proc.popen_detached(cmd, env=env, stdout=logf, stderr=logf)
+            log_path = self._log_file(worker.get("name", wid))
+            logf = open(log_path, "a", encoding="utf-8")
+            try:
+                logf.write(f"\n=== session "
+                           f"{datetime.datetime.now().isoformat()} "
+                           f"cmd={' '.join(cmd)} ===\n")
+                logf.flush()
+                p = proc.popen_detached(cmd, env=env, stdout=logf,
+                                        stderr=logf)
+            finally:
+                # the child inherited the fd; keeping ours open would leak
+                # one per launch across restart cycles
+                logf.close()
+        except BaseException:
+            with self._lock:  # roll back the reservation
+                self.processes.pop(wid, None)
+            raise
         entry = {
             "pid": p.pid,
             "process": p,
@@ -96,6 +112,11 @@ class WorkerProcessManager:
             "launching": True,
         }
         with self._lock:
+            if wid not in self.processes:
+                # stop_worker popped our reservation mid-launch: honor the
+                # stop — kill the just-spawned process instead of tracking it
+                proc.kill_process_tree(p.pid)
+                raise RuntimeError(f"worker {wid} stopped during launch")
             self.processes[wid] = entry
         self.save_processes()
         log(f"launched worker {wid} (pid {p.pid}, port {worker['port']}, "
@@ -165,13 +186,18 @@ class WorkerProcessManager:
             self.save_processes()
 
     def save_processes(self) -> None:
-        cfg = cfg_mod.load_config(self.config_path)
         with self._lock:
-            cfg["managed_processes"] = {
+            snapshot = {
                 wid: {k: v for k, v in entry.items() if k != "process"}
                 for wid, entry in self.processes.items()
             }
-        cfg_mod.save_config(cfg, self.config_path)
+
+        def mutate(cfg):
+            cfg["managed_processes"] = snapshot
+
+        # atomic RMW: a stale full-config write here would clobber worker
+        # edits made concurrently through the HTTP config endpoints
+        cfg_mod.mutate_config(mutate, self.config_path)
 
     # --- log tail (reference get_worker_log_endpoint :525-599) -------------
 
@@ -244,12 +270,16 @@ def install_exit_hooks(manager: WorkerProcessManager) -> None:
     for sig in (signal.SIGINT, signal.SIGTERM, signal.SIGHUP):
         try:
             prev = signal.getsignal(sig)
+            if prev == signal.SIG_IGN:
+                # previously ignored (e.g. SIGHUP under nohup): installing a
+                # dying handler would defeat the ignore — leave it alone
+                continue
 
             def handler(signum, frame, _prev=prev):
                 cleanup()
                 if callable(_prev):
                     _prev(signum, frame)
-                else:
+                else:  # SIG_DFL: mimic default termination
                     sys.exit(128 + signum)
 
             signal.signal(sig, handler)

@@ -46,7 +46,6 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-import numpy as np
 from aiohttp import web
 
 from comfyui_distributed_tpu.ops.base import OpContext
@@ -58,7 +57,7 @@ from comfyui_distributed_tpu.runtime.manager import (
 from comfyui_distributed_tpu.utils import config as cfg_mod
 from comfyui_distributed_tpu.utils import net as net_mod
 from comfyui_distributed_tpu.utils.constants import LOG_TAIL_BYTES
-from comfyui_distributed_tpu.utils.image import decode_png, encode_png
+from comfyui_distributed_tpu.utils.image import decode_png
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 from comfyui_distributed_tpu.workflow.executor import WorkflowExecutor
 
@@ -180,43 +179,55 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
 
     # --- config CRUD (reference distributed.py:49-364) ---------------------
 
+    async def _mutate(mutator):
+        """Config RMW off the event loop: the config lock is shared with the
+        exec thread and auto-launch timer, and file IO under it must not
+        stall the data plane (same reason PNG decode is offloaded)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: cfg_mod.mutate_config(mutator, state.config_path))
+
     async def get_config(request):
-        return web.json_response(cfg_mod.load_config(state.config_path))
+        loop = asyncio.get_running_loop()
+        cfg = await loop.run_in_executor(
+            None, lambda: cfg_mod.load_config(state.config_path))
+        return web.json_response(cfg)
 
     async def update_worker(request):
         data = await request.json()
         if "id" not in data:
             return web.json_response({"error": "missing worker id"},
                                      status=400)
-        cfg = cfg_mod.load_config(state.config_path)
-        worker = cfg_mod.upsert_worker(cfg, data)
-        cfg_mod.save_config(cfg, state.config_path)
-        return ok({"worker": worker})
+        result = {}
+        await _mutate(lambda cfg: result.update(
+            cfg_mod.upsert_worker(cfg, data)))
+        return ok({"worker": result})
 
     async def delete_worker(request):
         data = await request.json()
-        cfg = cfg_mod.load_config(state.config_path)
-        if not cfg_mod.delete_worker(cfg, str(data.get("id"))):
+        found = []
+        await _mutate(lambda cfg: found.append(
+            cfg_mod.delete_worker(cfg, str(data.get("id")))))
+        if not found[0]:
             return web.json_response({"error": "worker not found"},
                                      status=404)
-        cfg_mod.save_config(cfg, state.config_path)
         return ok()
 
     async def update_setting(request):
         data = await request.json()
         if "key" not in data:
             return web.json_response({"error": "missing key"}, status=400)
-        cfg = cfg_mod.load_config(state.config_path)
-        cfg_mod.update_setting(cfg, data["key"], data.get("value"))
-        cfg_mod.save_config(cfg, state.config_path)
+        await _mutate(lambda cfg: cfg_mod.update_setting(
+            cfg, data["key"], data.get("value")))
         return ok()
 
     async def update_master(request):
         data = await request.json()
-        cfg = cfg_mod.load_config(state.config_path)
-        cfg_mod.update_master(cfg, **{k: data.get(k) for k in
-                                      ("host", "port", "extra_args")})
-        cfg_mod.save_config(cfg, state.config_path)
+        # only keys present in the request are touched — an explicit null
+        # deletes a field, an absent key leaves it alone (partial update)
+        fields = {k: data[k] for k in ("host", "port", "extra_args")
+                  if k in data}
+        await _mutate(lambda cfg: cfg_mod.update_master(cfg, **fields))
         return ok()
 
     # --- info / lifecycle ---------------------------------------------------
@@ -299,8 +310,11 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         if not mj:
             return web.json_response({"error": "missing multi_job_id"},
                                      status=400)
-        await state.jobs.prepare_job(mj)
-        debug_log(f"prepared job {mj}")
+        if data.get("kind") == "tile":
+            await state.jobs.prepare_tile_job(mj)
+        else:
+            await state.jobs.prepare_job(mj)
+        debug_log(f"prepared {data.get('kind', 'image')} job {mj}")
         return ok()
 
     async def queue_status(request):
@@ -315,7 +329,11 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         img_field = form.get("image")
         if not mj or img_field is None:
             return web.json_response({"error": "missing fields"}, status=400)
-        tensor = decode_png(img_field.file.read())
+        # PNG decode off the event loop: concurrent uploads must not stall
+        # the control plane (a stalled /prompt fails preflight's 300ms probe)
+        loop = asyncio.get_running_loop()
+        tensor = await loop.run_in_executor(
+            None, lambda: decode_png(img_field.file.read()))
         item = {
             "worker_id": form.get("worker_id", ""),
             "image_index": int(form.get("image_index", 0)),
@@ -344,9 +362,14 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             "extracted_height": int(form.get("extracted_height", 0)),
             "padding": int(form.get("padding", 0)),
             "is_last": str(form.get("is_last", "false")).lower() == "true",
-            "tensor": decode_png(tile_field.file.read()),
+            "tensor": await asyncio.get_running_loop().run_in_executor(
+                None, lambda: decode_png(tile_field.file.read())),
         }
-        await state.jobs.put_tile(mj, item)
+        if not await state.jobs.put_tile(mj, item):
+            # unknown/expired tile job -> 404; the worker's retry loop backs
+            # off instead of resurrecting an orphan queue
+            return web.json_response({"error": f"unknown tile job {mj}"},
+                                     status=404)
         state.metrics["tiles_received"] += 1
         return ok()
 
@@ -362,8 +385,10 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         if not os.path.exists(path):
             return web.json_response({"error": f"not found: {name}"},
                                      status=404)
-        with open(path, "rb") as f:
-            b64 = base64.b64encode(f.read()).decode()
+        def read_b64():
+            with open(path, "rb") as f:
+                return base64.b64encode(f.read()).decode()
+        b64 = await asyncio.get_running_loop().run_in_executor(None, read_b64)
         return web.json_response({"image_data": b64, "name": name})
 
     # --- ComfyUI-compatible worker surface ---------------------------------
@@ -377,6 +402,18 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         prompt = data.get("prompt")
         if not isinstance(prompt, dict) or not prompt:
             return web.json_response({"error": "missing prompt"}, status=400)
+        # master-mode tile jobs: pre-create their queues at prompt-queue
+        # time, before the exec thread gets anywhere near the upscale node
+        # (reference pre-inits at validation time, distributed_upscale.py:
+        # 85-105) — otherwise a fast worker's tiles 404 through its retries
+        for node in prompt.values():
+            if not isinstance(node, dict) \
+                    or node.get("class_type") != "UltimateSDUpscaleDistributed":
+                continue
+            h = {**node.get("inputs", {}), **node.get("hidden", {})}
+            mj = h.get("multi_job_id")
+            if mj and not h.get("is_worker"):
+                await state.jobs.prepare_tile_job(str(mj))
         try:
             pid = state.enqueue_prompt(prompt,
                                        data.get("client_id", "unknown"))

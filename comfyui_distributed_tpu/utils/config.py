@@ -111,6 +111,18 @@ def save_config(cfg: Dict[str, Any], path: Optional[str] = None) -> None:
     set_debug(bool(cfg.get("settings", {}).get("debug", False)))
 
 
+def mutate_config(mutator, path: Optional[str] = None) -> Dict[str, Any]:
+    """Atomic read-modify-write: load, apply ``mutator(cfg)``, save — all
+    under the config lock, so concurrent writers (HTTP handlers, the process
+    manager's PID persistence, auto-launch timer threads) can't clobber each
+    other's edits with stale copies."""
+    with _lock:
+        cfg = load_config(path)
+        mutator(cfg)
+        save_config(cfg, path)
+        return cfg
+
+
 def ensure_config_exists(path: Optional[str] = None) -> str:
     """Create the default config if absent (reference ``utils/config.py:42-50``)."""
     path = path or default_config_path()

@@ -79,6 +79,34 @@ def run_async_in_loop(coro, loop: asyncio.AbstractEventLoop,
         raise TimeoutError(f"coroutine timed out after {timeout}s")
 
 
+async def post_form_with_retry(url: str, make_form, timeout: float,
+                               max_retries: Optional[int] = None,
+                               what: str = "upload") -> None:
+    """POST a multipart form with exponential backoff, retrying any error
+    including 404 (the queue-not-ready race the reference's tile sender
+    retries through, ``distributed_upscale.py:618-665``).  ``make_form``
+    is a zero-arg factory — FormData payloads are single-use."""
+    from comfyui_distributed_tpu.utils import constants as C
+    retries = max_retries if max_retries is not None else C.SEND_MAX_RETRIES
+    session = await get_client_session()
+    delay = C.SEND_BACKOFF_BASE
+    for attempt in range(retries):
+        try:
+            async with session.post(
+                    url, data=make_form(),
+                    timeout=aiohttp.ClientTimeout(total=timeout)) as resp:
+                if resp.status == 200:
+                    return
+                body = await resp.text()
+                raise RuntimeError(f"{what} {resp.status}: {body[:100]}")
+        except Exception as e:  # noqa: BLE001 - retry transport + status
+            if attempt == retries - 1:
+                raise
+            debug_log(f"{what} retry {attempt + 1}: {e}")
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, C.SEND_BACKOFF_CAP)
+
+
 # --- host IP discovery (reference distributed.py:93-207) --------------------
 
 def get_network_ips() -> List[str]:

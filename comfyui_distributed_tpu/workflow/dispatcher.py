@@ -67,15 +67,17 @@ def prune_for_worker(graph: Graph) -> Graph:
     (``pruneWorkflowForWorker``, ``gpupanel.js:1045-1071``)."""
     roots = graph.find_by_type(*DISTRIBUTED_TYPES)
     if not roots:
-        return graph
+        # still a private copy: callers inject per-participant hidden inputs
+        return Graph(nodes={nid: copy.deepcopy(n)
+                            for nid, n in graph.nodes.items()})
     keep = connected_component(graph, roots)
     nodes = {nid: copy.deepcopy(n) for nid, n in graph.nodes.items()
              if nid in keep}
-    # drop dangling links to pruned nodes
+    # drop dangling links to pruned nodes (link_inputs applies the strict
+    # link-shape test, so 2-element widget values are never touched)
     for n in nodes.values():
-        for name, val in list(n.inputs.items()):
-            if isinstance(val, (list, tuple)) and len(val) == 2 \
-                    and str(val[0]) not in nodes:
+        for name, (src, _slot) in list(n.link_inputs().items()):
+            if str(src) not in nodes:
                 del n.inputs[name]
     return Graph(nodes=nodes)
 
@@ -196,19 +198,25 @@ async def dispatch_to_worker(worker: Dict[str, Any], graph: Graph,
     async with session.post(
             worker_url(worker) + "/prompt", json=payload,
             timeout=aiohttp.ClientTimeout(total=30)) as r:
-        body = await r.json()
         if r.status != 200:
-            raise RuntimeError(f"worker {worker.get('id')} rejected prompt: "
-                               f"{body}")
-        return body
+            # error bodies may be text/plain — don't let a JSON decode
+            # failure mask the real status
+            text = await r.text()
+            raise RuntimeError(f"worker {worker.get('id')} rejected prompt "
+                               f"({r.status}): {text[:200]}")
+        return await r.json()
 
 
-async def prepare_job_on(url: str, multi_job_id: str) -> None:
-    """Create the result queue before dispatch so worker results can't race
-    master startup (``prepare_job_endpoint``, ``distributed.py:366-381``)."""
+async def prepare_job_on(url: str, multi_job_id: str,
+                         kind: str = "image") -> None:
+    """Create the result queue (image or tile) before dispatch so worker
+    results can't race master startup (``prepare_job_endpoint``,
+    ``distributed.py:366-381``; tile analog = the reference's IS_CHANGED
+    pre-init, ``distributed_upscale.py:85-105``)."""
     session = await get_client_session()
     async with session.post(f"{url}/distributed/prepare_job",
-                            json={"multi_job_id": multi_job_id},
+                            json={"multi_job_id": multi_job_id,
+                                  "kind": kind},
                             timeout=aiohttp.ClientTimeout(total=5)) as r:
         if r.status != 200:
             raise RuntimeError(f"prepare_job failed: {r.status}")

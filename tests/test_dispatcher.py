@@ -112,3 +112,27 @@ class TestUpstream:
             "b": Node(id="b", class_type="X", inputs={"i": ["a", 0]}),
         })
         assert not dsp.has_upstream_type(g, "a", ("Y",))
+
+
+def test_prune_without_distributed_nodes_returns_copy():
+    """Regression: a graph with no collector/upscaler must still be deep
+    copied, or per-participant hidden inputs leak into the caller's graph."""
+    from comfyui_distributed_tpu.workflow.dispatcher import (
+        make_job_id_map, prepare_for_participant, prune_for_worker)
+    from comfyui_distributed_tpu.workflow.graph import parse_api_format
+
+    g = parse_api_format({
+        "1": {"class_type": "DistributedSeed", "inputs": {"seed": 5}},
+        "2": {"class_type": "PreviewImage", "inputs": {"images": ["1", 0]}},
+    })
+    pruned = prune_for_worker(g)
+    assert pruned is not g
+    assert all(pruned.nodes[n] is not g.nodes[n] for n in g.nodes)
+
+    w0 = prepare_for_participant(g, "worker", {}, ["0", "1"],
+                                 worker_index=0)
+    w1 = prepare_for_participant(g, "worker", {}, ["0", "1"],
+                                 worker_index=1)
+    assert w0.nodes["1"].hidden["worker_id"] == "worker_0"
+    assert w1.nodes["1"].hidden["worker_id"] == "worker_1"
+    assert "worker_id" not in g.nodes["1"].hidden

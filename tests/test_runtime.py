@@ -112,3 +112,21 @@ class TestMonitor:
             env={**os.environ, "PYTHONPATH": "/root/repo"})
         mon.wait(timeout=15)
         assert mon.returncode == 7
+
+
+def test_put_tile_requires_existing_queue():
+    """Regression: late tile posts after queue removal must be rejected, not
+    resurrect an orphan queue (unbounded memory on a long-running master)."""
+    import asyncio
+    from comfyui_distributed_tpu.runtime.jobs import JobStore
+
+    async def run():
+        store = JobStore()
+        assert not await store.put_tile("gone", {"tile_idx": 0})
+        await store.get_tile_queue("live")
+        assert await store.put_tile("live", {"tile_idx": 0})
+        await store.remove_tile_queue("live")
+        assert not await store.put_tile("live", {"tile_idx": 1})
+        assert store.snapshot()["tile_jobs"] == []
+
+    asyncio.run(run())

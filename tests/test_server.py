@@ -141,19 +141,29 @@ class TestDataPlane:
             assert (await r.json())["exists"] is False
 
             import aiohttp
-            form = aiohttp.FormData()
-            form.add_field("multi_job_id", "t1")
-            form.add_field("worker_id", "worker_0")
-            form.add_field("tile_idx", "3")
-            form.add_field("x", "64")
-            form.add_field("y", "0")
-            form.add_field("extracted_width", "96")
-            form.add_field("extracted_height", "96")
-            form.add_field("is_last", "true")
-            form.add_field("tile", encode_png(
-                rng.random((1, 8, 8, 3)).astype(np.float32)),
-                filename="t.png", content_type="image/png")
-            r = await client.post("/distributed/tile_complete", data=form)
+            png = encode_png(rng.random((1, 8, 8, 3)).astype(np.float32))
+
+            def mkform():  # FormData payloads are single-use
+                form = aiohttp.FormData()
+                form.add_field("multi_job_id", "t1")
+                form.add_field("worker_id", "worker_0")
+                form.add_field("tile_idx", "3")
+                form.add_field("x", "64")
+                form.add_field("y", "0")
+                form.add_field("extracted_width", "96")
+                form.add_field("extracted_height", "96")
+                form.add_field("is_last", "true")
+                form.add_field("tile", png, filename="t.png",
+                               content_type="image/png")
+                return form
+
+            # unknown tile job -> 404 (worker retry loop backs off; the
+            # master pre-creates the queue before dispatch)
+            r = await client.post("/distributed/tile_complete", data=mkform())
+            assert r.status == 404
+
+            await state.jobs.get_tile_queue("t1")  # master-side pre-create
+            r = await client.post("/distributed/tile_complete", data=mkform())
             assert r.status == 200
 
             r = await client.get("/distributed/queue_status",

@@ -1,0 +1,101 @@
+"""Sharded training step: dp/tp/sp shardings compile and step on the 8-device
+virtual mesh (SURVEY.md §4 — multi-device without a cluster)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from comfyui_distributed_tpu.models.schedules import make_discrete_schedule
+from comfyui_distributed_tpu.models.unet import UNet, TINY_CONFIG
+from comfyui_distributed_tpu.parallel import sharding as shd
+from comfyui_distributed_tpu.parallel.mesh import build_mesh
+from comfyui_distributed_tpu.parallel.train import (
+    TrainConfig,
+    diffusion_loss,
+    make_train_step,
+    shard_train_step,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = UNet(TINY_CONFIG)
+    ds = make_discrete_schedule()
+    rng = jax.random.PRNGKey(0)
+    B, L = 4, 16
+    x = jnp.zeros((B, 8, 8, 4), jnp.float32)
+    ts = jnp.zeros((B,), jnp.float32)
+    ctx = jnp.zeros((B, L, TINY_CONFIG.context_dim), jnp.float32)
+    params = model.init(rng, x, ts, ctx)
+
+    def apply_fn(p, x, t, c, y):
+        return model.apply(p, x, t, c, y)
+
+    batch = {"latents": np.random.default_rng(0).normal(
+        size=(B, 8, 8, 4)).astype(np.float32),
+        "context": np.random.default_rng(1).normal(
+        size=(B, L, TINY_CONFIG.context_dim)).astype(np.float32)}
+    return model, ds, params, apply_fn, batch
+
+
+def test_param_spec_rules():
+    # trailing dim divisible -> column parallel
+    assert shd.param_spec("k", (64, 64), 2, min_elements=2) == P(None, "tensor")
+    # only second-to-last divisible -> row parallel
+    assert shd.param_spec("k", (64, 63), 2, min_elements=2) == P("tensor", None)
+    # biases/scales replicate
+    assert shd.param_spec("b", (64,), 2, min_elements=2) == P()
+    # too small replicates
+    assert shd.param_spec("k", (4, 4), 2, min_elements=2 ** 11) == P()
+    # tensor axis of 1 replicates
+    assert shd.param_spec("k", (64, 64), 1, min_elements=2) == P()
+
+
+def test_loss_decreases_and_finite(setup):
+    model, ds, params, apply_fn, batch = setup
+    loss, metrics = diffusion_loss(apply_fn, params, batch,
+                                   jax.random.PRNGKey(0), ds)
+    assert np.isfinite(float(loss))
+    assert float(loss) > 0
+
+
+def test_sharded_train_step_runs(setup):
+    model, ds, params, apply_fn, batch = setup
+    mesh = build_mesh({"data": 2, "tensor": 2, "seq": 2})
+    step, tx = make_train_step(apply_fn, ds, TrainConfig(learning_rate=1e-3))
+    # the jitted step donates params/opt_state; keep the fixture's copy alive
+    params = jax.tree_util.tree_map(jnp.array, params)
+    opt_state = tx.init(params)
+    jitted, p, o, b = shard_train_step(step, mesh, params, opt_state, batch,
+                                       min_shard_elements=2)
+    key = jax.random.PRNGKey(1)
+    p1, o1, m1 = jitted(p, o, b, key)
+    loss1 = float(jax.device_get(m1["loss"]))
+    assert np.isfinite(loss1)
+    # params actually sharded over the tensor axis somewhere
+    specs = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda x: x.sharding.spec, p1,
+                               is_leaf=lambda x: hasattr(x, "sharding")))
+    assert any("tensor" in str(s) for s in specs)
+    # a second step with the same key family keeps making progress (finite)
+    b2 = {k: jnp.asarray(v) for k, v in b.items()}
+    p2, o2, m2 = jitted(p1, o1, b2, jax.random.fold_in(key, 1))
+    assert np.isfinite(float(jax.device_get(m2["loss"])))
+
+
+def test_training_reduces_loss(setup):
+    """A few steps on a fixed batch must reduce the loss (fixed key -> same
+    noise draw, so this isolates optimizer correctness)."""
+    model, ds, params, apply_fn, batch = setup
+    step, tx = make_train_step(apply_fn, ds, TrainConfig(learning_rate=1e-3))
+    opt_state = tx.init(params)
+    key = jax.random.PRNGKey(7)
+    jstep = jax.jit(step)
+    losses = []
+    p, o = params, opt_state
+    for _ in range(5):
+        p, o, m = jstep(p, o, batch, key)
+        losses.append(float(jax.device_get(m["loss"])))
+    assert losses[-1] < losses[0]
