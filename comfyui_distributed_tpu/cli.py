@@ -37,6 +37,8 @@ def cmd_worker(args) -> int:
 
 
 def cmd_run(args) -> int:
+    if args.via:
+        return _run_via_server(args)
     from comfyui_distributed_tpu.ops.base import OpContext
     from comfyui_distributed_tpu.parallel.mesh import get_runtime
     from comfyui_distributed_tpu.workflow import WorkflowExecutor
@@ -57,6 +59,42 @@ def cmd_run(args) -> int:
         "output_dir": ctx.output_dir,
     }))
     return 0
+
+
+def _run_via_server(args) -> int:
+    """Submit a workflow to a running master server and poll until done —
+    the headless stand-in for the reference's browser queueing a prompt
+    (its interceptor orchestrates server-side)."""
+    import time
+    import urllib.request
+
+    with open(args.workflow, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    from comfyui_distributed_tpu.workflow.graph import parse_workflow
+    prompt = parse_workflow(doc).to_api_format()
+
+    def post(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    res = post(f"{args.via}/prompt", {"prompt": prompt,
+                                      "client_id": "dtpu-cli"})
+    pid = res["prompt_id"]
+    if res.get("workers"):
+        print(f"dispatched to workers: {res['workers']}", file=sys.stderr)
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        with urllib.request.urlopen(f"{args.via}/history", timeout=10) as r:
+            hist = json.loads(r.read())
+        if pid in hist:
+            print(json.dumps({"prompt_id": pid, **hist[pid]}))
+            return 0 if hist[pid].get("status") == "success" else 1
+        time.sleep(1.0)
+    print(json.dumps({"prompt_id": pid, "status": "timeout"}))
+    return 1
 
 
 def cmd_devices(args) -> int:
@@ -98,6 +136,10 @@ def main(argv=None) -> int:
     p.add_argument("workflow")
     p.add_argument("--out", default=None)
     p.add_argument("--input-dir", default=None)
+    p.add_argument("--via", default=None, metavar="URL",
+                   help="submit to a running master server (it orchestrates "
+                        "HTTP workers) instead of executing in-process")
+    p.add_argument("--timeout", type=float, default=600.0)
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser("devices", help="show device topology")

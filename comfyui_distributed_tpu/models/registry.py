@@ -223,9 +223,39 @@ class DiffusionPipeline:
 
 
 def _virtual_params(module, seed: int, *shaped_args) -> Any:
-    rng = jax.random.PRNGKey(seed)
-    variables = module.init(rng, *shaped_args)
-    return variables["params"]
+    """Deterministic random init WITHOUT compiling the model's init graph.
+
+    ``module.init`` traces the full forward pass — for SDXL that is a
+    multi-minute XLA compile before a single weight exists.  Virtual
+    checkpoints only need *deterministic, sanely-scaled* weights, so we
+    eval_shape the init (trace only, no compile) and fill each leaf with
+    seeded numpy: fan-in-scaled normals for kernels, zeros for biases, ones
+    for norm scales.  Per-leaf streams are keyed by crc32 of the tree path —
+    stable across processes and hosts, so every mesh host materializes
+    identical weights (the reference's "same models on all machines"
+    requirement, ``README.md:189-193``)."""
+    import zlib
+
+    shapes = jax.eval_shape(module.init, jax.random.PRNGKey(0), *shaped_args)
+
+    def leaf(path, sd):
+        name = jax.tree_util.keystr(path)
+        leaf_name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        rng = np.random.default_rng(
+            (np.uint64(seed), np.uint64(zlib.crc32(name.encode()))))
+        shape = tuple(sd.shape)
+        dtype = sd.dtype
+        if leaf_name in ("scale",):
+            arr = np.ones(shape, np.float32)
+        elif leaf_name in ("bias",) or len(shape) <= 1:
+            arr = np.zeros(shape, np.float32)
+        else:
+            fan_in = int(np.prod(shape[:-1])) or 1
+            arr = rng.standard_normal(shape, dtype=np.float32) \
+                / np.sqrt(fan_in)
+        return jnp.asarray(arr, dtype=dtype)
+
+    return jax.tree_util.tree_map_with_path(leaf, shapes)["params"]
 
 
 _pipeline_cache: Dict[str, DiffusionPipeline] = {}

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference has no custom kernels (its compute is entirely ComfyUI's torch
+stack); these exist because the UNet's attention is the dominant non-conv
+cost on TPU and a fused VMEM-resident kernel avoids materializing the
+[N, N] attention matrix in HBM.
+"""
+
+from comfyui_distributed_tpu.ops.pallas.flash_attention import (  # noqa: F401
+    flash_attention,
+)

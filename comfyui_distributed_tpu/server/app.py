@@ -74,6 +74,7 @@ class ServerState:
                  start_exec_thread: bool = True):
         self.config_path = config_path
         self.is_worker = is_worker
+        self.port: Optional[int] = None  # set by serve()
         self.input_dir = input_dir or os.path.join(os.getcwd(), "input")
         self.output_dir = output_dir or os.path.join(os.getcwd(), "output")
         self.models_dir = models_dir
@@ -97,6 +98,29 @@ class ServerState:
             t = threading.Thread(target=self._exec_loop, daemon=True,
                                  name="dtpu-exec")
             t.start()
+
+    def _drop_tile_queues(self, prompt: Dict[str, Any]) -> None:
+        """Remove master-mode tile queues for a finished prompt.  They're
+        pre-created at /prompt time (before the exec thread runs), so a
+        prompt that fails before its upscale node would otherwise leave an
+        orphan queue accepting tiles forever — the leak put_tile's
+        require_existing guard exists to prevent.  The upscale node's own
+        drain also removes the queue; this is the failure-path backstop."""
+        if self.loop is None:
+            return
+        for node in prompt.values():
+            if not isinstance(node, dict) \
+                    or node.get("class_type") != "UltimateSDUpscaleDistributed":
+                continue
+            h = {**node.get("inputs", {}), **node.get("hidden", {})}
+            mj = h.get("multi_job_id")
+            if mj and not h.get("is_worker"):
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        self.jobs.remove_tile_queue(str(mj)),
+                        self.loop).result(timeout=5)
+                except Exception as e:  # noqa: BLE001 - cleanup best-effort
+                    debug_log(f"tile queue cleanup {mj}: {e}")
 
     # --- execution queue (ComfyUI /prompt semantics) -----------------------
 
@@ -149,6 +173,7 @@ class ServerState:
                                              "error": str(e)}
                 self.metrics["prompts_failed"] += 1
             finally:
+                self._drop_tile_queues(item["prompt"])
                 with self._queue_lock:
                     self._running = False
                 debug_log(f"prompt {item['id']} done in "
@@ -248,7 +273,32 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         return web.json_response(st)
 
     async def metrics(request):
-        return web.json_response(state.metrics)
+        from comfyui_distributed_tpu.utils.trace import GLOBAL_PHASES
+        return web.json_response({**state.metrics,
+                                  "phases": GLOBAL_PHASES.snapshot()})
+
+    # --- profiling (the subsystem the reference lacks, SURVEY.md §5) -------
+
+    async def profile_start(request):
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        data = await request.json() if request.can_read_body else {}
+        try:
+            out = trace_mod.start_device_trace(data.get("dir"))
+        except RuntimeError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return ok({"dir": out})
+
+    async def profile_stop(request):
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        try:
+            out = trace_mod.stop_device_trace()
+        except RuntimeError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return ok({"dir": out})
+
+    async def profile_status(request):
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        return web.json_response(trace_mod.trace_status())
 
     async def clear_memory(request):
         import gc
@@ -414,13 +464,64 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             mj = h.get("multi_job_id")
             if mj and not h.get("is_worker"):
                 await state.jobs.prepare_tile_job(str(mj))
+        client_id = data.get("client_id", "unknown")
         try:
-            pid = state.enqueue_prompt(prompt,
-                                       data.get("client_id", "unknown"))
+            cfg = await _orchestration_config(prompt)
+            if cfg is not None:
+                # headless interceptor (reference setupInterceptor,
+                # gpupanel.js:819-834): fan out to enabled HTTP workers,
+                # enqueue the master's prepared share locally
+                from comfyui_distributed_tpu.workflow.orchestrate import (
+                    run_distributed)
+
+                async def enqueue_graph(g):
+                    return state.enqueue_prompt(g.to_api_format(), client_id)
+
+                host = cfg.get("master", {}).get("host") or "127.0.0.1"
+                master_url = f"http://{host}:{state.port or 8288}"
+                out = await run_distributed(
+                    prompt, master_url,
+                    workers=cfg_mod.enabled_workers(cfg),
+                    master_dispatch=enqueue_graph, job_store=state.jobs,
+                    client_id=client_id)
+                return web.json_response({
+                    "prompt_id": out["result"],
+                    "number": state.queue_remaining(),
+                    "workers": out["workers"],
+                    "failed_workers": out.get("failed", []),
+                })
+            pid = state.enqueue_prompt(prompt, client_id)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=400)
         return web.json_response({"prompt_id": pid,
                                   "number": state.queue_remaining()})
+
+    async def _orchestration_config(prompt: Dict[str, Any]):
+        """Return the loaded config when this prompt should fan out, else
+        None.  Conditions: we're a master, the graph has distributed nodes,
+        they are not already prepared (no hidden multi_job_id — i.e. not a
+        graph some other orchestrator dispatched to us), and HTTP workers
+        are enabled (reference routing condition, ``gpupanel.js:826-833``).
+        The config is loaded ONCE, off the event loop, and reused for the
+        master URL and worker list."""
+        if state.is_worker:
+            return None
+        found = False
+        for node in prompt.values():
+            if not isinstance(node, dict):
+                continue
+            if node.get("class_type") in ("DistributedCollector",
+                                          "UltimateSDUpscaleDistributed"):
+                h = {**node.get("inputs", {}), **node.get("hidden", {})}
+                if h.get("multi_job_id"):
+                    return None  # already orchestrated elsewhere
+                found = True
+        if not found:
+            return None
+        loop = asyncio.get_running_loop()
+        cfg = await loop.run_in_executor(
+            None, lambda: cfg_mod.load_config(state.config_path))
+        return cfg if cfg_mod.enabled_workers(cfg) else None
 
     async def interrupt(request):
         state.interrupt_event.set()
@@ -450,6 +551,9 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/distributed/network_info", network_info)
     r.add_get("/distributed/status", status)
     r.add_get("/distributed/metrics", metrics)
+    r.add_post("/distributed/profile/start", profile_start)
+    r.add_post("/distributed/profile/stop", profile_stop)
+    r.add_get("/distributed/profile/status", profile_status)
     r.add_post("/distributed/clear_memory", clear_memory)
     r.add_post("/distributed/launch_worker", launch_worker)
     r.add_post("/distributed/stop_worker", stop_worker)
@@ -474,6 +578,7 @@ def serve(host: str = "0.0.0.0", port: int = 8288,
           auto_launch: bool = True) -> None:
     """Blocking server entry point."""
     state = state or ServerState()
+    state.port = port
     app = build_app(state)
     if auto_launch and not state.is_worker:
         auto_launch_workers(state.manager)

@@ -75,4 +75,8 @@ class Timer:
         self.elapsed_s = time.perf_counter() - self._t0
         if self.emit:
             debug_log(f"phase[{self.name}] {self.elapsed_s * 1e3:.1f} ms")
+        # feed the process-wide phase aggregator (lazy import: trace sits
+        # above logging in the utils dependency order)
+        from comfyui_distributed_tpu.utils.trace import GLOBAL_PHASES
+        GLOBAL_PHASES.record(self.name, self.elapsed_s)
         return False

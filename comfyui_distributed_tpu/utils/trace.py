@@ -1,0 +1,109 @@
+"""Tracing / profiling subsystem.
+
+The reference has NONE (SURVEY.md §5: "Tracing / profiling: ABSENT" — its
+only timing is a preflight elapsed-ms debug line, ``gpupanel.js:1502``).
+Here profiling is a first-class subsystem:
+
+- phase wall-clock aggregation (:class:`PhaseStats`) fed by
+  ``utils.logging.Timer`` and the executor's per-node timings, surfaced on
+  ``GET /distributed/metrics``;
+- XLA/device traces via ``jax.profiler`` (viewable in TensorBoard /
+  Perfetto), driven by ``POST /distributed/profile/start`` + ``/stop`` or
+  the :func:`trace` context manager.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+from comfyui_distributed_tpu.utils.logging import log
+
+
+class PhaseStats:
+    """Aggregated per-phase wall-clock: count/total/max (thread-safe)."""
+
+    def __init__(self) -> None:
+        self._stats: Dict[str, Dict[str, float]] = {}
+        self._lock = threading.Lock()
+
+    def record(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            s = self._stats.setdefault(
+                phase, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            s["count"] += 1
+            s["total_s"] += seconds
+            s["max_s"] = max(s["max_s"], seconds)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._stats.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+# process-wide sink the Timer class reports into
+GLOBAL_PHASES = PhaseStats()
+
+
+@contextmanager
+def phase(name: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        GLOBAL_PHASES.record(name, time.perf_counter() - t0)
+
+
+# --- device/XLA tracing ------------------------------------------------------
+
+_trace_lock = threading.Lock()
+_trace_dir: Optional[str] = None
+
+
+def start_device_trace(out_dir: Optional[str] = None) -> str:
+    """Begin a ``jax.profiler`` trace (TensorBoard/Perfetto format)."""
+    global _trace_dir
+    import jax
+    with _trace_lock:
+        if _trace_dir is not None:
+            raise RuntimeError(f"trace already running -> {_trace_dir}")
+        out_dir = out_dir or os.path.join(
+            os.getcwd(), "traces", time.strftime("%Y%m%d-%H%M%S"))
+        os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+        _trace_dir = out_dir
+        log(f"device trace started -> {out_dir}")
+        return out_dir
+
+
+def stop_device_trace() -> str:
+    global _trace_dir
+    import jax
+    with _trace_lock:
+        if _trace_dir is None:
+            raise RuntimeError("no trace running")
+        jax.profiler.stop_trace()
+        out = _trace_dir
+        _trace_dir = None
+        log(f"device trace stopped -> {out}")
+        return out
+
+
+def trace_status() -> Dict[str, Any]:
+    with _trace_lock:
+        return {"running": _trace_dir is not None, "dir": _trace_dir}
+
+
+@contextmanager
+def device_trace(out_dir: Optional[str] = None):
+    d = start_device_trace(out_dir)
+    try:
+        yield d
+    finally:
+        stop_device_trace()

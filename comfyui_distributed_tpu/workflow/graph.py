@@ -168,6 +168,8 @@ def parse_ui_format(doc: Dict[str, Any]) -> Graph:
 def parse_api_format(doc: Dict[str, Any]) -> Graph:
     nodes: Dict[str, Node] = {}
     for nid, entry in doc.items():
+        if not isinstance(entry, dict) or "class_type" not in entry:
+            continue  # metadata keys ("__doc__", "extra_data", ...)
         cls = NODE_CLASS_MAPPINGS.get(entry["class_type"])
         inputs = dict(cls.DEFAULTS) if cls and cls.DEFAULTS else {}
         raw = dict(entry.get("inputs", {}))

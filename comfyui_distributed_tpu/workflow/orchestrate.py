@@ -1,0 +1,223 @@
+"""Run orchestrator: the reference's browser-side ``executeParallelDistributed``
+flow (``/root/reference/web/gpupanel.js:836-941``) as a headless driver.
+
+Sequence (parity-by-step with the reference):
+1. preflight every enabled worker, drop the dead ones (``:842-848``);
+   zero alive -> master-only fallback;
+2. map each distributed node to a ``multi_job_id`` (``:856-858``);
+3. prepare result queues on the master BEFORE any dispatch (``:860-862``)
+   — image queues for collectors, tile queues for upscalers (the
+   reference covers the latter with IS_CHANGED pre-init);
+4. stage referenced input images onto remote workers (``:1364-1468``);
+5. build per-participant graphs (prune + hidden-input injection,
+   ``:1074-1177``) and dispatch: master locally through the executor,
+   workers via POST /prompt — in parallel (``:910-941``).
+
+The SPMD mesh path needs none of this; this module exists for the HTTP
+multi-host topology (remote hosts joined over the network rather than ICI).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import aiohttp
+
+from comfyui_distributed_tpu.utils import config as cfg_mod
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+from comfyui_distributed_tpu.utils.net import get_client_session
+from comfyui_distributed_tpu.workflow import dispatcher as dsp
+from comfyui_distributed_tpu.workflow.graph import Graph, parse_workflow
+
+# filename-valued image inputs, incl. ComfyUI's "name.png [input]" suffix and
+# subfolder paths (reference findImageReferences, gpupanel.js:955-979)
+_IMAGE_REF = re.compile(
+    r"^[\w\-. /\\]+\.(png|jpg|jpeg|webp|bmp|gif)(\s*\[\w+\])?$",
+    re.IGNORECASE)
+
+
+def find_image_references(graph: Graph) -> List[str]:
+    """Filename-valued ``image`` inputs that must be staged onto remote
+    workers before dispatch (reference ``findImageReferences``)."""
+    refs: List[str] = []
+    for node in graph.nodes.values():
+        for name, val in node.inputs.items():
+            if name != "image" or not isinstance(val, str):
+                continue
+            if _IMAGE_REF.match(val.strip()):
+                refs.append(val.strip())
+    return refs
+
+
+def _clean_image_name(ref: str) -> str:
+    return re.sub(r"\s*\[\w+\]$", "", ref)
+
+
+async def stage_images_on_worker(master_url: str, worker: Dict[str, Any],
+                                 refs: List[str]) -> None:
+    """Pull input images from the master and push them to one remote worker
+    (reference ``loadImagesForWorker``/``uploadImagesToWorker``,
+    ``gpupanel.js:1364-1468``)."""
+    if not refs:
+        return
+    session = await get_client_session()
+    wurl = dsp.worker_url(worker)
+    for ref in refs:
+        name = _clean_image_name(ref)
+        async with session.post(
+                f"{master_url}/distributed/load_image",
+                json={"image_name": name},
+                timeout=aiohttp.ClientTimeout(total=30)) as r:
+            if r.status != 200:
+                log(f"stage: master missing input {name!r} ({r.status}); "
+                    f"skipping")
+                continue
+            data = await r.json()
+        form = aiohttp.FormData()
+        form.add_field("image", base64.b64decode(data["image_data"]),
+                       filename=os.path.basename(name),
+                       content_type="image/png")
+        async with session.post(
+                f"{wurl}/upload/image", data=form,
+                timeout=aiohttp.ClientTimeout(total=30)) as r:
+            if r.status != 200:
+                raise RuntimeError(
+                    f"image staging to {worker.get('id')} failed: {r.status}")
+        debug_log(f"staged {name} -> worker {worker.get('id')}")
+
+
+def _is_remote(worker: Dict[str, Any]) -> bool:
+    return worker.get("host") not in (None, "", "localhost", "127.0.0.1")
+
+
+async def _post_prompt(url: str, graph: Graph, client_id: str) -> Any:
+    """Queue a graph on a server's ComfyUI-compatible /prompt."""
+    session = await get_client_session()
+    payload = {"prompt": graph.to_api_format(), "client_id": client_id}
+    async with session.post(f"{url}/prompt", json=payload,
+                            timeout=aiohttp.ClientTimeout(total=30)) as r:
+        if r.status != 200:
+            raise RuntimeError(f"master rejected prompt ({r.status}): "
+                               f"{(await r.text())[:200]}")
+        return await r.json()
+
+
+async def run_distributed(graph_or_doc: Any,
+                          master_url: str,
+                          workers: Optional[List[Dict[str, Any]]] = None,
+                          config_path: Optional[str] = None,
+                          executor=None,
+                          master_dispatch=None,
+                          job_store=None,
+                          client_id: str = "dtpu-orchestrator",
+                          job_prefix: Optional[str] = None) -> Dict[str, Any]:
+    """Fan a workflow out to master + enabled workers.
+
+    The master's share runs through exactly one of:
+    - ``executor``: sync callable ``(graph) -> ExecutionResult`` run on a
+      thread in this process (CLI-with-local-mesh; the collector op inside
+      it drains worker results);
+    - ``master_dispatch``: async callable ``(graph) -> Any`` (the server's
+      own enqueue when orchestrating from inside the master process);
+    - neither: POST to ``master_url/prompt`` (remote orchestrator client —
+      the closest analog of the reference's browser calling
+      ``originalQueuePrompt``, ``gpupanel.js:931``).
+
+    Returns ``{"result": ..., "workers": [...], "failed": [...],
+    "job_ids": {...}}``.
+    """
+    graph = graph_or_doc if isinstance(graph_or_doc, Graph) \
+        else parse_workflow(graph_or_doc)
+    if workers is None:
+        cfg = cfg_mod.load_config(config_path)
+        workers = cfg_mod.enabled_workers(cfg)
+
+    if master_dispatch is None:
+        if executor is not None:
+            async def master_dispatch(g, _ex=executor):
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(None, lambda: _ex(g))
+        else:
+            async def master_dispatch(g):
+                return await _post_prompt(master_url, g, client_id)
+
+    # 1. preflight (drop dead workers; reference gpupanel.js:842-848)
+    alive = await dsp.preflight_check(workers) if workers else []
+    if workers and not alive:
+        log("orchestrator: no workers alive, running master-only")
+
+    has_distributed = bool(graph.find_by_type(*dsp.DISTRIBUTED_TYPES))
+    if not alive or not has_distributed:
+        result = await master_dispatch(graph)
+        return {"result": result, "workers": [], "failed": [],
+                "job_ids": {}}
+
+    # 2. one multi_job_id per distributed node (reference :856-858)
+    job_id_map = dsp.make_job_id_map(graph, prefix=job_prefix)
+
+    # 3. prepare queues BEFORE dispatch (reference :860-862 + IS_CHANGED);
+    # when orchestrating from inside the master process, hit the job store
+    # directly instead of looping through our own HTTP surface
+    for nid, mj in job_id_map.items():
+        kind = "tile" if graph.nodes[nid].class_type in dsp.UPSCALER_TYPES \
+            else "image"
+        if job_store is not None:
+            if kind == "tile":
+                await job_store.prepare_tile_job(mj)
+            else:
+                await job_store.prepare_job(mj)
+        else:
+            await dsp.prepare_job_on(master_url, mj, kind=kind)
+
+    # 4. stage input images on remote workers (reference :1364-1468)
+    refs = find_image_references(graph)
+    if refs:
+        await asyncio.gather(*(
+            stage_images_on_worker(master_url, w, refs)
+            for w in alive if _is_remote(w)))
+
+    # 5. per-participant graphs + parallel dispatch (reference :868-941)
+    enabled_ids = [str(w["id"]) for w in alive]
+    master_graph = dsp.prepare_for_participant(
+        graph, "master", job_id_map, enabled_ids, master_url=master_url)
+
+    async def dispatch(worker, index):
+        wgraph = dsp.prepare_for_participant(
+            graph, "worker", job_id_map, enabled_ids,
+            master_url=master_url, worker_index=index)
+        return await dsp.dispatch_to_worker(worker, wgraph,
+                                            client_id=client_id)
+
+    t0 = time.perf_counter()
+    dispatches = asyncio.gather(
+        *(dispatch(w, i) for i, w in enumerate(alive)),
+        return_exceptions=True)
+
+    # master executes its own share while worker dispatches are in flight;
+    # the collector/upscaler ops block on the queues prepared above
+    result = await master_dispatch(master_graph)
+
+    outcomes = await dispatches
+    ok_workers, failed = [], []
+    for w, out in zip(alive, outcomes):
+        if isinstance(out, Exception):
+            log(f"orchestrator: dispatch to {w.get('id')} failed: {out}")
+            failed.append(str(w.get("id")))
+        else:
+            ok_workers.append(str(w.get("id")))
+    debug_log(f"orchestrator: {len(ok_workers)} dispatched, "
+              f"{len(failed)} failed, {time.perf_counter() - t0:.2f}s total")
+    return {"result": result, "workers": ok_workers, "failed": failed,
+            "job_ids": job_id_map}
+
+
+def run_distributed_sync(graph_or_doc: Any, master_url: str, **kw
+                         ) -> Dict[str, Any]:
+    """Blocking wrapper for CLI use (no running event loop)."""
+    return asyncio.run(run_distributed(graph_or_doc, master_url, **kw))

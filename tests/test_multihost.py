@@ -134,3 +134,37 @@ def test_parallel_generation_over_http(servers):
 
     whist = _get(f"http://127.0.0.1:{wport}/history")
     assert whist[wr["prompt_id"]]["status"] == "success"
+
+
+@pytest.mark.integration
+def test_interceptor_orchestrates_automatically(servers):
+    """The headless interceptor (server-side equivalent of the reference's
+    queuePrompt monkey-patch, gpupanel.js:819-834): a RAW workflow POSTed to
+    the master with an enabled worker fans out with no client-side rewrite."""
+    mport, wport, tmp_path = servers
+    master_url = f"http://127.0.0.1:{mport}"
+
+    # enable the worker in the master's config (the panel's checkbox)
+    _post(f"{master_url}/distributed/config/update_worker",
+          {"id": "w0", "name": "w0", "port": wport, "enabled": True})
+
+    g = parse_workflow(TXT2IMG)
+    g.nodes["9"].inputs.update(width=64, height=64, batch_size=1)
+    g.nodes["8"].inputs.update(steps=1)
+
+    mr = _post(f"{master_url}/prompt",
+               {"prompt": g.to_api_format(), "client_id": "test"})
+    assert mr.get("workers") == ["w0"], mr
+    assert mr.get("failed_workers") == [], mr
+
+    deadline = time.time() + 240
+    done = {}
+    while time.time() < deadline:
+        hist = _get(f"{master_url}/history")
+        if mr["prompt_id"] in hist:
+            done = hist[mr["prompt_id"]]
+            break
+        time.sleep(1.0)
+    assert done, "master prompt never completed"
+    assert done["status"] == "success", done
+    assert done["images"] == 2  # master's + worker's, gathered over HTTP

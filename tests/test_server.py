@@ -285,3 +285,41 @@ class TestLifecycleRoutes:
             r = await client.get("/distributed/managed_workers")
             assert await r.json() == {}
         run_with_client(body, tmp_path, start_exec_thread=False)
+
+
+class TestProfiling:
+    def test_profile_endpoints(self, tmp_path):
+        async def body(client, state):
+            r = await client.get("/distributed/profile/status")
+            assert (await r.json())["running"] is False
+
+            r = await client.post("/distributed/profile/start",
+                                  json={"dir": str(tmp_path / "tr")})
+            assert r.status == 200
+
+            r = await client.post("/distributed/profile/start",
+                                  json={"dir": str(tmp_path / "tr2")})
+            assert r.status == 409  # already running
+
+            r = await client.get("/distributed/profile/status")
+            assert (await r.json())["running"] is True
+
+            r = await client.post("/distributed/profile/stop")
+            assert r.status == 200
+            assert (await r.json())["dir"] == str(tmp_path / "tr")
+
+            r = await client.post("/distributed/profile/stop")
+            assert r.status == 409
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+    def test_metrics_include_phases(self, tmp_path):
+        from comfyui_distributed_tpu.utils.logging import Timer
+        with Timer("unit_test_phase"):
+            pass
+
+        async def body(client, state):
+            r = await client.get("/distributed/metrics")
+            data = await r.json()
+            assert "phases" in data
+            assert data["phases"]["unit_test_phase"]["count"] >= 1
+        run_with_client(body, tmp_path, start_exec_thread=False)
