@@ -103,6 +103,79 @@ def cmd_devices(args) -> int:
     return 0
 
 
+def cmd_worker_ctl(args) -> int:
+    """launch/stop/log for one worker — the reference panel's per-card
+    buttons (``gpupanel.js:1519-2085``), driven locally or via a running
+    master's HTTP endpoints with --url."""
+    if args.url:
+        import urllib.request
+        if args.action == "log":
+            with urllib.request.urlopen(
+                    f"{args.url}/distributed/worker_log?id={args.id}",
+                    timeout=10) as r:
+                print(json.loads(r.read())["log"])
+            return 0
+        req = urllib.request.Request(
+            f"{args.url}/distributed/{args.action}_worker",
+            data=json.dumps({"id": args.id}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            print(r.read().decode())
+        return 0
+
+    from comfyui_distributed_tpu.runtime.manager import WorkerProcessManager
+    from comfyui_distributed_tpu.utils import config as cfg_mod
+    manager = WorkerProcessManager(config_path=args.config)
+    if args.action == "log":
+        print(manager.tail_log(args.id))
+        return 0
+    if args.action == "stop":
+        ok = manager.stop_worker(args.id)
+        print(json.dumps({"stopped": ok}))
+        return 0 if ok else 1
+    cfg = cfg_mod.load_config(args.config)
+    worker = next((w for w in cfg.get("workers", [])
+                   if str(w.get("id")) == str(args.id)), None)
+    if worker is None:
+        print(json.dumps({"error": f"worker {args.id} not in config"}))
+        return 1
+    # never tie the worker to this one-shot CLI process: the master-death
+    # monitor would kill it the moment the CLI exits (stop_on_master_exit
+    # only makes sense when a resident master launches the worker)
+    entry = manager.launch_worker(worker, stop_on_master_exit=False)
+    print(json.dumps(entry))
+    return 0
+
+
+def cmd_workers(args) -> int:
+    """Headless worker panel: config + live health + managed-process state
+    (what the reference's sidebar cards show, ``gpupanel.js:327-801``)."""
+    from comfyui_distributed_tpu.runtime.health import HealthPoller
+    from comfyui_distributed_tpu.runtime.manager import WorkerProcessManager
+    from comfyui_distributed_tpu.utils import config as cfg_mod
+
+    cfg = cfg_mod.load_config(args.config)
+    manager = WorkerProcessManager(config_path=args.config)
+    health = HealthPoller(config_path=args.config).poll_once()
+    managed = manager.get_managed_workers()
+    out = []
+    for w in cfg.get("workers", []):
+        wid = str(w.get("id"))
+        out.append({
+            "id": wid,
+            "name": w.get("name", wid),
+            "host": w.get("host") or "127.0.0.1",
+            "port": w.get("port"),
+            "enabled": bool(w.get("enabled")),
+            "health": health.get(wid, {}).get("status", "unknown"),
+            "queue_remaining": health.get(wid, {}).get("queue_remaining"),
+            "managed": managed.get(wid),
+        })
+    print(json.dumps({"master": cfg.get("master", {}), "workers": out},
+                     indent=2))
+    return 0
+
+
 def cmd_status(args) -> int:
     import urllib.request
     with urllib.request.urlopen(f"{args.url}/distributed/status",
@@ -144,6 +217,18 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("devices", help="show device topology")
     p.set_defaults(fn=cmd_devices)
+
+    p = sub.add_parser("workers", help="worker panel: config+health+managed")
+    common(p)
+    p.set_defaults(fn=cmd_workers)
+
+    for action in ("launch", "stop", "log"):
+        p = sub.add_parser(action, help=f"{action} a managed worker")
+        common(p)
+        p.add_argument("id")
+        p.add_argument("--url", default=None,
+                       help="drive a running master instead of acting locally")
+        p.set_defaults(fn=cmd_worker_ctl, action=action)
 
     p = sub.add_parser("status", help="query a running server")
     p.add_argument("--url", default="http://127.0.0.1:8288")

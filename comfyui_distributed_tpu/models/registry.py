@@ -277,7 +277,14 @@ def load_pipeline(ckpt_name: str, models_dir: Optional[str] = None,
         if os.path.exists(cand):
             path = cand
 
-    if path is not None:
+    from comfyui_distributed_tpu.runtime.checkpointing import (
+        is_native_checkpoint, load_pipeline_checkpoint)
+    if path is not None and is_native_checkpoint(path):
+        # native orbax directory checkpoint (runtime/checkpointing.py) —
+        # its manifest carries the family, overriding name heuristics
+        native_family, unet_p, clip_ps, vae_p = load_pipeline_checkpoint(path)
+        fam = FAMILIES[family_name or native_family]
+    elif path is not None:
         from comfyui_distributed_tpu.models.checkpoints import load_checkpoint
         unet_p, clip_ps, vae_p = load_checkpoint(path, fam)
         log(f"loaded checkpoint {ckpt_name} ({fam.name}) from {path}")

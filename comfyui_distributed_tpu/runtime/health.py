@@ -1,0 +1,94 @@
+"""Worker health poller.
+
+The reference's browser polls every worker's ``GET /prompt`` every 2 s to
+drive the status dots and clear the 'launching' state
+(``/root/reference/web/gpupanel.js:1233-1311``).  Headless equivalent: a
+daemon thread on the master polling enabled workers, deriving
+online / processing / offline from reachability and queue depth, feeding
+``GET /distributed/workers_status``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from comfyui_distributed_tpu.utils import config as cfg_mod
+from comfyui_distributed_tpu.utils.constants import WORKER_CHECK_INTERVAL
+from comfyui_distributed_tpu.utils.logging import debug_log
+
+
+def probe_worker(worker: Dict[str, Any], timeout: float = 2.0) -> Dict[str, Any]:
+    """One status probe — reference ``checkWorkerStatus`` semantics
+    (``gpupanel.js:1249-1311``): offline on error, processing when
+    ``queue_remaining > 0``."""
+    host = worker.get("host") or "127.0.0.1"
+    url = f"http://{host}:{worker['port']}/prompt"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            data = json.loads(r.read())
+        remaining = int(data.get("exec_info", {}).get("queue_remaining", 0))
+        return {"status": "processing" if remaining > 0 else "online",
+                "queue_remaining": remaining, "last_seen": time.time()}
+    except (urllib.error.URLError, OSError, ValueError, TimeoutError):
+        return {"status": "offline", "queue_remaining": None,
+                "last_seen": None}
+
+
+class HealthPoller:
+    """Daemon polling thread + status snapshot store."""
+
+    def __init__(self, config_path: Optional[str] = None, manager=None,
+                 interval: float = WORKER_CHECK_INTERVAL):
+        self.config_path = config_path
+        self.manager = manager
+        self.interval = interval
+        self._status: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dtpu-health")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 - poller must survive
+                debug_log(f"health poll error: {e}")
+
+    def poll_once(self) -> Dict[str, Dict[str, Any]]:
+        cfg = cfg_mod.load_config(self.config_path)
+        workers: List[Dict[str, Any]] = cfg.get("workers", [])
+        snapshot: Dict[str, Dict[str, Any]] = {}
+        for w in workers:
+            wid = str(w.get("id"))
+            st = probe_worker(w) if w.get("enabled") else {
+                "status": "disabled", "queue_remaining": None,
+                "last_seen": None}
+            st["enabled"] = bool(w.get("enabled"))
+            snapshot[wid] = st
+            # first successful contact clears 'launching' (reference
+            # gpupanel.js:1286-1293 -> clear_launching endpoint)
+            if st["status"] in ("online", "processing") \
+                    and self.manager is not None:
+                self.manager.clear_launching(wid)
+        with self._lock:
+            self._status = snapshot
+        return snapshot
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._status.items()}

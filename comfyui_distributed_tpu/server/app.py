@@ -81,6 +81,9 @@ class ServerState:
         self.jobs = JobStore()
         self.manager = WorkerProcessManager(config_path=config_path,
                                             models_dir=models_dir)
+        from comfyui_distributed_tpu.runtime.health import HealthPoller
+        self.health = HealthPoller(config_path=config_path,
+                                   manager=self.manager)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.interrupt_event = threading.Event()
         self.metrics: Dict[str, Any] = {
@@ -338,6 +341,50 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     async def managed_workers(request):
         return web.json_response(state.manager.get_managed_workers())
 
+    async def workers_status(request):
+        """Live worker health (the reference panel's 2s status dots,
+        ``gpupanel.js:1233-1311``), served from the poller's snapshot."""
+        return web.json_response(state.health.snapshot())
+
+    async def _fanout_to_workers(path: str) -> Dict[str, Any]:
+        """POST ``path`` on every enabled worker (reference toolbar fan-out,
+        ``gpupanel.js:204-306``)."""
+        import aiohttp
+
+        from comfyui_distributed_tpu.utils.net import get_client_session
+        from comfyui_distributed_tpu.workflow.dispatcher import worker_url
+        loop = asyncio.get_running_loop()
+        cfg = await loop.run_in_executor(
+            None, lambda: cfg_mod.load_config(state.config_path))
+        session = await get_client_session()
+        results: Dict[str, Any] = {}
+
+        async def hit(w):
+            try:
+                async with session.post(
+                        worker_url(w) + path,
+                        timeout=aiohttp.ClientTimeout(total=10)) as r:
+                    results[str(w["id"])] = r.status
+            except Exception as e:  # noqa: BLE001 - report per-worker
+                results[str(w["id"])] = str(e)
+
+        await asyncio.gather(*(hit(w) for w in cfg_mod.enabled_workers(cfg)))
+        return results
+
+    async def cluster_clear_memory(request):
+        """Clear caches here AND on every enabled worker (reference
+        ``_handleClearMemory``, ``gpupanel.js:259-306``)."""
+        results = await _fanout_to_workers("/distributed/clear_memory")
+        await clear_memory(request)
+        return ok({"workers": results})
+
+    async def cluster_interrupt(request):
+        """Interrupt here AND on every enabled worker (reference
+        ``_handleInterruptWorkers``, ``gpupanel.js:204-257``)."""
+        results = await _fanout_to_workers("/interrupt")
+        state.interrupt_event.set()
+        return ok({"workers": results})
+
     async def worker_log(request):
         wid = request.query.get("id", "")
         try:
@@ -551,6 +598,9 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/distributed/network_info", network_info)
     r.add_get("/distributed/status", status)
     r.add_get("/distributed/metrics", metrics)
+    r.add_get("/distributed/workers_status", workers_status)
+    r.add_post("/distributed/cluster/clear_memory", cluster_clear_memory)
+    r.add_post("/distributed/cluster/interrupt", cluster_interrupt)
     r.add_post("/distributed/profile/start", profile_start)
     r.add_post("/distributed/profile/stop", profile_stop)
     r.add_get("/distributed/profile/status", profile_status)
@@ -580,6 +630,19 @@ def serve(host: str = "0.0.0.0", port: int = 8288,
     state = state or ServerState()
     state.port = port
     app = build_app(state)
+    if not state.is_worker:
+        # master-IP autodetect: save the recommended private-range IP as
+        # master.host when unset (reference detectMasterIP/saveMasterIp,
+        # gpupanel.js:2114-2190) so dispatched remote workers can reach us.
+        # Skipped when binding loopback-only — the LAN IP would then be
+        # unreachable and 127.0.0.1 (the master_url fallback) is correct.
+        if host not in ("127.0.0.1", "localhost"):
+            def autodetect(cfg):
+                if not cfg.get("master", {}).get("host"):
+                    cfg.setdefault("master", {})["host"] = \
+                        net_mod.get_recommended_ip()
+            cfg_mod.mutate_config(autodetect, state.config_path)
+        state.health.start()
     if auto_launch and not state.is_worker:
         auto_launch_workers(state.manager)
     role = "worker" if state.is_worker else "master"

@@ -130,3 +130,55 @@ def test_put_tile_requires_existing_queue():
         assert store.snapshot()["tile_jobs"] == []
 
     asyncio.run(run())
+
+
+class TestHealthPoller:
+    def test_poll_derives_status(self, tmp_path, monkeypatch):
+        """online / processing / offline / disabled derivation (reference
+        checkWorkerStatus, gpupanel.js:1249-1311)."""
+        from comfyui_distributed_tpu.runtime import health as health_mod
+        from comfyui_distributed_tpu.utils import config as cfg_mod
+
+        cfg = cfg_mod.load_config()
+        cfg["workers"] = [
+            {"id": "up", "port": 1, "enabled": True},
+            {"id": "busy", "port": 2, "enabled": True},
+            {"id": "down", "port": 3, "enabled": True},
+            {"id": "off", "port": 4, "enabled": False},
+        ]
+        cfg_mod.save_config(cfg)
+
+        def fake_probe(worker, timeout=2.0):
+            wid = worker["id"]
+            if wid == "up":
+                return {"status": "online", "queue_remaining": 0,
+                        "last_seen": 1.0}
+            if wid == "busy":
+                return {"status": "processing", "queue_remaining": 2,
+                        "last_seen": 1.0}
+            return {"status": "offline", "queue_remaining": None,
+                    "last_seen": None}
+
+        monkeypatch.setattr(health_mod, "probe_worker", fake_probe)
+
+        class FakeManager:
+            cleared = []
+
+            def clear_launching(self, wid):
+                self.cleared.append(wid)
+
+        mgr = FakeManager()
+        poller = health_mod.HealthPoller(manager=mgr)
+        snap = poller.poll_once()
+        assert snap["up"]["status"] == "online"
+        assert snap["busy"]["status"] == "processing"
+        assert snap["down"]["status"] == "offline"
+        assert snap["off"]["status"] == "disabled"
+        # first contact clears 'launching' for reachable workers only
+        assert sorted(mgr.cleared) == ["busy", "up"]
+        assert poller.snapshot() == snap
+
+    def test_probe_worker_offline(self):
+        from comfyui_distributed_tpu.runtime.health import probe_worker
+        st = probe_worker({"id": "x", "port": 1}, timeout=0.2)
+        assert st["status"] == "offline"

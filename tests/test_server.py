@@ -323,3 +323,20 @@ class TestProfiling:
             assert "phases" in data
             assert data["phases"]["unit_test_phase"]["count"] >= 1
         run_with_client(body, tmp_path, start_exec_thread=False)
+
+
+class TestClusterActions:
+    def test_workers_status_and_cluster_endpoints(self, tmp_path):
+        async def body(client, state):
+            r = await client.get("/distributed/workers_status")
+            assert r.status == 200 and await r.json() == {}
+
+            # no enabled workers -> fan-out is a no-op but self still acts
+            r = await client.post("/distributed/cluster/interrupt")
+            assert r.status == 200
+            assert (await r.json())["workers"] == {}
+            assert state.interrupt_event.is_set()
+
+            r = await client.post("/distributed/cluster/clear_memory")
+            assert r.status == 200
+        run_with_client(body, tmp_path, start_exec_thread=False)
