@@ -11,51 +11,284 @@ performance numbers (``/root/reference/README.md`` is qualitative only;
 BASELINE.json ``published: {}``), so there is no external number to ratio
 against; cross-round BENCH_r{N}.json values are the comparable series.
 
-Env/flags let CI run a smaller config (``--family tiny``) without changing
-the metric name printed for the flagship run.
+Resilience (rounds 1+2 both died in ``jax.devices()`` — the TPU client can
+hang *or* crash intermittently when the chip is held by a stale process):
+
+* the backend is probed in a **subprocess with a hard timeout**, retried
+  with backoff, with environment diagnostics logged per attempt;
+* the in-process init is guarded by a **watchdog thread** that emits the
+  structured-failure JSON and hard-exits if the C client wedges;
+* every failure path still prints one JSON line with ``metric/value/unit/
+  vs_baseline`` plus an ``error`` object (``stage`` + ``detail``), so an
+  environment flake is distinguishable from a code bug.
+
+Extra modes:
+
+* ``--scaling-sweep``: SPMD scaling on an 8-device virtual CPU mesh — a
+  fixed global batch sharded over data=1,2,4,8.  On one host the devices
+  share the same cores, so per-replica speedup is meaningless; what IS
+  measurable is **partitioning overhead**: efficiency_N = T(data=1) /
+  T(data=N) for the same total work.  ≥0.9 means the SPMD program adds
+  <10% overhead vs the unsharded program (BASELINE.md method, ready to
+  re-run unchanged on a real multi-chip slice where it becomes true
+  scaling efficiency).
+* ``--platform cpu``: force the CPU backend (smoke-testing the harness).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+UNIT = "images/sec/chip"
+
+# bf16 peak FLOPs/s per chip by device-kind substring (public TPU specs);
+# used only for the advisory MFU figure printed to stderr.
+PEAK_FLOPS = [
+    ("v6e", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
 
-def parse_args():
+def parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--family", default="sdxl", choices=["sdxl", "sd15", "tiny"])
     p.add_argument("--height", type=int, default=1024)
     p.add_argument("--width", type=int, default=1024)
     p.add_argument("--batch", type=int, default=1)
-    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--steps", type=int, default=None,
+                   help="denoise steps (default: 20 throughput, 8 sweep)")
     p.add_argument("--cfg", type=float, default=7.5)
     p.add_argument("--sampler", default="euler")
     p.add_argument("--scheduler", default="karras")
     p.add_argument("--repeats", type=int, default=3)
-    return p.parse_args()
+    p.add_argument("--platform", default="auto", choices=["auto", "cpu"],
+                   help="'cpu' forces the CPU backend (harness smoke tests)")
+    p.add_argument("--init-retries", type=int, default=4,
+                   help="backend probe attempts before giving up")
+    p.add_argument("--init-timeout", type=int, default=150,
+                   help="seconds per backend probe / in-process init")
+    p.add_argument("--scaling-sweep", action="store_true",
+                   help="virtual-mesh SPMD overhead sweep instead of the "
+                        "single-chip throughput bench")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON line (or sweep table) here")
+    args = p.parse_args(argv)
+    if args.steps is None:
+        args.steps = 8 if args.scaling_sweep else 20
+    return args
+
+
+def log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def metric_name(args):
+    if args.scaling_sweep:
+        return "tiny_virtual_mesh_spmd_efficiency_8dev"
+    return (f"{args.family}_{args.width}x{args.height}_"
+            f"{args.steps}step_images_per_sec_per_chip")
+
+
+def metric_unit(args):
+    return "fraction" if args.scaling_sweep else UNIT
+
+
+def failure_payload(args, stage, detail, diagnostics=None):
+    return {
+        "metric": metric_name(args),
+        "value": 0.0,
+        "unit": metric_unit(args),
+        "vs_baseline": 0.0,
+        "error": {"stage": stage, "detail": str(detail)[:2000],
+                  "diagnostics": diagnostics or collect_diagnostics()},
+    }
+
+
+def emit(args, payload):
+    line = json.dumps(payload)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+def collect_diagnostics():
+    """Best-effort environment snapshot for a failed backend init."""
+    diag = {"env": {k: v for k, v in os.environ.items()
+                    if k.startswith(("JAX", "XLA", "TPU", "PJRT", "LIBTPU"))}}
+    try:
+        diag["dev_accel"] = sorted(
+            d for d in os.listdir("/dev")
+            if d.startswith(("accel", "vfio"))) or []
+    except OSError:
+        diag["dev_accel"] = "unreadable"
+    # processes holding accel/vfio fds (a stale holder is the usual culprit)
+    holders = []
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == os.getpid():
+                continue
+            fd_dir = f"/proc/{pid}/fd"
+            try:
+                for fd in os.listdir(fd_dir):
+                    tgt = os.readlink(os.path.join(fd_dir, fd))
+                    if "accel" in tgt or "vfio" in tgt:
+                        with open(f"/proc/{pid}/cmdline", "rb") as f:
+                            cmd = f.read().replace(b"\0", b" ").decode(
+                                "utf-8", "replace")[:200]
+                        holders.append({"pid": int(pid), "fd": tgt,
+                                        "cmd": cmd.strip()})
+                        break
+            except OSError:
+                continue
+    except OSError:
+        pass
+    diag["device_holders"] = holders
+    return diag
+
+
+def fail(args, stage, detail, diagnostics=None):
+    """Print the structured-failure JSON line and exit nonzero."""
+    log(f"FAIL stage={stage}: {detail}")
+    emit(args, failure_payload(args, stage, detail, diagnostics))
+    sys.exit(1)
+
+
+PROBE_SRC = r"""
+import json, sys
+import jax
+ds = jax.devices()
+print(json.dumps({
+    "platform": ds[0].platform,
+    "kind": getattr(ds[0], "device_kind", "?"),
+    "count": len(ds),
+}))
+"""
+
+
+def probe_backend(timeout):
+    """Initialize the default backend in a THROWAWAY subprocess with a hard
+    timeout — a wedged TPU client kills the child, never this process."""
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE_SRC],
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung >{timeout}s (TPU client wedged?)"
+    if r.returncode != 0:
+        return False, f"probe rc={r.returncode}: {r.stderr.strip()[-800:]}"
+    try:
+        return True, json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return False, f"probe output unparseable: {r.stdout[-200:]!r}"
+
+
+def init_backend(args):
+    """Probe (subprocess, retried) then init in-process under a watchdog.
+    Returns the list of devices."""
+    if args.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    else:
+        for attempt in range(1, args.init_retries + 1):
+            ok, info = probe_backend(args.init_timeout)
+            if ok:
+                log(f"backend probe ok (attempt {attempt}): {info}")
+                break
+            log(f"backend probe failed (attempt {attempt}/"
+                f"{args.init_retries}): {info}")
+            diag = collect_diagnostics()
+            if diag["device_holders"]:
+                log(f"device holders: {diag['device_holders']}")
+            if attempt == args.init_retries:
+                fail(args, "backend_init",
+                     f"default backend unusable after {attempt} probes; "
+                     f"last: {info}", diag)
+            time.sleep(min(5 * attempt, 30))
+
+    # The probe succeeding doesn't guarantee the in-process init can't wedge
+    # (the flake is intermittent) — guard it with a hard-exit watchdog.
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(args.init_timeout):
+            log(f"in-process backend init hung >{args.init_timeout}s")
+            emit(args, failure_payload(
+                args, "backend_init_inprocess",
+                f"in-process jax.devices() wedged "
+                f"(platform={args.platform})"))
+            os._exit(1)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    import jax
+    if args.platform == "cpu":
+        # sitecustomize imports jax at interpreter startup, freezing the
+        # env var — the live config override is the only reliable switch
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    done.set()
+    return devices
 
 
 def bf16_params(tree):
+    import jax
+    import jax.numpy as jnp
     return jax.tree_util.tree_map(
         lambda x: x.astype(jnp.bfloat16)
         if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, tree)
 
 
-def main():
-    args = parse_args()
+def estimate_unet_flops(pipe, batch, h, w, ctx_len, y):
+    """FLOPs of one UNet forward at the CFG batch size, from XLA's own cost
+    analysis of the lowered HLO (no backend compile needed)."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.zeros((batch, h, w, pipe.family.latent_channels), jnp.float32)
+    t = jnp.zeros((batch,), jnp.float32)
+    ctx = jnp.zeros((batch, ctx_len, pipe.family.unet.context_dim),
+                    jnp.float32)
+    yb = None
+    if y is not None:
+        yb = jnp.zeros((batch, y.shape[-1]), jnp.float32)
+    lowered = jax.jit(pipe.raw_unet_apply).lower(
+        pipe.unet_params, x, t, ctx, yb)
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:
+        ca = lowered.compile().cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return float(ca.get("flops", 0.0)) if ca else 0.0
+
+
+def peak_flops_for(kind):
+    k = (kind or "").lower()
+    for sub, peak in PEAK_FLOPS:
+        if sub in k:
+            return peak
+    return None
+
+
+def run_throughput(args):
+    devices = init_backend(args)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
     from comfyui_distributed_tpu.models.registry import load_pipeline
 
-    dev = jax.devices()[0]
-    print(f"[bench] platform={dev.platform} kind="
-          f"{getattr(dev, 'device_kind', '?')} family={args.family} "
-          f"{args.width}x{args.height} steps={args.steps} batch={args.batch}",
-          file=sys.stderr)
+    dev = devices[0]
+    kind = getattr(dev, "device_kind", "?")
+    log(f"platform={dev.platform} kind={kind} n={len(devices)} "
+        f"family={args.family} {args.width}x{args.height} "
+        f"steps={args.steps} batch={args.batch}")
 
     if args.family == "tiny":
         args.height = min(args.height, 128)
@@ -67,7 +300,7 @@ def main():
     # weights (10.3 GB) would crowd a 16 GB v5e chip
     pipe.unet_params = bf16_params(pipe.unet_params)
     pipe.clip_params = [bf16_params(p) for p in pipe.clip_params]
-    print(f"[bench] init {time.time()-t0:.1f}s", file=sys.stderr)
+    log(f"init {time.time()-t0:.1f}s")
 
     B = args.batch
     ds = pipe.family.vae.downscale
@@ -93,25 +326,126 @@ def main():
 
     t0 = time.time()
     run()  # compile + first batch
-    print(f"[bench] compile+first {time.time()-t0:.1f}s", file=sys.stderr)
+    compile_s = time.time() - t0
+    log(f"compile+first {compile_s:.1f}s")
 
     t0 = time.time()
     for _ in range(args.repeats):
         run()
     elapsed = time.time() - t0
-    n_chips = 1  # bench runs single-chip; scaling measured via dryrun/mesh tests
+    n_chips = 1  # bench runs single-chip; scaling via --scaling-sweep
     ips = (B * args.repeats) / elapsed / n_chips
-    print(f"[bench] {args.repeats}x batch={B}: {elapsed:.2f}s "
-          f"-> {ips:.4f} img/s/chip", file=sys.stderr)
+    log(f"{args.repeats}x batch={B}: {elapsed:.2f}s -> {ips:.4f} img/s/chip")
 
-    metric = (f"{args.family}_{args.width}x{args.height}_"
-              f"{args.steps}step_images_per_sec_per_chip")
-    print(json.dumps({
-        "metric": metric,
+    mfu = None
+    try:
+        cfg_mult = 2 if args.cfg != 1.0 else 1
+        fwd = estimate_unet_flops(
+            pipe, cfg_mult * B, lat.shape[1], lat.shape[2],
+            context.shape[1], y)
+        flops_per_img = args.steps * fwd / B
+        peak = peak_flops_for(kind)
+        log(f"unet fwd (cfg batch): {fwd/1e12:.2f} TFLOP; "
+            f"{flops_per_img/1e12:.2f} TFLOP/img over {args.steps} steps")
+        if peak:
+            mfu = ips * flops_per_img / peak
+            log(f"MFU ~= {mfu:.3f} (peak {peak/1e12:.0f} TFLOP/s {kind})")
+    except Exception as e:  # advisory only — never fail the bench on this
+        log(f"MFU estimate unavailable: {e!r}")
+
+    payload = {
+        "metric": metric_name(args),
         "value": round(ips, 4),
-        "unit": "images/sec/chip",
+        "unit": UNIT,
         "vs_baseline": 1.0,
-    }))
+        "compile_s": round(compile_s, 1),
+    }
+    if mfu is not None:
+        payload["mfu"] = round(mfu, 4)
+    emit(args, payload)
+
+
+def run_scaling_sweep(args):
+    """Fixed global batch sharded over data=1,2,4,8 virtual CPU devices.
+    efficiency_N = T(data=1)/T(data=N): SPMD partitioning overhead."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from comfyui_distributed_tpu.models.registry import load_pipeline
+    from comfyui_distributed_tpu.parallel.mesh import build_mesh
+
+    os.environ.setdefault("DTPU_DEFAULT_FAMILY", "tiny")
+    pipe = load_pipeline("bench-tiny.ckpt", family_name="tiny")
+    B, steps, repeats = 8, args.steps, args.repeats
+    ds = pipe.family.vae.downscale
+    size = 64
+    prompts = ["bench"] * B
+    context, _ = pipe.encode_prompt(prompts)
+    uncond, _ = pipe.encode_prompt([""] * B)
+    seeds = np.arange(B, dtype=np.uint64) + 42
+    rows = []
+    for n in (1, 2, 4, 8):
+        mesh = build_mesh({"data": n, "tensor": 1, "seq": 1},
+                          devices=jax.devices()[:n])
+        sh = NamedSharding(mesh, P("data"))
+        lat = jax.device_put(
+            jnp.zeros((B, size // ds, size // ds,
+                       pipe.family.latent_channels), jnp.float32), sh)
+        ctx_s = jax.device_put(context, sh)
+        unc_s = jax.device_put(uncond, sh)
+
+        def run():
+            z = pipe.sample(lat, ctx_s, unc_s, seeds, steps=steps,
+                            cfg=args.cfg, sampler_name=args.sampler,
+                            scheduler=args.scheduler)
+            img = pipe.vae_decode(z)
+            img.block_until_ready()
+
+        run()  # compile
+        t0 = time.time()
+        for _ in range(repeats):
+            run()
+        dt = (time.time() - t0) / repeats
+        rows.append({"data": n, "global_batch": B, "sec_per_batch":
+                     round(dt, 4)})
+        log(f"data={n}: {dt:.3f}s per global batch of {B}")
+    t1 = rows[0]["sec_per_batch"]
+    for r in rows:
+        r["efficiency_vs_unsharded"] = round(t1 / r["sec_per_batch"], 4)
+    eff8 = rows[-1]["efficiency_vs_unsharded"]
+    log(f"sweep table: {json.dumps(rows)}")
+    emit(args, {
+        "metric": metric_name(args),
+        "value": eff8,
+        "unit": "fraction",
+        "vs_baseline": 1.0,
+        "table": rows,
+    })
+
+
+def main():
+    args = parse_args()
+    try:
+        if args.scaling_sweep:
+            run_scaling_sweep(args)
+        else:
+            run_throughput(args)
+    except SystemExit:
+        raise
+    except MemoryError:
+        fail(args, "oom", "host OOM during bench")
+    except Exception as e:
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        stage = "runtime"
+        msg = repr(e)
+        if "UNAVAILABLE" in msg or "backend" in msg.lower():
+            stage = "backend_init"
+        fail(args, stage, msg)
 
 
 if __name__ == "__main__":

@@ -109,14 +109,21 @@ def _get_path(tree: Params, path: str) -> Optional[np.ndarray]:
 class _LoadMapper:
     """torch state dict -> flax tree."""
 
-    def __init__(self, sd: Dict[str, np.ndarray], prefix: str):
+    def __init__(self, sd: Dict[str, np.ndarray], prefix: str,
+                 consumed: Optional[set] = None):
         self.sd = sd
         self.prefix = prefix
         self.tree: Params = {}
         self.missing: List[str] = []
+        # torch keys actually read — lets callers detect unexpected keys
+        self.consumed = consumed if consumed is not None else set()
 
     def _get(self, key: str) -> Optional[np.ndarray]:
-        return self.sd.get(self.prefix + key)
+        full = self.prefix + key
+        if full in self.sd:
+            self.consumed.add(full)
+            return self.sd[full]
+        return None
 
     def _pair(self, tkey: str, fpath: str, wtrans, wname: str = "kernel",
               bias: bool = True, required: bool = True) -> None:
@@ -137,7 +144,8 @@ class _LoadMapper:
     def conv_optional(self, tkey, fpath):
         self._pair(tkey, fpath, t_conv, required=False)
 
-    def conv_as_dense(self, tkey, fpath):
+    def conv_as_dense(self, tkey, fpath, export_conv=False):
+        # export_conv is export-side metadata; loading accepts both forms
         def tr(w):
             return t_lin(w[:, :, 0, 0] if w.ndim == 4 else w)
         self._pair(tkey, fpath, tr)
@@ -211,9 +219,15 @@ class _ExportMapper:
     def conv_optional(self, tkey, fpath):
         self._pair(tkey, fpath, t_conv_inv, required=False)
 
-    def conv_as_dense(self, tkey, fpath):
-        # always exports the linear form (what SDXL-style checkpoints use)
-        self._pair(tkey, fpath, t_lin)
+    def conv_as_dense(self, tkey, fpath, export_conv=False):
+        """Dense kernel [in, out] -> torch linear [out, in], or — when the
+        canonical torch layout is a 1x1 conv (VAE attention always, SD1.x
+        transformer proj) — [out, in, 1, 1] so strict-shape torch loaders
+        accept the export."""
+        if export_conv:
+            self._pair(tkey, fpath, lambda w: t_lin(w)[:, :, None, None])
+        else:
+            self._pair(tkey, fpath, t_lin)
 
     def linear(self, tkey, fpath, bias=True):
         self._pair(tkey, fpath, t_lin, bias=bias)
@@ -269,9 +283,11 @@ def _map_resblock(m, tkey: str, fpath: str) -> None:
     m.conv_optional(f"{tkey}.skip_connection", f"{fpath}/skip")
 
 
-def _map_spatial_transformer(m, tkey: str, fpath: str, depth: int) -> None:
+def _map_spatial_transformer(m, tkey: str, fpath: str, depth: int,
+                             linear_proj: bool = False) -> None:
     _groupnorm(m, f"{tkey}.norm", f"{fpath}/norm")
-    m.conv_as_dense(f"{tkey}.proj_in", f"{fpath}/proj_in")
+    m.conv_as_dense(f"{tkey}.proj_in", f"{fpath}/proj_in",
+                    export_conv=not linear_proj)
     for j in range(depth):
         b = f"{tkey}.transformer_blocks.{j}"
         fb = f"{fpath}/blocks_{j}"
@@ -285,7 +301,8 @@ def _map_spatial_transformer(m, tkey: str, fpath: str, depth: int) -> None:
         m.norm(f"{b}.norm3", f"{fb}/norm3")
         m.linear(f"{b}.ff.net.0.proj", f"{fb}/ff/geglu/proj")
         m.linear(f"{b}.ff.net.2", f"{fb}/ff/out")
-    m.conv_as_dense(f"{tkey}.proj_out", f"{fpath}/proj_out")
+    m.conv_as_dense(f"{tkey}.proj_out", f"{fpath}/proj_out",
+                    export_conv=not linear_proj)
 
 
 def _run_unet(m, cfg: UNetConfig):
@@ -306,7 +323,8 @@ def _run_unet(m, cfg: UNetConfig):
             if cfg.transformer_depth[level] > 0:
                 _map_spatial_transformer(
                     m, f"input_blocks.{idx}.1", f"down_{level}_attn_{i}",
-                    cfg.transformer_depth[level])
+                    cfg.transformer_depth[level],
+                    linear_proj=cfg.use_linear_in_transformer)
             idx += 1
         if level != L - 1:
             m.conv(f"input_blocks.{idx}.0.op", f"down_{level}_ds/conv")
@@ -314,7 +332,8 @@ def _run_unet(m, cfg: UNetConfig):
 
     _map_resblock(m, "middle_block.0", "mid_res_0")
     _map_spatial_transformer(m, "middle_block.1", "mid_attn",
-                             max(cfg.transformer_depth[-1], 1))
+                             max(cfg.transformer_depth[-1], 1),
+                             linear_proj=cfg.use_linear_in_transformer)
     _map_resblock(m, "middle_block.2", "mid_res_1")
 
     idx = 0
@@ -325,7 +344,8 @@ def _run_unet(m, cfg: UNetConfig):
             if cfg.transformer_depth[level] > 0:
                 _map_spatial_transformer(
                     m, f"output_blocks.{idx}.{sub}", f"up_{level}_attn_{i}",
-                    cfg.transformer_depth[level])
+                    cfg.transformer_depth[level],
+                    linear_proj=cfg.use_linear_in_transformer)
                 sub += 1
             if level != 0 and i == cfg.num_res_blocks:
                 m.conv(f"output_blocks.{idx}.{sub}.conv", f"up_{level}_us/conv")
@@ -348,9 +368,12 @@ def _map_vae_resblock(m, tkey: str, fpath: str) -> None:
 
 def _map_vae_attn(m, tkey: str, fpath: str) -> None:
     _groupnorm(m, f"{tkey}.norm", f"{fpath}/norm")
-    # torch stores q/k/v/proj_out as 1x1 convs; our block uses Dense
+    # torch stores q/k/v/proj_out as 1x1 convs; our block uses Dense.
+    # Exports MUST be 4D [O, I, 1, 1] — strict torch VAE loaders
+    # shape-check and drop 2D tensors here.
     for name in ("q", "k", "v", "proj_out"):
-        m.conv_as_dense(f"{tkey}.{name}", f"{fpath}/{name}")
+        m.conv_as_dense(f"{tkey}.{name}", f"{fpath}/{name}",
+                        export_conv=True)
 
 
 def _run_vae(m, cfg: VAEConfig):
@@ -443,16 +466,50 @@ def _clip_prefixes(family) -> List[str]:
     return list(CLIP_PREFIXES_SDXL)
 
 
-def convert_state_dict(sd: Dict[str, np.ndarray],
-                       family) -> Tuple[Params, List[Params], Params]:
-    unet = _run_unet(_LoadMapper(sd, UNET_PREFIX), family.unet)
-    vae = _run_vae(_LoadMapper(sd, VAE_PREFIX), family.vae)
+def convert_state_dict(sd: Dict[str, np.ndarray], family,
+                       consumed: Optional[set] = None,
+                       ) -> Tuple[Params, List[Params], Params]:
+    unet = _run_unet(_LoadMapper(sd, UNET_PREFIX, consumed), family.unet)
+    vae = _run_vae(_LoadMapper(sd, VAE_PREFIX, consumed), family.vae)
     clips: List[Params] = []
     for ccfg, prefix in zip(family.clips, _clip_prefixes(family)):
         run = _run_clip_hf if "transformer.text_model" in prefix \
             else _run_openclip
-        clips.append(run(_LoadMapper(sd, prefix), ccfg))
+        clips.append(run(_LoadMapper(sd, prefix, consumed), ccfg))
     return unet, clips, vae
+
+
+# non-parameter keys real checkpoints carry that no model weight maps to:
+# diffusion schedule buffers, EMA copies, CLIP position ids / logit scale
+EXPECTED_NONPARAM_KEYS = (
+    "betas", "alphas_cumprod", "alphas_cumprod_prev",
+    "sqrt_alphas_cumprod", "sqrt_one_minus_alphas_cumprod",
+    "log_one_minus_alphas_cumprod", "sqrt_recip_alphas_cumprod",
+    "sqrt_recipm1_alphas_cumprod", "posterior_variance",
+    "posterior_log_variance_clipped", "posterior_mean_coef1",
+    "posterior_mean_coef2", "logvar",
+    "model_ema.",
+    "cond_stage_model.transformer.text_model.embeddings.position_ids",
+    "conditioner.embedders.0.transformer.text_model.embeddings.position_ids",
+    "conditioner.embedders.1.model.logit_scale",
+    "cond_stage_model.logit_scale",
+)
+
+
+def unconsumed_keys(sd: Dict[str, np.ndarray], family) -> List[str]:
+    """Checkpoint keys that map onto no model parameter (after dropping the
+    known non-parameter buffers) — a loader-coverage check: non-empty means
+    either an unexpected checkpoint layout or a mapping gap."""
+    consumed: set = set()
+    convert_state_dict(sd, family, consumed=consumed)
+    leftover = []
+    for k in sd:
+        if k in consumed:
+            continue
+        if any(k == e or k.startswith(e) for e in EXPECTED_NONPARAM_KEYS):
+            continue
+        leftover.append(k)
+    return sorted(leftover)
 
 
 def load_checkpoint(path: str, family) -> Tuple[Params, List[Params], Params]:
@@ -499,6 +556,16 @@ def _rrdb_key_norm(sd: Dict[str, np.ndarray]) -> Dict[str, str]:
         out = {}
         nb = max(int(k.split(".")[3]) for k in sd
                  if k.startswith("model.1.sub.") and k.split(".")[3].isdigit())
+        # The tail layout depends on scale (one upconv per 2x plus HRconv
+        # and conv_last, interleaved with param-free Upsample/LeakyReLU):
+        # 4x = model.{3,6,8,10}, 2x = model.{3,5,7}, 1x = model.{2,4}.
+        # Detect the parameterized indices instead of hardcoding 4x.
+        tail = sorted({int(p[1]) for p in (k.split(".") for k in sd)
+                       if p[0] == "model" and p[1].isdigit()
+                       and int(p[1]) >= 2})
+        names = ([f"upconv{i + 1}" for i in range(len(tail) - 2)]
+                 + ["HRconv", "conv_last"])
+        tail_map = dict(zip(tail, names))
         for k in sd:
             parts = k.split(".")
             if k.startswith("model.0."):
@@ -508,14 +575,9 @@ def _rrdb_key_norm(sd: Dict[str, np.ndarray]) -> Dict[str, str]:
             elif k.startswith("model.1.sub."):
                 i, rdb, conv = parts[3], parts[4], parts[5]
                 out[k] = f"body.{i}.{rdb}.{conv}.{parts[-1]}"
-            elif k.startswith("model.3."):
-                out[k] = f"upconv1.{parts[-1]}"
-            elif k.startswith("model.6."):
-                out[k] = f"upconv2.{parts[-1]}"
-            elif k.startswith("model.8."):
-                out[k] = f"HRconv.{parts[-1]}"
-            elif k.startswith("model.10."):
-                out[k] = f"conv_last.{parts[-1]}"
+            elif parts[0] == "model" and parts[1].isdigit() \
+                    and int(parts[1]) in tail_map:
+                out[k] = f"{tail_map[int(parts[1])]}.{parts[-1]}"
         return out
     # new-arch (xinntao ESRGAN: RRDB_trunk) and Real-ESRGAN (body/conv_body)
     out = {}

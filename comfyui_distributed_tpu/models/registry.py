@@ -12,6 +12,7 @@ any file — the reference's "same models on all machines" requirement
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import hashlib
 import os
@@ -107,7 +108,13 @@ class DiffusionPipeline:
         self.schedule = sch.make_discrete_schedule()
         self.tokenizer = make_tokenizer(
             vocab_size=min(c.vocab_size for c in family.clips))
-        self._jit_cache: Dict[Any, Any] = {}
+        # LRU-bounded: every (resolution, batch, sampler...) combination is
+        # its own compiled executable; an unbounded dict leaks one per shape
+        # seen.  16 live entries cover a realistic session (clip×2, vae×2,
+        # and a dozen sample configs); evictions are logged.
+        self._jit_cache: "collections.OrderedDict[Any, Any]" = \
+            collections.OrderedDict()
+        self._jit_cache_cap = int(os.environ.get("DTPU_JIT_CACHE_CAP", "16"))
         self._lock = threading.Lock()
 
     # --- text ---------------------------------------------------------------
@@ -205,10 +212,7 @@ class DiffusionPipeline:
 
             return jax.jit(core)
 
-        with self._lock:
-            if static_key not in self._jit_cache:
-                self._jit_cache[static_key] = make_core()
-            core = self._jit_cache[static_key]
+        core = self._cache_get_or_make(static_key, make_core)
         y_arg = y if y is not None else jnp.zeros((latents.shape[0], 1))
         return core(self.unet_params, latents, context, uncond_context,
                     keys, sigmas, y_arg)
@@ -216,10 +220,19 @@ class DiffusionPipeline:
     # --- internals ----------------------------------------------------------
 
     def _jitted(self, key, fn):
+        return self._cache_get_or_make(key, lambda: jax.jit(fn))
+
+    def _cache_get_or_make(self, key, make):
         with self._lock:
-            if key not in self._jit_cache:
-                self._jit_cache[key] = jax.jit(fn)
-            return self._jit_cache[key]
+            if key in self._jit_cache:
+                self._jit_cache.move_to_end(key)
+                return self._jit_cache[key]
+            fn = self._jit_cache[key] = make()
+            while len(self._jit_cache) > self._jit_cache_cap:
+                old_key, _ = self._jit_cache.popitem(last=False)
+                log(f"jit cache: evicting {old_key!r} "
+                    f"(cap {self._jit_cache_cap})")
+            return fn
 
 
 def _virtual_params(module, seed: int, *shaped_args) -> Any:

@@ -44,6 +44,10 @@ class UNetConfig:
     num_heads: Optional[int] = None  # fixed head count overrides head_channels
     # SDXL class/vector conditioning (text-emb pooled + size conds)
     adm_in_channels: Optional[int] = None
+    # checkpoint-layout metadata only: torch stores spatial-transformer
+    # proj_in/proj_out as 1x1 convs (SD1.x) or nn.Linear (SD2.x/SDXL); the
+    # flax module always uses Dense (mathematically identical)
+    use_linear_in_transformer: bool = False
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     prediction_type: str = "eps"  # "eps" | "v"
@@ -61,6 +65,7 @@ SDXL_CONFIG = UNetConfig(
     transformer_depth=(0, 2, 10),
     context_dim=2048,
     adm_in_channels=2816,
+    use_linear_in_transformer=True,
 )
 
 TINY_CONFIG = UNetConfig(

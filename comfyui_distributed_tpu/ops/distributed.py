@@ -151,7 +151,13 @@ class DistributedCollector(Op):
 
         async def drain():
             q = await ctx.job_store.get_queue(multi_job_id)
-            results: Dict[str, List] = {}
+            # keyed by (worker, image_index): the worker's send path retries
+            # with backoff, so a timed-out-but-delivered POST arrives twice —
+            # last write wins instead of duplicating an image in the batch.
+            # Indexless senders get per-worker arrival numbers (sorted after
+            # any indexed uploads) so their images are all preserved.
+            results: Dict[str, Dict[tuple, Any]] = {}
+            arrival: Dict[str, int] = {}
             done = set()
             # deadline inside the loop: hitting it still returns the partial
             # batch (parity with reference distributed.py:1372-1412); an
@@ -175,8 +181,12 @@ class DistributedCollector(Op):
                             f"continuing with partial results")
                         break
                     wid = str(item["worker_id"])
-                    results.setdefault(wid, []).append(
-                        (item.get("image_index", 0), item["tensor"]))
+                    if "image_index" in item:
+                        key = (0, int(item["image_index"]))
+                    else:
+                        arrival[wid] = n = arrival.get(wid, 0) + 1
+                        key = (1, n)
+                    results.setdefault(wid, {})[key] = item["tensor"]
                     if item.get("is_last"):
                         done.add(wid)
             finally:
@@ -192,7 +202,7 @@ class DistributedCollector(Op):
 
         ordered = [master_images]
         for wid in sorted(results, key=lambda w: (parse_worker_index(w), w)):
-            imgs = [t for _, t in sorted(results[wid], key=lambda p: p[0])]
+            imgs = [results[wid][i] for i in sorted(results[wid])]
             ordered.extend(np.asarray(t, np.float32) for t in imgs)
         out = np.concatenate([as_image_array(o) for o in ordered], axis=0)
         log(f"collector: combined {out.shape[0]} images "

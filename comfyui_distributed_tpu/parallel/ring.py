@@ -107,6 +107,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if q.shape[1] % n_shards:
         raise ValueError(f"sequence length {q.shape[1]} not divisible by "
                          f"{axis_name} axis size {n_shards}")
+    if k.shape[1] != v.shape[1]:
+        raise ValueError(f"k/v length mismatch: {k.shape[1]} vs {v.shape[1]}")
+    if k.shape[1] % n_shards:
+        raise ValueError(f"k/v length {k.shape[1]} not divisible by "
+                         f"{axis_name} axis size {n_shards}")
+    if causal and k.shape[1] != q.shape[1]:
+        # causal cross-attention (Nq != Nk) has no well-defined position
+        # alignment; silently masking by local index would be wrong
+        raise ValueError(f"causal ring attention requires Nq == Nk, got "
+                         f"{q.shape[1]} vs {k.shape[1]}")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if n_shards == 1:
         m = jnp.full(q.shape[:1] + (q.shape[2], q.shape[1]), NEG_INF,

@@ -433,10 +433,14 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             None, lambda: decode_png(img_field.file.read()))
         item = {
             "worker_id": form.get("worker_id", ""),
-            "image_index": int(form.get("image_index", 0)),
             "is_last": str(form.get("is_last", "false")).lower() == "true",
             "tensor": tensor,
         }
+        # only pass the index through when the sender set one: the collector
+        # dedups retransmits by (worker, index), and defaulting indexless
+        # uploads to 0 would collapse them into a single image
+        if form.get("image_index") is not None:
+            item["image_index"] = int(form["image_index"])
         if not await state.jobs.put_result(mj, item):
             # unknown job -> 404 so the worker's retry loop backs off
             return web.json_response({"error": f"unknown job {mj}"},

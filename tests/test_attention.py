@@ -50,6 +50,32 @@ class TestRingAttention:
         with pytest.raises(ValueError, match="not divisible"):
             ring_attention(q, k, v, mesh)
 
+    def test_cross_attention_matches_reference(self, rng):
+        """Nk != Nq (cross-attention): both axes shard over the ring."""
+        mesh = build_mesh({"data": 1, "tensor": 1, "seq": 4},
+                          devices=jax.devices()[:4])
+        q, k, v = _qkv(rng, N=64, M=32)
+        out = ring_attention(q, k, v, mesh)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_rejects_indivisible_kv(self, rng):
+        """ADVICE r1: k/v divisibility was unvalidated."""
+        mesh = build_mesh({"data": 1, "tensor": 1, "seq": 4},
+                          devices=jax.devices()[:4])
+        q, k, v = _qkv(rng, N=64, M=30)
+        with pytest.raises(ValueError, match="k/v length"):
+            ring_attention(q, k, v, mesh)
+
+    def test_rejects_causal_cross_attention(self, rng):
+        """ADVICE r1: causal cross-attention was silently mis-masked."""
+        mesh = build_mesh({"data": 1, "tensor": 1, "seq": 4},
+                          devices=jax.devices()[:4])
+        q, k, v = _qkv(rng, N=64, M=32)
+        with pytest.raises(ValueError, match="causal ring"):
+            ring_attention(q, k, v, mesh, causal=True)
+
     def test_sharded_inputs_roundtrip(self, rng):
         """Works with inputs actually placed with the seq sharding (the way
         the sp train/inference path feeds it)."""

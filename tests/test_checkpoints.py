@@ -87,6 +87,42 @@ def test_openclip_packed_qkv_layout():
     np.testing.assert_allclose(w[:W], np.asarray(q).T, rtol=1e-6)
 
 
+def test_vae_attn_exports_4d_conv():
+    """VAE attention q/k/v/proj_out must export as 1x1 convs [O, I, 1, 1] —
+    strict-shape torch VAE loaders drop 2D tensors here (ADVICE r1)."""
+    fam = reg.FAMILIES["tiny"]
+    unet_p, clip_ps, vae_p = _init_family(fam)
+    sd = ckpt.export_state_dict(unet_p, clip_ps, vae_p, fam)
+    for enc_dec in ("encoder", "decoder"):
+        for name in ("q", "k", "v", "proj_out"):
+            w = sd[f"first_stage_model.{enc_dec}.mid.attn_1.{name}.weight"]
+            assert w.ndim == 4 and w.shape[2:] == (1, 1), \
+                f"{enc_dec}.{name}: {w.shape}"
+    # round-trips exactly through the 4D form
+    _, _, v2 = ckpt.convert_state_dict(sd, fam)
+    _assert_trees_equal(vae_p, v2)
+
+
+def test_transformer_proj_export_form_follows_family():
+    """SD1.x-style configs export spatial-transformer proj_in/out as 1x1
+    convs; use_linear_in_transformer configs export nn.Linear 2D."""
+    conv_fam = reg.FAMILIES["tiny"]
+    assert not conv_fam.unet.use_linear_in_transformer
+    lin_fam = reg.ModelFamily(
+        name="tiny_lin",
+        unet=dataclasses.replace(conv_fam.unet,
+                                 use_linear_in_transformer=True),
+        vae=conv_fam.vae, clips=conv_fam.clips)
+    for fam, ndim in ((conv_fam, 4), (lin_fam, 2)):
+        unet_p, clip_ps, vae_p = _init_family(fam)
+        sd = ckpt.export_state_dict(unet_p, clip_ps, vae_p, fam)
+        key = next(k for k in sd if k.endswith(".proj_in.weight")
+                   and k.startswith("model.diffusion_model"))
+        assert sd[key].ndim == ndim, f"{fam.name}: {sd[key].shape}"
+        u2, _, _ = ckpt.convert_state_dict(sd, fam)
+        _assert_trees_equal(unet_p, u2)
+
+
 def test_missing_keys_raise():
     fam = reg.FAMILIES["tiny"]
     unet_p, clip_ps, vae_p = _init_family(fam)
@@ -105,7 +141,7 @@ def test_file_roundtrip(tmp_path):
     _assert_trees_equal(unet_p, u2)
 
 
-def _rrdb_torch_sd(params, naming="realesrgan"):
+def _rrdb_torch_sd(params, naming="realesrgan", scale=2, num_blocks=2):
     """Synthesize a torch-layout ESRGAN state dict from flax RRDB params."""
     sd = {}
 
@@ -113,15 +149,27 @@ def _rrdb_torch_sd(params, naming="realesrgan"):
         sd[tkey + ".weight"] = ckpt.t_conv_inv(np.asarray(leaf["kernel"]))
         sd[tkey + ".bias"] = np.asarray(leaf["bias"])
 
-    names = {
-        "realesrgan": dict(first="conv_first", body="body.{i}.rdb{j}.conv{k}",
-                           trunk="conv_body", up="conv_up{i}", hr="conv_hr",
-                           last="conv_last"),
-        "xinntao": dict(first="conv_first",
-                        body="RRDB_trunk.{i}.RDB{j}.conv{k}",
-                        trunk="trunk_conv", up="upconv{i}", hr="HRconv",
-                        last="conv_last"),
-    }[naming]
+    if naming == "oldarch":
+        # old ESRGAN arch "model.N" numbering: 0 = conv_first, 1 = shortcut
+        # (sub.i = blocks, sub.last = trunk), then per-2x [Upsample, conv,
+        # lrelu] triplets, HRconv, lrelu, conv_last
+        n_up = {1: 0, 2: 1, 4: 2, 8: 3}[scale]
+        names = dict(first="model.0",
+                     body=f"model.1.sub.{{i}}.RDB{{j}}.conv{{k}}",
+                     trunk=f"model.1.sub.{num_blocks}",
+                     up="model.{model_idx}", hr=f"model.{2 + 3 * n_up}",
+                     last=f"model.{4 + 3 * n_up}")
+    else:
+        names = {
+            "realesrgan": dict(first="conv_first",
+                               body="body.{i}.rdb{j}.conv{k}",
+                               trunk="conv_body", up="conv_up{i}",
+                               hr="conv_hr", last="conv_last"),
+            "xinntao": dict(first="conv_first",
+                            body="RRDB_trunk.{i}.RDB{j}.conv{k}",
+                            trunk="trunk_conv", up="upconv{i}", hr="HRconv",
+                            last="conv_last"),
+        }[naming]
     put(names["first"], params["conv_first"])
     for i, blk in ((int(k.split("_")[1]), v) for k, v in params.items()
                    if k.startswith("rrdb_")):
@@ -132,20 +180,25 @@ def _rrdb_torch_sd(params, naming="realesrgan"):
     put(names["trunk"], params["trunk_conv"])
     for k in params:
         if k.startswith("up_"):
-            put(names["up"].format(i=int(k.split("_")[1]) + 1), params[k])
+            i = int(k.split("_")[1])
+            put(names["up"].format(i=i + 1, model_idx=3 + 3 * i), params[k])
     put(names["hr"], params["hr_conv"])
     put(names["last"], params["conv_last"])
     return sd
 
 
-@pytest.mark.parametrize("naming", ["realesrgan", "xinntao"])
-def test_upscaler_checkpoint_roundtrip(tmp_path, naming):
+@pytest.mark.parametrize("naming", ["realesrgan", "xinntao", "oldarch"])
+@pytest.mark.parametrize("scale", [1, 2, 4])
+def test_upscaler_checkpoint_roundtrip(tmp_path, naming, scale):
+    """All three torch naming schemes at 1x/2x/4x — the old-arch tail
+    indices depend on scale (ADVICE r1: 4x was hardcoded)."""
     from comfyui_distributed_tpu.models.upscalers import (
         RRDBNet, TINY_RRDB_CONFIG)
-    cfg = TINY_RRDB_CONFIG
+    cfg = dataclasses.replace(TINY_RRDB_CONFIG, scale=scale)
     params = RRDBNet(cfg).init(jax.random.PRNGKey(0),
                                jnp.zeros((1, 8, 8, 3)))["params"]
-    sd = _rrdb_torch_sd(params, naming)
+    sd = _rrdb_torch_sd(params, naming, scale=scale,
+                        num_blocks=cfg.num_blocks)
     path = str(tmp_path / "up.safetensors")
     ckpt.save_state_dict(sd, path)
     loaded = ckpt.load_upscaler_checkpoint(path, cfg)

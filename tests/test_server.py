@@ -208,6 +208,47 @@ class TestDataPlane:
         run_with_client(body, tmp_path, start_exec_thread=False)
 
 
+class TestCollectorDedup:
+    def test_retransmitted_upload_not_duplicated(self, tmp_path, rng):
+        """ADVICE r1: the worker send path retries with backoff, so a
+        timed-out-but-delivered job_complete POST arrives twice; the master
+        must key results by (worker, image_index), not append."""
+        import threading
+        from comfyui_distributed_tpu.ops.base import OpContext
+        from comfyui_distributed_tpu.ops.distributed import DistributedCollector
+        from comfyui_distributed_tpu.runtime.jobs import JobStore
+
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        try:
+            store = JobStore()
+            img0 = rng.random((1, 4, 4, 3)).astype(np.float32)
+            img1 = rng.random((1, 4, 4, 3)).astype(np.float32)
+
+            async def seed():
+                await store.prepare_job("j1")
+                for idx, tensor, last in ((0, img0, False), (0, img0, False),
+                                          (1, img1, True)):
+                    await store.put_result("j1", {
+                        "worker_id": "worker_1", "image_index": idx,
+                        "tensor": tensor, "is_last": last})
+
+            asyncio.run_coroutine_threadsafe(seed(), loop).result(10)
+            ctx = OpContext(job_store=store, server_loop=loop)
+            master = rng.random((1, 4, 4, 3)).astype(np.float32)
+            (out,) = DistributedCollector().execute(
+                ctx, master, multi_job_id="j1",
+                enabled_worker_ids='["worker_1"]')
+            # 1 master + 2 distinct worker images — not 4
+            assert out.shape[0] == 3
+            np.testing.assert_allclose(out[1], img0[0], atol=1e-6)
+            np.testing.assert_allclose(out[2], img1[0], atol=1e-6)
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(5)
+
+
 class TestPromptSurface:
     def test_get_prompt_health(self, tmp_path):
         async def body(client, state):
