@@ -52,7 +52,7 @@ class CLIPLayer(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
         cfg = self.cfg
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln1")(x)
         B, N, C = h.shape
         hd = cfg.width // cfg.heads
         q = nn.Dense(cfg.width, dtype=cfg.dtype, name="q")(h)
@@ -69,7 +69,7 @@ class CLIPLayer(nn.Module):
         attn = attn.reshape(B, N, cfg.width)
         x = x + nn.Dense(cfg.width, dtype=cfg.dtype, name="proj")(attn)
 
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln2")(x)
         h = nn.Dense(cfg.width * 4, dtype=cfg.dtype, name="fc1")(h)
         h = _act(self.cfg.act)(h)
         h = nn.Dense(cfg.width, dtype=cfg.dtype, name="fc2")(h)
@@ -103,7 +103,7 @@ class CLIPTextModel(nn.Module):
         # ln_final is shared: applied to the last layer for pooling and to the
         # selected output layer (clip-skip reuses the same checkpoint weights,
         # matching ComfyUI's behavior)
-        ln_final = nn.LayerNorm(dtype=jnp.float32, name="ln_final")
+        ln_final = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_final")
         out = ln_final(hidden[cfg.output_layer])
         final = out if cfg.output_layer == -1 else ln_final(hidden[-1])
 

@@ -54,8 +54,12 @@ class Attention(nn.Module):
 
     Self-attention when ``context`` is None, cross-attention otherwise.
     Shapes: q from ``x [B, N, C]``, k/v from ``context [B, M, Cc]``.
-    ``attn_impl`` selects the math: "xla" (fused by the compiler) or
-    "pallas" (custom flash kernel, ops/pallas/flash_attention.py).
+    ``attn_impl`` selects the math: "xla" (fused by the compiler),
+    "pallas" (custom flash kernel, ops/pallas/flash_attention.py), or
+    "ring" (sequence-parallel over the mesh's ``seq`` axis,
+    parallel/ring.py; falls back to "xla" when the sequence is short,
+    indivisible, or the mesh has no seq axis — e.g. the 77-token text
+    cross-attention).
     """
     num_heads: int
     head_dim: Optional[int] = None
@@ -88,6 +92,11 @@ class Attention(nn.Module):
 def scaled_dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                  impl: str = "xla") -> jax.Array:
     """[B, N, H, D] attention. fp32 softmax accumulation."""
+    if impl == "ring":
+        out = _maybe_ring_attention(q, k, v)
+        if out is not None:
+            return out
+        impl = "xla"
     if impl == "pallas":
         from comfyui_distributed_tpu.ops.pallas.flash_attention import (
             flash_attention)
@@ -98,6 +107,30 @@ def scaled_dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     out = jnp.einsum("bhnm,bmhd->bnhd", weights.astype(v.dtype), v)
     return out
+
+
+def _maybe_ring_attention(q: jax.Array, k: jax.Array,
+                          v: jax.Array) -> Optional[jax.Array]:
+    """Ring attention over the runtime mesh's ``seq`` axis when it applies.
+
+    Returns None (-> caller falls back to "xla") when the mesh has no seq
+    axis, the token count is below ``DTPU_RING_MIN_TOKENS`` (ring's ICI
+    rotation only pays off on long sequences), or either sequence length
+    doesn't divide the axis.  All conditions are static shapes/env, so the
+    choice is fixed at trace time — no dynamic control flow under jit."""
+    import os
+
+    from comfyui_distributed_tpu.parallel.mesh import get_runtime
+    from comfyui_distributed_tpu.parallel.ring import ring_attention
+    from comfyui_distributed_tpu.utils.constants import SEQ_AXIS
+
+    mesh = get_runtime().mesh
+    n = int(mesh.shape.get(SEQ_AXIS, 1))
+    min_tokens = int(os.environ.get("DTPU_RING_MIN_TOKENS", "256"))
+    if (n <= 1 or q.shape[1] < min_tokens
+            or q.shape[1] % n or k.shape[1] % n):
+        return None
+    return ring_attention(q, k, v, mesh)
 
 
 class GEGLU(nn.Module):
@@ -133,12 +166,12 @@ class TransformerBlock(nn.Module):
     def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           attn_impl=self.attn_impl, name="attn1")(
-            nn.LayerNorm(dtype=jnp.float32, name="norm1")(x))
+            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(x))
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           attn_impl=self.attn_impl, name="attn2")(
-            nn.LayerNorm(dtype=jnp.float32, name="norm2")(x), context=context)
+            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x), context=context)
         x = x + FeedForward(dtype=self.dtype, name="ff")(
-            nn.LayerNorm(dtype=jnp.float32, name="norm3")(x))
+            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm3")(x))
         return x
 
 

@@ -95,7 +95,8 @@ class DiffusionPipeline:
 
     def __init__(self, name: str, family: ModelFamily,
                  unet_params: Any, clip_params: List[Any], vae_params: Any,
-                 prediction_type: str = "eps"):
+                 prediction_type: str = "eps",
+                 assets_dir: Optional[str] = None):
         self.name = name
         self.family = family
         self.unet = unet_mod.UNet(family.unet)
@@ -106,7 +107,10 @@ class DiffusionPipeline:
         self.vae_params = vae_params
         self.prediction_type = prediction_type
         self.schedule = sch.make_discrete_schedule()
+        # real CLIP BPE when vocab.json/merges.txt sit in the models dir
+        # (zero-egress asset drop); deterministic hash tokenizer otherwise
         self.tokenizer = make_tokenizer(
+            assets_dir=assets_dir,
             vocab_size=min(c.vocab_size for c in family.clips))
         # LRU-bounded: every (resolution, batch, sampler...) combination is
         # its own compiled executable; an unbounded dict leaks one per shape
@@ -179,9 +183,11 @@ class DiffusionPipeline:
             self.schedule, scheduler, steps, denoise))
         keys = smp.sample_keys(seeds, sample_idx)
 
+        from comfyui_distributed_tpu.runtime.interrupt import polling_enabled
         static_key = ("sample", sampler_name, scheduler, steps, float(cfg),
                       float(denoise), bool(add_noise), y is not None,
-                      tuple(latents.shape), tuple(context.shape))
+                      tuple(latents.shape), tuple(context.shape),
+                      polling_enabled())
 
         def make_core():
             full_denoise = denoise >= 0.9999
@@ -321,7 +327,8 @@ def load_pipeline(ckpt_name: str, models_dir: Optional[str] = None,
         log(f"virtual checkpoint {ckpt_name!r} ({fam.name}): no file on disk, "
             f"deterministic init (seed {seed})")
 
-    pipe = DiffusionPipeline(ckpt_name, fam, unet_p, clip_ps, vae_p)
+    pipe = DiffusionPipeline(ckpt_name, fam, unet_p, clip_ps, vae_p,
+                             assets_dir=models_dir)
     with _pipeline_lock:
         _pipeline_cache[key] = pipe
     return pipe

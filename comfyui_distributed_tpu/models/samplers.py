@@ -89,13 +89,42 @@ def _to_d(x: jax.Array, sigma: jax.Array, denoised: jax.Array) -> jax.Array:
 
 
 def _scan_sampler(step_fn, x, sigmas, carry_init=None):
-    """Run ``step_fn`` over consecutive sigma pairs with lax.scan."""
+    """Run ``step_fn`` over consecutive sigma pairs with lax.scan.
+
+    Per-step interrupt (reference parity with ComfyUI's in-sampler
+    interrupt): each iteration polls the process-global flag
+    (:mod:`comfyui_distributed_tpu.runtime.interrupt`) via a host callback
+    and, once set, skips the model call — the scan still runs its remaining
+    (now trivial) iterations and returns the partially-denoised latent.
+    The poll's operand is a carry-derived scalar purely to sequence the
+    callback after the previous step."""
+    from comfyui_distributed_tpu.runtime import interrupt as itr
+
     pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=1)
     steps = jnp.arange(pairs.shape[0])
+    poll = itr.polling_enabled()
 
     def body(carry, inp):
         step, (s, s_next) = inp
-        return step_fn(carry, step, s, s_next)
+        if not poll:
+            return step_fn(carry, step, s, s_next)
+        import numpy as _np
+
+        from jax.experimental import io_callback
+        # io_callback, not pure_callback: the poll reads mutable host state,
+        # and an effectful callback can't be CSE'd/elided when the operand
+        # repeats (it does once interrupted — the carry goes constant).
+        # Ordering comes from the carry-derived operand, so ordered=False
+        # keeps it compatible with sharded (SPMD) sampling.
+        stop = io_callback(
+            itr.poll, jax.ShapeDtypeStruct((), _np.bool_),
+            carry[0].reshape(-1)[0])
+        new_carry = jax.lax.cond(
+            stop,
+            lambda c: c,
+            lambda c: step_fn(c, step, s, s_next)[0],
+            carry)
+        return new_carry, None
 
     carry = (x, carry_init) if carry_init is not None else (x, None)
     (x_final, _), _ = jax.lax.scan(body, carry, (steps, pairs))
@@ -296,16 +325,7 @@ def sample_dpmpp_2m(model: Model, x: jax.Array, sigmas: jax.Array,
         x = jnp.where(s_next > 0, x_new, denoised_d)
         return (x, denoised), None
 
-    old = jnp.zeros_like(x)
-    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=1)
-    steps = jnp.arange(n)
-
-    def body(carry, inp):
-        step_i, (s, s_next) = inp
-        return step(carry, step_i, s, s_next)
-
-    (x_final, _), _ = jax.lax.scan(body, (x, old), (steps, pairs))
-    return x_final
+    return _scan_sampler(step, x, sigmas, carry_init=jnp.zeros_like(x))
 
 
 def sample_dpmpp_2m_sde(model: Model, x: jax.Array, sigmas: jax.Array,
@@ -350,17 +370,9 @@ def sample_dpmpp_2m_sde(model: Model, x: jax.Array, sigmas: jax.Array,
         x, new_carry = jax.lax.cond(s_next > 0, sde_step, final, None)
         return (x, new_carry), None
 
-    pairs = jnp.stack([sigmas[:-1], sigmas[1:]], axis=1)
-    steps = jnp.arange(n)
-
-    def body(carry, inp):
-        step_i, (s, s_next) = inp
-        return step(carry, step_i, s, s_next)
-
-    (x_final, _), _ = jax.lax.scan(
-        body, (x, (jnp.zeros_like(x), jnp.asarray(1.0, x.dtype))),
-        (steps, pairs))
-    return x_final
+    return _scan_sampler(
+        step, x, sigmas,
+        carry_init=(jnp.zeros_like(x), jnp.asarray(1.0, x.dtype)))
 
 
 def sample_lcm(model: Model, x: jax.Array, sigmas: jax.Array,

@@ -151,7 +151,13 @@ class VAEDecode(Op):
     def execute(self, ctx: OpContext, samples, vae):
         ctx.check_interrupt()
         with Timer("vae_decode"):
-            img = vae.vae_decode(jnp.asarray(samples["samples"]))
+            # clamp to image range at the decode boundary (ComfyUI's
+            # VAEDecode does the same): everything downstream — PNG wire,
+            # tile blend, preview — assumes [0,1], and unclamped floats
+            # would make the HTTP paths (clipped by the uint8 wire) diverge
+            # from the SPMD/local paths (unclipped)
+            img = jnp.clip(
+                vae.vae_decode(jnp.asarray(samples["samples"])), 0.0, 1.0)
         meta = {k: samples[k] for k in ("local_batch", "fanout")
                 if k in samples}
         return (ImageBatch(img, **meta),)

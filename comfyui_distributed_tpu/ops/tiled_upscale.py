@@ -131,7 +131,33 @@ class UltimateSDUpscaleDistributed(Op):
             steps=p["steps"], cfg=p["cfg"], sampler_name=p["sampler_name"],
             scheduler=p["scheduler"], denoise=p["denoise"],
             add_noise=True, sample_idx=idx, y=y)
-        return np.asarray(pipe.vae_decode(out_lat))
+        # clamp at the decode boundary (ComfyUI VAEDecode parity): the
+        # worker->master PNG wire clips to [0,1], so unclamped local tiles
+        # would blend differently from the same tile shipped over HTTP
+        return np.clip(np.asarray(pipe.vae_decode(out_lat)), 0.0, 1.0)
+
+    def _window_to_extracted(self, tile: np.ndarray, pos: Tuple[int, int],
+                             p: Dict[str, Any], img_size: Tuple[int, int]
+                             ) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
+        """Padded-window tile (possibly downsampled to tile size) -> the
+        clamped extraction region at natural size.
+
+        This is THE canonical window->blend-form transform (inverse of
+        ``_worker_tile_to_window``): both the local blend and the HTTP wire
+        must use it so worker tiles land bit-identically to local ones
+        (reference resizes to extracted size, distributed_upscale.py:
+        480-514, 606-635)."""
+        w, h = img_size
+        x, y = pos
+        tw, th, pad = p["tile_w"], p["tile_h"], p["padding"]
+        x1, y1, x2, y2 = tiling.extraction_region(x, y, tw, th, pad, w, h)
+        if pad > 0:
+            full_w, full_h = tw + 2 * pad, th + 2 * pad
+            if (tile.shape[1], tile.shape[0]) != (full_w, full_h):
+                tile = resize_image(tile[None], full_w, full_h)[0]
+            ox, oy = x1 - (x - pad), y1 - (y - pad)
+            tile = tile[oy:oy + (y2 - y1), ox:ox + (x2 - x1), :]
+        return tile, (x1, y1, x2, y2)
 
     def _blend_all(self, image: np.ndarray,
                    refined: Dict[int, np.ndarray],
@@ -141,20 +167,12 @@ class UltimateSDUpscaleDistributed(Op):
         copy of the base image (timed-out/missing tiles keep base pixels —
         the reference's partial-result semantics)."""
         h, w = image.shape[1:3]
-        tw, th, pad = p["tile_w"], p["tile_h"], p["padding"]
+        tw, th = p["tile_w"], p["tile_h"]
         canvas = image[0].copy()
-        full_w, full_h = tw + 2 * pad, th + 2 * pad
         for tile_idx in sorted(refined):
             x, y = all_tiles[tile_idx]
-            x1, y1, x2, y2 = tiling.extraction_region(x, y, tw, th, pad, w, h)
-            tile = refined[tile_idx]
-            if pad > 0:
-                # back to full padded-window size, then crop the clamped
-                # extraction region (reference resizes to extracted size)
-                tile = resize_image(tile[None], full_w, full_h)[0]
-                ox = x1 - (x - pad)
-                oy = y1 - (y - pad)
-                tile = tile[oy:oy + (y2 - y1), ox:ox + (x2 - x1), :]
+            tile, (x1, y1, x2, y2) = self._window_to_extracted(
+                refined[tile_idx], all_tiles[tile_idx], p, (w, h))
             canvas = tiling.blend_tile(
                 canvas, tile, x1, y1, (x, y), tw, th,
                 (x2 - x1, y2 - y1), p["mask_blur"])
@@ -222,10 +240,13 @@ class UltimateSDUpscaleDistributed(Op):
 
         async def send_all():
             for k, tile_idx in enumerate(indices):
-                x, y = all_tiles[tile_idx]
-                x1, y1, x2, y2 = tiling.extraction_region(
-                    x, y, p["tile_w"], p["tile_h"], p["padding"], w, h)
-                png = encode_png(refined[k:k + 1])
+                # the wire carries the clamped extraction region at natural
+                # size — the exact form the master's blend consumes; sending
+                # the raw window would make the master resize-distort it to
+                # the advertised extracted_width/height at image edges
+                tile, (x1, y1, x2, y2) = self._window_to_extracted(
+                    refined[k], all_tiles[tile_idx], p, (w, h))
+                png = encode_png(tile[None])
 
                 def make_form(k=k, tile_idx=tile_idx, x1=x1, y1=y1,
                               x2=x2, y2=y2, png=png):

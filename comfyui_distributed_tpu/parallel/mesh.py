@@ -32,6 +32,27 @@ from comfyui_distributed_tpu.utils.logging import debug_log, log
 AXIS_ORDER = (DATA_AXIS, TENSOR_AXIS, SEQ_AXIS)
 
 
+def force_cpu_platform(n_devices: int) -> None:
+    """Pin JAX to ``n_devices`` virtual CPU devices WITHOUT ever probing the
+    default backend.
+
+    Calling ``jax.devices()`` first would initialize the default (TPU)
+    backend, which can hang indefinitely inside ``make_c_api_client`` when
+    the chip is held by another process (round-2 dryrun root cause,
+    VERDICT.md).  Works even when sitecustomize imported jax at interpreter
+    startup (env alone is frozen then — the live config update is the
+    reliable switch) and when a CPU backend already initialized with a
+    different device count (cleared first so the new count applies)."""
+    try:  # drop any backend a host process already initialized
+        import jax.extend as jex
+        jex.backend.clear_backends()
+    except Exception:
+        pass
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_devices)
+
+
 def describe_devices(devices: Optional[Sequence[jax.Device]] = None) -> Dict[str, Any]:
     """Topology discovery — the TPU analog of the reference's worker/CUDA
     enumeration (``CUDA_VISIBLE_DEVICES`` handling, reference

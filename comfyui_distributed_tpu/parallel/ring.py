@@ -31,7 +31,11 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from comfyui_distributed_tpu.utils.constants import SEQ_AXIS
+from comfyui_distributed_tpu.utils.constants import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    TENSOR_AXIS,
+)
 
 NEG_INF = -1e30
 
@@ -95,14 +99,22 @@ def _ring_body(q, k, v, axis_name: str, n_shards: int, causal: bool,
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    mesh: Mesh, axis_name: str = SEQ_AXIS,
                    causal: bool = False,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   batch_axis: Optional[str] = DATA_AXIS,
+                   head_axis: Optional[str] = TENSOR_AXIS) -> jax.Array:
     """Sequence-parallel attention over ``mesh[axis_name]``.
 
     q/k/v: [B, N, H, D] with the token axis N sharded over ``axis_name``
     (replicated inputs are fine too — shard_map partitions them).  Returns
     [B, N, H, D] with the same sharding.  N must divide evenly by the axis
     size (pad upstream — same pad-and-mask stance as the tile scatter,
-    ``parallel/collectives.py``)."""
+    ``parallel/collectives.py``).
+
+    Composes with the other mesh axes: when the batch dim divides
+    ``batch_axis`` (dp) and/or the head dim divides ``head_axis`` (tp),
+    those dims shard too instead of forcing an all-gather of dp-sharded
+    activations into every seq shard — so dp x tp x sp runs as one
+    shard_map with the K/V ring riding only the ``seq`` axis."""
     n_shards = mesh.shape[axis_name]
     if q.shape[1] % n_shards:
         raise ValueError(f"sequence length {q.shape[1]} not divisible by "
@@ -132,7 +144,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return (acc / jnp.maximum(l, 1e-20)[..., None].transpose(0, 2, 1, 3)
                 ).astype(q.dtype)
 
-    spec = P(None, axis_name, None, None)
+    def _axis_if_divisible(name: Optional[str], dim: int) -> Optional[str]:
+        if not name or name == axis_name or name not in mesh.shape:
+            return None
+        size = int(mesh.shape[name])
+        return name if size > 1 and dim % size == 0 else None
+
+    b_ax = _axis_if_divisible(batch_axis, q.shape[0])
+    h_ax = _axis_if_divisible(head_axis, q.shape[2])
+    spec = P(b_ax, axis_name, h_ax, None)
     body = partial(_ring_body, axis_name=axis_name, n_shards=n_shards,
                    causal=causal, scale=scale)
     return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),

@@ -1,0 +1,57 @@
+"""Process-global interrupt flag, pollable from inside compiled programs.
+
+The reference inherits ComfyUI's per-step interrupt: ``common_ksampler``
+checks a processing flag between denoise steps (reference
+``distributed_upscale.py:516-541`` runs under ComfyUI's executor, whose
+``/interrupt`` route flips that flag).  An ``lax.scan`` denoise loop is one
+compiled program, so between-node checks (``ops/base.py check_interrupt``)
+can't stop a 20-step sample already in flight — instead the scan body polls
+this flag through a host callback each step and skips the model call once
+set (``models/samplers.py _scan_sampler``), returning the partially-denoised
+latent within one step.
+
+One process-global event mirrors ComfyUI's global processing-interrupted
+semantics; the server's ``/interrupt`` route sets it, the executor clears it
+at run start.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+_event = threading.Event()
+
+
+def interrupt_event() -> threading.Event:
+    """The process-wide interrupt event (shared with the server state)."""
+    return _event
+
+
+def request_interrupt() -> None:
+    _event.set()
+
+
+def clear_interrupt() -> None:
+    _event.clear()
+
+
+def is_interrupted() -> bool:
+    return _event.is_set()
+
+
+def polling_enabled() -> bool:
+    """Whether compiled samplers poll the flag each step.  Default on;
+    ``DTPU_INTERRUPT_POLL=0`` opts out (e.g. microbenchmarks that don't
+    want the per-step host readback)."""
+    return os.environ.get("DTPU_INTERRUPT_POLL", "1") != "0"
+
+
+def poll(_sequencer=None) -> np.bool_:
+    """Host-callback body: reads the flag.  The ignored operand exists so
+    callers can pass a carry-dependent scalar, giving the callback a data
+    dependency on the previous step (otherwise XLA could hoist all the
+    polls to the start of the scan)."""
+    return np.bool_(_event.is_set())

@@ -85,7 +85,10 @@ class ServerState:
         self.health = HealthPoller(config_path=config_path,
                                    manager=self.manager)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
-        self.interrupt_event = threading.Event()
+        # the process-global flag: compiled samplers poll it per step
+        # (runtime/interrupt.py), so /interrupt stops a sample in flight
+        from comfyui_distributed_tpu.runtime.interrupt import interrupt_event
+        self.interrupt_event = interrupt_event()
         self.metrics: Dict[str, Any] = {
             "prompts_executed": 0, "prompts_failed": 0,
             "images_received": 0, "tiles_received": 0,
@@ -574,6 +577,14 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             None, lambda: cfg_mod.load_config(state.config_path))
         return cfg if cfg_mod.enabled_workers(cfg) else None
 
+    async def panel(request):
+        """Visual cluster panel (status dots, worker lifecycle, metrics,
+        log tail) — one static dependency-free page over the JSON routes;
+        the capability analog of the reference's sidebar
+        (``gpupanel.js:327-801, 1519-2085``)."""
+        return web.FileResponse(
+            os.path.join(os.path.dirname(__file__), "panel.html"))
+
     async def interrupt(request):
         state.interrupt_event.set()
         log("interrupt requested")
@@ -622,6 +633,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/prompt", get_prompt)
     r.add_post("/prompt", post_prompt)
     r.add_post("/interrupt", interrupt)
+    r.add_get("/panel", panel)
     r.add_post("/upload/image", upload_image)
     r.add_get("/history", history)
     return app

@@ -154,7 +154,15 @@ def prepare_for_participant(graph: Graph, participant: str,
             h["enabled_worker_ids"] = ids_json  # both sides need tile math
             if is_worker:
                 h["master_url"] = master_url
-                h["worker_id"] = worker_id
+                # the upscaler locates its tile range by finding its own id
+                # IN enabled_worker_ids (reference parity: tile assignment
+                # is recomputed from (enabled_worker_ids, worker_id) on each
+                # side, distributed_upscale.py:143-147) — so it must get the
+                # participant's CONFIG id, not the positional worker_N label
+                # the seed/collector nodes use
+                h["worker_id"] = (str(enabled_worker_ids[worker_index])
+                                  if worker_index < len(enabled_worker_ids)
+                                  else worker_id)
     return g
 
 

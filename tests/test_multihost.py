@@ -18,6 +18,7 @@ from comfyui_distributed_tpu.workflow import parse_workflow
 from comfyui_distributed_tpu.workflow import dispatcher as dsp
 
 TXT2IMG = "/root/reference/workflows/distributed-txt2img.json"
+UPSCALE = "/root/reference/workflows/distributed-upscale.json"
 
 
 def _post(url, payload, timeout=10):
@@ -44,8 +45,7 @@ def _wait_up(port, timeout=90):
     raise TimeoutError(f"server on {port} never came up")
 
 
-@pytest.fixture
-def servers(tmp_path):
+def _spawn_cluster(tmp_path, n_workers=1):
     env = {
         **os.environ,
         "PYTHONPATH": "/root/repo",
@@ -54,33 +54,56 @@ def servers(tmp_path):
         "DTPU_DEFAULT_FAMILY": "tiny",
         "DISTRIBUTED_TPU_CONFIG": str(tmp_path / "cfg.json"),
     }
-    mport, wport = find_free_port(), find_free_port()
-    logs = [open(tmp_path / "master.log", "w"),
-            open(tmp_path / "worker.log", "w")]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-m", "comfyui_distributed_tpu.cli", "serve",
-             "--host", "127.0.0.1", "--port", str(mport)],
-            env=env, cwd=str(tmp_path), stdout=logs[0], stderr=logs[0]),
-        subprocess.Popen(
+    mport = find_free_port()
+    wports = [find_free_port() for _ in range(n_workers)]
+    logs = [open(tmp_path / "master.log", "w")]
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "comfyui_distributed_tpu.cli", "serve",
+         "--host", "127.0.0.1", "--port", str(mport)],
+        env=env, cwd=str(tmp_path), stdout=logs[0], stderr=logs[0])]
+    for i, wp in enumerate(wports):
+        f = open(tmp_path / f"worker{i}.log", "w")
+        logs.append(f)
+        procs.append(subprocess.Popen(
             [sys.executable, "-m", "comfyui_distributed_tpu.cli", "worker",
-             "--host", "127.0.0.1", "--port", str(wport)],
-            env=env, cwd=str(tmp_path), stdout=logs[1], stderr=logs[1]),
-    ]
+             "--host", "127.0.0.1", "--port", str(wp)],
+            env=env, cwd=str(tmp_path), stdout=f, stderr=f))
+    return mport, wports, procs, logs
+
+
+def _teardown_cluster(procs, logs):
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for f in logs:
+        f.close()
+
+
+@pytest.fixture
+def servers(tmp_path):
+    mport, wports, procs, logs = _spawn_cluster(tmp_path, n_workers=1)
     try:
         _wait_up(mport)
-        _wait_up(wport)
-        yield mport, wport, tmp_path
+        _wait_up(wports[0])
+        yield mport, wports[0], tmp_path
     finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-        for f in logs:
-            f.close()
+        _teardown_cluster(procs, logs)
+
+
+@pytest.fixture
+def servers2(tmp_path):
+    mport, wports, procs, logs = _spawn_cluster(tmp_path, n_workers=2)
+    try:
+        _wait_up(mport)
+        for wp in wports:
+            _wait_up(wp)
+        yield mport, wports, tmp_path
+    finally:
+        _teardown_cluster(procs, logs)
 
 
 @pytest.mark.integration
@@ -168,3 +191,91 @@ def test_interceptor_orchestrates_automatically(servers):
     assert done, "master prompt never completed"
     assert done["status"] == "success", done
     assert done["images"] == 2  # master's + worker's, gathered over HTTP
+
+
+def _scaled_upscale_graph():
+    """The reference's distributed-upscale fixture scaled for CPU CI, with
+    the terminal preview swapped for SaveImage so the master persists the
+    blended result for pixel comparison."""
+    g = parse_workflow(UPSCALE)
+    g.nodes["12"].inputs["image"] = "__missing__.png"   # synthetic test card
+    g.nodes["17"].inputs.update(width=64, height=64)
+    g.nodes["13"].inputs.update(steps=1, tile_width=32, tile_height=32,
+                                padding=8, mask_blur=2)
+    for n in g.nodes.values():
+        if n.class_type == "PreviewImage":
+            n.class_type = "SaveImage"
+    return g
+
+
+@pytest.mark.integration
+def test_tiled_upscale_over_http_matches_oracle(servers2, tmp_path,
+                                                monkeypatch):
+    """VERDICT r2 #7: the tile scatter/gather worker->master HTTP path
+    (reference distributed_upscale.py:132-199, 606-665) over real sockets
+    with 2 workers, blended output compared against the in-process
+    single-participant oracle."""
+    import numpy as np
+
+    # the oracle runs in THIS process: pin the same family the server
+    # processes use, and drop any pipeline cached under another family
+    monkeypatch.setenv("DTPU_DEFAULT_FAMILY", "tiny")
+    from comfyui_distributed_tpu.models import registry
+    registry.clear_pipeline_cache()
+
+    mport, wports, tmp = servers2
+    master_url = f"http://127.0.0.1:{mport}"
+    for i, wp in enumerate(wports):
+        _post(f"{master_url}/distributed/config/update_worker",
+              {"id": f"w{i}", "name": f"w{i}", "port": wp, "enabled": True})
+
+    g = _scaled_upscale_graph()
+    mr = _post(f"{master_url}/prompt",
+               {"prompt": g.to_api_format(), "client_id": "test"})
+    assert sorted(mr.get("workers", [])) == ["w0", "w1"], mr
+    assert mr.get("failed_workers") == [], mr
+
+    deadline = time.time() + 300
+    done = {}
+    while time.time() < deadline:
+        hist = _get(f"{master_url}/history")
+        if mr["prompt_id"] in hist:
+            done = hist[mr["prompt_id"]]
+            break
+        time.sleep(1.0)
+    assert done, "master prompt never completed"
+    assert done["status"] == "success", done
+    assert done["images"] == 1
+
+    metrics = _get(f"{master_url}/distributed/metrics")
+    assert metrics["tiles_received"] >= 1, \
+        "workers never delivered tiles over HTTP"
+
+    out_files = sorted((tmp / "output").glob("*.png"))
+    assert out_files, "master saved no output image"
+    from PIL import Image
+    got = np.asarray(Image.open(out_files[-1]), np.float32) / 255.0
+
+    # in-process single-participant oracle (the reference's
+    # process_single_gpu analog) on the identical graph
+    from comfyui_distributed_tpu.ops.base import OpContext
+    from comfyui_distributed_tpu.parallel.mesh import MeshRuntime, build_mesh
+    from comfyui_distributed_tpu.workflow.executor import WorkflowExecutor
+    rt = MeshRuntime(mesh=build_mesh())
+    rt.enabled = False   # num_participants -> 1
+    ctx = OpContext(runtime=rt, input_dir=str(tmp / "input"),
+                    output_dir=str(tmp / "oracle_out"))
+    res = WorkflowExecutor(ctx).execute(_scaled_upscale_graph())
+    oracle = np.asarray(res.images[0], np.float32)
+
+    assert got.shape == oracle.shape
+    # Bound, not bit-equality: the wire quantizes tiles to uint8 PNG before
+    # blending, and worker processes (1 XLA device) can diverge from this
+    # process (8 virtual devices) by float-fusion noise that the feathered
+    # seams amplify.  Misplaced or wrongly-refined tiles fail this by a
+    # mile (the two bugs this test caught produced 50-95% mismatch at
+    # diff≈1.0); the healthy path leaves a handful of seam pixels < 0.15.
+    diff = np.abs(got - oracle).max(axis=-1)
+    assert (diff > 0.02).mean() < 0.01, \
+        f"{(diff > 0.02).mean():.1%} of pixels off (seam noise budget 1%)"
+    assert diff.max() < 0.15, f"max pixel diff {diff.max():.3f}"

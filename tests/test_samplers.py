@@ -118,6 +118,73 @@ class TestSamplers:
             smp.get_sampler("plms9000")
 
 
+class TestPerStepInterrupt:
+    """VERDICT r2 #8: /interrupt must stop a sample already inside the
+    compiled scan, not just between nodes."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_flag(self):
+        from comfyui_distributed_tpu.runtime import interrupt as itr
+        itr.clear_interrupt()
+        yield
+        itr.clear_interrupt()
+
+    def _run(self, ds, steps=20, sampler="euler"):
+        x0 = jnp.zeros((1, 4, 4, 3), jnp.float32)
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "karras", steps))
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(1, dtype=jnp.uint32))
+        x = jnp.ones(x0.shape, jnp.float32) * sigmas[0]
+        fn = smp.get_sampler(sampler)
+        return x, fn(ideal_model(x0), x, sigmas, keys=keys)
+
+    @pytest.mark.parametrize("name", smp.SAMPLER_NAMES)
+    def test_interrupt_skips_all_steps(self, ds, name):
+        """Flag set -> every scan iteration skips the model call; the
+        latent comes back untouched (the partial-result semantics).
+        Parametrized over ALL samplers: dpmpp_2m/_sde once had their own
+        scans bypassing the polling _scan_sampler."""
+        from comfyui_distributed_tpu.runtime import interrupt as itr
+        itr.request_interrupt()
+        x_in, out = self._run(ds, sampler=name)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x_in))
+
+    def test_clear_resumes_normal_sampling(self, ds):
+        x_in, out = self._run(ds)
+        # ideal denoiser: converges to 0, far from the initial latent
+        assert not np.allclose(np.asarray(out), np.asarray(x_in))
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.zeros_like(np.asarray(out)), atol=1e-3)
+
+    def test_mid_run_interrupt_returns_partial(self, ds):
+        """The model sets the flag on its 3rd call (host callback): every
+        later scan iteration must skip, so the result is exactly the
+        3-step partial — deterministic proof the poll stops a sample
+        mid-scan within one step."""
+        from comfyui_distributed_tpu.runtime import interrupt as itr
+
+        steps = 20
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "karras", steps))
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(1, dtype=jnp.uint32))
+        x = jnp.ones((1, 4, 4, 3), jnp.float32) * sigmas[0]
+        calls = []
+
+        def model(xin, sigma, **kw):
+            def cb(_x_seq):
+                calls.append(1)
+                if len(calls) == 3:
+                    itr.request_interrupt()
+                return np.float32(0.0)
+            z = jax.pure_callback(cb, jax.ShapeDtypeStruct((), np.float32),
+                                  xin.reshape(-1)[0])
+            return jnp.zeros_like(xin) + z   # ideal denoiser to x0 = 0
+
+        out = np.asarray(smp.sample_euler(model, x, sigmas, keys=keys))
+        # euler to x0=0: x_{k+1} = x_k * s_{k+1}/s_k, stopped after 3 steps
+        expect = np.asarray(x) * float(sigmas[3] / sigmas[0])
+        np.testing.assert_allclose(out, expect, rtol=1e-4)
+        assert len(calls) == 3   # steps 4..20 never called the model
+
+
 class TestCFG:
     def test_cfg_interpolates(self):
         calls = []

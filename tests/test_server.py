@@ -305,9 +305,29 @@ class TestPromptSurface:
 
     def test_interrupt(self, tmp_path):
         async def body(client, state):
+            from comfyui_distributed_tpu.runtime import interrupt as itr
             r = await client.post("/interrupt")
             assert r.status == 200
             assert state.interrupt_event.is_set()
+            # the server's event IS the process-global flag that compiled
+            # samplers poll per step (runtime/interrupt.py) — so /interrupt
+            # reaches a sample already inside its lax.scan
+            assert itr.is_interrupted()
+            itr.clear_interrupt()
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
+
+class TestPanel:
+    def test_panel_serves_html(self, tmp_path):
+        async def body(client, state):
+            r = await client.get("/panel")
+            assert r.status == 200
+            assert "text/html" in r.headers.get("Content-Type", "")
+            text = await r.text()
+            # drives the existing JSON routes, no external deps
+            for needle in ("/distributed/workers_status", "_worker",
+                           "/distributed/metrics", "<script>"):
+                assert needle in text, needle
         run_with_client(body, tmp_path, start_exec_thread=False)
 
 
