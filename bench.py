@@ -73,6 +73,9 @@ def parse_args(argv=None):
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--platform", default="auto", choices=["auto", "cpu"],
                    help="'cpu' forces the CPU backend (harness smoke tests)")
+    p.add_argument("--attn", default="xla", choices=["xla", "pallas", "ring"],
+                   help="UNet attention impl — 'pallas' benchmarks the "
+                        "custom flash kernel against the default XLA path")
     p.add_argument("--init-retries", type=int, default=4,
                    help="backend probe attempts before giving up")
     p.add_argument("--init-timeout", type=int, default=150,
@@ -100,8 +103,9 @@ def log(msg):
 def metric_name(args):
     if args.scaling_sweep:
         return "tiny_virtual_mesh_spmd_efficiency_8dev"
+    attn = "" if args.attn == "xla" else f"_{args.attn}"
     return (f"{args.family}_{args.width}x{args.height}_"
-            f"{args.steps}step_images_per_sec_per_chip")
+            f"{args.steps}step{attn}_images_per_sec_per_chip")
 
 
 def metric_unit(args):
@@ -301,6 +305,28 @@ def run_throughput(args):
     # weights (10.3 GB) would crowd a 16 GB v5e chip
     pipe.unet_params = bf16_params(pipe.unet_params)
     pipe.clip_params = [bf16_params(p) for p in pipe.clip_params]
+    if args.attn == "ring":
+        # ring only engages over a multi-device seq mesh; on one chip every
+        # call would silently fall back to XLA and the '_ring' metric name
+        # would label an XLA measurement
+        if len(devices) < 2:
+            fail(args, "config",
+                 f"--attn ring needs >=2 devices for a seq axis, "
+                 f"have {len(devices)}")
+        from comfyui_distributed_tpu.parallel.mesh import (
+            MeshRuntime, build_mesh, set_runtime)
+        set_runtime(MeshRuntime(mesh=build_mesh(
+            {"data": 1, "tensor": 1, "seq": len(devices)},
+            devices=devices)))
+        log(f"ring attention over seq={len(devices)} mesh")
+    if args.attn != "xla":
+        # params are impl-agnostic: swap only the module's attention math
+        import dataclasses
+
+        from comfyui_distributed_tpu.models import unet as unet_mod
+        pipe.unet = unet_mod.UNet(dataclasses.replace(
+            pipe.family.unet, attn_impl=args.attn))
+        log(f"attn_impl={args.attn}")
     log(f"init {time.time()-t0:.1f}s")
 
     B = args.batch

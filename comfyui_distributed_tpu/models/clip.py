@@ -43,7 +43,8 @@ TINY_CLIP_CONFIG = CLIPConfig(vocab_size=4096, width=64, layers=2, heads=4,
 def _act(name: str):
     if name == "quick_gelu":
         return lambda x: x * jax.nn.sigmoid(1.702 * x)
-    return nn.gelu
+    # OpenCLIP's nn.GELU is the exact (erf) form, not flax's default tanh
+    return lambda x: nn.gelu(x, approximate=False)
 
 
 class CLIPLayer(nn.Module):

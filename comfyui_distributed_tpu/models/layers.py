@@ -141,7 +141,9 @@ class GEGLU(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         h = nn.Dense(self.dim_out * 2, dtype=self.dtype, name="proj")(x)
         a, b = jnp.split(h, 2, axis=-1)
-        return a * nn.gelu(b)
+        # exact (erf) gelu: torch F.gelu's default, what SD was trained
+        # with — flax's default tanh approximation drifts ~1e-3
+        return a * nn.gelu(b, approximate=False)
 
 
 class FeedForward(nn.Module):
@@ -186,7 +188,9 @@ class SpatialTransformer(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
         B, H, W, C = x.shape
-        h = GroupNorm32(name="norm")(x)
+        # CompVis attention.py Normalize: GroupNorm eps=1e-6 (the UNet's
+        # ResBlock GroupNorm32 uses torch's 1e-5 default instead)
+        h = GroupNorm32(epsilon=1e-6, name="norm")(x)
         h = nn.Dense(C, dtype=self.dtype, name="proj_in")(h)
         h = h.reshape(B, H * W, C)
         for i in range(self.depth):

@@ -284,7 +284,9 @@ _pipeline_lock = threading.Lock()
 def load_pipeline(ckpt_name: str, models_dir: Optional[str] = None,
                   family_name: Optional[str] = None) -> DiffusionPipeline:
     """Load or virtually-initialize the named checkpoint (cached)."""
-    key = f"{ckpt_name}:{family_name or ''}"
+    # models_dir is part of the identity: it decides both which file loads
+    # AND which tokenizer assets (vocab/merges) the pipeline picks up
+    key = f"{ckpt_name}:{family_name or ''}:{models_dir or ''}"
     with _pipeline_lock:
         if key in _pipeline_cache:
             return _pipeline_cache[key]

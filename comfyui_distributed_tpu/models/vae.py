@@ -46,10 +46,10 @@ class VAEResBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        h = nn.silu(GroupNorm32(name="norm1")(x))
+        h = nn.silu(GroupNorm32(epsilon=1e-6, name="norm1")(x))
         h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
                     name="conv1")(h)
-        h = nn.silu(GroupNorm32(name="norm2")(h))
+        h = nn.silu(GroupNorm32(epsilon=1e-6, name="norm2")(h))
         h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
                     name="conv2")(h)
         if x.shape[-1] != self.out_channels:
@@ -65,7 +65,7 @@ class VAEAttnBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         B, H, W, C = x.shape
-        h = GroupNorm32(name="norm")(x)
+        h = GroupNorm32(epsilon=1e-6, name="norm")(x)
         q = nn.Dense(C, dtype=self.dtype, name="q")(h).reshape(B, H * W, C)
         k = nn.Dense(C, dtype=self.dtype, name="k")(h).reshape(B, H * W, C)
         v = nn.Dense(C, dtype=self.dtype, name="v")(h).reshape(B, H * W, C)
@@ -92,12 +92,16 @@ class Encoder(nn.Module):
                 h = VAEResBlock(out_ch, dtype=cfg.dtype,
                                 name=f"down_{level}_res_{i}")(h)
             if level != len(cfg.channel_mult) - 1:
-                h = nn.Conv(out_ch, (3, 3), strides=(2, 2), padding=1,
+                # CompVis VAE Downsample pads (0,1,0,1) — right/bottom only —
+                # then convs stride 2 pad 0; symmetric padding would shift
+                # the whole grid half a stride vs real checkpoints
+                h = nn.Conv(out_ch, (3, 3), strides=(2, 2),
+                            padding=((0, 1), (0, 1)),
                             dtype=cfg.dtype, name=f"down_{level}_ds")(h)
         h = VAEResBlock(h.shape[-1], dtype=cfg.dtype, name="mid_res_0")(h)
         h = VAEAttnBlock(dtype=cfg.dtype, name="mid_attn")(h)
         h = VAEResBlock(h.shape[-1], dtype=cfg.dtype, name="mid_res_1")(h)
-        h = nn.silu(GroupNorm32(name="out_norm")(h))
+        h = nn.silu(GroupNorm32(epsilon=1e-6, name="out_norm")(h))
         return nn.Conv(2 * cfg.latent_channels, (3, 3), padding=1,
                        dtype=jnp.float32, name="conv_out")(h).astype(jnp.float32)
 
@@ -123,7 +127,7 @@ class Decoder(nn.Module):
                 h = jax.image.resize(h, (B, H * 2, W * 2, C), method="nearest")
                 h = nn.Conv(C, (3, 3), padding=1, dtype=cfg.dtype,
                             name=f"up_{level}_us")(h)
-        h = nn.silu(GroupNorm32(name="out_norm")(h))
+        h = nn.silu(GroupNorm32(epsilon=1e-6, name="out_norm")(h))
         return nn.Conv(3, (3, 3), padding=1, dtype=jnp.float32,
                        name="conv_out")(h).astype(jnp.float32)
 

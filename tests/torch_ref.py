@@ -1,0 +1,417 @@
+"""Hand-written torch reference models in the CANONICAL SD checkpoint
+layout (CompVis/LDM module structure, attribute names = checkpoint keys).
+
+These are the parity oracles for ``tests/test_torch_parity.py``: they encode
+the torch ecosystem's conventions — NCHW, skip-concat order, the VAE's
+asymmetric downsample padding, GroupNorm eps (1e-5 UNet / 1e-6 VAE and
+spatial-transformer norms), exact-erf GELU — independently of the flax
+implementation, so a convention bug in either the flax modules or the
+checkpoint converter shows up as a numeric mismatch instead of silently
+producing a "working" model that can't load real weights.
+
+Tiny hyperparameters only (tests run them on CPU in seconds); the layout
+logic is size-independent.
+"""
+
+from __future__ import annotations
+
+import math
+
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+
+def _groups(c: int) -> int:
+    g = min(32, c)
+    while c % g:
+        g -= 1
+    return g
+
+
+def norm_unet(c: int) -> nn.GroupNorm:      # openaimodel GroupNorm32
+    return nn.GroupNorm(_groups(c), c, eps=1e-5)
+
+
+def norm_vae(c: int) -> nn.GroupNorm:       # CompVis Normalize
+    return nn.GroupNorm(_groups(c), c, eps=1e-6)
+
+
+def timestep_embedding(t: torch.Tensor, dim: int) -> torch.Tensor:
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0)
+                      * torch.arange(half, dtype=torch.float32) / half)
+    args = t.float()[:, None] * freqs[None]
+    return torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+
+
+# --- UNet building blocks (ldm.modules.diffusionmodules.openaimodel) --------
+
+class ResBlock(nn.Module):
+    def __init__(self, cin: int, cout: int, time_dim: int):
+        super().__init__()
+        self.in_layers = nn.Sequential(
+            norm_unet(cin), nn.SiLU(), nn.Conv2d(cin, cout, 3, padding=1))
+        self.emb_layers = nn.Sequential(nn.SiLU(), nn.Linear(time_dim, cout))
+        self.out_layers = nn.Sequential(
+            norm_unet(cout), nn.SiLU(), nn.Dropout(0.0),
+            nn.Conv2d(cout, cout, 3, padding=1))
+        self.skip_connection = nn.Conv2d(cin, cout, 1) if cin != cout \
+            else nn.Identity()
+
+    def forward(self, x, emb):
+        h = self.in_layers(x)
+        h = h + self.emb_layers(emb)[:, :, None, None]
+        h = self.out_layers(h)
+        return self.skip_connection(x) + h
+
+
+class CrossAttention(nn.Module):
+    def __init__(self, query_dim: int, context_dim: int, heads: int):
+        super().__init__()
+        inner = query_dim
+        self.heads = heads
+        self.scale = (inner // heads) ** -0.5
+        self.to_q = nn.Linear(query_dim, inner, bias=False)
+        self.to_k = nn.Linear(context_dim, inner, bias=False)
+        self.to_v = nn.Linear(context_dim, inner, bias=False)
+        self.to_out = nn.Sequential(nn.Linear(inner, query_dim),
+                                    nn.Dropout(0.0))
+
+    def forward(self, x, context=None):
+        ctx = x if context is None else context
+        B, N, C = x.shape
+        H = self.heads
+        q = self.to_q(x).reshape(B, N, H, C // H).permute(0, 2, 1, 3)
+        k = self.to_k(ctx).reshape(B, ctx.shape[1], H, C // H).permute(0, 2, 1, 3)
+        v = self.to_v(ctx).reshape(B, ctx.shape[1], H, C // H).permute(0, 2, 1, 3)
+        sim = torch.einsum("bhnd,bhmd->bhnm", q, k) * self.scale
+        attn = sim.softmax(dim=-1)
+        out = torch.einsum("bhnm,bhmd->bhnd", attn, v)
+        out = out.permute(0, 2, 1, 3).reshape(B, N, C)
+        return self.to_out(out)
+
+
+class GEGLU(nn.Module):
+    def __init__(self, dim_in: int, dim_out: int):
+        super().__init__()
+        self.proj = nn.Linear(dim_in, dim_out * 2)
+
+    def forward(self, x):
+        a, gate = self.proj(x).chunk(2, dim=-1)
+        return a * F.gelu(gate)     # exact erf gelu (torch default)
+
+
+class FeedForward(nn.Module):
+    def __init__(self, dim: int):
+        super().__init__()
+        self.net = nn.Sequential(GEGLU(dim, dim * 4), nn.Dropout(0.0),
+                                 nn.Linear(dim * 4, dim))
+
+    def forward(self, x):
+        return self.net(x)
+
+
+class BasicTransformerBlock(nn.Module):
+    def __init__(self, dim: int, context_dim: int, heads: int):
+        super().__init__()
+        self.attn1 = CrossAttention(dim, dim, heads)
+        self.ff = FeedForward(dim)
+        self.attn2 = CrossAttention(dim, context_dim, heads)
+        self.norm1 = nn.LayerNorm(dim)
+        self.norm2 = nn.LayerNorm(dim)
+        self.norm3 = nn.LayerNorm(dim)
+
+    def forward(self, x, context):
+        x = self.attn1(self.norm1(x)) + x
+        x = self.attn2(self.norm2(x), context=context) + x
+        x = self.ff(self.norm3(x)) + x
+        return x
+
+
+class SpatialTransformer(nn.Module):
+    """SD1.x conv form (proj_in/out are 1x1 convs)."""
+
+    def __init__(self, c: int, context_dim: int, heads: int, depth: int):
+        super().__init__()
+        self.norm = norm_vae(c)          # attention.py Normalize: eps 1e-6
+        self.proj_in = nn.Conv2d(c, c, 1)
+        self.transformer_blocks = nn.ModuleList(
+            [BasicTransformerBlock(c, context_dim, heads)
+             for _ in range(depth)])
+        self.proj_out = nn.Conv2d(c, c, 1)
+
+    def forward(self, x, context):
+        B, C, H, W = x.shape
+        x_in = x
+        h = self.norm(x)
+        h = self.proj_in(h)
+        h = h.reshape(B, C, H * W).permute(0, 2, 1)   # b, hw, c
+        for blk in self.transformer_blocks:
+            h = blk(h, context)
+        h = h.permute(0, 2, 1).reshape(B, C, H, W)
+        return x_in + self.proj_out(h)
+
+
+class Downsample(nn.Module):
+    def __init__(self, c: int):
+        super().__init__()
+        self.op = nn.Conv2d(c, c, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.op(x)
+
+
+class Upsample(nn.Module):
+    def __init__(self, c: int):
+        super().__init__()
+        self.conv = nn.Conv2d(c, c, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2, mode="nearest"))
+
+
+class TorchUNet(nn.Module):
+    """LDM UNet at arbitrary (tiny) hyperparameters, canonical keys."""
+
+    def __init__(self, model_channels=32, channel_mult=(1, 2),
+                 num_res_blocks=1, transformer_depth=(1, 1),
+                 context_dim=64, num_head_channels=16,
+                 in_channels=4, out_channels=4):
+        super().__init__()
+        mc = model_channels
+        time_dim = mc * 4
+        self.time_embed = nn.Sequential(
+            nn.Linear(mc, time_dim), nn.SiLU(),
+            nn.Linear(time_dim, time_dim))
+        self.model_channels = mc
+
+        def heads(c):
+            return max(c // num_head_channels, 1)
+
+        self.input_blocks = nn.ModuleList(
+            [nn.Sequential(nn.Conv2d(in_channels, mc, 3, padding=1))])
+        ch = mc
+        for level, mult in enumerate(channel_mult):
+            out_ch = mc * mult
+            for _ in range(num_res_blocks):
+                mods = [ResBlock(ch, out_ch, time_dim)]
+                ch = out_ch
+                if transformer_depth[level] > 0:
+                    mods.append(SpatialTransformer(
+                        ch, context_dim, heads(ch),
+                        transformer_depth[level]))
+                self.input_blocks.append(nn.Sequential(*mods))
+            if level != len(channel_mult) - 1:
+                self.input_blocks.append(nn.Sequential(Downsample(ch)))
+
+        self.middle_block = nn.Sequential(
+            ResBlock(ch, ch, time_dim),
+            SpatialTransformer(ch, context_dim, heads(ch),
+                               max(transformer_depth[-1], 1)),
+            ResBlock(ch, ch, time_dim))
+
+        # skip channels per input block, for up-path concat widths
+        skip_chs = [mc]
+        c = mc
+        for level, mult in enumerate(channel_mult):
+            for _ in range(num_res_blocks):
+                c = mc * mult
+                skip_chs.append(c)
+            if level != len(channel_mult) - 1:
+                skip_chs.append(c)
+
+        self.output_blocks = nn.ModuleList()
+        for level in reversed(range(len(channel_mult))):
+            out_ch = mc * channel_mult[level]
+            for i in range(num_res_blocks + 1):
+                mods = [ResBlock(ch + skip_chs.pop(), out_ch, time_dim)]
+                ch = out_ch
+                if transformer_depth[level] > 0:
+                    mods.append(SpatialTransformer(
+                        ch, context_dim, heads(ch),
+                        transformer_depth[level]))
+                if level != 0 and i == num_res_blocks:
+                    mods.append(Upsample(ch))
+                self.output_blocks.append(nn.Sequential(*mods))
+
+        self.out = nn.Sequential(norm_unet(ch), nn.SiLU(),
+                                 nn.Conv2d(ch, out_channels, 3, padding=1))
+
+    def forward(self, x, timesteps, context):
+        emb = self.time_embed(timestep_embedding(timesteps,
+                                                 self.model_channels))
+        hs = []
+        h = x
+        for block in self.input_blocks:
+            for mod in block:
+                if isinstance(mod, ResBlock):
+                    h = mod(h, emb)
+                elif isinstance(mod, SpatialTransformer):
+                    h = mod(h, context)
+                else:
+                    h = mod(h)
+            hs.append(h)
+        for mod in self.middle_block:
+            h = mod(h, emb) if isinstance(mod, ResBlock) else mod(h, context)
+        for block in self.output_blocks:
+            h = torch.cat([h, hs.pop()], dim=1)
+            for mod in block:
+                if isinstance(mod, ResBlock):
+                    h = mod(h, emb)
+                elif isinstance(mod, SpatialTransformer):
+                    h = mod(h, context)
+                else:
+                    h = mod(h)
+        return self.out(h)
+
+
+# --- VAE (ldm.modules.diffusionmodules.model / AutoencoderKL) ---------------
+
+class VAEResnetBlock(nn.Module):
+    def __init__(self, cin: int, cout: int):
+        super().__init__()
+        self.norm1 = norm_vae(cin)
+        self.conv1 = nn.Conv2d(cin, cout, 3, padding=1)
+        self.norm2 = norm_vae(cout)
+        self.conv2 = nn.Conv2d(cout, cout, 3, padding=1)
+        if cin != cout:
+            self.nin_shortcut = nn.Conv2d(cin, cout, 1)
+
+    def forward(self, x):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = self.conv2(F.silu(self.norm2(h)))
+        if hasattr(self, "nin_shortcut"):
+            x = self.nin_shortcut(x)
+        return x + h
+
+
+class VAEAttnBlock(nn.Module):
+    def __init__(self, c: int):
+        super().__init__()
+        self.norm = norm_vae(c)
+        self.q = nn.Conv2d(c, c, 1)
+        self.k = nn.Conv2d(c, c, 1)
+        self.v = nn.Conv2d(c, c, 1)
+        self.proj_out = nn.Conv2d(c, c, 1)
+
+    def forward(self, x):
+        B, C, H, W = x.shape
+        h = self.norm(x)
+        q = self.q(h).reshape(B, C, H * W).permute(0, 2, 1)
+        k = self.k(h).reshape(B, C, H * W)
+        w = torch.bmm(q, k) * C ** -0.5
+        w = w.softmax(dim=2)
+        v = self.v(h).reshape(B, C, H * W)
+        out = torch.bmm(v, w.permute(0, 2, 1)).reshape(B, C, H, W)
+        return x + self.proj_out(out)
+
+
+class VAEDownsample(nn.Module):
+    def __init__(self, c: int):
+        super().__init__()
+        self.conv = nn.Conv2d(c, c, 3, stride=2, padding=0)
+
+    def forward(self, x):
+        return self.conv(F.pad(x, (0, 1, 0, 1)))   # right/bottom only
+
+
+class VAEUpsample(nn.Module):
+    def __init__(self, c: int):
+        super().__init__()
+        self.conv = nn.Conv2d(c, c, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2, mode="nearest"))
+
+
+class _Level(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.block = nn.ModuleList()
+
+
+class VAEEncoder(nn.Module):
+    def __init__(self, ch=16, ch_mult=(1, 2), num_res=1, z=4):
+        super().__init__()
+        self.conv_in = nn.Conv2d(3, ch, 3, padding=1)
+        self.down = nn.ModuleList()
+        cin = ch
+        for level, mult in enumerate(ch_mult):
+            lv = _Level()
+            cout = ch * mult
+            for _ in range(num_res):
+                lv.block.append(VAEResnetBlock(cin, cout))
+                cin = cout
+            if level != len(ch_mult) - 1:
+                lv.downsample = VAEDownsample(cin)
+            self.down.append(lv)
+        self.mid = nn.Module()
+        self.mid.block_1 = VAEResnetBlock(cin, cin)
+        self.mid.attn_1 = VAEAttnBlock(cin)
+        self.mid.block_2 = VAEResnetBlock(cin, cin)
+        self.norm_out = norm_vae(cin)
+        self.conv_out = nn.Conv2d(cin, 2 * z, 3, padding=1)
+
+    def forward(self, x):
+        h = self.conv_in(x)
+        for lv in self.down:
+            for blk in lv.block:
+                h = blk(h)
+            if hasattr(lv, "downsample"):
+                h = lv.downsample(h)
+        h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+
+class VAEDecoder(nn.Module):
+    def __init__(self, ch=16, ch_mult=(1, 2), num_res=1, z=4):
+        super().__init__()
+        cin = ch * ch_mult[-1]
+        self.conv_in = nn.Conv2d(z, cin, 3, padding=1)
+        self.mid = nn.Module()
+        self.mid.block_1 = VAEResnetBlock(cin, cin)
+        self.mid.attn_1 = VAEAttnBlock(cin)
+        self.mid.block_2 = VAEResnetBlock(cin, cin)
+        self.up = nn.ModuleList([_Level() for _ in ch_mult])
+        for level in reversed(range(len(ch_mult))):
+            lv = self.up[level]
+            cout = ch * ch_mult[level]
+            for _ in range(num_res + 1):
+                lv.block.append(VAEResnetBlock(cin, cout))
+                cin = cout
+            if level != 0:
+                lv.upsample = VAEUpsample(cin)
+        self.norm_out = norm_vae(cin)
+        self.conv_out = nn.Conv2d(cin, 3, 3, padding=1)
+
+    def forward(self, z):
+        h = self.conv_in(z)
+        h = self.mid.block_2(self.mid.attn_1(self.mid.block_1(h)))
+        for level in reversed(range(len(self.up))):
+            lv = self.up[level]
+            for blk in lv.block:
+                h = blk(h)
+            if hasattr(lv, "upsample"):
+                h = lv.upsample(h)
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+
+class TorchVAE(nn.Module):
+    def __init__(self, ch=16, ch_mult=(1, 2), num_res=1, z=4,
+                 scaling_factor=0.18215):
+        super().__init__()
+        self.encoder = VAEEncoder(ch, ch_mult, num_res, z)
+        self.decoder = VAEDecoder(ch, ch_mult, num_res, z)
+        self.quant_conv = nn.Conv2d(2 * z, 2 * z, 1)
+        self.post_quant_conv = nn.Conv2d(z, z, 1)
+        self.sf = scaling_factor
+
+    def encode(self, images01):
+        moments = self.quant_conv(self.encoder(images01 * 2.0 - 1.0))
+        mean, _ = moments.chunk(2, dim=1)
+        return mean * self.sf
+
+    def decode(self, latents):
+        dec = self.decoder(self.post_quant_conv(latents / self.sf))
+        return ((dec + 1.0) / 2.0).clamp(0.0, 1.0)
