@@ -164,6 +164,27 @@ class VAEDecode(Op):
 
 
 @register_op
+class VAEDecodeTiled(Op):
+    """ComfyUI's VAEDecodeTiled: bounded-memory decode for large latents
+    (overlapping tiles, feathered blend — registry.vae_decode_tiled)."""
+    TYPE = "VAEDecodeTiled"
+    WIDGETS = ["tile_size", "overlap"]
+    DEFAULTS = {"tile_size": 512, "overlap": 64}
+
+    def execute(self, ctx: OpContext, samples, vae,
+                tile_size: int = 512, overlap: int = 64):
+        ctx.check_interrupt()
+        with Timer("vae_decode_tiled"):
+            img = jnp.clip(vae.vae_decode_tiled(
+                jnp.asarray(samples["samples"]), tile_size=int(tile_size),
+                overlap=int(overlap),
+                check_interrupt=ctx.check_interrupt), 0.0, 1.0)
+        meta = {k: samples[k] for k in ("local_batch", "fanout")
+                if k in samples}
+        return (ImageBatch(img, **meta),)
+
+
+@register_op
 class VAEEncode(Op):
     TYPE = "VAEEncode"
 

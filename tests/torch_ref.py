@@ -130,27 +130,39 @@ class BasicTransformerBlock(nn.Module):
 
 
 class SpatialTransformer(nn.Module):
-    """SD1.x conv form (proj_in/out are 1x1 convs)."""
+    """SD1.x conv form (proj_in/out 1x1 convs) or SD2.x/SDXL linear form
+    (``use_linear_in_transformer``)."""
 
-    def __init__(self, c: int, context_dim: int, heads: int, depth: int):
+    def __init__(self, c: int, context_dim: int, heads: int, depth: int,
+                 use_linear: bool = False):
         super().__init__()
+        self.use_linear = use_linear
         self.norm = norm_vae(c)          # attention.py Normalize: eps 1e-6
-        self.proj_in = nn.Conv2d(c, c, 1)
+        self.proj_in = nn.Linear(c, c) if use_linear else nn.Conv2d(c, c, 1)
         self.transformer_blocks = nn.ModuleList(
             [BasicTransformerBlock(c, context_dim, heads)
              for _ in range(depth)])
-        self.proj_out = nn.Conv2d(c, c, 1)
+        self.proj_out = nn.Linear(c, c) if use_linear else nn.Conv2d(c, c, 1)
 
     def forward(self, x, context):
         B, C, H, W = x.shape
         x_in = x
         h = self.norm(x)
-        h = self.proj_in(h)
-        h = h.reshape(B, C, H * W).permute(0, 2, 1)   # b, hw, c
+        if self.use_linear:
+            h = h.reshape(B, C, H * W).permute(0, 2, 1)
+            h = self.proj_in(h)
+        else:
+            h = self.proj_in(h)
+            h = h.reshape(B, C, H * W).permute(0, 2, 1)   # b, hw, c
         for blk in self.transformer_blocks:
             h = blk(h, context)
-        h = h.permute(0, 2, 1).reshape(B, C, H, W)
-        return x_in + self.proj_out(h)
+        if self.use_linear:
+            h = self.proj_out(h)
+            h = h.permute(0, 2, 1).reshape(B, C, H, W)
+        else:
+            h = h.permute(0, 2, 1).reshape(B, C, H, W)
+            h = self.proj_out(h)
+        return x_in + h
 
 
 class Downsample(nn.Module):
@@ -177,17 +189,27 @@ class TorchUNet(nn.Module):
     def __init__(self, model_channels=32, channel_mult=(1, 2),
                  num_res_blocks=1, transformer_depth=(1, 1),
                  context_dim=64, num_head_channels=16,
-                 in_channels=4, out_channels=4):
+                 in_channels=4, out_channels=4,
+                 adm_in_channels=None, use_linear=False):
         super().__init__()
         mc = model_channels
         time_dim = mc * 4
         self.time_embed = nn.Sequential(
             nn.Linear(mc, time_dim), nn.SiLU(),
             nn.Linear(time_dim, time_dim))
+        if adm_in_channels is not None:
+            # SDXL vector conditioning — keys label_emb.0.{0,2}
+            self.label_emb = nn.Sequential(nn.Sequential(
+                nn.Linear(adm_in_channels, time_dim), nn.SiLU(),
+                nn.Linear(time_dim, time_dim)))
         self.model_channels = mc
 
         def heads(c):
             return max(c // num_head_channels, 1)
+
+        def st(c, depth):
+            return SpatialTransformer(c, context_dim, heads(c), depth,
+                                      use_linear=use_linear)
 
         self.input_blocks = nn.ModuleList(
             [nn.Sequential(nn.Conv2d(in_channels, mc, 3, padding=1))])
@@ -198,17 +220,14 @@ class TorchUNet(nn.Module):
                 mods = [ResBlock(ch, out_ch, time_dim)]
                 ch = out_ch
                 if transformer_depth[level] > 0:
-                    mods.append(SpatialTransformer(
-                        ch, context_dim, heads(ch),
-                        transformer_depth[level]))
+                    mods.append(st(ch, transformer_depth[level]))
                 self.input_blocks.append(nn.Sequential(*mods))
             if level != len(channel_mult) - 1:
                 self.input_blocks.append(nn.Sequential(Downsample(ch)))
 
         self.middle_block = nn.Sequential(
             ResBlock(ch, ch, time_dim),
-            SpatialTransformer(ch, context_dim, heads(ch),
-                               max(transformer_depth[-1], 1)),
+            st(ch, max(transformer_depth[-1], 1)),
             ResBlock(ch, ch, time_dim))
 
         # skip channels per input block, for up-path concat widths
@@ -228,9 +247,7 @@ class TorchUNet(nn.Module):
                 mods = [ResBlock(ch + skip_chs.pop(), out_ch, time_dim)]
                 ch = out_ch
                 if transformer_depth[level] > 0:
-                    mods.append(SpatialTransformer(
-                        ch, context_dim, heads(ch),
-                        transformer_depth[level]))
+                    mods.append(st(ch, transformer_depth[level]))
                 if level != 0 and i == num_res_blocks:
                     mods.append(Upsample(ch))
                 self.output_blocks.append(nn.Sequential(*mods))
@@ -238,9 +255,11 @@ class TorchUNet(nn.Module):
         self.out = nn.Sequential(norm_unet(ch), nn.SiLU(),
                                  nn.Conv2d(ch, out_channels, 3, padding=1))
 
-    def forward(self, x, timesteps, context):
+    def forward(self, x, timesteps, context, y=None):
         emb = self.time_embed(timestep_embedding(timesteps,
                                                  self.model_channels))
+        if y is not None:
+            emb = emb + self.label_emb(y)
         hs = []
         h = x
         for block in self.input_blocks:
@@ -415,3 +434,65 @@ class TorchVAE(nn.Module):
     def decode(self, latents):
         dec = self.decoder(self.post_quant_conv(latents / self.sf))
         return ((dec + 1.0) / 2.0).clamp(0.0, 1.0)
+
+
+# --- ESRGAN / Real-ESRGAN RRDBNet (xinntao layout, realesrgan naming) -------
+
+class ResidualDenseBlock(nn.Module):
+    def __init__(self, feat: int, growth: int):
+        super().__init__()
+        for i in range(5):
+            cout = feat if i == 4 else growth
+            setattr(self, f"conv{i + 1}",
+                    nn.Conv2d(feat + i * growth, cout, 3, padding=1))
+
+    def forward(self, x):
+        feats = [x]
+        for i in range(4):
+            h = getattr(self, f"conv{i + 1}")(torch.cat(feats, dim=1))
+            feats.append(F.leaky_relu(h, 0.2))
+        out = self.conv5(torch.cat(feats, dim=1))
+        return x + out * 0.2
+
+
+class RRDB(nn.Module):
+    def __init__(self, feat: int, growth: int):
+        super().__init__()
+        self.rdb1 = ResidualDenseBlock(feat, growth)
+        self.rdb2 = ResidualDenseBlock(feat, growth)
+        self.rdb3 = ResidualDenseBlock(feat, growth)
+
+    def forward(self, x):
+        return x + self.rdb3(self.rdb2(self.rdb1(x))) * 0.2
+
+
+class TorchRRDBNet(nn.Module):
+    """Real-ESRGAN naming (conv_first/body/conv_body/conv_up*/conv_hr/
+    conv_last) — one of the three schemes the loader normalizes."""
+
+    def __init__(self, feat=16, num_blocks=2, growth=8, scale=2):
+        super().__init__()
+        self.scale = scale
+        self.conv_first = nn.Conv2d(3, feat, 3, padding=1)
+        self.body = nn.ModuleList(
+            [RRDB(feat, growth) for _ in range(num_blocks)])
+        self.conv_body = nn.Conv2d(feat, feat, 3, padding=1)
+        n_up = {1: 0, 2: 1, 4: 2, 8: 3}[scale]
+        for i in range(n_up):
+            setattr(self, f"conv_up{i + 1}",
+                    nn.Conv2d(feat, feat, 3, padding=1))
+        self.n_up = n_up
+        self.conv_hr = nn.Conv2d(feat, feat, 3, padding=1)
+        self.conv_last = nn.Conv2d(feat, 3, 3, padding=1)
+
+    def forward(self, x):
+        fea = self.conv_first(x)
+        h = fea
+        for blk in self.body:
+            h = blk(h)
+        h = fea + self.conv_body(h)
+        for i in range(self.n_up):
+            h = F.interpolate(h, scale_factor=2, mode="nearest")
+            h = F.leaky_relu(getattr(self, f"conv_up{i + 1}")(h), 0.2)
+        h = F.leaky_relu(self.conv_hr(h), 0.2)
+        return self.conv_last(h)
