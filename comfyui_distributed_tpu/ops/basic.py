@@ -186,13 +186,34 @@ class VAEDecodeTiled(Op):
 
 @register_op
 class VAEEncode(Op):
+    """Pixels -> latent.  In a distributed run the encoded batch expands to
+    ``batch * fanout`` exactly like ``EmptyLatentImage`` — the img2img
+    variation sweep (every participant denoises the SAME source latent with
+    its own seed offset; reference semantics: each worker runs the full
+    graph on its own copy of the staged input image)."""
     TYPE = "VAEEncode"
 
     def execute(self, ctx: OpContext, pixels, vae):
         img = jnp.asarray(as_image_array(pixels))
         with Timer("vae_encode"):
             lat = vae.vae_encode(img)
-        return ({"samples": lat},)
+        b = int(lat.shape[0])
+        in_fan = int(getattr(pixels, "fanout", 1) or 1)
+        if in_fan > 1:
+            # already-fanned pixels (hires-fix chain: KSampler -> VAEDecode
+            # -> ... -> VAEEncode): the batch holds one slice per replica
+            # — re-tiling would square the fan-out
+            local_b = int(getattr(pixels, "local_batch", None)
+                          or b // in_fan)
+            return ({"samples": lat, "local_batch": local_b,
+                     "fanout": in_fan},)
+        fanout = max(ctx.fanout, 1)
+        if fanout > 1:
+            # host-side tile (EmptyLatentImage convention): KSampler pulls
+            # the latent to host anyway, so duplicating on-device would add
+            # a fanout-times device->host transfer for identical bytes
+            lat = np.tile(np.asarray(lat), (fanout, 1, 1, 1))
+        return ({"samples": lat, "local_batch": b, "fanout": fanout},)
 
 
 class ImageBatch(np.ndarray):
@@ -254,6 +275,12 @@ class ImageScale(Op):
             arr = arr[:, y0:y0 + height, x0:x0 + width, :]
         else:
             arr = resize_image(arr, int(width), int(height), upscale_method)
+        if getattr(image, "fanout", 1) > 1:
+            # keep fan-out metadata through resizes (hires-fix chains):
+            # resize_image round-trips through jnp, stripping the subclass
+            arr = ImageBatch(arr, local_batch=getattr(image, "local_batch",
+                                                      None),
+                             fanout=image.fanout)
         return (arr,)
 
 

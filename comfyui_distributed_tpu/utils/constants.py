@@ -34,6 +34,15 @@ CHUNK_SIZE = 8192
 LOG_TAIL_BYTES = 65536
 
 # --- mesh defaults ----------------------------------------------------------
+# node types whose presence makes a graph "distributed" — the fan-out /
+# prune root set (reference findCollectorConnectedNodes, gpupanel.js:987).
+# Single source of truth for the executor (SPMD gating) and dispatcher
+# (worker pruning): the two must never disagree on what fans out.
+SEED_NODE_TYPES = ("DistributedSeed",)
+COLLECTOR_NODE_TYPES = ("DistributedCollector",)
+UPSCALER_NODE_TYPES = ("UltimateSDUpscaleDistributed",)
+DISTRIBUTED_NODE_TYPES = COLLECTOR_NODE_TYPES + UPSCALER_NODE_TYPES
+
 DATA_AXIS = "data"       # replica fan-out (reference: one worker process each)
 TENSOR_AXIS = "tensor"   # intra-op model parallelism (no reference analog)
 SEQ_AXIS = "seq"         # sequence/context parallelism (ring attention)

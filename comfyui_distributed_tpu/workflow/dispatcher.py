@@ -34,10 +34,10 @@ from comfyui_distributed_tpu.utils.logging import debug_log, log
 from comfyui_distributed_tpu.utils.net import get_client_session
 from comfyui_distributed_tpu.workflow.graph import Graph, Node
 
-SEED_TYPES = ("DistributedSeed",)
-COLLECTOR_TYPES = ("DistributedCollector",)
-UPSCALER_TYPES = ("UltimateSDUpscaleDistributed",)
-DISTRIBUTED_TYPES = COLLECTOR_TYPES + UPSCALER_TYPES
+SEED_TYPES = C.SEED_NODE_TYPES
+COLLECTOR_TYPES = C.COLLECTOR_NODE_TYPES
+UPSCALER_TYPES = C.UPSCALER_NODE_TYPES
+DISTRIBUTED_TYPES = C.DISTRIBUTED_NODE_TYPES
 
 
 def connected_component(graph: Graph, roots: List[str]) -> set:

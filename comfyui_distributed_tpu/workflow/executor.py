@@ -17,10 +17,11 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from comfyui_distributed_tpu.ops.base import Op, OpContext, get_op
+from comfyui_distributed_tpu.utils.constants import \
+    DISTRIBUTED_NODE_TYPES as DISTRIBUTED_TYPES
+from comfyui_distributed_tpu.workflow.dispatcher import connected_component
 from comfyui_distributed_tpu.workflow.graph import Graph, parse_workflow
 from comfyui_distributed_tpu.utils.logging import debug_log, log
-
-DISTRIBUTED_TYPES = ("DistributedCollector", "UltimateSDUpscaleDistributed")
 
 
 @dataclasses.dataclass
@@ -64,16 +65,25 @@ class WorkflowExecutor:
         # fresh per-run collection state (assign, don't clear — prior
         # ExecutionResults keep their own lists)
         self.ctx.saved_images = []
-        self.ctx.fanout = self._decide_fanout(graph)
-        if self.ctx.fanout > 1:
-            log(f"distributed run: fan-out x{self.ctx.fanout} over mesh "
-                f"data axis")
+        fanout = self._decide_fanout(graph)
+        fan_nodes = None
+        if fanout > 1:
+            # fan out ONLY the distributed connected component — the SPMD
+            # analog of the reference pruning workers to that component
+            # (gpupanel.js:1045-1071): a side branch with no distributed
+            # node runs once, not fanout times
+            fan_nodes = connected_component(
+                graph, graph.find_by_type(*DISTRIBUTED_TYPES))
+            log(f"distributed run: fan-out x{fanout} over mesh "
+                f"data axis ({len(fan_nodes)}/{len(graph.nodes)} nodes)")
 
         outputs: Dict[str, Tuple] = {}
         timings: Dict[str, float] = {}
         t_start = time.perf_counter()
 
         for nid in graph.topo_order():
+            self.ctx.fanout = fanout if (fan_nodes is None
+                                         or nid in fan_nodes) else 1
             node = graph.nodes[nid]
             op = get_op(node.class_type)
             kwargs: Dict[str, Any] = {}

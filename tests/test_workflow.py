@@ -150,6 +150,143 @@ class TestTxt2ImgE2E:
         assert res.total_s > 0
 
 
+IMG2IMG = "/root/repo/workflows/distributed-img2img.json"
+
+
+def _scaled_img2img(size=32, steps=2):
+    """The img2img variation-sweep fixture scaled for CPU tests."""
+    g = parse_workflow(IMG2IMG)
+    g.nodes["1"].inputs["image"] = "__missing__.png"    # synthetic test card
+    g.nodes["2"].inputs.update(width=size, height=size)
+    g.nodes["3"].inputs.update(steps=steps)
+    return g
+
+
+class TestImg2ImgE2E:
+    """BASELINE config 4: seed-offset fan-out over one VAE-encoded source
+    (every participant denoises the same latent with its own seed)."""
+
+    def test_variation_sweep_fans_out(self, ctx):
+        res = WorkflowExecutor(ctx).execute(_scaled_img2img())
+        assert len(res.images) == 8
+        imgs = np.stack(res.images)
+        assert imgs.shape == (8, 32, 32, 3)
+        # same source latent + distributed seed => variations, not copies
+        for i in range(1, 8):
+            assert not np.allclose(imgs[0], imgs[i]), \
+                f"variation {i} identical to master"
+
+    def test_plain_seed_gives_identical_variations(self, ctx):
+        g = _scaled_img2img()
+        g.nodes["3"].inputs["seed"] = 77  # break link, plain int
+        res = WorkflowExecutor(ctx).execute(g)
+        imgs = np.stack(res.images)
+        assert imgs.shape[0] == 8
+        for i in range(1, 8):
+            assert np.allclose(imgs[0], imgs[i], atol=1e-5)
+
+    def test_worker_mode_single_variation(self):
+        ctx = OpContext(runtime=mesh_mod.MeshRuntime(mesh=mesh_mod.build_mesh()),
+                        is_worker=True, worker_id="worker_1")
+        res = WorkflowExecutor(ctx).execute(_scaled_img2img())
+        assert len(res.images) == 1
+
+    def test_side_branch_not_fanned_out(self, ctx):
+        """A branch with no distributed node runs once even when the graph
+        has a distributed component elsewhere (reference parity: workers
+        are pruned to the connected component, gpupanel.js:1045-1071).
+        The side branch needs its OWN loader — sharing node 4 would merge
+        the components via the bidirectional walk, as in the reference."""
+        g = _scaled_img2img()
+        g2 = parse_workflow(json.dumps({
+            "20": {"class_type": "CheckpointLoaderSimple",
+                   "inputs": {"ckpt_name": "side.ckpt"}},
+            "21": {"class_type": "EmptyLatentImage",
+                   "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+            "22": {"class_type": "CLIPTextEncode",
+                   "inputs": {"text": "side", "clip": ["20", 1]}},
+            "23": {"class_type": "KSampler",
+                   "inputs": {"seed": 5, "steps": 1, "cfg": 1.0,
+                              "sampler_name": "euler", "scheduler": "normal",
+                              "denoise": 1.0, "model": ["20", 0],
+                              "positive": ["22", 0], "negative": ["22", 0],
+                              "latent_image": ["21", 0]}},
+            "24": {"class_type": "VAEDecode",
+                   "inputs": {"samples": ["23", 0], "vae": ["20", 2]}},
+            "25": {"class_type": "PreviewImage",
+                   "inputs": {"images": ["24", 0]}}}))
+        g.nodes.update(g2.nodes)
+        res = WorkflowExecutor(ctx).execute(g)
+        # 8 fanned variations + exactly 1 side-branch image
+        assert len(res.images) == 9
+
+    def test_hires_fix_chain_not_reexpanded(self, ctx):
+        """A mid-graph VAEEncode (hires-fix: sample -> decode -> upscale ->
+        re-encode -> refine) must NOT tile an already-fanned batch again:
+        8 variations stay 8, not 64."""
+        g = parse_workflow(json.dumps({
+            "4": {"class_type": "CheckpointLoaderSimple",
+                  "inputs": {"ckpt_name": "hires.ckpt"}},
+            "5": {"class_type": "EmptyLatentImage",
+                  "inputs": {"width": 16, "height": 16, "batch_size": 1}},
+            "6": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "x", "clip": ["4", 1]}},
+            "13": {"class_type": "DistributedSeed", "inputs": {"seed": 9}},
+            "3": {"class_type": "KSampler",
+                  "inputs": {"seed": ["13", 0], "steps": 1, "cfg": 1.0,
+                             "sampler_name": "euler", "scheduler": "normal",
+                             "denoise": 1.0, "model": ["4", 0],
+                             "positive": ["6", 0], "negative": ["6", 0],
+                             "latent_image": ["5", 0]}},
+            "8": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["3", 0], "vae": ["4", 2]}},
+            "10": {"class_type": "ImageScale",
+                   "inputs": {"image": ["8", 0], "upscale_method": "lanczos",
+                              "width": 32, "height": 32,
+                              "crop": "disabled"}},
+            "11": {"class_type": "VAEEncode",
+                   "inputs": {"pixels": ["10", 0], "vae": ["4", 2]}},
+            "12": {"class_type": "KSampler",
+                   "inputs": {"seed": ["13", 0], "steps": 1, "cfg": 1.0,
+                              "sampler_name": "euler", "scheduler": "normal",
+                              "denoise": 0.5, "model": ["4", 0],
+                              "positive": ["6", 0], "negative": ["6", 0],
+                              "latent_image": ["11", 0]}},
+            "15": {"class_type": "VAEDecode",
+                   "inputs": {"samples": ["12", 0], "vae": ["4", 2]}},
+            "14": {"class_type": "DistributedCollector",
+                   "inputs": {"images": ["15", 0]}},
+            "9": {"class_type": "PreviewImage",
+                  "inputs": {"images": ["14", 0]}}}))
+        res = WorkflowExecutor(ctx).execute(g)
+        imgs = np.stack(res.images)
+        assert imgs.shape == (8, 32, 32, 3), imgs.shape
+        # refined variations still differ per replica
+        assert not np.allclose(imgs[0], imgs[1])
+
+    def test_denoise_below_one_preserves_source_structure(self, ctx):
+        """img2img at low denoise stays closer to the source than a fresh
+        txt2img sample from the same seed would — the encoded latent must
+        actually be the starting point (add_noise on top of source)."""
+        g = _scaled_img2img()
+        g.nodes["3"].inputs["denoise"] = 0.1
+        res_low = WorkflowExecutor(ctx).execute(g)
+        g2 = _scaled_img2img()
+        g2.nodes["3"].inputs["denoise"] = 1.0
+        res_full = WorkflowExecutor(ctx).execute(g2)
+        # the source card is a smooth gradient; at denoise 0.1 the output
+        # must correlate with it far more than the fully-resampled one
+        from comfyui_distributed_tpu.ops.base import get_op
+        card = get_op("LoadImage").execute(OpContext(), "__missing__.png")[0]
+        card = get_op("ImageScale").execute(
+            OpContext(), card, "lanczos", 32, 32)[0][0]
+
+        def err(r):
+            return float(np.mean(np.abs(np.stack(r.images) - card[None])))
+
+        assert err(res_low) < err(res_full)
+
+
 def _scaled_upscale(tile=32, padding=8, blur=2, steps=1):
     g = parse_workflow(UPSCALE)
     g.nodes["12"].inputs["image"] = "__missing__.png"   # synthetic test card
