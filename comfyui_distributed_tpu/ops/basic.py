@@ -216,6 +216,17 @@ class VAEEncode(Op):
         return ({"samples": lat, "local_batch": b, "fanout": fanout},)
 
 
+def _keep_fanout_meta(src, arr):
+    """Re-attach fan-out metadata after an op that round-trips through jnp
+    (which strips the ImageBatch subclass).  Image-space ops in a hires-fix
+    chain must preserve it so a downstream VAEEncode doesn't re-tile an
+    already-fanned batch."""
+    if getattr(src, "fanout", 1) > 1:
+        return ImageBatch(arr, local_batch=getattr(src, "local_batch", None),
+                          fanout=src.fanout)
+    return arr
+
+
 class ImageBatch(np.ndarray):
     """IMAGE ndarray carrying fan-out metadata through image-space ops."""
 
@@ -275,13 +286,7 @@ class ImageScale(Op):
             arr = arr[:, y0:y0 + height, x0:x0 + width, :]
         else:
             arr = resize_image(arr, int(width), int(height), upscale_method)
-        if getattr(image, "fanout", 1) > 1:
-            # keep fan-out metadata through resizes (hires-fix chains):
-            # resize_image round-trips through jnp, stripping the subclass
-            arr = ImageBatch(arr, local_batch=getattr(image, "local_batch",
-                                                      None),
-                             fanout=image.fanout)
-        return (arr,)
+        return (_keep_fanout_meta(image, arr),)
 
 
 @register_op
@@ -302,7 +307,7 @@ class ImageUpscaleWithModel(Op):
         arr = as_image_array(image)
         with Timer(f"sr_upscale[x{scale}]"):
             out = net.apply({"params": params}, jnp.asarray(arr))
-        return (np.asarray(out),)
+        return (_keep_fanout_meta(image, np.asarray(out)),)
 
 
 @register_op

@@ -32,34 +32,13 @@ import aiohttp
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 from comfyui_distributed_tpu.utils.net import get_client_session
-from comfyui_distributed_tpu.workflow.graph import Graph, Node
+from comfyui_distributed_tpu.workflow.graph import (
+    Graph, Node, connected_component)
 
 SEED_TYPES = C.SEED_NODE_TYPES
 COLLECTOR_TYPES = C.COLLECTOR_NODE_TYPES
 UPSCALER_TYPES = C.UPSCALER_NODE_TYPES
 DISTRIBUTED_TYPES = C.DISTRIBUTED_NODE_TYPES
-
-
-def connected_component(graph: Graph, roots: List[str]) -> set:
-    """Bidirectional reachability from the root nodes (reference BFS over
-    links both directions, ``gpupanel.js:987-1037``)."""
-    # adjacency both ways
-    adj: Dict[str, set] = {nid: set() for nid in graph.nodes}
-    for nid, node in graph.nodes.items():
-        for src, _ in node.link_inputs().values():
-            src = str(src)
-            if src in adj:
-                adj[nid].add(src)
-                adj[src].add(nid)
-    seen = set()
-    frontier = [r for r in roots if r in adj]
-    while frontier:
-        cur = frontier.pop()
-        if cur in seen:
-            continue
-        seen.add(cur)
-        frontier.extend(adj[cur] - seen)
-    return seen
 
 
 def prune_for_worker(graph: Graph) -> Graph:

@@ -19,8 +19,8 @@ import numpy as np
 from comfyui_distributed_tpu.ops.base import Op, OpContext, get_op
 from comfyui_distributed_tpu.utils.constants import \
     DISTRIBUTED_NODE_TYPES as DISTRIBUTED_TYPES
-from comfyui_distributed_tpu.workflow.dispatcher import connected_component
-from comfyui_distributed_tpu.workflow.graph import Graph, parse_workflow
+from comfyui_distributed_tpu.workflow.graph import (
+    Graph, connected_component, parse_workflow)
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 
 

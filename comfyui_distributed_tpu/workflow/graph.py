@@ -91,6 +91,29 @@ class Graph:
         return order
 
 
+def connected_component(graph: Graph, roots: List[str]) -> set:
+    """Bidirectional reachability from the root nodes (reference BFS over
+    links both directions, ``gpupanel.js:987-1037``).  Used by the
+    dispatcher to prune worker graphs and by the executor to scope SPMD
+    fan-out to the distributed component."""
+    adj: Dict[str, set] = {nid: set() for nid in graph.nodes}
+    for nid, node in graph.nodes.items():
+        for src, _ in node.link_inputs().values():
+            src = str(src)
+            if src in adj:
+                adj[nid].add(src)
+                adj[src].add(nid)
+    seen = set()
+    frontier = [r for r in roots if r in adj]
+    while frontier:
+        cur = frontier.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        frontier.extend(adj[cur] - seen)
+    return seen
+
+
 def _widgets_to_inputs(class_type: str,
                        widgets_values: Optional[list]) -> Dict[str, Any]:
     cls = NODE_CLASS_MAPPINGS.get(class_type)

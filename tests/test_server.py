@@ -330,6 +330,42 @@ class TestPanel:
                 assert needle in text, needle
         run_with_client(body, tmp_path, start_exec_thread=False)
 
+    def test_panel_settings_form_contract(self, tmp_path):
+        """The panel's settings form (reference settings dialog analog)
+        drives exactly these routes with exactly these payload shapes —
+        exercise them the way the form does (no browser in CI)."""
+        async def body(client, state):
+            text = await (await client.get("/panel")).text()
+            for needle in ("config/update_worker", "config/delete_worker",
+                           "config/update_setting", "config/update_master",
+                           "saveWorker", "wf-port"):
+                assert needle in text, needle
+            # saveWorker(): upsert with explicit nulls for cleared fields
+            r = await client.post("/distributed/config/update_worker",
+                                  json={"id": "p1", "name": "p1",
+                                        "port": 18999, "host": None,
+                                        "extra_args": None})
+            assert r.status == 200
+            w = (await r.json())["worker"]
+            assert w["port"] == 18999 and "host" not in w
+            # settings checkbox + master host field
+            r = await client.post("/distributed/config/update_setting",
+                                  json={"key": "debug", "value": True})
+            assert r.status == 200
+            r = await client.post("/distributed/config/update_master",
+                                  json={"host": "10.0.0.9"})
+            assert r.status == 200
+            cfg = await (await client.get("/distributed/config")).json()
+            assert cfg["settings"]["debug"] is True
+            assert cfg["master"]["host"] == "10.0.0.9"
+            # delete button path
+            r = await client.post("/distributed/config/delete_worker",
+                                  json={"id": "p1"})
+            assert r.status == 200
+            cfg = await (await client.get("/distributed/config")).json()
+            assert all(x["id"] != "p1" for x in cfg["workers"])
+        run_with_client(body, tmp_path, start_exec_thread=False)
+
 
 class TestLifecycleRoutes:
     def test_launch_unknown_worker_404(self, tmp_path):

@@ -240,8 +240,14 @@ class TestImg2ImgE2E:
                              "latent_image": ["5", 0]}},
             "8": {"class_type": "VAEDecode",
                   "inputs": {"samples": ["3", 0], "vae": ["4", 2]}},
+            "16": {"class_type": "UpscaleModelLoader",
+                   "inputs": {"model_name": "2x_hires.pth"}},
+            "17": {"class_type": "ImageUpscaleWithModel",
+                   "inputs": {"upscale_model": ["16", 0],
+                              "image": ["8", 0]}},
             "10": {"class_type": "ImageScale",
-                   "inputs": {"image": ["8", 0], "upscale_method": "lanczos",
+                   "inputs": {"image": ["17", 0],
+                              "upscale_method": "lanczos",
                               "width": 32, "height": 32,
                               "crop": "disabled"}},
             "11": {"class_type": "VAEEncode",
