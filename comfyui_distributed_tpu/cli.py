@@ -18,7 +18,16 @@ import os
 import sys
 
 
+def _maybe_init_multihost() -> None:
+    """Join a jax.distributed cluster when DTPU_COORDINATOR is set (no-op
+    otherwise).  Must run before anything probes devices: after init,
+    jax.devices() is the GLOBAL pod view and collectives ride ICI/DCN."""
+    from comfyui_distributed_tpu.parallel.mesh import initialize_multihost
+    initialize_multihost()
+
+
 def cmd_serve(args) -> int:
+    _maybe_init_multihost()
     from comfyui_distributed_tpu.server.app import ServerState, serve
     state = ServerState(config_path=args.config, is_worker=False,
                         models_dir=args.models_dir)
@@ -29,6 +38,7 @@ def cmd_serve(args) -> int:
 
 
 def cmd_worker(args) -> int:
+    _maybe_init_multihost()
     from comfyui_distributed_tpu.server.app import ServerState, serve
     state = ServerState(config_path=args.config, is_worker=True,
                         models_dir=args.models_dir)
@@ -39,6 +49,7 @@ def cmd_worker(args) -> int:
 def cmd_run(args) -> int:
     if args.via:
         return _run_via_server(args)
+    _maybe_init_multihost()
     from comfyui_distributed_tpu.ops.base import OpContext
     from comfyui_distributed_tpu.parallel.mesh import get_runtime
     from comfyui_distributed_tpu.workflow import WorkflowExecutor
@@ -98,6 +109,7 @@ def _run_via_server(args) -> int:
 
 
 def cmd_devices(args) -> int:
+    _maybe_init_multihost()  # topology must be the global pod view
     from comfyui_distributed_tpu.parallel.mesh import describe_devices
     print(json.dumps(describe_devices(), indent=2))
     return 0

@@ -78,6 +78,13 @@ class WorkerProcessManager:
         try:
             env = dict(os.environ)
             env[MASTER_PID_ENV] = str(os.getpid())
+            # never inherit the master's pod-cluster identity: a managed
+            # HTTP worker is its own single-process jax world, and a
+            # duplicate jax.distributed.initialize with the master's
+            # process_id would error/block inside the child's CLI boot
+            for k in ("DTPU_COORDINATOR", "DTPU_NUM_PROCESSES",
+                      "DTPU_PROCESS_ID"):
+                env.pop(k, None)
             cmd = self.build_launch_command(worker)
             if stop_on_master_exit:
                 # wrap with the master-death monitor (reference

@@ -32,8 +32,7 @@ import aiohttp
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 from comfyui_distributed_tpu.utils.net import get_client_session
-from comfyui_distributed_tpu.workflow.graph import (
-    Graph, Node, connected_component)
+from comfyui_distributed_tpu.workflow.graph import Graph, connected_component
 
 SEED_TYPES = C.SEED_NODE_TYPES
 COLLECTOR_TYPES = C.COLLECTOR_NODE_TYPES

@@ -193,6 +193,40 @@ def test_interceptor_orchestrates_automatically(servers):
     assert done["images"] == 2  # master's + worker's, gathered over HTTP
 
 
+@pytest.mark.integration
+def test_jax_distributed_two_process_collectives(tmp_path):
+    """The DCN-analog comm backend (SURVEY §2.4 'TPU-native equivalent'):
+    two REAL processes join one jax.distributed cluster through the
+    framework's initialize_multihost/build_mesh entry points (the path
+    cli.py takes on a pod), then run cross-process psum + all_gather over
+    the mesh data axis.  CPU devices + gRPC/Gloo stand in for chips + DCN."""
+    port = find_free_port()
+    env_base = {**os.environ,
+                "PYTHONPATH": "/root/repo",
+                "DTPU_COORDINATOR": f"127.0.0.1:{port}",
+                "DTPU_NUM_PROCESSES": "2"}
+    procs = []
+    for pid in range(2):
+        env = {**env_base, "DTPU_PROCESS_ID": str(pid)}
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(os.path.dirname(__file__),
+                                          "jd_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(tmp_path)))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} rc={p.returncode}:\n{out[-2000:]}"
+        assert "JD_OK" in out, f"proc {i}:\n{out[-2000:]}"
+
+
 def _scaled_upscale_graph():
     """The reference's distributed-upscale fixture scaled for CPU CI, with
     the terminal preview swapped for SaveImage so the master persists the
