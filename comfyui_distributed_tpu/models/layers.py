@@ -101,12 +101,18 @@ def scaled_dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         from comfyui_distributed_tpu.ops.pallas.flash_attention import (
             flash_attention)
         return flash_attention(q, k, v)
-    scale = 1.0 / math.sqrt(q.shape[-1])
+    return xla_attention(q, k, v, 1.0 / math.sqrt(q.shape[-1]))
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  scale: float) -> jax.Array:
+    """The reference attention math (XLA-fused einsum -> fp32 softmax ->
+    einsum).  The single copy both the default impl and the flash kernel's
+    over-VMEM fallback use — duplicates would drift."""
     logits = jnp.einsum("bnhd,bmhd->bhnm", q, k,
                         preferred_element_type=jnp.float32) * scale
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    out = jnp.einsum("bhnm,bmhd->bnhd", weights.astype(v.dtype), v)
-    return out
+    return jnp.einsum("bhnm,bmhd->bnhd", weights.astype(v.dtype), v)
 
 
 def _maybe_ring_attention(q: jax.Array, k: jax.Array,

@@ -30,6 +30,13 @@ NEG_INF = -1e30
 BLOCK_Q = 128
 BLOCK_K = 512
 
+# Per-program VMEM budget (bytes).  Each program holds its q tile, the
+# FULL padded K/V for its head, the output tile and fp32 accumulators;
+# v5e TensorCore VMEM is ~16 MiB, and exceeding it is a compile-time
+# failure on hardware that interpret-mode tests can't see.  Shapes over
+# budget fall back to the XLA path instead of crashing the serving run.
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float,
                   kv_len: int, block_k: int):
@@ -104,6 +111,22 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kf = _pad_to(_pad_to(kf, 1, block_k), 2, 128)
     vf = _pad_to(_pad_to(vf, 1, block_k), 2, 128)
     n_pad, dp = qf.shape[1], qf.shape[2]
+
+    # static VMEM estimate for one program: q/out tiles + full K/V +
+    # fp32 logits/accumulator tiles (shapes are trace-time constants, so
+    # this branch is resolved at trace time — no control flow under jit)
+    itemsize = jnp.dtype(q.dtype).itemsize
+    vmem = (2 * BLOCK_Q * dp * itemsize            # q tile + out tile
+            + 2 * kf.shape[1] * dp * itemsize      # full K + V
+            + BLOCK_Q * block_k * 4                # logits tile (fp32)
+            + BLOCK_Q * dp * 4)                    # accumulator (fp32)
+    if vmem > VMEM_BUDGET_BYTES:
+        from comfyui_distributed_tpu.models.layers import xla_attention
+        from comfyui_distributed_tpu.utils.logging import debug_log
+        debug_log(f"flash_attention: est. {vmem/2**20:.1f} MiB/program "
+                  f"VMEM > {VMEM_BUDGET_BYTES/2**20:.0f} MiB budget "
+                  f"(kv_len {kf.shape[1]}) — using XLA fallback")
+        return xla_attention(q, k, v, scale)
 
     grid = (B * H, n_pad // BLOCK_Q)
     kernel = functools.partial(_flash_kernel, scale=scale, kv_len=M,

@@ -179,3 +179,22 @@ class TestFlashAttention:
         out_x = scaled_dot_product_attention(q, k, v, impl="xla")
         np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x),
                                    rtol=2e-4, atol=2e-4)
+
+    def test_vmem_guard_falls_back_correctly(self, rng, monkeypatch):
+        """Shapes whose full K/V exceed the per-program VMEM budget must
+        take the XLA fallback (numerically identical) rather than hand
+        pallas_call a program that can't compile on hardware."""
+        import importlib
+        fa = importlib.import_module(
+            "comfyui_distributed_tpu.ops.pallas.flash_attention")
+        # shrink the budget so a modest shape trips the guard
+        monkeypatch.setattr(fa, "VMEM_BUDGET_BYTES", 64 * 1024)
+        called = []
+        monkeypatch.setattr(fa.pl, "pallas_call",
+                            lambda *a, **k: called.append(1) or fa.pl.pallas_call)
+        q, k, v = _qkv(rng, B=1, N=256, H=2, D=16)
+        out = fa.flash_attention(q, k, v, interpret=True)
+        assert not called, "guard did not divert away from pallas_call"
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
