@@ -9,6 +9,7 @@ standard single-file SD checkpoint layout (safetensors or torch pickle):
 - ``model.diffusion_model.*``            <-> :class:`..models.unet.UNet`
 - ``first_stage_model.*``                <-> :class:`..models.vae.VAE`
 - ``cond_stage_model.transformer.*``     <-> CLIP-L (SD1.x, HF layout)
+- ``cond_stage_model.model.*``           <-> OpenCLIP ViT-H (SD2.x)
 - ``conditioner.embedders.0.transformer.*`` <-> CLIP-L (SDXL)
 - ``conditioner.embedders.1.model.*``    <-> OpenCLIP bigG (SDXL)
 
@@ -456,14 +457,22 @@ def _run_openclip(m, cfg: CLIPConfig):
 UNET_PREFIX = "model.diffusion_model."
 VAE_PREFIX = "first_stage_model."
 CLIP_PREFIX_SD15 = "cond_stage_model.transformer.text_model."
+# SD2.x: FrozenOpenCLIPEmbedder stores the OpenCLIP text tower directly
+CLIP_PREFIX_SD2 = "cond_stage_model.model."
 CLIP_PREFIXES_SDXL = ("conditioner.embedders.0.transformer.text_model.",
                       "conditioner.embedders.1.model.")
 
 
 def _clip_prefixes(family) -> List[str]:
     if len(family.clips) == 1:
-        return [CLIP_PREFIX_SD15]
+        layout = getattr(family.clips[0], "layout", "hf")
+        return [CLIP_PREFIX_SD2 if layout == "openclip" else CLIP_PREFIX_SD15]
     return list(CLIP_PREFIXES_SDXL)
+
+
+def _clip_runner(ccfg):
+    return _run_openclip if getattr(ccfg, "layout", "hf") == "openclip" \
+        else _run_clip_hf
 
 
 def convert_state_dict(sd: Dict[str, np.ndarray], family,
@@ -473,9 +482,8 @@ def convert_state_dict(sd: Dict[str, np.ndarray], family,
     vae = _run_vae(_LoadMapper(sd, VAE_PREFIX, consumed), family.vae)
     clips: List[Params] = []
     for ccfg, prefix in zip(family.clips, _clip_prefixes(family)):
-        run = _run_clip_hf if "transformer.text_model" in prefix \
-            else _run_openclip
-        clips.append(run(_LoadMapper(sd, prefix, consumed), ccfg))
+        clips.append(_clip_runner(ccfg)(_LoadMapper(sd, prefix, consumed),
+                                        ccfg))
     return unet, clips, vae
 
 
@@ -493,6 +501,10 @@ EXPECTED_NONPARAM_KEYS = (
     "conditioner.embedders.0.transformer.text_model.embeddings.position_ids",
     "conditioner.embedders.1.model.logit_scale",
     "cond_stage_model.logit_scale",
+    # SD2.x OpenCLIP tower buffers (FrozenOpenCLIPEmbedder keeps the
+    # causal mask and logit scale in the state dict)
+    "cond_stage_model.model.attn_mask",
+    "cond_stage_model.model.logit_scale",
 )
 
 
@@ -531,9 +543,7 @@ def export_state_dict(unet: Params, clips: List[Params], vae: Params,
     sd.update(_run_unet(_ExportMapper(unet, UNET_PREFIX), family.unet))
     sd.update(_run_vae(_ExportMapper(vae, VAE_PREFIX), family.vae))
     for ccfg, tree, prefix in zip(family.clips, clips, _clip_prefixes(family)):
-        run = _run_clip_hf if "transformer.text_model" in prefix \
-            else _run_openclip
-        sd.update(run(_ExportMapper(tree, prefix), ccfg))
+        sd.update(_clip_runner(ccfg)(_ExportMapper(tree, prefix), ccfg))
     return sd
 
 

@@ -27,6 +27,10 @@ class CLIPConfig:
     # which hidden layer feeds cross-attention: -1 final, -2 penultimate
     output_layer: int = -1
     projection_dim: Optional[int] = None  # pooled-output projection (bigG)
+    # checkpoint layout this tower serializes as: "hf" (CLIPTextModel,
+    # q/k/v split) or "openclip" (resblocks, packed in_proj) — drives the
+    # converter's key walk (checkpoints.py)
+    layout: str = "hf"
     dtype: Any = jnp.bfloat16
 
 
@@ -35,7 +39,12 @@ CLIP_L_CONFIG = CLIPConfig()
 CLIP_L_SDXL_CONFIG = dataclasses.replace(CLIP_L_CONFIG, output_layer=-2)
 OPEN_CLIP_BIGG_CONFIG = CLIPConfig(width=1280, layers=32, heads=20,
                                    act="gelu", output_layer=-2,
-                                   projection_dim=1280)
+                                   projection_dim=1280, layout="openclip")
+# SD2.x text tower: OpenCLIP ViT-H, penultimate layer (FrozenOpenCLIP
+# Embedder layer="penultimate"); text_projection ships in the checkpoint
+OPEN_CLIP_H_CONFIG = CLIPConfig(width=1024, layers=24, heads=16,
+                                act="gelu", output_layer=-2,
+                                projection_dim=1024, layout="openclip")
 TINY_CLIP_CONFIG = CLIPConfig(vocab_size=4096, width=64, layers=2, heads=4,
                               max_length=77, dtype=jnp.float32)
 

@@ -61,6 +61,18 @@ FAMILIES: Dict[str, ModelFamily] = {
         vae=vae_mod.SDXL_VAE_CONFIG,
         clips=(clip_mod.CLIP_L_SDXL_CONFIG, clip_mod.OPEN_CLIP_BIGG_CONFIG),
     ),
+    "sd21": ModelFamily(
+        name="sd21",
+        unet=unet_mod.SD21_CONFIG,          # v-prediction (768-v line)
+        vae=vae_mod.SD_VAE_CONFIG,
+        clips=(clip_mod.OPEN_CLIP_H_CONFIG,),
+    ),
+    "sd21_base": ModelFamily(
+        name="sd21_base",
+        unet=unet_mod.SD21_BASE_CONFIG,     # eps (512-base line)
+        vae=vae_mod.SD_VAE_CONFIG,
+        clips=(clip_mod.OPEN_CLIP_H_CONFIG,),
+    ),
     "tiny": ModelFamily(
         name="tiny",
         unet=unet_mod.TINY_CONFIG,
@@ -83,6 +95,14 @@ def detect_family(ckpt_name: str) -> str:
         return "tiny"
     if "xl" in lowered:
         return "sdxl"
+    # Stability SD2 naming only — a bare "v2" would misroute SD1.5
+    # community finetunes like anything-v2 / counterfeit-v2.5
+    if ("sd2" in lowered or "v2-0" in lowered or "v2-1" in lowered
+            or "768-v" in lowered or "512-base" in lowered):
+        # v2-1_768-ema-pruned is the v-pred line; v2-1_512-ema-pruned /
+        # 512-base-ema the eps line
+        return "sd21" if ("768" in lowered or "v-pred" in lowered
+                          or "vpred" in lowered) else "sd21_base"
     return "sd15"
 
 
@@ -109,9 +129,13 @@ class DiffusionPipeline:
         self.schedule = sch.make_discrete_schedule()
         # real CLIP BPE when vocab.json/merges.txt sit in the models dir
         # (zero-egress asset drop); deterministic hash tokenizer otherwise
+        # pad convention follows the text tower: CLIP (SD1.x/SDXL) pads
+        # with EOT, OpenCLIP (SD2.x) pads with 0 — ComfyUI's sd2 tokenizer
         self.tokenizer = make_tokenizer(
             assets_dir=assets_dir,
-            vocab_size=min(c.vocab_size for c in family.clips))
+            vocab_size=min(c.vocab_size for c in family.clips),
+            pad_with_end=not all(c.layout == "openclip"
+                                 for c in family.clips))
         # LRU-bounded: every (resolution, batch, sampler...) combination is
         # its own compiled executable; an unbounded dict leaks one per shape
         # seen.  16 live entries cover a realistic session (clip×2, vae×2,
@@ -389,6 +413,7 @@ def load_pipeline(ckpt_name: str, models_dir: Optional[str] = None,
             f"deterministic init (seed {seed})")
 
     pipe = DiffusionPipeline(ckpt_name, fam, unet_p, clip_ps, vae_p,
+                             prediction_type=fam.unet.prediction_type,
                              assets_dir=models_dir)
     with _pipeline_lock:
         _pipeline_cache[key] = pipe

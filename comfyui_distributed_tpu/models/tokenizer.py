@@ -181,11 +181,15 @@ class BPETokenizer:
 
 def make_tokenizer(assets_dir: Optional[str] = None,
                    vocab_size: int = 49408,
-                   max_length: int = 77):
-    """BPE if assets exist, hash fallback otherwise."""
+                   max_length: int = 77,
+                   pad_with_end: bool = True):
+    """BPE if assets exist, hash fallback otherwise.  ``pad_with_end``:
+    SD1.x/SDXL CLIP pads with EOT; SD2.x OpenCLIP pads with 0."""
     if assets_dir:
         vocab = os.path.join(assets_dir, "vocab.json")
         merges = os.path.join(assets_dir, "merges.txt")
         if os.path.exists(vocab) and os.path.exists(merges):
-            return BPETokenizer(vocab, merges, max_length=max_length)
-    return HashTokenizer(vocab_size=vocab_size, max_length=max_length)
+            return BPETokenizer(vocab, merges, max_length=max_length,
+                                pad_with_end=pad_with_end)
+    return HashTokenizer(vocab_size=vocab_size, max_length=max_length,
+                         pad_with_end=pad_with_end)

@@ -68,6 +68,16 @@ SDXL_CONFIG = UNetConfig(
     use_linear_in_transformer=True,
 )
 
+# SD2.1: SD1.x topology with per-level head_channels=64 (not fixed 8
+# heads), OpenCLIP-H context (1024), linear transformer projections;
+# the 768-v checkpoint line is v-prediction, the 512-base line is eps
+SD21_CONFIG = UNetConfig(
+    context_dim=1024,
+    use_linear_in_transformer=True,
+    prediction_type="v",
+)
+SD21_BASE_CONFIG = dataclasses.replace(SD21_CONFIG, prediction_type="eps")
+
 TINY_CONFIG = UNetConfig(
     model_channels=32,
     channel_mult=(1, 2),

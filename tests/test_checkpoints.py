@@ -53,7 +53,8 @@ TINY_XL_FAMILY = reg.ModelFamily(
     unet=dataclasses.replace(unet_mod.TINY_CONFIG, adm_in_channels=32),
     vae=vae_mod.TINY_VAE_CONFIG,
     clips=(clip_mod.TINY_CLIP_CONFIG,
-           dataclasses.replace(clip_mod.TINY_CLIP_CONFIG, projection_dim=48)),
+           dataclasses.replace(clip_mod.TINY_CLIP_CONFIG, projection_dim=48,
+                               layout="openclip")),  # like the real bigG cfg
 )
 
 

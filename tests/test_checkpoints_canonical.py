@@ -33,12 +33,22 @@ from comfyui_distributed_tpu.models import vae as vae_mod
 # --- independent canonical SD1.5 inventory (torch LDM layout) ---------------
 
 def sd15_unet_inventory():
+    return _ldm_unet_inventory(ctx=768, linear_proj=False)
+
+
+def sd21_unet_inventory():
+    # SD2.1: same topology, OpenCLIP-H context, nn.Linear transformer
+    # projections (use_linear_in_transformer)
+    return _ldm_unet_inventory(ctx=1024, linear_proj=True)
+
+
+def _ldm_unet_inventory(ctx, linear_proj):
     keys = {}
 
     def p(name, *shape):
         keys["model.diffusion_model." + name] = tuple(shape)
 
-    mc, ctx = 320, 768
+    mc = 320
     emb = 4 * mc
     p("time_embed.0.weight", emb, mc); p("time_embed.0.bias", emb)
     p("time_embed.2.weight", emb, emb); p("time_embed.2.bias", emb)
@@ -61,7 +71,10 @@ def sd15_unet_inventory():
 
     def attn(prefix, c, depth=1):
         p(f"{prefix}.norm.weight", c); p(f"{prefix}.norm.bias", c)
-        p(f"{prefix}.proj_in.weight", c, c, 1, 1)   # SD1.x: 1x1 conv form
+        if linear_proj:                              # SD2.x/SDXL: nn.Linear
+            p(f"{prefix}.proj_in.weight", c, c)
+        else:
+            p(f"{prefix}.proj_in.weight", c, c, 1, 1)  # SD1.x: 1x1 conv
         p(f"{prefix}.proj_in.bias", c)
         for j in range(depth):
             b = f"{prefix}.transformer_blocks.{j}"
@@ -77,7 +90,10 @@ def sd15_unet_inventory():
             p(f"{b}.ff.net.2.bias", c)
             for n in ("norm1", "norm2", "norm3"):
                 p(f"{b}.{n}.weight", c); p(f"{b}.{n}.bias", c)
-        p(f"{prefix}.proj_out.weight", c, c, 1, 1)
+        if linear_proj:
+            p(f"{prefix}.proj_out.weight", c, c)
+        else:
+            p(f"{prefix}.proj_out.weight", c, c, 1, 1)
         p(f"{prefix}.proj_out.bias", c)
 
     mult = (1, 2, 4, 4)
@@ -210,6 +226,33 @@ def sd15_clip_inventory():
     return keys
 
 
+def sd21_clip_inventory():
+    """OpenCLIP ViT-H text tower, FrozenOpenCLIPEmbedder serialization
+    (``cond_stage_model.model.*``, packed in_proj, raw text_projection)."""
+    keys = {}
+    pre = "cond_stage_model.model."
+
+    def p(name, *shape):
+        keys[pre + name] = tuple(shape)
+
+    W, L, V, N = 1024, 24, 49408, 77
+    p("token_embedding.weight", V, W)
+    p("positional_embedding", N, W)
+    for i in range(L):
+        b = f"transformer.resblocks.{i}"
+        p(f"{b}.ln_1.weight", W); p(f"{b}.ln_1.bias", W)
+        p(f"{b}.attn.in_proj_weight", 3 * W, W)
+        p(f"{b}.attn.in_proj_bias", 3 * W)
+        p(f"{b}.attn.out_proj.weight", W, W)
+        p(f"{b}.attn.out_proj.bias", W)
+        p(f"{b}.ln_2.weight", W); p(f"{b}.ln_2.bias", W)
+        p(f"{b}.mlp.c_fc.weight", 4 * W, W); p(f"{b}.mlp.c_fc.bias", 4 * W)
+        p(f"{b}.mlp.c_proj.weight", W, 4 * W); p(f"{b}.mlp.c_proj.bias", W)
+    p("ln_final.weight", W); p("ln_final.bias", W)
+    p("text_projection", W, W)
+    return keys
+
+
 def sd15_nonparam_buffers():
     """Non-parameter tensors real SD1.5 checkpoints carry."""
     sd = {f"{n}": np.zeros((1000,), np.float32) for n in (
@@ -233,6 +276,21 @@ def canonical_sd15():
     return inv, sd
 
 
+def canonical_sd21():
+    inv = {**sd21_unet_inventory(), **sd15_vae_inventory(),
+           **sd21_clip_inventory()}
+    sd = {k: np.zeros(s, np.float32) for k, s in inv.items()}
+    buffers = sd15_nonparam_buffers()
+    # SD2.x carries the OpenCLIP tower's buffers instead of HF position_ids
+    del buffers["cond_stage_model.transformer.text_model"
+                ".embeddings.position_ids"]
+    buffers["cond_stage_model.model.attn_mask"] = np.zeros((77, 77),
+                                                           np.float32)
+    buffers["cond_stage_model.model.logit_scale"] = np.zeros((), np.float32)
+    sd.update(buffers)
+    return inv, sd
+
+
 # --- full-size flax trees as zeros (eval_shape: trace only, no compile) -----
 
 def _zeros_params(module, *shaped_args):
@@ -241,16 +299,20 @@ def _zeros_params(module, *shaped_args):
         lambda s: np.zeros(s.shape, np.float32), shapes)["params"]
 
 
-def _sd15_trees():
-    fam = reg.FAMILIES["sd15"]
+def _family_trees(name):
+    fam = reg.FAMILIES[name]
     unet_p = _zeros_params(unet_mod.UNet(fam.unet),
                            jnp.zeros((1, 8, 8, 4)), jnp.zeros((1,)),
-                           jnp.zeros((1, 77, 768)))
+                           jnp.zeros((1, 77, fam.unet.context_dim)))
     clip_p = _zeros_params(clip_mod.CLIPTextModel(fam.clips[0]),
                            jnp.zeros((1, 77), jnp.int32))
     vae_p = _zeros_params(vae_mod.VAE(fam.vae),
                           jnp.zeros((1, 64, 64, 3)))
     return fam, unet_p, clip_p, vae_p
+
+
+def _sd15_trees():
+    return _family_trees("sd15")
 
 
 def _tree_keys(tree):
@@ -278,6 +340,36 @@ def test_load_canonical_full_coverage():
     side (includes the schedule buffers + position_ids real files carry)."""
     fam, unet_p, clip_p, vae_p = _sd15_trees()
     _, sd = canonical_sd15()
+    leftover = ckpt.unconsumed_keys(sd, fam)
+    assert leftover == [], \
+        f"{len(leftover)} unconsumed param keys, first: {leftover[:8]}"
+    u2, (c2,), v2 = ckpt.convert_state_dict(sd, fam)
+    assert _tree_keys(u2) == _tree_keys(unet_p)
+    assert _tree_keys(c2) == _tree_keys(clip_p)
+    assert _tree_keys(v2) == _tree_keys(vae_p)
+
+
+def test_sd21_export_matches_canonical_inventory_exactly():
+    """SD2.1 (v2-1_768 layout): linear transformer projections, OpenCLIP
+    ViT-H tower at ``cond_stage_model.model.`` — export side."""
+    fam, unet_p, clip_p, vae_p = _family_trees("sd21")
+    inv, _ = canonical_sd21()
+    sd = ckpt.export_state_dict(unet_p, [clip_p], vae_p, fam)
+    missing = sorted(set(inv) - set(sd))
+    unexpected = sorted(set(sd) - set(inv))
+    assert not missing, f"{len(missing)} missing, first: {missing[:8]}"
+    assert not unexpected, \
+        f"{len(unexpected)} unexpected, first: {unexpected[:8]}"
+    bad = [(k, sd[k].shape, inv[k]) for k in inv
+           if tuple(sd[k].shape) != inv[k]]
+    assert not bad, f"{len(bad)} shape mismatches, first: {bad[:5]}"
+
+
+def test_sd21_load_canonical_full_coverage():
+    """SD2.1 load side: zero unconsumed keys (incl. the OpenCLIP tower's
+    attn_mask/logit_scale buffers), trees fully populated."""
+    fam, unet_p, clip_p, vae_p = _family_trees("sd21")
+    _, sd = canonical_sd21()
     leftover = ckpt.unconsumed_keys(sd, fam)
     assert leftover == [], \
         f"{len(leftover)} unconsumed param keys, first: {leftover[:8]}"

@@ -214,3 +214,87 @@ class TestUpscaler:
         assert scale == 2
         out = net.apply({"params": params}, jnp.zeros((1, 4, 4, 3)))
         assert out.shape == (1, 8, 8, 3)
+
+
+class TestSD21Family:
+    def test_detect_family_stability_names(self):
+        cases = {
+            "v2-1_768-ema-pruned.safetensors": "sd21",
+            "v2-1_512-ema-pruned.ckpt": "sd21_base",
+            "512-base-ema.ckpt": "sd21_base",  # official SD2.0-base name
+            "sd2_vpred_custom.safetensors": "sd21",
+            "v1-5-pruned-emaonly.safetensors": "sd15",
+            "sd_xl_base_1.0.safetensors": "sdxl",
+            # SD1.5-architecture community finetunes with v2 in the NAME
+            # must not be misrouted to the sd21 converter
+            "anything-v2.ckpt": "sd15",
+            "counterfeit-v2.5.safetensors": "sd15",
+        }
+        env = os.environ.pop(registry.FAMILY_ENV, None)
+        try:
+            for name, fam in cases.items():
+                assert registry.detect_family(name) == fam, name
+        finally:
+            if env is not None:
+                os.environ[registry.FAMILY_ENV] = env
+
+    def test_sd21_configs(self):
+        fam = registry.FAMILIES["sd21"]
+        assert fam.unet.prediction_type == "v"
+        assert fam.unet.context_dim == 1024
+        assert fam.unet.use_linear_in_transformer
+        assert fam.clips[0].layout == "openclip"
+        assert fam.clips[0].output_layer == -2
+        assert registry.FAMILIES["sd21_base"].unet.prediction_type == "eps"
+
+    def test_openclip_family_pads_with_zero(self):
+        """SD2.x pad convention: OpenCLIP towers pad with 0 after EOT;
+        CLIP towers (SD1.x/SDXL) pad with EOT — ComfyUI tokenizer parity."""
+        import dataclasses as dc
+        fam_oc = dc.replace(
+            registry.FAMILIES["tiny"], name="tiny_oc",
+            clips=(dc.replace(TINY_CLIP_CONFIG, layout="openclip"),))
+        pipe_oc = registry.DiffusionPipeline("toc", fam_oc, {}, [{}], {})
+        ids, _ = pipe_oc.tokenizer.encode("hello")
+        assert ids[-1] == 0
+        pipe_clip = registry.DiffusionPipeline(
+            "tcl", registry.FAMILIES["tiny"], {}, [{}], {})
+        ids2, _ = pipe_clip.tokenizer.encode("hello")
+        assert ids2[-1] == pipe_clip.tokenizer.end
+
+    def test_v_prediction_pipeline_samples(self):
+        """End-to-end sample through a v-prediction pipeline at tiny scale:
+        the family's prediction_type must reach the denoiser (finite,
+        deterministic output differing from the eps pipeline's)."""
+        import dataclasses as dc
+        fam_v = dc.replace(
+            registry.FAMILIES["tiny"], name="tiny_v",
+            unet=dc.replace(TINY_CONFIG, prediction_type="v"))
+        seed = 7
+        rng = jax.random.PRNGKey(seed)
+        x = jnp.zeros((1, 8, 8, 4))
+        ts = jnp.zeros((1,))
+        ctx = jnp.zeros((1, 77, TINY_CONFIG.context_dim))
+        unet_p = jax.jit(UNet(fam_v.unet).init)(rng, x, ts, ctx)["params"]
+        clip_p = CLIPTextModel(fam_v.clips[0]).init(
+            rng, jnp.zeros((1, 77), jnp.int32))["params"]
+        vae_p = VAE(fam_v.vae).init(rng, jnp.zeros((1, 16, 16, 3)))["params"]
+
+        def build(fam):
+            return registry.DiffusionPipeline(
+                "vtest", fam, unet_p, [clip_p], vae_p,
+                prediction_type=fam.unet.prediction_type)
+
+        pipe_v = build(fam_v)
+        ctx_b, _ = pipe_v.encode_prompt(["x"])
+        seeds = np.asarray([3], np.uint64)
+        out_v = pipe_v.sample(x, ctx_b, ctx_b, seeds, steps=2, cfg=1.0,
+                              sampler_name="euler", scheduler="normal")
+        assert np.isfinite(np.asarray(out_v)).all()
+
+        pipe_e = build(registry.FAMILIES["tiny"])
+        out_e = pipe_e.sample(x, ctx_b, ctx_b, seeds, steps=2, cfg=1.0,
+                              sampler_name="euler", scheduler="normal")
+        assert not np.allclose(np.asarray(out_v), np.asarray(out_e)), \
+            "v-pred pipeline produced identical output to eps — the " \
+            "prediction_type never reached the denoiser"

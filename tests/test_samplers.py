@@ -211,3 +211,45 @@ class TestCFG:
                                    jnp.zeros((2, 2, 4)), cfg_scale=1.0)
         out = wrapped(jnp.zeros((2, 4, 4, 1)), jnp.asarray(1.0))
         assert np.allclose(np.asarray(out), 2.0)  # context not doubled
+
+
+class TestDenoiserPredictionTypes:
+    """make_denoiser conventions: a model predicting the TRUE quantity
+    (eps or v, VP parameterization) must denoise exactly back to x0."""
+
+    def _setup(self, ds, sigma_val):
+        rng = np.random.default_rng(11)
+        x0 = jnp.asarray(rng.standard_normal((2, 4, 4, 3)), jnp.float32)
+        noise = jnp.asarray(rng.standard_normal((2, 4, 4, 3)), jnp.float32)
+        sigma = jnp.float32(sigma_val)
+        x = x0 + sigma * noise
+        return x0, noise, sigma, x
+
+    @pytest.mark.parametrize("sigma_val", [0.5, 2.0, 7.0])
+    def test_eps_prediction_recovers_x0(self, ds, sigma_val):
+        from comfyui_distributed_tpu.models.denoiser import make_denoiser
+        x0, noise, sigma, x = self._setup(ds, sigma_val)
+
+        def apply_fn(params, xin, ts, ctx, y=None):
+            return noise                     # the true eps
+
+        den = make_denoiser(apply_fn, {}, ds, prediction_type="eps")
+        np.testing.assert_allclose(np.asarray(den(x, sigma)),
+                                   np.asarray(x0), rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("sigma_val", [0.5, 2.0, 7.0])
+    def test_v_prediction_recovers_x0(self, ds, sigma_val):
+        """VP v-target: v = alpha*eps - sigma_vp*x0 with
+        alpha = 1/sqrt(sigma^2+1), sigma_vp = sigma*alpha (the SD2.x
+        768-v parameterization)."""
+        from comfyui_distributed_tpu.models.denoiser import make_denoiser
+        x0, noise, sigma, x = self._setup(ds, sigma_val)
+        alpha = 1.0 / jnp.sqrt(sigma ** 2 + 1.0)
+        v_true = alpha * noise - (sigma * alpha) * x0
+
+        def apply_fn(params, xin, ts, ctx, y=None):
+            return v_true                    # the true v
+
+        den = make_denoiser(apply_fn, {}, ds, prediction_type="v")
+        np.testing.assert_allclose(np.asarray(den(x, sigma)),
+                                   np.asarray(x0), rtol=1e-4, atol=1e-4)

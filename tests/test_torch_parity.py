@@ -109,6 +109,53 @@ def test_clip_skip_matches_transformers_penultimate():
     np.testing.assert_allclose(np.asarray(hidden), ref, rtol=2e-4, atol=2e-4)
 
 
+def test_openclip_text_tower_matches_torch_reference():
+    """flax CLIPTextModel with layout='openclip' == the open_clip-style
+    torch tower (packed in_proj split, raw positional_embedding /
+    text_projection, exact gelu, penultimate + shared ln_final), through
+    the real SD2.x key mapping (``cond_stage_model.model.*``).  Covers the
+    tower geometry SD2.1 (ViT-H) and SDXL's bigG serialize."""
+    from tests.torch_ref import TorchOpenClipText
+
+    cfg = dataclasses.replace(clip_mod.TINY_CLIP_CONFIG,
+                              vocab_size=512, dtype=jnp.float32,
+                              act="gelu", output_layer=-2,
+                              projection_dim=64, layout="openclip")
+    torch.manual_seed(2)
+    tref = TorchOpenClipText(vocab=cfg.vocab_size, width=cfg.width,
+                             layers=cfg.layers, heads=cfg.heads,
+                             proj=cfg.projection_dim).eval()
+    sd = {"cond_stage_model.model." + k: v.detach().numpy()
+          for k, v in tref.state_dict().items()}
+    params = ckpt._run_openclip(
+        ckpt._LoadMapper(sd, ckpt.CLIP_PREFIX_SD2), cfg)
+
+    rng = np.random.default_rng(3)
+    B = 2
+    ids = rng.integers(1, cfg.vocab_size - 2,
+                       (B, cfg.max_length)).astype(np.int64)
+    ids[:, 0] = cfg.vocab_size - 2
+    ids[:, 9] = cfg.vocab_size - 1            # EOT = argmax position
+    ids[:, 10:] = 0
+
+    with torch.no_grad():
+        hid = tref(torch.from_numpy(ids))
+        # SD2 "penultimate": ln_final applied to hidden[-2]; pooled from
+        # ln_final(hidden[-1]) at the EOT position, through text_projection
+        ref_hidden = tref.ln_final(hid[-2]).numpy()
+        final = tref.ln_final(hid[-1])
+        eot = torch.from_numpy(ids).argmax(dim=-1)
+        ref_pooled = (final[torch.arange(B), eot]
+                      @ tref.text_projection).numpy()
+
+    fm = clip_mod.CLIPTextModel(cfg)
+    hidden, pooled = fm.apply({"params": params},
+                              jnp.asarray(ids, jnp.int32))
+    tol = dict(rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(hidden), ref_hidden, **tol)
+    np.testing.assert_allclose(np.asarray(pooled), ref_pooled, **tol)
+
+
 # --- UNet / VAE vs hand-written canonical-layout torch references ----------
 
 @pytest.mark.parametrize("variant", ["sd15", "sdxl"])

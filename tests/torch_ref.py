@@ -496,3 +496,63 @@ class TorchRRDBNet(nn.Module):
             h = F.leaky_relu(getattr(self, f"conv_up{i + 1}")(h), 0.2)
         h = F.leaky_relu(self.conv_hr(h), 0.2)
         return self.conv_last(h)
+
+
+class _OpenClipBlock(nn.Module):
+    def __init__(self, width, heads):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(width)
+        # nn.MultiheadAttention serializes exactly the open_clip layout:
+        # packed in_proj_weight/in_proj_bias + out_proj
+        self.attn = nn.MultiheadAttention(width, heads, batch_first=True)
+        self.ln_2 = nn.LayerNorm(width)
+        from collections import OrderedDict
+        self.mlp = nn.Sequential(OrderedDict([
+            ("c_fc", nn.Linear(width, width * 4)),
+            ("gelu", nn.GELU()),                    # exact erf form
+            ("c_proj", nn.Linear(width * 4, width)),
+        ]))
+
+    def forward(self, x, attn_mask):
+        h = self.ln_1(x)
+        a, _ = self.attn(h, h, h, need_weights=False, attn_mask=attn_mask)
+        x = x + a
+        return x + self.mlp(self.ln_2(x))
+
+
+class _OpenClipTransformer(nn.Module):
+    def __init__(self, width, layers, heads):
+        super().__init__()
+        self.resblocks = nn.ModuleList(
+            [_OpenClipBlock(width, heads) for _ in range(layers)])
+
+
+class TorchOpenClipText(nn.Module):
+    """open_clip text tower in FrozenOpenCLIPEmbedder serialization
+    (SD2.x ``cond_stage_model.model.*``, SDXL's bigG embedder): resblocks
+    with packed q/k/v, raw ``positional_embedding``/``text_projection``
+    parameters, causal ``attn_mask`` buffer."""
+
+    def __init__(self, vocab, width, layers, heads, ctx_len=77, proj=None):
+        super().__init__()
+        self.token_embedding = nn.Embedding(vocab, width)
+        self.positional_embedding = nn.Parameter(
+            torch.empty(ctx_len, width).normal_(std=0.01))
+        self.transformer = _OpenClipTransformer(width, layers, heads)
+        self.ln_final = nn.LayerNorm(width)
+        self.text_projection = nn.Parameter(
+            torch.empty(width, proj or width).normal_(std=0.02))
+        self.register_buffer(
+            "attn_mask",
+            torch.full((ctx_len, ctx_len), float("-inf")).triu_(1))
+
+    def forward(self, ids):
+        """Returns the per-layer hidden states (pre-ln_final)."""
+        x = self.token_embedding(ids) \
+            + self.positional_embedding[:ids.shape[1]]
+        m = self.attn_mask[:ids.shape[1], :ids.shape[1]]
+        hidden = []
+        for blk in self.transformer.resblocks:
+            x = blk(x, m)
+            hidden.append(x)
+        return hidden
