@@ -477,9 +477,11 @@ def _clip_runner(ccfg):
 
 def convert_state_dict(sd: Dict[str, np.ndarray], family,
                        consumed: Optional[set] = None,
-                       ) -> Tuple[Params, List[Params], Params]:
+                       include_vae: bool = True,
+                       ) -> Tuple[Params, List[Params], Optional[Params]]:
     unet = _run_unet(_LoadMapper(sd, UNET_PREFIX, consumed), family.unet)
-    vae = _run_vae(_LoadMapper(sd, VAE_PREFIX, consumed), family.vae)
+    vae = _run_vae(_LoadMapper(sd, VAE_PREFIX, consumed), family.vae) \
+        if include_vae else None
     clips: List[Params] = []
     for ccfg, prefix in zip(family.clips, _clip_prefixes(family)):
         clips.append(_clip_runner(ccfg)(_LoadMapper(sd, prefix, consumed),
@@ -536,12 +538,16 @@ def load_checkpoint(path: str, family) -> Tuple[Params, List[Params], Params]:
 
 
 def export_state_dict(unet: Params, clips: List[Params], vae: Params,
-                      family) -> Dict[str, np.ndarray]:
+                      family, include_vae: bool = True
+                      ) -> Dict[str, np.ndarray]:
     """flax param trees -> torch-layout state dict (interop back to the
-    reference's ecosystem: a checkpoint exported here loads in ComfyUI)."""
+    reference's ecosystem: a checkpoint exported here loads in ComfyUI).
+    ``include_vae=False`` skips the VAE walk (LoRA patching never touches
+    it — no point copying it through torch layout)."""
     sd: Dict[str, np.ndarray] = {}
     sd.update(_run_unet(_ExportMapper(unet, UNET_PREFIX), family.unet))
-    sd.update(_run_vae(_ExportMapper(vae, VAE_PREFIX), family.vae))
+    if include_vae:
+        sd.update(_run_vae(_ExportMapper(vae, VAE_PREFIX), family.vae))
     for ccfg, tree, prefix in zip(family.clips, clips, _clip_prefixes(family)):
         sd.update(_clip_runner(ccfg)(_ExportMapper(tree, prefix), ccfg))
     return sd

@@ -1,0 +1,272 @@
+"""LoRA patching: kohya-format low-rank adapters onto the flax model zoo.
+
+The reference delegates LoRA to ComfyUI's ``LoraLoader`` node (the single
+most common model-patching node in workflows the reference fans out);
+here the equivalent applies ``lora_up @ lora_down`` deltas to the UNet
+and text-encoder weights.
+
+Key resolution uses the same trick ComfyUI's loader uses: kohya module
+names are the base checkpoint's torch module paths with dots flattened
+to underscores (``lora_unet_input_blocks_1_1_transformer_blocks_0_attn1
+_to_q`` <- ``model.diffusion_model.input_blocks.1.1...to_q.weight``), so
+instead of parsing the underscored names (ambiguous — segment names
+contain underscores) we enumerate the torch keys our own exporter
+produces and index them flattened.  Application happens in torch layout
+(export -> add deltas -> convert back), so every layout transform the
+converter knows (conv OIHW, transposed linears, packed qkv) is reused
+rather than re-implemented.
+
+Text-encoder prefixes: ``lora_te_`` (single-tower families),
+``lora_te1_``/``lora_te2_`` (SDXL's CLIP-L + bigG).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from comfyui_distributed_tpu.models import checkpoints as ckpt
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+
+UNET_LORA_PREFIX = "lora_unet_"
+
+
+def _te_prefixes(n_clips: int) -> List[str]:
+    if n_clips == 1:
+        return ["lora_te_"]
+    return [f"lora_te{i + 1}_" for i in range(n_clips)]
+
+
+def build_key_index(sd: Dict[str, np.ndarray], family
+                    ) -> Dict[str, Tuple[str, Optional[slice]]]:
+    """kohya module name -> (torch weight key, row slice or None),
+    generated from the exported state dict's own keys (never by parsing
+    underscored names — those are ambiguous).
+
+    For OpenCLIP-layout towers (SD2.x, SDXL's te2) kohya trains against
+    the HF-converted tower, so the HF module names are ALSO indexed as
+    aliases: ``..._self_attn_q_proj`` maps onto the packed
+    ``attn.in_proj_weight`` rows [0:W] (k: [W:2W], v: [2W:3W]),
+    ``mlp_fc1/fc2`` onto ``mlp.c_fc/c_proj``."""
+    index: Dict[str, Tuple[str, Optional[slice]]] = {}
+    te_pre = _te_prefixes(len(family.clips))
+    clip_prefixes = ckpt._clip_prefixes(family)
+    for key in sd:
+        if key.endswith(".in_proj_weight"):
+            # packed qkv: "...attn.in_proj_weight" — underscore, not dot
+            module = key[: -len("_weight")]
+        elif key.endswith(".weight"):
+            module = key[: -len(".weight")]
+        else:
+            continue
+        if key.startswith(ckpt.UNET_PREFIX):
+            flat = module[len(ckpt.UNET_PREFIX):].replace(".", "_")
+            index[UNET_LORA_PREFIX + flat] = (key, None)
+            continue
+        for pre, lora_pre in zip(clip_prefixes, te_pre):
+            if not key.startswith(pre.rsplit("text_model.", 1)[0]):
+                continue
+            if pre.endswith("text_model."):
+                # HF tower: kohya names start at "text_model." — the part
+                # after "cond_stage_model.transformer."
+                root = pre[: -len("text_model.")]
+                flat = module[len(root):].replace(".", "_")
+                index[lora_pre + flat] = (key, None)
+            elif module.startswith(pre):
+                _index_openclip_aliases(index, lora_pre, pre, module, key,
+                                        family)
+            break
+    return index
+
+
+def _index_openclip_aliases(index, lora_pre: str, prefix: str, module: str,
+                            key: str, family) -> None:
+    """HF-converted kohya names for an OpenCLIP-serialized tower."""
+    width = next(c.width for c, p in zip(family.clips,
+                                         ckpt._clip_prefixes(family))
+                 if p == prefix)
+    rel = module[len(prefix):]                     # e.g. transformer.resblocks.0.attn.in_proj
+    parts = rel.split(".")
+    if len(parts) >= 4 and parts[0] == "transformer" \
+            and parts[1] == "resblocks":
+        i = parts[2]
+        tail = ".".join(parts[3:])
+        hf_base = f"text_model_encoder_layers_{i}_"
+        if tail == "attn.in_proj":
+            for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+                index[f"{lora_pre}{hf_base}self_attn_{name}"] = \
+                    (key, slice(j * width, (j + 1) * width))
+        elif tail == "attn.out_proj":
+            index[f"{lora_pre}{hf_base}self_attn_out_proj"] = (key, None)
+        elif tail == "mlp.c_fc":
+            index[f"{lora_pre}{hf_base}mlp_fc1"] = (key, None)
+        elif tail == "mlp.c_proj":
+            index[f"{lora_pre}{hf_base}mlp_fc2"] = (key, None)
+    # the native openclip spelling stays available too (some tools emit it)
+    index[lora_pre + rel.replace(".", "_")] = (key, None)
+
+
+def load_lora_state_dict(path: str) -> Dict[str, np.ndarray]:
+    return ckpt.load_state_dict(path)
+
+
+def virtual_lora_state_dict(name: str, index: Dict[str, str],
+                            sd: Dict[str, np.ndarray],
+                            rank: int = 4,
+                            max_modules: int = 8) -> Dict[str, np.ndarray]:
+    """Deterministic synthetic LoRA (zero-egress parity with virtual
+    checkpoints): small rank, a few attention modules, seeded from the
+    file name so every host materializes identical adapters."""
+    from comfyui_distributed_tpu.models.registry import _name_seed
+    rng = np.random.default_rng(_name_seed(name))
+    out: Dict[str, np.ndarray] = {}
+    picked = [m for m in sorted(index)
+              if m.endswith(("to_q", "to_k", "to_v", "q_proj", "k_proj",
+                             "v_proj"))][:max_modules]
+    for mod in picked:
+        key, rows = index[mod]
+        w = sd[key]
+        if w.ndim < 2:
+            continue
+        out_f = (rows.stop - rows.start) if rows is not None else w.shape[0]
+        in_f = int(np.prod(w.shape[1:]))
+        out[f"{mod}.lora_down.weight"] = rng.standard_normal(
+            (rank, in_f)).astype(np.float32) * 0.01
+        out[f"{mod}.lora_up.weight"] = rng.standard_normal(
+            (out_f, rank)).astype(np.float32) * 0.01
+        out[f"{mod}.alpha"] = np.full((), rank, np.float32)
+    return out
+
+
+def _delta(up: np.ndarray, down: np.ndarray,
+           target_shape: Tuple[int, ...]) -> np.ndarray:
+    """lora_up @ lora_down in torch layout, reshaped to the base weight.
+
+    Linear: up [out, r] @ down [r, in].  Conv: up [out, r, 1, 1], down
+    [r, in, kh, kw] (or both 1x1) — flatten ranks, matmul, reshape."""
+    u = up.reshape(up.shape[0], -1)
+    d = down.reshape(down.shape[0], -1)
+    return (u @ d).reshape(target_shape)
+
+
+def apply_lora_to_state_dict(sd: Dict[str, np.ndarray],
+                             lora_sd: Dict[str, np.ndarray],
+                             index: Dict[str, str],
+                             strength_model: float,
+                             strength_clip: float) -> Tuple[int, List[str]]:
+    """Add scaled deltas into ``sd`` in place.  Returns (n_applied,
+    unmatched kohya module names)."""
+    modules = sorted({k.split(".")[0] for k in lora_sd
+                      if ".lora_down." in k or ".lora_up." in k})
+    applied, unmatched = 0, []
+    for mod in modules:
+        entry = index.get(mod)
+        if entry is None:
+            unmatched.append(mod)
+            continue
+        key, rows = entry
+        strength = strength_model if mod.startswith(UNET_LORA_PREFIX) \
+            else strength_clip
+        if strength == 0.0:
+            continue
+        down = lora_sd.get(f"{mod}.lora_down.weight")
+        up = lora_sd.get(f"{mod}.lora_up.weight")
+        if down is None or up is None:
+            unmatched.append(mod)
+            continue
+        rank = down.shape[0]
+        alpha = float(lora_sd.get(f"{mod}.alpha", rank))
+        w = sd[key].copy()
+        target = w[rows] if rows is not None else w
+        target = target + (strength * alpha / rank) * _delta(
+            np.asarray(up, np.float32), np.asarray(down, np.float32),
+            target.shape).astype(w.dtype)
+        if rows is not None:
+            w[rows] = target        # packed-qkv row block (HF alias)
+            sd[key] = w
+        else:
+            sd[key] = target
+        applied += 1
+    return applied, unmatched
+
+
+# Patched pipelines cached by (base, lora, strengths): re-running the same
+# graph must reuse the SAME pipeline object, or every run would recompile
+# its jit caches from scratch.  LRU-bounded — each entry is a full copy of
+# UNet+CLIP weights, so a strength-tuning sweep would otherwise leak one
+# model per value (same leak class registry's _jit_cache documents).
+_lora_cache: "collections.OrderedDict[Tuple, Any]" = collections.OrderedDict()
+_lora_cache_cap = int(os.environ.get("DTPU_LORA_CACHE_CAP", "4"))
+_lora_lock = threading.Lock()
+
+
+def clear_lora_cache() -> None:
+    with _lora_lock:
+        _lora_cache.clear()
+
+
+def apply_lora_to_pipeline(pipe, lora_name: str,
+                           strength_model: float, strength_clip: float,
+                           models_dir: Optional[str] = None):
+    """Return a NEW pipeline with the named LoRA merged into UNet/CLIP
+    weights (the base pipeline and its jit caches stay untouched; merged
+    weights mean zero per-step overhead — the deltas ride the same
+    compiled executables).
+
+    Missing files virtually initialize (deterministic from the name),
+    mirroring virtual checkpoints."""
+    cache_key = (pipe.name, lora_name, float(strength_model),
+                 float(strength_clip), models_dir or "")
+    with _lora_lock:
+        if cache_key in _lora_cache:
+            _lora_cache.move_to_end(cache_key)
+            return _lora_cache[cache_key]
+
+    fam = pipe.family
+    # VAE excluded end-to-end: LoRA never touches it and the base params
+    # are shared by reference into the patched pipeline
+    sd = ckpt.export_state_dict(pipe.unet_params, pipe.clip_params,
+                                None, fam, include_vae=False)
+    index = build_key_index(sd, fam)
+
+    path = None
+    if models_dir:
+        cand = os.path.join(models_dir, lora_name.replace("\\", "/"))
+        if os.path.exists(cand):
+            path = cand
+    if path is not None:
+        lora_sd = load_lora_state_dict(path)
+        log(f"LoRA {lora_name!r}: {len(lora_sd)} tensors from {path}")
+    else:
+        lora_sd = virtual_lora_state_dict(lora_name, index, sd)
+        log(f"virtual LoRA {lora_name!r}: no file on disk, deterministic "
+            f"init ({len(lora_sd)} tensors)")
+
+    applied, unmatched = apply_lora_to_state_dict(
+        sd, lora_sd, index, strength_model, strength_clip)
+    if unmatched:
+        log(f"LoRA {lora_name!r}: {len(unmatched)} modules matched no "
+            f"weight (first: {unmatched[:3]})")
+    debug_log(f"LoRA {lora_name!r}: applied {applied} modules "
+              f"(model={strength_model}, clip={strength_clip})")
+
+    unet_p, clip_ps, _ = ckpt.convert_state_dict(sd, fam, include_vae=False)
+    if strength_clip == 0.0:
+        clip_ps = pipe.clip_params      # untouched: share, don't copy
+    from comfyui_distributed_tpu.models.registry import DiffusionPipeline
+    patched = DiffusionPipeline(
+        f"{pipe.name}+{lora_name}", fam, unet_p, clip_ps,
+        pipe.vae_params,                # LoRA never touches the VAE
+        prediction_type=pipe.prediction_type,
+        assets_dir=getattr(pipe, "assets_dir", None))
+    with _lora_lock:
+        _lora_cache[cache_key] = patched
+        while len(_lora_cache) > _lora_cache_cap:
+            old, _ = _lora_cache.popitem(last=False)
+            debug_log(f"lora cache: evicting {old!r} "
+                      f"(cap {_lora_cache_cap})")
+    return patched

@@ -126,6 +126,7 @@ class DiffusionPipeline:
         self.clip_params = clip_params
         self.vae_params = vae_params
         self.prediction_type = prediction_type
+        self.assets_dir = assets_dir
         self.schedule = sch.make_discrete_schedule()
         # real CLIP BPE when vocab.json/merges.txt sit in the models dir
         # (zero-egress asset drop); deterministic hash tokenizer otherwise
@@ -425,6 +426,8 @@ def clear_pipeline_cache() -> None:
     the reference's VRAM-clear endpoint, ``distributed.py:383-426``)."""
     with _pipeline_lock:
         _pipeline_cache.clear()
+    from comfyui_distributed_tpu.models import lora as lora_mod
+    lora_mod.clear_lora_cache()
 
 
 # --- upscalers --------------------------------------------------------------

@@ -43,6 +43,38 @@ class CheckpointLoaderSimple(Op):
 
 
 @register_op
+class LoraLoader(Op):
+    """ComfyUI's LoraLoader: merge a kohya-format LoRA into the UNet and
+    text-encoder weights at the given strengths.  Returns a patched
+    (MODEL, CLIP) pair; the base pipeline stays untouched and patched
+    pipelines are cached so repeat runs reuse compiled executables."""
+    TYPE = "LoraLoader"
+    WIDGETS = ["lora_name", "strength_model", "strength_clip"]
+    DEFAULTS = {"strength_model": 1.0, "strength_clip": 1.0}
+
+    def execute(self, ctx: OpContext, model, clip, lora_name: str,
+                strength_model: float = 1.0, strength_clip: float = 1.0):
+        from comfyui_distributed_tpu.models.lora import apply_lora_to_pipeline
+        sm, sc = float(strength_model), float(strength_clip)
+        name = str(lora_name)
+        if sm == 0.0 and sc == 0.0:
+            return (model, clip)
+        if model is clip:
+            patched = apply_lora_to_pipeline(model, name, sm, sc,
+                                             models_dir=ctx.models_dir)
+            return (patched, patched)
+        # MODEL and CLIP wired from different checkpoints: patch each
+        # independently, like ComfyUI's loader
+        m2 = apply_lora_to_pipeline(model, name, sm, 0.0,
+                                    models_dir=ctx.models_dir) \
+            if sm != 0.0 else model
+        c2 = apply_lora_to_pipeline(clip, name, 0.0, sc,
+                                    models_dir=ctx.models_dir) \
+            if sc != 0.0 else clip
+        return (m2, c2)
+
+
+@register_op
 class CLIPTextEncode(Op):
     TYPE = "CLIPTextEncode"
     WIDGETS = ["text"]

@@ -1,0 +1,240 @@
+"""LoRA patching: kohya key resolution, delta math, op + cache behavior."""
+
+import dataclasses
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import checkpoints as ckpt
+from comfyui_distributed_tpu.models import lora as lora_mod
+from comfyui_distributed_tpu.models import registry as reg
+from comfyui_distributed_tpu.ops.base import OpContext, get_op
+
+
+@pytest.fixture(autouse=True)
+def tiny_family(monkeypatch):
+    monkeypatch.setenv(reg.FAMILY_ENV, "tiny")
+    yield
+    lora_mod.clear_lora_cache()
+
+
+@pytest.fixture
+def pipe():
+    return reg.load_pipeline("lora-base.ckpt")
+
+
+def _init_params(fam):
+    """Virtual-init (unet, clips, vae) param trees for a family."""
+    import jax
+    from comfyui_distributed_tpu.models import clip as clip_mod
+    from comfyui_distributed_tpu.models import unet as unet_mod
+    from comfyui_distributed_tpu.models import vae as vae_mod
+    rng = jax.random.PRNGKey(0)
+    u = unet_mod.UNet(fam.unet).init(
+        rng, jnp.zeros((1, 8, 8, 4)), jnp.zeros((1,)),
+        jnp.zeros((1, 77, fam.unet.context_dim)))["params"]
+    cs = [clip_mod.CLIPTextModel(c).init(
+        rng, jnp.zeros((1, 77), jnp.int32))["params"] for c in fam.clips]
+    v = vae_mod.VAE(fam.vae).init(rng, jnp.zeros((1, 16, 16, 3)))["params"]
+    return u, cs, v
+
+
+def _export(pipe):
+    return ckpt.export_state_dict(pipe.unet_params, pipe.clip_params,
+                                  pipe.vae_params, pipe.family)
+
+
+def _make_kohya_lora(sd, index, modules, rank=2, seed=0):
+    """Synthetic kohya-format LoRA for the given module names."""
+    rng = np.random.default_rng(seed)
+    out = {}
+    for mod in modules:
+        key, rows = index[mod]
+        w = sd[key]
+        out_f = (rows.stop - rows.start) if rows is not None else w.shape[0]
+        out[f"{mod}.lora_down.weight"] = rng.standard_normal(
+            (rank, int(np.prod(w.shape[1:])))).astype(np.float32)
+        out[f"{mod}.lora_up.weight"] = rng.standard_normal(
+            (out_f, rank)).astype(np.float32)
+        # 0-d array like real kohya files (a bare scalar breaks save_file)
+        out[f"{mod}.alpha"] = np.full((), rank / 2, np.float32)
+    return out
+
+
+class TestKeyIndex:
+    def test_unet_and_te_modules_indexed(self, pipe):
+        sd = _export(pipe)
+        index = lora_mod.build_key_index(sd, pipe.family)
+        unet_mods = [m for m in index if m.startswith("lora_unet_")]
+        te_mods = [m for m in index if m.startswith("lora_te_")]
+        assert unet_mods and te_mods
+        # kohya names are flattened torch paths; spot-check both towers
+        assert any("attn1_to_q" in m for m in unet_mods)
+        assert any("self_attn_q_proj" in m for m in te_mods)
+        for mod, (key, rows) in index.items():
+            assert key in sd
+
+    def test_openclip_tower_gets_hf_aliases_with_row_slices(self):
+        """Real kohya SD2/SDXL-te2 LoRAs use HF-converted names; for an
+        OpenCLIP-serialized tower those alias onto the packed in_proj's
+        q/k/v row blocks."""
+        import dataclasses as dc
+        from comfyui_distributed_tpu.models.clip import TINY_CLIP_CONFIG
+        fam = dc.replace(
+            reg.FAMILIES["tiny"], name="tiny_oc",
+            clips=(dc.replace(TINY_CLIP_CONFIG, layout="openclip"),))
+        p = reg.DiffusionPipeline("oc", fam,
+                                  *_init_params(fam))
+        sd = ckpt.export_state_dict(p.unet_params, p.clip_params,
+                                    p.vae_params, fam)
+        index = lora_mod.build_key_index(sd, fam)
+        W = fam.clips[0].width
+        for j, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+            mod = f"lora_te_text_model_encoder_layers_0_self_attn_{proj}"
+            assert mod in index, mod
+            key, rows = index[mod]
+            assert key.endswith("attn.in_proj_weight")
+            assert (rows.start, rows.stop) == (j * W, (j + 1) * W)
+        # slice application touches ONLY that row block
+        mod = "lora_te_text_model_encoder_layers_0_self_attn_k_proj"
+        lora_sd = _make_kohya_lora(sd, index, [mod])
+        key, rows = index[mod]
+        base = sd[key].copy()
+        n, unmatched = lora_mod.apply_lora_to_state_dict(
+            sd, lora_sd, index, 1.0, 1.0)
+        assert n == 1 and unmatched == []
+        assert not np.allclose(sd[key][rows], base[rows])
+        np.testing.assert_array_equal(sd[key][:W], base[:W])        # q rows
+        np.testing.assert_array_equal(sd[key][2 * W:], base[2 * W:])  # v
+
+    def test_sdxl_style_two_towers_use_te1_te2(self):
+        from tests.test_checkpoints import TINY_XL_FAMILY, _init_family
+        unet_p, clip_ps, vae_p = _init_family(TINY_XL_FAMILY)
+        sd = ckpt.export_state_dict(unet_p, clip_ps, vae_p, TINY_XL_FAMILY)
+        index = lora_mod.build_key_index(sd, TINY_XL_FAMILY)
+        assert any(m.startswith("lora_te1_") for m in index)
+        assert any(m.startswith("lora_te2_") for m in index)
+        assert not any(m.startswith("lora_te_") for m in index)
+
+
+class TestDeltaMath:
+    def test_applied_delta_matches_manual(self, pipe):
+        """patched == base + strength * alpha/rank * up@down, exactly, in
+        torch layout — through export -> apply -> re-export."""
+        sd = _export(pipe)
+        index = lora_mod.build_key_index(sd, pipe.family)
+        mod = next(m for m in sorted(index) if m.endswith("attn1_to_q"))
+        lora_sd = _make_kohya_lora(sd, index, [mod])
+        key, _rows = index[mod]
+        base = sd[key].copy()
+
+        patched = lora_mod.apply_lora_to_pipeline(
+            pipe, "unit.safetensors", 0.7, 0.7)
+        # write the synthetic lora into the cache-bypassing low-level API
+        sd2 = _export(pipe)
+        n, unmatched = lora_mod.apply_lora_to_state_dict(
+            sd2, lora_sd, index, 0.7, 0.7)
+        assert n == 1 and unmatched == []
+        up = lora_sd[f"{mod}.lora_up.weight"]
+        down = lora_sd[f"{mod}.lora_down.weight"]
+        alpha, rank = float(lora_sd[f"{mod}.alpha"]), down.shape[0]
+        expect = base + 0.7 * (alpha / rank) * (up @ down).reshape(base.shape)
+        np.testing.assert_allclose(sd2[key], expect,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_conv_module_delta_shape(self, pipe):
+        """1x1-conv-layout modules (e.g. SD1.x proj_in) reshape correctly."""
+        sd = _export(pipe)
+        index = lora_mod.build_key_index(sd, pipe.family)
+        mod = next(m for m in sorted(index) if m.endswith("proj_in"))
+        base_shape = sd[index[mod][0]].shape
+        lora_sd = _make_kohya_lora(sd, index, [mod])
+        n, unmatched = lora_mod.apply_lora_to_state_dict(
+            sd, lora_sd, index, 1.0, 1.0)
+        assert n == 1 and unmatched == []
+        assert sd[index[mod][0]].shape == base_shape
+
+    def test_strengths_gate_towers_independently(self, pipe):
+        sd = _export(pipe)
+        index = lora_mod.build_key_index(sd, pipe.family)
+        umod = next(m for m in sorted(index) if m.startswith("lora_unet_")
+                    and m.endswith("to_q"))
+        tmod = next(m for m in sorted(index) if m.startswith("lora_te_")
+                    and m.endswith("q_proj"))
+        lora_sd = _make_kohya_lora(sd, index, [umod, tmod])
+        ub, tb = sd[index[umod][0]].copy(), sd[index[tmod][0]].copy()
+        n, _ = lora_mod.apply_lora_to_state_dict(
+            sd, lora_sd, index, 1.0, 0.0)   # model only
+        assert n == 1
+        assert not np.allclose(sd[index[umod][0]], ub)
+        np.testing.assert_array_equal(sd[index[tmod][0]], tb)
+
+
+class TestPipelineAndOp:
+    def test_virtual_lora_changes_outputs_deterministically(self, pipe):
+        p1 = lora_mod.apply_lora_to_pipeline(pipe, "styleA.safetensors",
+                                             1.0, 1.0)
+        lora_mod.clear_lora_cache()
+        p2 = lora_mod.apply_lora_to_pipeline(pipe, "styleA.safetensors",
+                                             1.0, 1.0)
+        ctx, _ = pipe.encode_prompt(["a cat"])
+        c1, _ = p1.encode_prompt(["a cat"])
+        c2, _ = p2.encode_prompt(["a cat"])
+        assert not np.allclose(np.asarray(ctx), np.asarray(c1))
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+
+    def test_patched_pipeline_cached(self, pipe):
+        a = lora_mod.apply_lora_to_pipeline(pipe, "x.safetensors", 1.0, 1.0)
+        b = lora_mod.apply_lora_to_pipeline(pipe, "x.safetensors", 1.0, 1.0)
+        assert a is b
+        c = lora_mod.apply_lora_to_pipeline(pipe, "x.safetensors", 0.5, 1.0)
+        assert c is not a
+
+    def test_real_file_loaded_from_models_dir(self, pipe, tmp_path):
+        sd = _export(pipe)
+        index = lora_mod.build_key_index(sd, pipe.family)
+        mod = next(m for m in sorted(index) if m.endswith("attn1_to_q"))
+        lora_sd = _make_kohya_lora(sd, index, [mod], seed=9)
+        from safetensors.numpy import save_file
+        save_file(lora_sd, str(tmp_path / "real.safetensors"))
+        patched = lora_mod.apply_lora_to_pipeline(
+            pipe, "real.safetensors", 1.0, 1.0,
+            models_dir=str(tmp_path))
+        out = ckpt.export_state_dict(patched.unet_params,
+                                     patched.clip_params,
+                                     patched.vae_params, patched.family)
+        up = lora_sd[f"{mod}.lora_up.weight"]
+        down = lora_sd[f"{mod}.lora_down.weight"]
+        alpha, rank = float(lora_sd[f"{mod}.alpha"]), down.shape[0]
+        key = index[mod][0]
+        expect = sd[key] + (alpha / rank) * (up @ down).reshape(sd[key].shape)
+        np.testing.assert_allclose(out[key], expect,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_loraloader_op_mixed_model_clip_sources(self, pipe):
+        """MODEL and CLIP wired from different checkpoints are patched
+        independently — the CLIP edge must NOT be replaced by the model
+        checkpoint's text encoder."""
+        other = reg.load_pipeline("lora-other.ckpt")
+        op = get_op("LoraLoader")
+        m2, c2 = op.execute(OpContext(), pipe, other, "mix.safetensors",
+                            0.5, 0.5)
+        assert m2 is not c2
+        assert m2.name.startswith(pipe.name)
+        assert c2.name.startswith(other.name)
+        # strength 0 on one side passes that input through untouched
+        m3, c3 = op.execute(OpContext(), pipe, other, "mix.safetensors",
+                            0.5, 0.0)
+        assert c3 is other
+
+    def test_loraloader_op(self, pipe):
+        op = get_op("LoraLoader")
+        m2, c2 = op.execute(OpContext(), pipe, pipe, "opstyle.safetensors",
+                            0.8, 0.8)
+        assert m2 is c2 and m2 is not pipe
+        # zero strengths: identity, no patching work
+        m3, c3 = op.execute(OpContext(), pipe, pipe, "opstyle.safetensors",
+                            0.0, 0.0)
+        assert m3 is pipe and c3 is pipe
