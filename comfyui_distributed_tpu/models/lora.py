@@ -114,7 +114,8 @@ def load_lora_state_dict(path: str) -> Dict[str, np.ndarray]:
     return ckpt.load_state_dict(path)
 
 
-def virtual_lora_state_dict(name: str, index: Dict[str, str],
+def virtual_lora_state_dict(name: str,
+                            index: Dict[str, Tuple[str, Optional[slice]]],
                             sd: Dict[str, np.ndarray],
                             rank: int = 4,
                             max_modules: int = 8) -> Dict[str, np.ndarray]:
@@ -155,7 +156,7 @@ def _delta(up: np.ndarray, down: np.ndarray,
 
 def apply_lora_to_state_dict(sd: Dict[str, np.ndarray],
                              lora_sd: Dict[str, np.ndarray],
-                             index: Dict[str, str],
+                             index: Dict[str, Tuple[str, Optional[slice]]],
                              strength_model: float,
                              strength_clip: float) -> Tuple[int, List[str]]:
     """Add scaled deltas into ``sd`` in place.  Returns (n_applied,
@@ -219,18 +220,29 @@ def apply_lora_to_pipeline(pipe, lora_name: str,
 
     Missing files virtually initialize (deterministic from the name),
     mirroring virtual checkpoints."""
-    cache_key = (pipe.name, lora_name, float(strength_model),
-                 float(strength_clip), models_dir or "")
+    cache_key = (getattr(pipe, "cache_token", pipe.name), lora_name,
+                 float(strength_model), float(strength_clip),
+                 models_dir or "")
     with _lora_lock:
         if cache_key in _lora_cache:
             _lora_cache.move_to_end(cache_key)
             return _lora_cache[cache_key]
 
     fam = pipe.family
-    # VAE excluded end-to-end: LoRA never touches it and the base params
-    # are shared by reference into the patched pipeline
-    sd = ckpt.export_state_dict(pipe.unet_params, pipe.clip_params,
-                                None, fam, include_vae=False)
+    # export ONLY the towers a nonzero strength can touch: the VAE never,
+    # the UNet not on the clip-only path (LoraLoader with split MODEL/CLIP
+    # edges), the text towers not on the model-only path — untouched trees
+    # are shared by reference into the patched pipeline, not copied
+    sd: Dict[str, np.ndarray] = {}
+    if strength_model != 0.0:
+        sd.update(ckpt._run_unet(
+            ckpt._ExportMapper(pipe.unet_params, ckpt.UNET_PREFIX),
+            fam.unet))
+    if strength_clip != 0.0:
+        for ccfg, tree, prefix in zip(fam.clips, pipe.clip_params,
+                                      ckpt._clip_prefixes(fam)):
+            sd.update(ckpt._clip_runner(ccfg)(
+                ckpt._ExportMapper(tree, prefix), ccfg))
     index = build_key_index(sd, fam)
 
     path = None
@@ -254,9 +266,16 @@ def apply_lora_to_pipeline(pipe, lora_name: str,
     debug_log(f"LoRA {lora_name!r}: applied {applied} modules "
               f"(model={strength_model}, clip={strength_clip})")
 
-    unet_p, clip_ps, _ = ckpt.convert_state_dict(sd, fam, include_vae=False)
-    if strength_clip == 0.0:
-        clip_ps = pipe.clip_params      # untouched: share, don't copy
+    if strength_model != 0.0:
+        unet_p = ckpt._run_unet(ckpt._LoadMapper(sd, ckpt.UNET_PREFIX),
+                                fam.unet)
+    else:
+        unet_p = pipe.unet_params       # untouched: share, don't copy
+    if strength_clip != 0.0:
+        clip_ps = [ckpt._clip_runner(c)(ckpt._LoadMapper(sd, p), c)
+                   for c, p in zip(fam.clips, ckpt._clip_prefixes(fam))]
+    else:
+        clip_ps = pipe.clip_params
     from comfyui_distributed_tpu.models.registry import DiffusionPipeline
     patched = DiffusionPipeline(
         f"{pipe.name}+{lora_name}", fam, unet_p, clip_ps,

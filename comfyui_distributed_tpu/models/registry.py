@@ -127,6 +127,11 @@ class DiffusionPipeline:
         self.vae_params = vae_params
         self.prediction_type = prediction_type
         self.assets_dir = assets_dir
+        # unique identity for derived-pipeline caches: ``name`` alone is
+        # just the ckpt filename, which two pipelines of different
+        # families/models_dirs can share (load_pipeline overwrites this
+        # with its full cache key)
+        self.cache_token = f"{name}:{family.name}:{assets_dir or ''}"
         self.schedule = sch.make_discrete_schedule()
         # real CLIP BPE when vocab.json/merges.txt sit in the models dir
         # (zero-egress asset drop); deterministic hash tokenizer otherwise
@@ -245,25 +250,41 @@ class DiffusionPipeline:
                uncond_context: jnp.ndarray, seeds,
                steps: int, cfg: float, sampler_name: str, scheduler: str,
                denoise: float = 1.0, y: Optional[jnp.ndarray] = None,
-               add_noise: bool = True, sample_idx=None) -> jnp.ndarray:
+               add_noise: bool = True, sample_idx=None,
+               start_step: int = 0, end_step: Optional[int] = None,
+               force_full_denoise: bool = False) -> jnp.ndarray:
         """Full ksampler: schedule -> noise -> scan-sampler -> latents.
 
         ``seeds``: per-sample host seed array [B] (64-bit ok; replica offsets
         already applied by the distributed layer).  ``sample_idx``: optional
         per-sample fold-in indices (replica-local positions in SPMD runs).
+        ``start_step``/``end_step`` run a window of the schedule (ComfyUI's
+        KSamplerAdvanced): noise scales by the window's FIRST sigma, and
+        stopping early returns a still-noisy latent for a later stage
+        unless ``force_full_denoise`` zeroes the final sigma.
         The denoise loop is jit-compiled and cached per static config."""
         sigmas = jnp.asarray(sch.compute_sigmas(
             self.schedule, scheduler, steps, denoise))
+        start = max(int(start_step), 0)
+        end = steps if end_step is None else min(int(end_step), steps)
+        if start >= end:
+            # degenerate window (start_at_step beyond the schedule):
+            # ComfyUI returns the latent unchanged rather than erroring
+            return latents
+        if start > 0 or end < steps:
+            sigmas = sigmas[start:end + 1]
+            if force_full_denoise:
+                sigmas = sigmas.at[-1].set(0.0)
         keys = smp.sample_keys(seeds, sample_idx)
 
         from comfyui_distributed_tpu.runtime.interrupt import polling_enabled
         static_key = ("sample", sampler_name, scheduler, steps, float(cfg),
                       float(denoise), bool(add_noise), y is not None,
                       tuple(latents.shape), tuple(context.shape),
-                      polling_enabled())
+                      polling_enabled(), start, end,
+                      bool(force_full_denoise))
 
         def make_core():
-            full_denoise = denoise >= 0.9999
             has_y = y is not None
             cfg_scale = float(cfg)
             sampler = smp.get_sampler(sampler_name)
@@ -281,11 +302,9 @@ class DiffusionPipeline:
                 # collides with per-step ancestral noise (steps from 0)
                 noise = smp.make_noise_fn(keys)(
                     jnp.asarray(0x7FFFFFFF, jnp.uint32), latents.shape[1:])
-                if add_noise:
-                    x = noise * sigmas[0] if full_denoise \
-                        else latents + noise * sigmas[0]
-                else:
-                    x = latents
+                # noise always lands ON the latent (ComfyUI convention) —
+                # txt2img passes zeros, so pure-noise starts fall out
+                x = latents + noise * sigmas[0] if add_noise else latents
                 extra = {"y": y2} if has_y else {}
                 return sampler(model, x, sigmas, extra_args=extra, keys=keys)
 
@@ -416,6 +435,7 @@ def load_pipeline(ckpt_name: str, models_dir: Optional[str] = None,
     pipe = DiffusionPipeline(ckpt_name, fam, unet_p, clip_ps, vae_p,
                              prediction_type=fam.unet.prediction_type,
                              assets_dir=models_dir)
+    pipe.cache_token = key
     with _pipeline_lock:
         _pipeline_cache[key] = pipe
     return pipe
@@ -426,8 +446,80 @@ def clear_pipeline_cache() -> None:
     the reference's VRAM-clear endpoint, ``distributed.py:383-426``)."""
     with _pipeline_lock:
         _pipeline_cache.clear()
+        _derived_cache.clear()
     from comfyui_distributed_tpu.models import lora as lora_mod
     lora_mod.clear_lora_cache()
+
+
+# derived pipelines (clip-skip variants, external VAEs): param trees are
+# SHARED with the base — only configs/modules differ — but each clone
+# carries its own jit caches, so keep identity stable across runs
+_derived_cache: "collections.OrderedDict[Tuple, DiffusionPipeline]" = \
+    collections.OrderedDict()
+_DERIVED_CACHE_CAP = 8
+
+
+def derive_pipeline(base: DiffusionPipeline, tag: str,
+                    family: Optional[ModelFamily] = None,
+                    vae_params: Any = None) -> DiffusionPipeline:
+    """Cached clone of ``base`` with a replacement family (e.g. clip-skip
+    configs) and/or VAE params; everything else shared by reference."""
+    key = (base.cache_token, tag)
+    with _pipeline_lock:
+        if key in _derived_cache:
+            _derived_cache.move_to_end(key)
+            return _derived_cache[key]
+    clone = DiffusionPipeline(
+        f"{base.name}|{tag}", family or base.family,
+        base.unet_params, base.clip_params,
+        vae_params if vae_params is not None else base.vae_params,
+        prediction_type=base.prediction_type,
+        assets_dir=base.assets_dir)
+    with _pipeline_lock:
+        _derived_cache[key] = clone
+        while len(_derived_cache) > _DERIVED_CACHE_CAP:
+            _derived_cache.popitem(last=False)
+    return clone
+
+
+def load_vae(vae_name: str, models_dir: Optional[str] = None,
+             family_name: Optional[str] = None) -> DiffusionPipeline:
+    """VAELoader equivalent: a standalone VAE usable wherever a pipeline's
+    VAE output is (VAEDecode/VAEEncode/tiled).  Accepts both serialization
+    forms real VAE files use — full-checkpoint style (``first_stage_model.
+    encoder...``) and bare (``encoder...``, e.g. vae-ft-mse-840000) —
+    and virtually initializes when no file exists."""
+    fam = FAMILIES[family_name or os.environ.get(FAMILY_ENV) or "sd15"]
+    key = f"vae:{vae_name}:{fam.name}:{models_dir or ''}"
+    with _pipeline_lock:
+        if key in _pipeline_cache:
+            return _pipeline_cache[key]
+
+    path = None
+    if models_dir:
+        cand = os.path.join(models_dir, vae_name.replace("\\", "/"))
+        if os.path.exists(cand):
+            path = cand
+    if path is not None:
+        from comfyui_distributed_tpu.models.checkpoints import (
+            VAE_PREFIX, _LoadMapper, _run_vae, load_state_dict)
+        sd = load_state_dict(path)
+        prefix = VAE_PREFIX if any(k.startswith(VAE_PREFIX) for k in sd) \
+            else ""
+        vae_p = _run_vae(_LoadMapper(sd, prefix), fam.vae)
+        log(f"loaded VAE {vae_name} ({fam.name}) from {path}")
+    else:
+        seed = _name_seed(vae_name)
+        ds = fam.vae.downscale
+        img = jnp.zeros((1, 8 * ds, 8 * ds, 3))
+        vae_p = _virtual_params(vae_mod.VAE(fam.vae), seed, img)
+        log(f"virtual VAE {vae_name!r} ({fam.name}): no file on disk, "
+            f"deterministic init (seed {seed})")
+
+    pipe = DiffusionPipeline(f"vae:{vae_name}", fam, {}, [{}], vae_p)
+    with _pipeline_lock:
+        _pipeline_cache[key] = pipe
+    return pipe
 
 
 # --- upscalers --------------------------------------------------------------

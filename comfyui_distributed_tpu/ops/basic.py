@@ -75,6 +75,39 @@ class LoraLoader(Op):
 
 
 @register_op
+class CLIPSetLastLayer(Op):
+    """ComfyUI's clip-skip: re-route cross-attention conditioning to an
+    earlier CLIP hidden layer (-1 = final, -2 = penultimate, ...).  The
+    weights are shared; only the tower's output_layer config changes."""
+    TYPE = "CLIPSetLastLayer"
+    WIDGETS = ["stop_at_clip_layer"]
+    DEFAULTS = {"stop_at_clip_layer": -1}
+
+    def execute(self, ctx: OpContext, clip, stop_at_clip_layer: int = -1):
+        import dataclasses
+        stop = int(stop_at_clip_layer)
+        fam = clip.family
+        if all(c.output_layer == stop for c in fam.clips):
+            return (clip,)
+        fam2 = dataclasses.replace(fam, clips=tuple(
+            dataclasses.replace(c, output_layer=stop) for c in fam.clips))
+        return (registry.derive_pipeline(clip, f"clip{stop}",
+                                         family=fam2),)
+
+
+@register_op
+class VAELoader(Op):
+    """Standalone VAE checkpoint (e.g. vae-ft-mse-840000) replacing the
+    one baked into the model checkpoint."""
+    TYPE = "VAELoader"
+    WIDGETS = ["vae_name"]
+
+    def execute(self, ctx: OpContext, vae_name: str):
+        return (registry.load_vae(str(vae_name),
+                                  models_dir=ctx.models_dir),)
+
+
+@register_op
 class CLIPTextEncode(Op):
     TYPE = "CLIPTextEncode"
     WIDGETS = ["text"]
@@ -157,6 +190,72 @@ class KSampler(Op):
                 denoise=float(denoise), y=y,
                 sample_idx=local_idx)
         return ({"samples": out, "local_batch": local_b, "fanout": fanout},)
+
+
+@register_op
+class KSamplerAdvanced(Op):
+    """ComfyUI's staged sampler: run a [start_at_step, end_at_step] window
+    of the schedule, optionally without adding noise (later hires stages)
+    and optionally returning a still-noisy latent for the next stage."""
+    TYPE = "KSamplerAdvanced"
+    WIDGETS = ["add_noise", "noise_seed", CONTROL, "steps", "cfg",
+               "sampler_name", "scheduler", "start_at_step", "end_at_step",
+               "return_with_leftover_noise"]
+    DEFAULTS = {"start_at_step": 0, "end_at_step": 10000,
+                "add_noise": "enable", "return_with_leftover_noise":
+                "disable"}
+
+    def execute(self, ctx: OpContext, model, add_noise, noise_seed, steps,
+                cfg, sampler_name, scheduler, positive: Conditioning,
+                negative: Conditioning, latent_image,
+                start_at_step: int = 0, end_at_step: int = 10000,
+                return_with_leftover_noise: str = "disable"):
+        ctx.check_interrupt()
+        lat = np.asarray(latent_image["samples"], np.float32)
+        fanout = int(latent_image.get("fanout", 1))
+        total = lat.shape[0]
+        local_b = int(latent_image.get("local_batch",
+                                       total // max(fanout, 1)))
+        if isinstance(noise_seed, SeedValue):
+            base, distributed = noise_seed.base, noise_seed.distributed
+        else:
+            base, distributed = int(noise_seed), False
+        if fanout > 1 and distributed:
+            seeds = coll.replica_seeds(base, fanout, local_b)
+        else:
+            seeds = np.full((total,), np.uint64(base), np.uint64)
+        local_idx = np.tile(np.arange(local_b, dtype=np.uint32),
+                            max(fanout, 1))[:total]
+
+        ctx_arr = jnp.repeat(positive.context, total, axis=0)
+        unc_arr = jnp.repeat(negative.context, total, axis=0)
+        y = None
+        if model.family.unet.adm_in_channels is not None:
+            y = _sdxl_vector_cond(model, positive, total,
+                                  lat.shape[1] * 8, lat.shape[2] * 8)
+        lat_dev = lat
+        if fanout > 1 and ctx.runtime is not None:
+            mesh = ctx.runtime.mesh
+            lat_dev = coll.shard_batch(lat, mesh)
+            ctx_arr = coll.shard_batch(ctx_arr, mesh)
+            unc_arr = coll.shard_batch(unc_arr, mesh)
+            if y is not None:
+                y = coll.shard_batch(y, mesh)
+
+        with Timer(f"ksampler_adv[{sampler_name}x{steps}"
+                   f"@{start_at_step}-{end_at_step}]"):
+            out = model.sample(
+                jnp.asarray(lat_dev), ctx_arr, unc_arr, seeds,
+                steps=int(steps), cfg=float(cfg),
+                sampler_name=str(sampler_name), scheduler=str(scheduler),
+                y=y, sample_idx=local_idx,
+                add_noise=(str(add_noise) != "disable"),
+                start_step=int(start_at_step),
+                end_step=min(int(end_at_step), int(steps)),
+                force_full_denoise=(
+                    str(return_with_leftover_noise) == "disable"))
+        return ({"samples": out, "local_batch": local_b,
+                 "fanout": fanout},)
 
 
 def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,

@@ -238,3 +238,34 @@ class TestPipelineAndOp:
         m3, c3 = op.execute(OpContext(), pipe, pipe, "opstyle.safetensors",
                             0.0, 0.0)
         assert m3 is pipe and c3 is pipe
+
+
+class TestCacheCollisions:
+    def test_same_name_different_family_not_collided(self):
+        """Two pipelines sharing a ckpt filename but differing in family
+        must not cross-pollinate derived/LoRA caches (cache_token, not
+        name, keys them)."""
+        a = reg.load_pipeline("shared.ckpt", family_name="tiny")
+        import dataclasses as dc
+        fam_b = dc.replace(reg.FAMILIES["tiny"], name="tinyB")
+        b = reg.DiffusionPipeline("shared.ckpt", fam_b,
+                                  a.unet_params, a.clip_params,
+                                  a.vae_params)
+        assert a.cache_token != b.cache_token
+        pa = lora_mod.apply_lora_to_pipeline(a, "s.safetensors", 1.0, 1.0)
+        pb = lora_mod.apply_lora_to_pipeline(b, "s.safetensors", 1.0, 1.0)
+        assert pa is not pb
+
+
+class TestSharedTrees:
+    def test_model_only_patch_shares_clip_and_vae(self, pipe):
+        p = lora_mod.apply_lora_to_pipeline(pipe, "m.safetensors", 1.0, 0.0)
+        assert p.clip_params is pipe.clip_params
+        assert p.vae_params is pipe.vae_params
+        assert p.unet_params is not pipe.unet_params
+
+    def test_clip_only_patch_shares_unet_and_vae(self, pipe):
+        p = lora_mod.apply_lora_to_pipeline(pipe, "c.safetensors", 0.0, 1.0)
+        assert p.unet_params is pipe.unet_params
+        assert p.vae_params is pipe.vae_params
+        assert p.clip_params is not pipe.clip_params
