@@ -149,47 +149,17 @@ class KSampler(Op):
                 scheduler, positive: Conditioning, negative: Conditioning,
                 latent_image, denoise: float = 1.0):
         ctx.check_interrupt()
-        lat = np.asarray(latent_image["samples"], np.float32)
-        fanout = int(latent_image.get("fanout", 1))
-        total = lat.shape[0]
-        local_b = int(latent_image.get("local_batch", total // max(fanout, 1)))
-
-        if isinstance(seed, SeedValue):
-            base, distributed = seed.base, seed.distributed
-        else:
-            base, distributed = int(seed), False
-
-        if fanout > 1 and distributed:
-            seeds = coll.replica_seeds(base, fanout, local_b)
-        else:
-            seeds = np.full((total,), np.uint64(base), np.uint64)
-        local_idx = np.tile(np.arange(local_b, dtype=np.uint32),
-                            max(fanout, 1))[:total]
-
-        ctx_arr = jnp.repeat(positive.context, total, axis=0)
-        unc_arr = jnp.repeat(negative.context, total, axis=0)
-        y = None
-        if model.family.unet.adm_in_channels is not None:
-            y = _sdxl_vector_cond(model, positive, total,
-                                  lat.shape[1] * 8, lat.shape[2] * 8)
-
-        lat_dev = lat
-        if fanout > 1 and ctx.runtime is not None:
-            mesh = ctx.runtime.mesh
-            lat_dev = coll.shard_batch(lat, mesh)
-            ctx_arr = coll.shard_batch(ctx_arr, mesh)
-            unc_arr = coll.shard_batch(unc_arr, mesh)
-            if y is not None:
-                y = coll.shard_batch(y, mesh)
-
+        prep = _prepare_sample_inputs(ctx, model, seed, latent_image,
+                                      positive, negative)
         with Timer(f"ksampler[{sampler_name}x{steps}]"):
             out = model.sample(
-                jnp.asarray(lat_dev), ctx_arr, unc_arr, seeds,
+                prep.latents, prep.context, prep.uncond, prep.seeds,
                 steps=int(steps), cfg=float(cfg),
                 sampler_name=str(sampler_name), scheduler=str(scheduler),
-                denoise=float(denoise), y=y,
-                sample_idx=local_idx)
-        return ({"samples": out, "local_batch": local_b, "fanout": fanout},)
+                denoise=float(denoise), y=prep.y,
+                sample_idx=prep.sample_idx)
+        return ({"samples": out, "local_batch": prep.local_batch,
+                 "fanout": prep.fanout},)
 
 
 @register_op
@@ -211,51 +181,79 @@ class KSamplerAdvanced(Op):
                 start_at_step: int = 0, end_at_step: int = 10000,
                 return_with_leftover_noise: str = "disable"):
         ctx.check_interrupt()
-        lat = np.asarray(latent_image["samples"], np.float32)
-        fanout = int(latent_image.get("fanout", 1))
-        total = lat.shape[0]
-        local_b = int(latent_image.get("local_batch",
-                                       total // max(fanout, 1)))
-        if isinstance(noise_seed, SeedValue):
-            base, distributed = noise_seed.base, noise_seed.distributed
-        else:
-            base, distributed = int(noise_seed), False
-        if fanout > 1 and distributed:
-            seeds = coll.replica_seeds(base, fanout, local_b)
-        else:
-            seeds = np.full((total,), np.uint64(base), np.uint64)
-        local_idx = np.tile(np.arange(local_b, dtype=np.uint32),
-                            max(fanout, 1))[:total]
-
-        ctx_arr = jnp.repeat(positive.context, total, axis=0)
-        unc_arr = jnp.repeat(negative.context, total, axis=0)
-        y = None
-        if model.family.unet.adm_in_channels is not None:
-            y = _sdxl_vector_cond(model, positive, total,
-                                  lat.shape[1] * 8, lat.shape[2] * 8)
-        lat_dev = lat
-        if fanout > 1 and ctx.runtime is not None:
-            mesh = ctx.runtime.mesh
-            lat_dev = coll.shard_batch(lat, mesh)
-            ctx_arr = coll.shard_batch(ctx_arr, mesh)
-            unc_arr = coll.shard_batch(unc_arr, mesh)
-            if y is not None:
-                y = coll.shard_batch(y, mesh)
-
+        prep = _prepare_sample_inputs(ctx, model, noise_seed, latent_image,
+                                      positive, negative)
         with Timer(f"ksampler_adv[{sampler_name}x{steps}"
                    f"@{start_at_step}-{end_at_step}]"):
             out = model.sample(
-                jnp.asarray(lat_dev), ctx_arr, unc_arr, seeds,
+                prep.latents, prep.context, prep.uncond, prep.seeds,
                 steps=int(steps), cfg=float(cfg),
                 sampler_name=str(sampler_name), scheduler=str(scheduler),
-                y=y, sample_idx=local_idx,
+                y=prep.y, sample_idx=prep.sample_idx,
                 add_noise=(str(add_noise) != "disable"),
                 start_step=int(start_at_step),
                 end_step=min(int(end_at_step), int(steps)),
                 force_full_denoise=(
                     str(return_with_leftover_noise) == "disable"))
-        return ({"samples": out, "local_batch": local_b,
-                 "fanout": fanout},)
+        return ({"samples": out, "local_batch": prep.local_batch,
+                 "fanout": prep.fanout},)
+
+
+class _SampleInputs:
+    """Shared KSampler/KSamplerAdvanced preamble: latent unpack, replica
+    seed fan-out, per-replica fold-in indices, conditioning batch repeat,
+    SDXL vector cond, and mesh sharding — ONE copy, so replica-seed or
+    sharding fixes can't land in one sampler and miss the other."""
+
+    __slots__ = ("latents", "context", "uncond", "seeds", "sample_idx",
+                 "y", "local_batch", "fanout")
+
+
+def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
+                           positive: Conditioning,
+                           negative: Conditioning) -> _SampleInputs:
+    lat = np.asarray(latent_image["samples"], np.float32)
+    fanout = int(latent_image.get("fanout", 1))
+    total = lat.shape[0]
+    local_b = int(latent_image.get("local_batch", total // max(fanout, 1)))
+
+    if isinstance(seed, SeedValue):
+        base, distributed = seed.base, seed.distributed
+    else:
+        base, distributed = int(seed), False
+    if fanout > 1 and distributed:
+        seeds = coll.replica_seeds(base, fanout, local_b)
+    else:
+        seeds = np.full((total,), np.uint64(base), np.uint64)
+    local_idx = np.tile(np.arange(local_b, dtype=np.uint32),
+                        max(fanout, 1))[:total]
+
+    ctx_arr = jnp.repeat(positive.context, total, axis=0)
+    unc_arr = jnp.repeat(negative.context, total, axis=0)
+    y = None
+    if model.family.unet.adm_in_channels is not None:
+        y = _sdxl_vector_cond(model, positive, total,
+                              lat.shape[1] * 8, lat.shape[2] * 8)
+
+    lat_dev = lat
+    if fanout > 1 and ctx.runtime is not None:
+        mesh = ctx.runtime.mesh
+        lat_dev = coll.shard_batch(lat, mesh)
+        ctx_arr = coll.shard_batch(ctx_arr, mesh)
+        unc_arr = coll.shard_batch(unc_arr, mesh)
+        if y is not None:
+            y = coll.shard_batch(y, mesh)
+
+    prep = _SampleInputs()
+    prep.latents = jnp.asarray(lat_dev)
+    prep.context = ctx_arr
+    prep.uncond = unc_arr
+    prep.seeds = seeds
+    prep.sample_idx = local_idx
+    prep.y = y
+    prep.local_batch = local_b
+    prep.fanout = fanout
+    return prep
 
 
 def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,
