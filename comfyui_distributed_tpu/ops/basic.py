@@ -6,6 +6,7 @@ Schemas mirror ComfyUI node surfaces used by the reference workflows
 
 from __future__ import annotations
 
+import dataclasses
 import os
 from typing import Optional
 
@@ -199,14 +200,20 @@ class KSamplerAdvanced(Op):
                  "fanout": prep.fanout},)
 
 
+@dataclasses.dataclass
 class _SampleInputs:
     """Shared KSampler/KSamplerAdvanced preamble: latent unpack, replica
     seed fan-out, per-replica fold-in indices, conditioning batch repeat,
     SDXL vector cond, and mesh sharding — ONE copy, so replica-seed or
     sharding fixes can't land in one sampler and miss the other."""
-
-    __slots__ = ("latents", "context", "uncond", "seeds", "sample_idx",
-                 "y", "local_batch", "fanout")
+    latents: object
+    context: object
+    uncond: object
+    seeds: object
+    sample_idx: object
+    y: object
+    local_batch: int
+    fanout: int
 
 
 def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
@@ -244,16 +251,9 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
         if y is not None:
             y = coll.shard_batch(y, mesh)
 
-    prep = _SampleInputs()
-    prep.latents = jnp.asarray(lat_dev)
-    prep.context = ctx_arr
-    prep.uncond = unc_arr
-    prep.seeds = seeds
-    prep.sample_idx = local_idx
-    prep.y = y
-    prep.local_batch = local_b
-    prep.fanout = fanout
-    return prep
+    return _SampleInputs(latents=jnp.asarray(lat_dev), context=ctx_arr,
+                         uncond=unc_arr, seeds=seeds, sample_idx=local_idx,
+                         y=y, local_batch=local_b, fanout=fanout)
 
 
 def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,
@@ -370,6 +370,81 @@ class ImageBatch(np.ndarray):
         if obj is not None:
             self.local_batch = getattr(obj, "local_batch", None)
             self.fanout = getattr(obj, "fanout", 1)
+
+
+def _latent_meta(samples) -> dict:
+    """Fan-out metadata to carry through latent-space ops — one copy, so a
+    future meta key can't be forwarded by one op and dropped by another
+    (which would make a downstream VAEEncode re-tile a fanned batch)."""
+    return {k: samples[k] for k in ("local_batch", "fanout")
+            if k in samples}
+
+
+@register_op
+class LatentUpscale(Op):
+    """ComfyUI's latent-space resize (hires-fix stage 1 -> 2).  Pixel
+    widget values divide by 8; width/height of 0 derive from the other
+    dimension preserving aspect (0/0 = passthrough); crop="center"
+    resizes aspect-preserving then center-crops."""
+    TYPE = "LatentUpscale"
+    WIDGETS = ["upscale_method", "width", "height", "crop"]
+    DEFAULTS = {"crop": "disabled", "upscale_method": "nearest-exact"}
+
+    def execute(self, ctx: OpContext, samples, upscale_method: str,
+                width: int, height: int, crop: str = "disabled"):
+        lat = np.asarray(samples["samples"], np.float32)
+        b, h, w, _ = lat.shape
+        width, height = int(width), int(height)
+        if width == 0 and height == 0:
+            return ({"samples": lat, **_latent_meta(samples)},)
+        ds = 8  # ComfyUI divides the PIXEL widget values by 8
+        if width == 0:
+            lh = max(height // ds, 1)
+            lw = max(round(w * lh / h), 1)
+        elif height == 0:
+            lw = max(width // ds, 1)
+            lh = max(round(h * lw / w), 1)
+        else:
+            lw, lh = max(width // ds, 1), max(height // ds, 1)
+        if crop == "center" and width and height:
+            ratio = max(lw / w, lh / h)
+            iw, ih = round(w * ratio), round(h * ratio)
+            out = resize_image(lat, iw, ih, upscale_method)
+            x0, y0 = (iw - lw) // 2, (ih - lh) // 2
+            out = out[:, y0:y0 + lh, x0:x0 + lw, :]
+        else:
+            out = resize_image(lat, lw, lh, upscale_method)
+        return ({"samples": out, **_latent_meta(samples)},)
+
+
+@register_op
+class LatentUpscaleBy(Op):
+    TYPE = "LatentUpscaleBy"
+    WIDGETS = ["upscale_method", "scale_by"]
+    DEFAULTS = {"upscale_method": "nearest-exact", "scale_by": 1.5}
+
+    def execute(self, ctx: OpContext, samples, upscale_method: str,
+                scale_by: float = 1.5):
+        lat = np.asarray(samples["samples"], np.float32)
+        lh = max(round(lat.shape[1] * float(scale_by)), 1)
+        lw = max(round(lat.shape[2] * float(scale_by)), 1)
+        out = resize_image(lat, lw, lh, upscale_method)
+        return ({"samples": out, **_latent_meta(samples)},)
+
+
+@register_op
+class ImageScaleBy(Op):
+    TYPE = "ImageScaleBy"
+    WIDGETS = ["upscale_method", "scale_by"]
+    DEFAULTS = {"upscale_method": "lanczos", "scale_by": 2.0}
+
+    def execute(self, ctx: OpContext, image, upscale_method: str,
+                scale_by: float = 2.0):
+        arr = as_image_array(image)
+        w = max(round(arr.shape[2] * float(scale_by)), 1)
+        h = max(round(arr.shape[1] * float(scale_by)), 1)
+        return (_keep_fanout_meta(image,
+                                  resize_image(arr, w, h, upscale_method)),)
 
 
 @register_op

@@ -293,6 +293,82 @@ class TestImg2ImgE2E:
         assert err(res_low) < err(res_full)
 
 
+HIRES = "/root/repo/workflows/distributed-hires-fix.json"
+
+
+class TestHiresFixE2E:
+    """The staged hires-fix fixture: LoraLoader -> CLIPSetLastLayer ->
+    KSamplerAdvanced (leftover noise) -> LatentUpscale -> KSamplerAdvanced
+    finish, fanned over the mesh."""
+
+    def test_hires_fix_fans_out(self, ctx):
+        g = parse_workflow(HIRES)
+        # scale for CPU: tiny latents, 1+1 steps (LatentUpscale divides
+        # pixel widgets by 8, ComfyUI convention)
+        g.nodes["5"].inputs.update(width=32, height=32)
+        g.nodes["3"].inputs.update(steps=2, end_at_step=1)
+        g.nodes["10"].inputs.update(width=64, height=64)
+        g.nodes["11"].inputs.update(steps=2, start_at_step=1)
+        res = WorkflowExecutor(ctx).execute(g)
+        assert len(res.images) == 8
+        imgs = np.stack(res.images)
+        # tiny VAE: 8x8 latent (64//8) -> 16px image at downscale 2
+        assert imgs.shape == (8, 16, 16, 3)
+        for i in range(1, 8):
+            assert not np.allclose(imgs[0], imgs[i]), \
+                f"variation {i} identical to master"
+
+    def test_latent_upscale_preserves_fanout_meta(self, ctx):
+        from comfyui_distributed_tpu.ops.base import get_op
+        lat = {"samples": np.zeros((8, 8, 8, 4), np.float32),
+               "local_batch": 1, "fanout": 8}
+        (out,) = get_op("LatentUpscale").execute(
+            ctx, lat, "nearest-exact", 128, 128)
+        assert out["samples"].shape == (8, 16, 16, 4)
+        assert out["fanout"] == 8 and out["local_batch"] == 1
+        (out2,) = get_op("LatentUpscaleBy").execute(ctx, lat, "bilinear",
+                                                    1.5)
+        assert out2["samples"].shape == (8, 12, 12, 4)
+        assert out2["fanout"] == 8
+
+    def test_latent_upscale_rectangular_and_zero_dims(self, ctx):
+        """Non-square targets (argument-order tripwire), width/height=0
+        aspect-derivation, 0/0 passthrough, and center crop — ComfyUI's
+        LatentUpscale conventions."""
+        from comfyui_distributed_tpu.ops.base import get_op
+        op = get_op("LatentUpscale")
+        lat = {"samples": np.zeros((1, 8, 16, 4), np.float32)}  # H=8, W=16
+        (r,) = op.execute(ctx, lat, "bilinear", 256, 64)   # W=32, H=8
+        assert r["samples"].shape == (1, 8, 32, 4)
+        (r,) = op.execute(ctx, lat, "bilinear", 0, 128)    # H=16, W by AR
+        assert r["samples"].shape == (1, 16, 32, 4)
+        (r,) = op.execute(ctx, lat, "bilinear", 128, 0)    # W=16, H by AR
+        assert r["samples"].shape == (1, 8, 16, 4)
+        (r,) = op.execute(ctx, lat, "bilinear", 0, 0)      # passthrough
+        assert r["samples"].shape == (1, 8, 16, 4)
+        # center crop: 2:1 latent -> square target without distortion
+        (r,) = op.execute(ctx, lat, "bilinear", 128, 128, "center")
+        assert r["samples"].shape == (1, 16, 16, 4)
+
+    def test_latent_upscale_by_rectangular(self, ctx):
+        from comfyui_distributed_tpu.ops.base import get_op
+        lat = {"samples": np.zeros((1, 8, 16, 4), np.float32)}
+        (r,) = get_op("LatentUpscaleBy").execute(ctx, lat, "bilinear", 2.0)
+        assert r["samples"].shape == (1, 16, 32, 4)
+        img = np.zeros((1, 8, 16, 3), np.float32)
+        (ri,) = get_op("ImageScaleBy").execute(ctx, img, "bilinear", 2.0)
+        assert ri.shape == (1, 16, 32, 3)
+
+    def test_image_scale_by_preserves_fanout_meta(self, ctx):
+        from comfyui_distributed_tpu.ops.base import get_op
+        from comfyui_distributed_tpu.ops.basic import ImageBatch
+        img = ImageBatch(np.zeros((8, 16, 16, 3), np.float32),
+                         local_batch=1, fanout=8)
+        (out,) = get_op("ImageScaleBy").execute(ctx, img, "bilinear", 2.0)
+        assert out.shape == (8, 32, 32, 3)
+        assert out.fanout == 8
+
+
 def _scaled_upscale(tile=32, padding=8, blur=2, steps=1):
     g = parse_workflow(UPSCALE)
     g.nodes["12"].inputs["image"] = "__missing__.png"   # synthetic test card
