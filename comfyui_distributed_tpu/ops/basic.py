@@ -287,9 +287,7 @@ class VAEDecode(Op):
             # from the SPMD/local paths (unclipped)
             img = jnp.clip(
                 vae.vae_decode(jnp.asarray(samples["samples"])), 0.0, 1.0)
-        meta = {k: samples[k] for k in ("local_batch", "fanout")
-                if k in samples}
-        return (ImageBatch(img, **meta),)
+        return (ImageBatch(img, **_latent_meta(samples)),)
 
 
 @register_op
@@ -308,9 +306,7 @@ class VAEDecodeTiled(Op):
                 jnp.asarray(samples["samples"]), tile_size=int(tile_size),
                 overlap=int(overlap),
                 check_interrupt=ctx.check_interrupt), 0.0, 1.0)
-        meta = {k: samples[k] for k in ("local_batch", "fanout")
-                if k in samples}
-        return (ImageBatch(img, **meta),)
+        return (ImageBatch(img, **_latent_meta(samples)),)
 
 
 @register_op
@@ -372,6 +368,143 @@ class ImageBatch(np.ndarray):
             self.fanout = getattr(obj, "fanout", 1)
 
 
+@register_op
+class ConditioningConcat(Op):
+    """Concatenate conditionings along the TOKEN axis (prompt chaining)."""
+    TYPE = "ConditioningConcat"
+
+    def execute(self, ctx: OpContext, conditioning_to: Conditioning,
+                conditioning_from: Conditioning):
+        return (Conditioning(
+            context=jnp.concatenate([conditioning_to.context,
+                                     conditioning_from.context], axis=1),
+            pooled=conditioning_to.pooled),)
+
+
+@register_op
+class ConditioningAverage(Op):
+    """Weighted blend of two conditionings (same token length)."""
+    TYPE = "ConditioningAverage"
+    WIDGETS = ["conditioning_to_strength"]
+    DEFAULTS = {"conditioning_to_strength": 1.0}
+
+    def execute(self, ctx: OpContext, conditioning_to: Conditioning,
+                conditioning_from: Conditioning,
+                conditioning_to_strength: float = 1.0):
+        w = float(conditioning_to_strength)
+        c_to, c_from = conditioning_to.context, conditioning_from.context
+        if c_from.shape[1] != c_to.shape[1]:
+            # ComfyUI zero-pads/truncates cond_from to cond_to's length
+            t0 = c_to.shape[1]
+            if c_from.shape[1] < t0:
+                c_from = jnp.pad(
+                    c_from, ((0, 0), (0, t0 - c_from.shape[1]), (0, 0)))
+            else:
+                c_from = c_from[:, :t0, :]
+        ctx_out = c_to * w + c_from * (1.0 - w)
+        # pooled fallback order matches ComfyUI: to's, else from's
+        pooled = conditioning_to.pooled
+        if pooled is not None and conditioning_from.pooled is not None:
+            pooled = pooled * w + conditioning_from.pooled * (1.0 - w)
+        elif pooled is None:
+            pooled = conditioning_from.pooled
+        return (Conditioning(context=ctx_out, pooled=pooled),)
+
+
+@register_op
+class ConditioningCombine(Op):
+    """ComfyUI combines conditionings as alternatives sampled together;
+    without per-cond area scheduling the faithful single-pass analog is
+    the equal-weight average."""
+    TYPE = "ConditioningCombine"
+
+    def execute(self, ctx: OpContext, conditioning_1: Conditioning,
+                conditioning_2: Conditioning):
+        return ConditioningAverage().execute(
+            ctx, conditioning_1, conditioning_2,
+            conditioning_to_strength=0.5)
+
+
+@register_op
+class RepeatLatentBatch(Op):
+    TYPE = "RepeatLatentBatch"
+    WIDGETS = ["amount"]
+    DEFAULTS = {"amount": 1}
+
+    def execute(self, ctx: OpContext, samples, amount: int = 1):
+        lat = np.asarray(samples["samples"], np.float32)
+        n = max(int(amount), 1)
+        meta = _latent_meta(samples)
+        fanout = int(meta.get("fanout", 1))
+        if fanout > 1:
+            # repeat WITHIN each replica block: replica r owns contiguous
+            # rows [r*local_b, (r+1)*local_b) and a whole-batch tile would
+            # interleave replicas' latents
+            out = np.concatenate([np.tile(blk, (n, 1, 1, 1))
+                                  for blk in np.split(lat, fanout)], axis=0)
+        else:
+            out = np.tile(lat, (n, 1, 1, 1))
+        if "local_batch" in meta:
+            meta["local_batch"] = meta["local_batch"] * n
+        return ({"samples": out, **meta},)
+
+
+@register_op
+class LatentFromBatch(Op):
+    """Slice [batch_index, batch_index+length) out of a latent batch."""
+    TYPE = "LatentFromBatch"
+    WIDGETS = ["batch_index", "length"]
+    DEFAULTS = {"batch_index": 0, "length": 1}
+
+    def execute(self, ctx: OpContext, samples, batch_index: int = 0,
+                length: int = 1):
+        lat = np.asarray(samples["samples"], np.float32)
+        i = min(max(int(batch_index), 0), lat.shape[0] - 1)
+        n = min(max(int(length), 1), lat.shape[0] - i)
+        # slicing breaks replica alignment: the result is a plain batch
+        return ({"samples": lat[i:i + n]},)
+
+
+@register_op
+class CheckpointSave(Op):
+    """Export the (possibly LoRA-patched) pipeline back to a single-file
+    torch-layout checkpoint — the interop loop back into the reference's
+    ecosystem (\"same models on all machines\", reference README:189-193)."""
+    TYPE = "CheckpointSave"
+    OUTPUT_NODE = True
+    WIDGETS = ["filename_prefix"]
+    DEFAULTS = {"filename_prefix": "checkpoints/save"}
+
+    def execute(self, ctx: OpContext, model, clip, vae,
+                filename_prefix: str = "checkpoints/save"):
+        from comfyui_distributed_tpu.models.checkpoints import save_checkpoint
+        out_dir = ctx.output_dir or os.getcwd()
+        path = os.path.join(out_dir, f"{filename_prefix}.safetensors")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # model/clip/vae may be three different pipelines (VAELoader,
+        # clip-skip, LoRA splits): take each tower from its own source
+        save_checkpoint(path, model.unet_params, clip.clip_params,
+                        vae.vae_params, model.family)
+        debug_log(f"CheckpointSave: wrote {path}")
+        return ()
+
+
+def _resize_maybe_center(arr: np.ndarray, width: int, height: int,
+                         method: str, crop: str) -> np.ndarray:
+    """Resize [B,H,W,C] to (width, height); crop=\"center\" scales
+    aspect-preserving then center-crops (ComfyUI common_upscale) — the ONE
+    copy of the crop math for image-space AND latent-space resizes."""
+    if crop == "center":
+        b, h, w, c = arr.shape
+        ratio = max(width / w, height / h)
+        iw, ih = round(w * ratio), round(h * ratio)
+        arr = resize_image(arr, iw, ih, method)
+        x0 = (iw - width) // 2
+        y0 = (ih - height) // 2
+        return arr[:, y0:y0 + height, x0:x0 + width, :]
+    return resize_image(arr, width, height, method)
+
+
 def _latent_meta(samples) -> dict:
     """Fan-out metadata to carry through latent-space ops — one copy, so a
     future meta key can't be forwarded by one op and dropped by another
@@ -406,14 +539,9 @@ class LatentUpscale(Op):
             lh = max(round(h * lw / w), 1)
         else:
             lw, lh = max(width // ds, 1), max(height // ds, 1)
-        if crop == "center" and width and height:
-            ratio = max(lw / w, lh / h)
-            iw, ih = round(w * ratio), round(h * ratio)
-            out = resize_image(lat, iw, ih, upscale_method)
-            x0, y0 = (iw - lw) // 2, (ih - lh) // 2
-            out = out[:, y0:y0 + lh, x0:x0 + lw, :]
-        else:
-            out = resize_image(lat, lw, lh, upscale_method)
+        out = _resize_maybe_center(
+            lat, lw, lh, upscale_method,
+            crop if (width and height) else "disabled")
         return ({"samples": out, **_latent_meta(samples)},)
 
 
@@ -479,17 +607,8 @@ class ImageScale(Op):
 
     def execute(self, ctx: OpContext, image, upscale_method: str,
                 width: int, height: int, crop: str = "disabled"):
-        arr = as_image_array(image)
-        if crop == "center":
-            b, h, w, c = arr.shape
-            ratio = max(width / w, height / h)
-            iw, ih = round(w * ratio), round(h * ratio)
-            arr = resize_image(arr, iw, ih, upscale_method)
-            x0 = (iw - width) // 2
-            y0 = (ih - height) // 2
-            arr = arr[:, y0:y0 + height, x0:x0 + width, :]
-        else:
-            arr = resize_image(arr, int(width), int(height), upscale_method)
+        arr = _resize_maybe_center(as_image_array(image), int(width),
+                                   int(height), upscale_method, crop)
         return (_keep_fanout_meta(image, arr),)
 
 

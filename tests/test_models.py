@@ -391,3 +391,106 @@ class TestAdvancedOps:
         # the mid-point is a genuine intermediate, not the final result
         assert not np.allclose(np.asarray(s1["samples"]),
                                np.asarray(full["samples"]), atol=1e-3)
+
+
+class TestUtilityOps:
+    """Conditioning combinators, latent batch utilities, CheckpointSave."""
+
+    def _pipe(self):
+        return registry.load_pipeline("util-ops.ckpt")
+
+    def test_conditioning_concat_average_combine(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        a = Conditioning(context=jnp.ones((1, 77, 64)),
+                         pooled=jnp.ones((1, 64)))
+        b = Conditioning(context=jnp.zeros((1, 77, 64)),
+                         pooled=jnp.zeros((1, 64)))
+        octx = OpContext()
+        (cat,) = get_op("ConditioningConcat").execute(octx, a, b)
+        assert cat.context.shape == (1, 154, 64)
+        (avg,) = get_op("ConditioningAverage").execute(octx, a, b, 0.25)
+        np.testing.assert_allclose(np.asarray(avg.context),
+                                   np.full((1, 77, 64), 0.25), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(avg.pooled),
+                                   np.full((1, 64), 0.25), atol=1e-6)
+        (comb,) = get_op("ConditioningCombine").execute(octx, a, b)
+        np.testing.assert_allclose(np.asarray(comb.context),
+                                   np.full((1, 77, 64), 0.5), atol=1e-6)
+
+    def test_repeat_and_from_batch(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        octx = OpContext()
+        lat = {"samples": np.arange(2 * 4 * 4 * 4, dtype=np.float32)
+               .reshape(2, 4, 4, 4), "local_batch": 2, "fanout": 1}
+        (rep,) = get_op("RepeatLatentBatch").execute(octx, lat, 3)
+        assert rep["samples"].shape == (6, 4, 4, 4)
+        assert rep["local_batch"] == 6
+        np.testing.assert_array_equal(rep["samples"][2:4],
+                                      lat["samples"])
+        (sel,) = get_op("LatentFromBatch").execute(octx, lat, 1, 1)
+        assert sel["samples"].shape == (1, 4, 4, 4)
+        np.testing.assert_array_equal(sel["samples"][0], lat["samples"][1])
+        # out-of-range clamps instead of crashing
+        (sel2,) = get_op("LatentFromBatch").execute(octx, lat, 5, 9)
+        assert sel2["samples"].shape == (1, 4, 4, 4)
+
+    def test_repeat_latent_batch_keeps_replica_blocks(self):
+        """A fanned batch is replica-major: repeating must stay WITHIN
+        each replica's contiguous block, or downstream seed fold-ins and
+        the collector's ordering attribute latents to the wrong replica."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        lat = np.stack([np.full((4, 4, 4), float(r)) for r in range(2)])
+        d = {"samples": lat, "local_batch": 1, "fanout": 2}
+        (rep,) = get_op("RepeatLatentBatch").execute(OpContext(), d, 2)
+        assert rep["samples"].shape == (4, 4, 4, 4)
+        assert rep["local_batch"] == 2 and rep["fanout"] == 2
+        # block layout: [r0, r0, r1, r1] — NOT [r0, r1, r0, r1]
+        got = rep["samples"][:, 0, 0, 0].tolist()
+        assert got == [0.0, 0.0, 1.0, 1.0], got
+
+    def test_conditioning_average_mismatched_lengths(self):
+        """ComfyUI pads the shorter cond_from with zeros; pooled falls
+        back to cond_from's when cond_to has none."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        a = Conditioning(context=jnp.ones((1, 154, 64)), pooled=None)
+        b = Conditioning(context=jnp.ones((1, 77, 64)),
+                         pooled=jnp.full((1, 64), 3.0))
+        (avg,) = get_op("ConditioningAverage").execute(
+            OpContext(), a, b, 0.5)
+        assert avg.context.shape == (1, 154, 64)
+        out = np.asarray(avg.context)
+        np.testing.assert_allclose(out[:, :77], 1.0, atol=1e-6)
+        np.testing.assert_allclose(out[:, 77:], 0.5, atol=1e-6)  # zero pad
+        np.testing.assert_allclose(np.asarray(avg.pooled), 3.0, atol=1e-6)
+
+    def test_checkpoint_save_round_trips(self, tmp_path):
+        from comfyui_distributed_tpu.models import checkpoints as ckpt
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        pipe = self._pipe()
+        octx = OpContext(output_dir=str(tmp_path))
+        get_op("CheckpointSave").execute(octx, pipe, pipe, pipe,
+                                         "checkpoints/exported")
+        path = tmp_path / "checkpoints" / "exported.safetensors"
+        assert path.exists()
+        sd = ckpt.load_state_dict(str(path))
+        ref = ckpt.export_state_dict(pipe.unet_params, pipe.clip_params,
+                                     pipe.vae_params, pipe.family)
+        for k, v in ref.items():
+            np.testing.assert_array_equal(sd[k], np.asarray(v), err_msg=k)
+        # and the file round-trips back into IDENTICAL param trees
+        u2, c2, v2 = ckpt.convert_state_dict(sd, pipe.family)
+
+        def trees_equal(a, b):
+            fa = jax.tree_util.tree_leaves_with_path(a)
+            fb = dict(jax.tree_util.tree_leaves_with_path(b))
+            assert len(fa) == len(fb)
+            for path_k, leaf in fa:
+                np.testing.assert_array_equal(
+                    np.asarray(leaf), np.asarray(fb[path_k]),
+                    err_msg=str(path_k))
+
+        trees_equal(u2, pipe.unet_params)
+        trees_equal(c2[0], pipe.clip_params[0])
+        trees_equal(v2, pipe.vae_params)
