@@ -89,6 +89,10 @@ def parse_args(argv=None):
                    help="BASELINE config 3: the distributed-upscale fixture "
                         "(ESRGAN 4x + tiled SD refine) wall-clock, in-process "
                         "single participant")
+    p.add_argument("--img2img", action="store_true",
+                   help="BASELINE config 4: the distributed-img2img "
+                        "variation-sweep fixture wall-clock, in-process "
+                        "single participant")
     p.add_argument("--upscale-target", type=int, default=2048,
                    help="refined output edge for --upscale (2048 = 4x the "
                         "512px test card)")
@@ -122,6 +126,9 @@ def metric_name(args):
     if args.upscale:
         return (f"{args.family}_{args.upscale_target}px_4x_tiled_upscale_"
                 f"sec_per_image")
+    if args.img2img:
+        return (f"{args.family}_{args.width}x{args.height}_{args.steps}step_"
+                f"img2img_sec_per_image")
     attn = "" if args.attn == "xla" else f"_{args.attn}"
     return (f"{args.family}_{args.width}x{args.height}_"
             f"{args.steps}step{attn}_images_per_sec_per_chip")
@@ -130,7 +137,7 @@ def metric_name(args):
 def metric_unit(args):
     if args.scaling_sweep:
         return "fraction"
-    if args.upscale:
+    if args.upscale or args.img2img:
         return "sec/image"
     return UNIT
 
@@ -453,45 +460,37 @@ def run_throughput(args):
     emit(args, payload)
 
 
-def run_upscale(args):
-    """BASELINE config 3: `distributed-upscale.json` (4x ESRGAN + SD tiled
-    refine) wall-clock per image, in-process single participant — the
-    reference's ``process_single_gpu`` analog.  Tile batch + blend run as
-    one compiled program (ops/tiled_upscale.py SPMD mode with data=1)."""
+def _run_fixture_bench(args, fixture_name, override_graph, label):
+    """Shared wall-clock bench over a workflows/ fixture (the --upscale
+    and --img2img modes): backend init, family pin, compile+first run,
+    timed repeats, one sec/image JSON line."""
     devices = init_backend(args)
     enable_compile_cache()
-    os.environ[  # pin the family so the fixture's sd15 ckpt name can't
-        "DTPU_DEFAULT_FAMILY"] = args.family  # shadow a --family override
+    # pin the family so the fixture's ckpt name can't shadow a --family
+    # override through detect_family's heuristics
+    os.environ["DTPU_DEFAULT_FAMILY"] = args.family
     from comfyui_distributed_tpu.ops.base import OpContext
     from comfyui_distributed_tpu.workflow.executor import WorkflowExecutor
     from comfyui_distributed_tpu.workflow.graph import parse_workflow
 
-    dev = devices[0]
-    log(f"platform={dev.platform} upscale target={args.upscale_target}px "
-        f"family={args.family} steps={args.steps}")
-
+    log(f"platform={devices[0].platform} {label} family={args.family} "
+        f"steps={args.steps}")
     fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "workflows", "distributed-upscale.json")
+                           "workflows", fixture_name)
 
     def build_graph():
         g = parse_workflow(fixture)
-        g.nodes["1"].inputs["image"] = "__bench_card__.png"  # synthetic
-        g.nodes["16"].inputs.update(width=args.upscale_target,
-                                    height=args.upscale_target)
-        g.nodes["2"].inputs.update(steps=args.steps, tile_width=args.tile,
-                                   tile_height=args.tile)
+        override_graph(g)
         return g
 
     import tempfile
-    out_dir = tempfile.mkdtemp(prefix="bench_upscale_")
-    executor = WorkflowExecutor(OpContext(output_dir=out_dir))
-
+    executor = WorkflowExecutor(OpContext(
+        output_dir=tempfile.mkdtemp(prefix="bench_fixture_")))
     t0 = time.time()
     res = executor.execute(build_graph())
     compile_s = time.time() - t0
-    assert res.images, "upscale produced no image"
-    shape = res.images[0].shape
-    log(f"compile+first {compile_s:.1f}s; output {shape}")
+    assert res.images, f"{label} produced no image"
+    log(f"compile+first {compile_s:.1f}s; output {res.images[0].shape}")
 
     payload = {
         "metric": metric_name(args),
@@ -505,13 +504,42 @@ def run_upscale(args):
         for _ in range(args.repeats):
             executor.execute(build_graph())
         sec = (time.time() - t0) / args.repeats
-        log(f"{args.repeats}x: {sec:.2f}s per {args.upscale_target}px image")
+        log(f"{args.repeats}x: {sec:.2f}s per image ({label})")
         payload.update(value=round(sec, 3), vs_baseline=1.0)
     else:
         # 0.0 sec/image would read as a flawless run on a lower-is-better
         # metric; mark compile-only explicitly
         payload["compile_only"] = True
     emit(args, payload)
+
+
+def run_upscale(args):
+    """BASELINE config 3: `distributed-upscale.json` (4x ESRGAN + SD tiled
+    refine) wall-clock per image, in-process single participant — the
+    reference's ``process_single_gpu`` analog.  Tile batch + blend run as
+    one compiled program (ops/tiled_upscale.py SPMD mode with data=1)."""
+    def override(g):
+        g.nodes["1"].inputs["image"] = "__bench_card__.png"  # synthetic
+        g.nodes["16"].inputs.update(width=args.upscale_target,
+                                    height=args.upscale_target)
+        g.nodes["2"].inputs.update(steps=args.steps, tile_width=args.tile,
+                                   tile_height=args.tile)
+
+    _run_fixture_bench(args, "distributed-upscale.json", override,
+                       f"upscale target={args.upscale_target}px")
+
+
+def run_img2img(args):
+    """BASELINE config 4: `distributed-img2img.json` (seed-offset
+    variation sweep over one VAE-encoded source) wall-clock per image,
+    in-process single participant."""
+    def override(g):
+        g.nodes["1"].inputs["image"] = "__bench_card__.png"
+        g.nodes["2"].inputs.update(width=args.width, height=args.height)
+        g.nodes["3"].inputs.update(steps=args.steps)
+
+    _run_fixture_bench(args, "distributed-img2img.json", override,
+                       f"img2img {args.width}x{args.height}")
 
 
 def run_scaling_sweep(args):
@@ -583,6 +611,8 @@ def main():
             run_scaling_sweep(args)
         elif args.upscale:
             run_upscale(args)
+        elif args.img2img:
+            run_img2img(args)
         else:
             run_throughput(args)
     except SystemExit:
