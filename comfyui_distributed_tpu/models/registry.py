@@ -252,7 +252,8 @@ class DiffusionPipeline:
                denoise: float = 1.0, y: Optional[jnp.ndarray] = None,
                add_noise: bool = True, sample_idx=None,
                start_step: int = 0, end_step: Optional[int] = None,
-               force_full_denoise: bool = False) -> jnp.ndarray:
+               force_full_denoise: bool = False,
+               noise_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         """Full ksampler: schedule -> noise -> scan-sampler -> latents.
 
         ``seeds``: per-sample host seed array [B] (64-bit ok; replica offsets
@@ -262,6 +263,11 @@ class DiffusionPipeline:
         KSamplerAdvanced): noise scales by the window's FIRST sigma, and
         stopping early returns a still-noisy latent for a later stage
         unless ``force_full_denoise`` zeroes the final sigma.
+        ``noise_mask`` [B_or_1, h, w, 1] in latent resolution inpaints: 1 =
+        resample, 0 = keep source.  ComfyUI's KSamplerX0Inpaint semantics —
+        every model call sees the source re-noised to the current sigma
+        outside the mask and its denoised output re-anchored to the clean
+        source there.
         The denoise loop is jit-compiled and cached per static config."""
         sigmas = jnp.asarray(sch.compute_sigmas(
             self.schedule, scheduler, steps, denoise))
@@ -282,15 +288,16 @@ class DiffusionPipeline:
                       float(denoise), bool(add_noise), y is not None,
                       tuple(latents.shape), tuple(context.shape),
                       polling_enabled(), start, end,
-                      bool(force_full_denoise))
+                      bool(force_full_denoise), noise_mask is not None)
 
         def make_core():
             has_y = y is not None
+            has_mask = noise_mask is not None
             cfg_scale = float(cfg)
             sampler = smp.get_sampler(sampler_name)
 
             def core(unet_params, latents, context, uncond_context, keys,
-                     sigmas, y_in):
+                     sigmas, y_in, mask_in):
                 den = make_denoiser(self.raw_unet_apply, unet_params,
                                     self.schedule, self.prediction_type)
                 model = smp.cfg_denoiser(den, context, uncond_context,
@@ -306,14 +313,37 @@ class DiffusionPipeline:
                 # txt2img passes zeros, so pure-noise starts fall out
                 x = latents + noise * sigmas[0] if add_noise else latents
                 extra = {"y": y2} if has_y else {}
-                return sampler(model, x, sigmas, extra_args=extra, keys=keys)
+                if has_mask:
+                    # inpainting (KSamplerX0Inpaint): every model call sees
+                    # the source re-noised to the CURRENT sigma outside the
+                    # mask, and its denoised output re-anchored to the
+                    # clean source there — so sampler math can't drift the
+                    # protected region.  With add_noise disabled the blend
+                    # noise is zero (ComfyUI's disable_noise: the input
+                    # latent IS the noised state already)
+                    inner = model
+                    mnoise = noise if add_noise else jnp.zeros_like(noise)
+
+                    def model(xi, sigma, **kw):  # noqa: F811
+                        s = sigma.reshape((-1,) + (1,) * (xi.ndim - 1))
+                        xi = xi * mask_in + (latents + mnoise * s) \
+                            * (1.0 - mask_in)
+                        out = inner(xi, sigma, **kw)
+                        return out * mask_in + latents * (1.0 - mask_in)
+
+                out = sampler(model, x, sigmas, extra_args=extra, keys=keys)
+                if has_mask:
+                    out = out * mask_in + latents * (1.0 - mask_in)
+                return out
 
             return jax.jit(core)
 
         core = self._cache_get_or_make(static_key, make_core)
         y_arg = y if y is not None else jnp.zeros((latents.shape[0], 1))
+        mask_arg = noise_mask if noise_mask is not None \
+            else jnp.ones((1, 1, 1, 1))
         return core(self.unet_params, latents, context, uncond_context,
-                    keys, sigmas, y_arg)
+                    keys, sigmas, y_arg, mask_arg)
 
     # --- internals ----------------------------------------------------------
 

@@ -158,9 +158,13 @@ class KSampler(Op):
                 steps=int(steps), cfg=float(cfg),
                 sampler_name=str(sampler_name), scheduler=str(scheduler),
                 denoise=float(denoise), y=prep.y,
-                sample_idx=prep.sample_idx)
-        return ({"samples": out, "local_batch": prep.local_batch,
-                 "fanout": prep.fanout},)
+                sample_idx=prep.sample_idx,
+                noise_mask=prep.noise_mask)
+        out_d = {"samples": out, "local_batch": prep.local_batch,
+                 "fanout": prep.fanout}
+        if "noise_mask" in latent_image:   # ComfyUI keeps the mask on the
+            out_d["noise_mask"] = latent_image["noise_mask"]  # latent
+        return (out_d,)
 
 
 @register_op
@@ -191,13 +195,17 @@ class KSamplerAdvanced(Op):
                 steps=int(steps), cfg=float(cfg),
                 sampler_name=str(sampler_name), scheduler=str(scheduler),
                 y=prep.y, sample_idx=prep.sample_idx,
+                noise_mask=prep.noise_mask,
                 add_noise=(str(add_noise) != "disable"),
                 start_step=int(start_at_step),
                 end_step=min(int(end_at_step), int(steps)),
                 force_full_denoise=(
                     str(return_with_leftover_noise) == "disable"))
-        return ({"samples": out, "local_batch": prep.local_batch,
-                 "fanout": prep.fanout},)
+        out_d = {"samples": out, "local_batch": prep.local_batch,
+                 "fanout": prep.fanout}
+        if "noise_mask" in latent_image:
+            out_d["noise_mask"] = latent_image["noise_mask"]
+        return (out_d,)
 
 
 @dataclasses.dataclass
@@ -214,6 +222,7 @@ class _SampleInputs:
     y: object
     local_batch: int
     fanout: int
+    noise_mask: object = None
 
 
 def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
@@ -251,9 +260,21 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
         if y is not None:
             y = coll.shard_batch(y, mesh)
 
+    mask = latent_image.get("noise_mask")
+    if mask is not None:
+        # image-res [B,H,W] -> latent-res [B,h,w,1] (area-downsampled);
+        # a single mask broadcasts across the whole (fanned) batch
+        m = np.asarray(mask, np.float32)
+        if m.ndim == 2:
+            m = m[None]
+        h, w = lat.shape[1], lat.shape[2]
+        m = resize_image(m[..., None], w, h, "area")
+        mask = jnp.asarray(np.clip(m, 0.0, 1.0))
+
     return _SampleInputs(latents=jnp.asarray(lat_dev), context=ctx_arr,
                          uncond=unc_arr, seeds=seeds, sample_idx=local_idx,
-                         y=y, local_batch=local_b, fanout=fanout)
+                         y=y, local_batch=local_b, fanout=fanout,
+                         noise_mask=mask)
 
 
 def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,
@@ -350,6 +371,61 @@ def _keep_fanout_meta(src, arr):
         return ImageBatch(arr, local_batch=getattr(src, "local_batch", None),
                           fanout=src.fanout)
     return arr
+
+
+@register_op
+class SetLatentNoiseMask(Op):
+    """Attach an inpaint mask to a latent batch (1 = resample, 0 = keep
+    source); samplers blend per ComfyUI's KSamplerX0Inpaint semantics."""
+    TYPE = "SetLatentNoiseMask"
+
+    def execute(self, ctx: OpContext, samples, mask):
+        m = np.asarray(mask, np.float32)
+        if m.ndim == 2:
+            m = m[None]
+        # meta spread FIRST: _latent_meta forwards any pre-existing
+        # noise_mask, and the NEW mask must win over it
+        out = {**_latent_meta(samples),
+               "samples": np.asarray(samples["samples"], np.float32),
+               "noise_mask": m}
+        return (out,)
+
+
+@register_op
+class VAEEncodeForInpaint(Op):
+    """ComfyUI's inpaint encode: neutralize the masked region to mid-gray
+    before encoding (so the encoder doesn't leak the old content into
+    neighboring latents), grow the mask, attach it as noise_mask."""
+    TYPE = "VAEEncodeForInpaint"
+    WIDGETS = ["grow_mask_by"]
+    DEFAULTS = {"grow_mask_by": 6}
+
+    def execute(self, ctx: OpContext, pixels, vae, mask,
+                grow_mask_by: int = 6):
+        img = np.asarray(as_image_array(pixels), np.float32)
+        m = np.asarray(mask, np.float32)
+        if m.ndim == 2:
+            m = m[None]
+        grow = max(int(grow_mask_by), 0)
+        if grow:
+            # dilate by max-pooling: a (2g+1)-square structuring element
+            from scipy import ndimage  # scipy ships with jax's deps
+            m = np.stack([ndimage.maximum_filter(mi, size=2 * grow + 1)
+                          for mi in m])
+        # neutralize with the GROWN mask: pixels anywhere in the grown
+        # band will be resampled, so their old content must not leak
+        # into the encoder (ComfyUI rounds the grown mask here)
+        hard = (m > 0.5).astype(np.float32)
+        img = (img - 0.5) * (1.0 - hard[..., None]) + 0.5
+        with Timer("vae_encode_inpaint"):
+            lat = vae.vae_encode(jnp.asarray(img))
+        b = int(lat.shape[0])
+        fanout = max(ctx.fanout, 1)
+        lat_np = np.asarray(lat)
+        if fanout > 1:
+            lat_np = np.tile(lat_np, (fanout, 1, 1, 1))
+        return ({"samples": lat_np, "noise_mask": m,
+                 "local_batch": b, "fanout": fanout},)
 
 
 class ImageBatch(np.ndarray):
@@ -509,7 +585,8 @@ def _latent_meta(samples) -> dict:
     """Fan-out metadata to carry through latent-space ops — one copy, so a
     future meta key can't be forwarded by one op and dropped by another
     (which would make a downstream VAEEncode re-tile a fanned batch)."""
-    return {k: samples[k] for k in ("local_batch", "fanout")
+    return {k: samples[k] for k in ("local_batch", "fanout",
+                                    "noise_mask")
             if k in samples}
 
 

@@ -494,3 +494,107 @@ class TestUtilityOps:
         trees_equal(u2, pipe.unet_params)
         trees_equal(c2[0], pipe.clip_params[0])
         trees_equal(v2, pipe.vae_params)
+
+
+class TestInpainting:
+    """noise_mask sampling (KSamplerX0Inpaint semantics), mask ops."""
+
+    def _pipe(self):
+        return registry.load_pipeline("inpaint.ckpt")
+
+    def test_unmasked_region_anchored_to_source(self):
+        """mask=1 resamples; mask=0 returns the source latent EXACTLY
+        (the final output is re-anchored to the clean source there)."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        pipe = self._pipe()
+        rng = np.random.default_rng(5)
+        src = rng.standard_normal((1, 8, 8, 4)).astype(np.float32)
+        mask = np.zeros((1, 16, 16), np.float32)   # image res (downscale 2)
+        mask[:, :, 8:] = 1.0                       # right half inpainted
+        lat = {"samples": src, "noise_mask": mask}
+        ctx_arr, _ = pipe.encode_prompt(["replace"])
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        pos = Conditioning(context=ctx_arr, pooled=None)
+        (out,) = get_op("KSampler").execute(
+            OpContext(), pipe, 11, 4, 1.5, "euler", "normal", pos, pos,
+            lat, 1.0)
+        o = np.asarray(out["samples"])
+        np.testing.assert_array_equal(o[:, :, :4], src[:, :, :4])  # kept
+        assert not np.allclose(o[:, :, 4:], src[:, :, 4:])         # redone
+        assert out["noise_mask"] is mask  # mask stays on the latent
+
+    def test_no_mask_output_differs_everywhere(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        pipe = self._pipe()
+        src = np.random.default_rng(6).standard_normal(
+            (1, 8, 8, 4)).astype(np.float32)
+        ctx_arr, _ = pipe.encode_prompt(["x"])
+        pos = Conditioning(context=ctx_arr, pooled=None)
+        (out,) = get_op("KSampler").execute(
+            OpContext(), pipe, 11, 2, 1.5, "euler", "normal", pos, pos,
+            {"samples": src}, 1.0)
+        assert not np.allclose(np.asarray(out["samples"]), src)
+
+    def test_set_latent_noise_mask_op(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32),
+               "local_batch": 1, "fanout": 1}
+        m = np.ones((16, 16), np.float32)
+        (out,) = get_op("SetLatentNoiseMask").execute(OpContext(), lat, m)
+        assert out["noise_mask"].shape == (1, 16, 16)
+        assert out["local_batch"] == 1
+
+    def test_set_mask_replaces_existing_mask(self):
+        """A new mask must WIN over one already on the latent (forwarded
+        by sampler outputs) — spread-order regression."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        old = np.zeros((1, 16, 16), np.float32)
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32),
+               "noise_mask": old}
+        new = np.ones((16, 16), np.float32)
+        (out,) = get_op("SetLatentNoiseMask").execute(OpContext(), lat, new)
+        assert out["noise_mask"].sum() == 16 * 16, "old mask survived"
+
+    def test_masked_add_noise_disable_keeps_source_unnoised(self):
+        """Stage-2 inpaint (add_noise=disable): the protected region's
+        blend must use ZERO noise — the input latent already is the noised
+        state (ComfyUI disable_noise semantics)."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        pipe = self._pipe()
+        rng = np.random.default_rng(8)
+        src = rng.standard_normal((1, 8, 8, 4)).astype(np.float32)
+        mask = np.zeros((1, 16, 16), np.float32)
+        mask[:, :, 8:] = 1.0
+        ctx_arr, _ = pipe.encode_prompt(["x"])
+        pos = Conditioning(context=ctx_arr, pooled=None)
+        lat = {"samples": src, "noise_mask": mask}
+        (out,) = get_op("KSamplerAdvanced").execute(
+            OpContext(), pipe, "disable", 11, 4, 1.5, "euler", "normal",
+            pos, pos, lat, 2, 10000, "disable")
+        o = np.asarray(out["samples"])
+        np.testing.assert_array_equal(o[:, :, :4], src[:, :, :4])
+        assert not np.allclose(o[:, :, 4:], src[:, :, 4:])
+
+    def test_vae_encode_for_inpaint(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        pipe = self._pipe()
+        img = np.full((1, 16, 16, 3), 0.9, np.float32)
+        mask = np.zeros((1, 16, 16), np.float32)
+        mask[:, 6:10, 6:10] = 1.0
+        (out,) = get_op("VAEEncodeForInpaint").execute(
+            OpContext(), img, pipe, mask, 2)
+        assert "noise_mask" in out
+        # grown mask covers MORE area than the input mask
+        assert out["noise_mask"].sum() > mask.sum()
+        ds = pipe.family.vae.downscale
+        assert out["samples"].shape == (1, 16 // ds, 16 // ds, 4)
+
+    def test_mask_survives_latent_ops(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32),
+               "noise_mask": np.ones((1, 16, 16), np.float32)}
+        (up,) = get_op("LatentUpscaleBy").execute(OpContext(), lat,
+                                                  "bilinear", 2.0)
+        assert "noise_mask" in up
