@@ -357,6 +357,67 @@ def _run_unet(m, cfg: UNetConfig):
     return m.finish("UNet")
 
 
+def _run_controlnet(m, cfg: UNetConfig):
+    """Walk the torch ControlNet layout (``control_model.*``): the UNet
+    encoder enumeration plus input_hint_block / zero_convs /
+    middle_block_out (models/controlnet.py mirrors the flax names)."""
+    from comfyui_distributed_tpu.models.controlnet import HINT_CHANNELS
+    m.linear("time_embed.0", "time_fc1")
+    m.linear("time_embed.2", "time_fc2")
+    if cfg.adm_in_channels is not None:
+        m.linear("label_emb.0.0", "label_fc1")
+        m.linear("label_emb.0.2", "label_fc2")
+    m.conv("input_blocks.0.0", "conv_in")
+
+    # hint encoder: torch Sequential with SiLU between convs — conv
+    # modules sit at even indices 0,2,4,...,14
+    for i in range(len(HINT_CHANNELS) + 1):
+        m.conv(f"input_hint_block.{2 * i}", f"hint_conv_{i}")
+
+    L = cfg.num_levels
+    idx, zi = 1, 1
+    m.conv("zero_convs.0.0", "zero_conv_0")
+    for level in range(L):
+        for i in range(cfg.num_res_blocks):
+            _map_resblock(m, f"input_blocks.{idx}.0", f"down_{level}_res_{i}")
+            if cfg.transformer_depth[level] > 0:
+                _map_spatial_transformer(
+                    m, f"input_blocks.{idx}.1", f"down_{level}_attn_{i}",
+                    cfg.transformer_depth[level],
+                    linear_proj=cfg.use_linear_in_transformer)
+            m.conv(f"zero_convs.{zi}.0", f"zero_conv_{zi}")
+            idx += 1
+            zi += 1
+        if level != L - 1:
+            m.conv(f"input_blocks.{idx}.0.op", f"down_{level}_ds/conv")
+            m.conv(f"zero_convs.{zi}.0", f"zero_conv_{zi}")
+            idx += 1
+            zi += 1
+
+    _map_resblock(m, "middle_block.0", "mid_res_0")
+    _map_spatial_transformer(m, "middle_block.1", "mid_attn",
+                             max(cfg.transformer_depth[-1], 1),
+                             linear_proj=cfg.use_linear_in_transformer)
+    _map_resblock(m, "middle_block.2", "mid_res_1")
+    m.conv("middle_block_out.0", "mid_out")
+    return m.finish("ControlNet")
+
+
+CONTROLNET_PREFIX = "control_model."
+
+
+def load_controlnet(path: str, cfg: UNetConfig):
+    """ControlNet ``.pth``/``.safetensors`` -> flax params."""
+    sd = load_state_dict(path)
+    prefix = CONTROLNET_PREFIX if any(
+        k.startswith(CONTROLNET_PREFIX) for k in sd) else ""
+    return _run_controlnet(_LoadMapper(sd, prefix), cfg)
+
+
+def export_controlnet(params, cfg: UNetConfig):
+    return _run_controlnet(_ExportMapper(params, CONTROLNET_PREFIX), cfg)
+
+
 # --- VAE walk ----------------------------------------------------------------
 
 def _map_vae_resblock(m, tkey: str, fpath: str) -> None:

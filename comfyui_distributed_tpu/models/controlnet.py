@@ -1,0 +1,123 @@
+"""ControlNet (Zhang et al.): a trainable copy of the UNet encoder that
+injects spatial-hint residuals into the paired UNet's skips and middle.
+
+The reference delegates ControlNet entirely to ComfyUI
+(``ControlNetLoader``/``ControlNetApply`` nodes used inside the workflows
+it fans out); here the flax module mirrors this framework's own UNet
+encoder **module-for-module with the same names** (``models/unet.py``
+down path), so the checkpoint converter reuses the exact same mapping
+walks for the shared structure (torch layout ``control_model.*`` —
+input_blocks/middle_block enumeration identical to the UNet's, plus
+``input_hint_block``, ``zero_convs``, ``middle_block_out``).
+
+TPU notes: the hint is encoded once per sampling step at the CFG batch
+size (one extra batched conv stack + encoder pass per step — large MXU
+matmuls, no host sync); zero-convs are 1x1 convs that XLA fuses into the
+adjacent adds.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from comfyui_distributed_tpu.models.layers import (
+    Downsample,
+    ResBlock,
+    SpatialTransformer,
+    timestep_embedding,
+)
+from comfyui_distributed_tpu.models.unet import UNetConfig
+
+# input_hint_block channel/stride ladder (torch ControlNet: 8 convs, three
+# stride-2 steps take the image-res hint down 8x to latent resolution)
+HINT_CHANNELS = (16, 16, 32, 32, 96, 96, 256)
+HINT_STRIDES = (1, 1, 2, 1, 2, 1, 2)
+
+
+class ControlNet(nn.Module):
+    """Returns (skip_residuals, middle_residual) for a paired UNet."""
+
+    cfg: UNetConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, timesteps: jax.Array,
+                 context: jax.Array, hint: jax.Array,
+                 y: Optional[jax.Array] = None
+                 ) -> Tuple[List[jax.Array], jax.Array]:
+        """x: [B,h,w,C] latent (same scaled input the UNet sees);
+        hint: [B,H,W,3] image-resolution control map in [0,1]."""
+        cfg = self.cfg
+        ch = cfg.model_channels
+        time_dim = ch * 4
+
+        emb = timestep_embedding(timesteps, ch)
+        emb = nn.Dense(time_dim, dtype=cfg.dtype, name="time_fc1")(emb)
+        emb = nn.Dense(time_dim, dtype=cfg.dtype,
+                       name="time_fc2")(nn.silu(emb))
+        if cfg.adm_in_channels is not None:
+            if y is None:
+                y = jnp.zeros((x.shape[0], cfg.adm_in_channels), x.dtype)
+            lab = nn.Dense(time_dim, dtype=cfg.dtype, name="label_fc1")(y)
+            lab = nn.Dense(time_dim, dtype=cfg.dtype,
+                           name="label_fc2")(nn.silu(lab))
+            emb = emb + lab
+
+        # hint encoder: image res -> latent res, final zero-init conv
+        g = hint.astype(cfg.dtype)
+        for i, (hc, st) in enumerate(zip(HINT_CHANNELS, HINT_STRIDES)):
+            g = nn.Conv(hc, (3, 3), strides=(st, st), padding=1,
+                        dtype=cfg.dtype, name=f"hint_conv_{i}")(g)
+            g = nn.silu(g)
+        g = nn.Conv(ch, (3, 3), padding=1, dtype=cfg.dtype,
+                    kernel_init=nn.initializers.zeros,
+                    name=f"hint_conv_{len(HINT_CHANNELS)}")(g)
+
+        def heads(c: int) -> int:
+            if cfg.num_heads is not None:
+                return cfg.num_heads
+            return max(c // cfg.num_head_channels, 1)
+
+        def zero_conv(h: jax.Array, i: int) -> jax.Array:
+            return nn.Conv(h.shape[-1], (1, 1), dtype=cfg.dtype,
+                           kernel_init=nn.initializers.zeros,
+                           name=f"zero_conv_{i}")(h)
+
+        h = nn.Conv(ch, (3, 3), padding=1, dtype=cfg.dtype,
+                    name="conv_in")(x)
+        h = h + g
+        outs = [zero_conv(h, 0)]
+        zi = 1
+
+        # down path — identical structure and names to the UNet encoder
+        for level, mult in enumerate(cfg.channel_mult):
+            out_ch = ch * mult
+            for i in range(cfg.num_res_blocks):
+                h = ResBlock(out_ch, dtype=cfg.dtype,
+                             name=f"down_{level}_res_{i}")(h, emb)
+                if cfg.transformer_depth[level] > 0:
+                    h = SpatialTransformer(
+                        heads(out_ch), depth=cfg.transformer_depth[level],
+                        dtype=cfg.dtype, attn_impl=cfg.attn_impl,
+                        name=f"down_{level}_attn_{i}")(h, context)
+                outs.append(zero_conv(h, zi))
+                zi += 1
+            if level != cfg.num_levels - 1:
+                h = Downsample(dtype=cfg.dtype, name=f"down_{level}_ds")(h)
+                outs.append(zero_conv(h, zi))
+                zi += 1
+
+        mid_ch = ch * cfg.channel_mult[-1]
+        h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_0")(h, emb)
+        h = SpatialTransformer(
+            heads(mid_ch), depth=max(cfg.transformer_depth[-1], 1),
+            dtype=cfg.dtype, attn_impl=cfg.attn_impl,
+            name="mid_attn")(h, context)
+        h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_1")(h, emb)
+        mid = nn.Conv(mid_ch, (1, 1), dtype=cfg.dtype,
+                      kernel_init=nn.initializers.zeros, name="mid_out")(h)
+
+        return outs, mid

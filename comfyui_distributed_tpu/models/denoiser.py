@@ -17,10 +17,15 @@ from comfyui_distributed_tpu.models.schedules import DiscreteSchedule
 
 
 def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
-                  prediction_type: str = "eps") -> Callable:
+                  prediction_type: str = "eps",
+                  control: Optional[tuple] = None) -> Callable:
     """Build ``model(x, sigma, context=..., y=...) -> denoised``.
 
-    ``apply_fn(params, x, timesteps, context, y)`` is the raw UNet.
+    ``apply_fn(params, x, timesteps, context, y, control)`` is the raw
+    UNet.  ``control`` = (cn_apply, cn_params, hint, strength) runs a
+    ControlNet on the SAME scaled input/timestep the UNet sees each call
+    and feeds its residuals (scaled by strength) into the UNet; the hint
+    broadcasts over CFG's doubled batch.
     """
     log_sigmas = jnp.asarray(jnp.log(jnp.asarray(ds.sigmas)))
 
@@ -41,7 +46,15 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
         c_in = 1.0 / jnp.sqrt(sigma ** 2 + 1.0)
         t = t_from_sigma(sigma)
         ts = jnp.broadcast_to(t, (x.shape[0],))
-        eps_or_v = apply_fn(params, x * c_in, ts, context, y)
+        xin = x * c_in
+        ctrl = None
+        if control is not None:
+            cn_apply, cn_params, hint, strength = control
+            reps = xin.shape[0] // hint.shape[0]
+            hb = jnp.concatenate([hint] * reps, axis=0) if reps > 1 else hint
+            outs, mid = cn_apply(cn_params, xin, ts, context, hb, y)
+            ctrl = ([o * strength for o in outs], mid * strength)
+        eps_or_v = apply_fn(params, xin, ts, context, y, ctrl)
         if prediction_type == "v":
             # v-prediction: denoised = c_skip*x - c_out*v  (VP parameterization)
             c_skip = 1.0 / (sigma ** 2 + 1.0)

@@ -239,8 +239,9 @@ class DiffusionPipeline:
 
     # --- denoising ----------------------------------------------------------
 
-    def raw_unet_apply(self, params, x, t, context, y=None):
-        return self.unet.apply({"params": params}, x, t, context, y=y)
+    def raw_unet_apply(self, params, x, t, context, y=None, control=None):
+        return self.unet.apply({"params": params}, x, t, context, y=y,
+                               control=control)
 
     def denoiser(self):
         return make_denoiser(self.raw_unet_apply, self.unet_params,
@@ -253,7 +254,8 @@ class DiffusionPipeline:
                add_noise: bool = True, sample_idx=None,
                start_step: int = 0, end_step: Optional[int] = None,
                force_full_denoise: bool = False,
-               noise_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+               noise_mask: Optional[jnp.ndarray] = None,
+               control=None) -> jnp.ndarray:
         """Full ksampler: schedule -> noise -> scan-sampler -> latents.
 
         ``seeds``: per-sample host seed array [B] (64-bit ok; replica offsets
@@ -288,18 +290,30 @@ class DiffusionPipeline:
                       float(denoise), bool(add_noise), y is not None,
                       tuple(latents.shape), tuple(context.shape),
                       polling_enabled(), start, end,
-                      bool(force_full_denoise), noise_mask is not None)
+                      bool(force_full_denoise), noise_mask is not None,
+                      control is not None,
+                      float(control[3]) if control is not None else 0.0)
 
         def make_core():
             has_y = y is not None
             has_mask = noise_mask is not None
+            has_control = control is not None
             cfg_scale = float(cfg)
             sampler = smp.get_sampler(sampler_name)
+            if has_control:
+                cn_module, _, _, cn_strength = control
+
+                def cn_apply(p, xi, ts, ctx, hint, y_in):
+                    return cn_module.apply({"params": p}, xi, ts, ctx,
+                                           hint, y_in)
 
             def core(unet_params, latents, context, uncond_context, keys,
-                     sigmas, y_in, mask_in):
+                     sigmas, y_in, mask_in, cn_params, hint_in):
+                ctrl_spec = (cn_apply, cn_params, hint_in,
+                             float(cn_strength)) if has_control else None
                 den = make_denoiser(self.raw_unet_apply, unet_params,
-                                    self.schedule, self.prediction_type)
+                                    self.schedule, self.prediction_type,
+                                    control=ctrl_spec)
                 model = smp.cfg_denoiser(den, context, uncond_context,
                                          cfg_scale)
                 y2 = y_in
@@ -342,8 +356,11 @@ class DiffusionPipeline:
         y_arg = y if y is not None else jnp.zeros((latents.shape[0], 1))
         mask_arg = noise_mask if noise_mask is not None \
             else jnp.ones((1, 1, 1, 1))
+        cn_params_arg = control[1] if control is not None else {}
+        hint_arg = control[2] if control is not None \
+            else jnp.zeros((1, 8, 8, 3))
         return core(self.unet_params, latents, context, uncond_context,
-                    keys, sigmas, y_arg, mask_arg)
+                    keys, sigmas, y_arg, mask_arg, cn_params_arg, hint_arg)
 
     # --- internals ----------------------------------------------------------
 
@@ -510,6 +527,55 @@ def derive_pipeline(base: DiffusionPipeline, tag: str,
         while len(_derived_cache) > _DERIVED_CACHE_CAP:
             _derived_cache.popitem(last=False)
     return clone
+
+
+def load_controlnet(cn_name: str, models_dir: Optional[str] = None,
+                    family_name: Optional[str] = None):
+    """ControlNetLoader equivalent -> (module, params); virtual when no
+    file exists (deterministic from the name, zero-convs start at zero so
+    a fresh virtual ControlNet is an exact no-op on the UNet)."""
+    fam = FAMILIES[family_name or os.environ.get(FAMILY_ENV) or "sd15"]
+    key = f"cn:{cn_name}:{fam.name}:{models_dir or ''}"
+    with _pipeline_lock:
+        if key in _pipeline_cache:
+            return _pipeline_cache[key]
+
+    from comfyui_distributed_tpu.models.controlnet import ControlNet
+    module = ControlNet(fam.unet)
+    path = None
+    if models_dir:
+        cand = os.path.join(models_dir, cn_name.replace("\\", "/"))
+        if os.path.exists(cand):
+            path = cand
+    if path is not None:
+        from comfyui_distributed_tpu.models.checkpoints import (
+            load_controlnet as load_cn_file)
+        params = load_cn_file(path, fam.unet)
+        log(f"loaded ControlNet {cn_name} ({fam.name}) from {path}")
+    else:
+        seed = _name_seed(cn_name)
+        x = jnp.zeros((1, 8, 8, fam.latent_channels))
+        ts = jnp.zeros((1,))
+        ctx = jnp.zeros((1, 77, fam.unet.context_dim))
+        hint = jnp.zeros((1, 64, 64, 3))
+        params = _virtual_params(module, seed, x, ts, ctx, hint)
+        # restore the untrained-ControlNet invariant _virtual_params'
+        # random fill breaks: zero projections make a fresh net an exact
+        # UNet no-op (the property real zero-init checkpoints have)
+        from comfyui_distributed_tpu.models.controlnet import HINT_CHANNELS
+        zero_mods = {f"zero_conv_{i}" for i in range(64)} | {
+            "mid_out", f"hint_conv_{len(HINT_CHANNELS)}"}
+        for name in list(params):
+            if name in zero_mods:
+                params[name] = jax.tree_util.tree_map(
+                    lambda a: np.zeros_like(a), params[name])
+        log(f"virtual ControlNet {cn_name!r} ({fam.name}): no file on "
+            f"disk, deterministic init (seed {seed}, zero projections)")
+
+    entry = (module, params)
+    with _pipeline_lock:
+        _pipeline_cache[key] = entry
+    return entry
 
 
 def load_vae(vae_name: str, models_dir: Optional[str] = None,

@@ -94,9 +94,13 @@ class UNet(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, timesteps: jax.Array,
-                 context: jax.Array, y: Optional[jax.Array] = None) -> jax.Array:
+                 context: jax.Array, y: Optional[jax.Array] = None,
+                 control=None) -> jax.Array:
         """x: [B,H,W,C_in] latent; timesteps: [B]; context: [B,M,Cc] text
-        tokens; y: [B, adm_in] optional vector conditioning (SDXL)."""
+        tokens; y: [B, adm_in] optional vector conditioning (SDXL);
+        control: optional ControlNet residuals ``(skip_list, middle)`` —
+        one entry per skip in down-path order, added torch-style
+        (``hs[i] + control[i]``, middle added after the middle block)."""
         cfg = self.cfg
         ch = cfg.model_channels
         time_dim = ch * 4
@@ -136,6 +140,10 @@ class UNet(nn.Module):
                 h = Downsample(dtype=cfg.dtype, name=f"down_{level}_ds")(h)
                 skips.append(h)
 
+        if control is not None:
+            ctrl_skips, ctrl_mid = control
+            skips = [s + c for s, c in zip(skips, ctrl_skips)]
+
         # middle
         mid_ch = ch * cfg.channel_mult[-1]
         h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_0")(h, emb)
@@ -143,6 +151,8 @@ class UNet(nn.Module):
             heads(mid_ch), depth=max(cfg.transformer_depth[-1], 1),
             dtype=cfg.dtype, attn_impl=cfg.attn_impl, name="mid_attn")(h, context)
         h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_1")(h, emb)
+        if control is not None:
+            h = h + ctrl_mid
 
         # up path
         for level in reversed(range(cfg.num_levels)):

@@ -25,6 +25,9 @@ class Conditioning:
     """CLIP encoding result (comfy CONDITIONING)."""
     context: Any          # [1, T, C]
     pooled: Any = None    # [1, P]
+    # attached ControlNet: (module, params, hint_image, strength);
+    # ComfyUI hangs control on conditioning entries the same way
+    control: Any = None
 
 
 @dataclasses.dataclass

@@ -109,6 +109,42 @@ class VAELoader(Op):
 
 
 @register_op
+class ControlNetLoader(Op):
+    """-> CONTROL_NET (module, params); virtual-initializes when no file
+    exists (zero-convs make a fresh virtual net an exact UNet no-op)."""
+    TYPE = "ControlNetLoader"
+    WIDGETS = ["control_net_name"]
+
+    def execute(self, ctx: OpContext, control_net_name: str):
+        return (registry.load_controlnet(str(control_net_name),
+                                         models_dir=ctx.models_dir),)
+
+
+@register_op
+class ControlNetApply(Op):
+    """Attach a ControlNet + hint image to a conditioning at the given
+    strength.  One divergence from ComfyUI, by construction of the
+    TPU-friendly single doubled-batch CFG call: the control applies to
+    the whole CFG batch (cond AND uncond halves), equivalent to applying
+    it to both conditionings."""
+    TYPE = "ControlNetApply"
+    WIDGETS = ["strength"]
+    DEFAULTS = {"strength": 1.0}
+
+    def execute(self, ctx: OpContext, conditioning: Conditioning,
+                control_net, image, strength: float = 1.0):
+        if float(strength) == 0.0:
+            # ComfyUI early-returns: zero strength must not pay a full
+            # encoder forward per step for a guaranteed no-op
+            return (conditioning,)
+        module, params = control_net
+        hint = np.asarray(as_image_array(image), np.float32)
+        return (dataclasses.replace(
+            conditioning, control=(module, params, hint,
+                                   float(strength))),)
+
+
+@register_op
 class CLIPTextEncode(Op):
     TYPE = "CLIPTextEncode"
     WIDGETS = ["text"]
@@ -159,7 +195,7 @@ class KSampler(Op):
                 sampler_name=str(sampler_name), scheduler=str(scheduler),
                 denoise=float(denoise), y=prep.y,
                 sample_idx=prep.sample_idx,
-                noise_mask=prep.noise_mask)
+                noise_mask=prep.noise_mask, control=prep.control)
         out_d = {"samples": out, "local_batch": prep.local_batch,
                  "fanout": prep.fanout}
         if "noise_mask" in latent_image:   # ComfyUI keeps the mask on the
@@ -195,7 +231,7 @@ class KSamplerAdvanced(Op):
                 steps=int(steps), cfg=float(cfg),
                 sampler_name=str(sampler_name), scheduler=str(scheduler),
                 y=prep.y, sample_idx=prep.sample_idx,
-                noise_mask=prep.noise_mask,
+                noise_mask=prep.noise_mask, control=prep.control,
                 add_noise=(str(add_noise) != "disable"),
                 start_step=int(start_at_step),
                 end_step=min(int(end_at_step), int(steps)),
@@ -223,6 +259,7 @@ class _SampleInputs:
     local_batch: int
     fanout: int
     noise_mask: object = None
+    control: object = None
 
 
 def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
@@ -260,6 +297,28 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
         if y is not None:
             y = coll.shard_batch(y, mesh)
 
+    # control may hang on either conditioning entry (ComfyUI honors both);
+    # positive wins when both carry one
+    control = getattr(positive, "control", None) \
+        or getattr(negative, "control", None)
+    if control is not None:
+        # hint image -> the resolution the hint ladder expects (8x the
+        # latent dims — families with other VAE downscales still align)
+        module, params, hint, strength = control
+        hh, ww = lat.shape[1] * 8, lat.shape[2] * 8
+        if hint.shape[1] != hh or hint.shape[2] != ww:
+            hint = resize_image(hint, ww, hh, "bilinear")
+        if hint.shape[0] != total:
+            # exactly one hint per sample, cycling a short batch — the
+            # denoiser's CFG doubling then pairs [hint;hint] with
+            # [cond;uncond] rows one-to-one
+            hint = np.take(hint, np.arange(total) % hint.shape[0], axis=0)
+        hint_dev = hint
+        if fanout > 1 and ctx.runtime is not None:
+            hint_dev = coll.shard_batch(np.asarray(hint, np.float32),
+                                        ctx.runtime.mesh)
+        control = (module, params, jnp.asarray(hint_dev), strength)
+
     mask = latent_image.get("noise_mask")
     if mask is not None:
         # image-res [B,H,W] -> latent-res [B,h,w,1] (area-downsampled);
@@ -274,7 +333,7 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
     return _SampleInputs(latents=jnp.asarray(lat_dev), context=ctx_arr,
                          uncond=unc_arr, seeds=seeds, sample_idx=local_idx,
                          y=y, local_batch=local_b, fanout=fanout,
-                         noise_mask=mask)
+                         noise_mask=mask, control=control)
 
 
 def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,
@@ -454,7 +513,9 @@ class ConditioningConcat(Op):
         return (Conditioning(
             context=jnp.concatenate([conditioning_to.context,
                                      conditioning_from.context], axis=1),
-            pooled=conditioning_to.pooled),)
+            pooled=conditioning_to.pooled,
+            control=conditioning_to.control
+            or conditioning_from.control),)
 
 
 @register_op
@@ -484,7 +545,9 @@ class ConditioningAverage(Op):
             pooled = pooled * w + conditioning_from.pooled * (1.0 - w)
         elif pooled is None:
             pooled = conditioning_from.pooled
-        return (Conditioning(context=ctx_out, pooled=pooled),)
+        return (Conditioning(context=ctx_out, pooled=pooled,
+                             control=conditioning_to.control
+                             or conditioning_from.control),)
 
 
 @register_op

@@ -1,0 +1,150 @@
+"""ControlNet: module structure, converter round-trip, sampling effect."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models import checkpoints as ckpt
+from comfyui_distributed_tpu.models import registry as reg
+from comfyui_distributed_tpu.models.controlnet import ControlNet
+from comfyui_distributed_tpu.models.unet import TINY_CONFIG, UNet
+from comfyui_distributed_tpu.ops.base import Conditioning, OpContext, get_op
+
+
+@pytest.fixture(autouse=True)
+def tiny_family(monkeypatch):
+    monkeypatch.setenv(reg.FAMILY_ENV, "tiny")
+    yield
+
+
+def _cn_inputs(B=1, h=8, w=8):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((B, h, w, 4)), jnp.float32)
+    ts = jnp.zeros((B,))
+    ctx = jnp.asarray(rng.standard_normal((B, 77, TINY_CONFIG.context_dim)),
+                      jnp.float32)
+    hint = jnp.asarray(rng.uniform(0, 1, (B, h * 8, w * 8, 3)), jnp.float32)
+    return x, ts, ctx, hint
+
+
+class TestModule:
+    def test_residual_count_matches_unet_skips(self):
+        """One residual per UNet skip (conv_in + per-block + downsamples)
+        plus the middle — the zip in UNet.__call__ must cover every skip."""
+        cn = ControlNet(TINY_CONFIG)
+        x, ts, ctx, hint = _cn_inputs()
+        params = cn.init(jax.random.PRNGKey(0), x, ts, ctx, hint)["params"]
+        outs, mid = cn.apply({"params": params}, x, ts, ctx, hint)
+        # tiny config: 2 levels x 1 res block + 1 downsample + conv_in = 4
+        n_skips = 1 + sum(
+            TINY_CONFIG.num_res_blocks + (1 if lvl != len(
+                TINY_CONFIG.channel_mult) - 1 else 0)
+            for lvl in range(len(TINY_CONFIG.channel_mult)))
+        assert len(outs) == n_skips
+        assert mid.shape[-1] == TINY_CONFIG.model_channels * \
+            TINY_CONFIG.channel_mult[-1]
+
+    def test_fresh_init_is_unet_noop(self):
+        """Zero-convs initialize to zero: an untrained ControlNet must not
+        change the UNet output AT ALL (the property that makes ControlNet
+        trainable from a copy)."""
+        unet = UNet(TINY_CONFIG)
+        cn = ControlNet(TINY_CONFIG)
+        x, ts, ctx, hint = _cn_inputs()
+        up = unet.init(jax.random.PRNGKey(0), x, ts, ctx)["params"]
+        cp = cn.init(jax.random.PRNGKey(1), x, ts, ctx, hint)["params"]
+        outs, mid = cn.apply({"params": cp}, x, ts, ctx, hint)
+        base = unet.apply({"params": up}, x, ts, ctx)
+        ctrl = unet.apply({"params": up}, x, ts, ctx,
+                          control=(list(outs), mid))
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(ctrl))
+
+    def test_nonzero_residuals_change_unet_output(self):
+        unet = UNet(TINY_CONFIG)
+        cn = ControlNet(TINY_CONFIG)
+        x, ts, ctx, hint = _cn_inputs()
+        up = unet.init(jax.random.PRNGKey(0), x, ts, ctx)["params"]
+        cp = cn.init(jax.random.PRNGKey(1), x, ts, ctx, hint)["params"]
+        # un-zero the zero convs (simulating a trained net)
+        cp = jax.tree_util.tree_map(
+            lambda a: a + 0.05 if a.ndim >= 1 else a, cp)
+        outs, mid = cn.apply({"params": cp}, x, ts, ctx, hint)
+        base = unet.apply({"params": up}, x, ts, ctx)
+        ctrl = unet.apply({"params": up}, x, ts, ctx,
+                          control=(list(outs), mid))
+        assert not np.allclose(np.asarray(base), np.asarray(ctrl))
+
+
+class TestConverter:
+    def test_round_trip_exact(self):
+        cn = ControlNet(TINY_CONFIG)
+        x, ts, ctx, hint = _cn_inputs()
+        params = cn.init(jax.random.PRNGKey(2), x, ts, ctx, hint)["params"]
+        sd = ckpt.export_controlnet(params, TINY_CONFIG)
+        assert any(k.startswith("control_model.input_hint_block.0")
+                   for k in sd)
+        assert any(k.startswith("control_model.zero_convs.0.0") for k in sd)
+        assert "control_model.middle_block_out.0.weight" in sd
+        p2 = ckpt._run_controlnet(
+            ckpt._LoadMapper(sd, ckpt.CONTROLNET_PREFIX), TINY_CONFIG)
+        fa = jax.tree_util.tree_leaves_with_path(params)
+        fb = dict(jax.tree_util.tree_leaves_with_path(p2))
+        assert len(fa) == len(fb)
+        for path_k, leaf in fa:
+            np.testing.assert_array_equal(np.asarray(leaf),
+                                          np.asarray(fb[path_k]),
+                                          err_msg=str(path_k))
+
+
+class TestSamplingAndOps:
+    def test_control_changes_sample_output(self):
+        """ControlNetApply with a non-trivial net changes the sample; a
+        fresh virtual net (zero-convs) is bit-identical to no control."""
+        pipe = reg.load_pipeline("cn-base.ckpt")
+        module, params = reg.load_controlnet("tile_cn.safetensors")
+        ctx_arr, _ = pipe.encode_prompt(["a house"])
+        pos = Conditioning(context=ctx_arr, pooled=None)
+        hint = np.random.default_rng(3).uniform(
+            0, 1, (1, 64, 64, 3)).astype(np.float32)
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        op = get_op("KSampler")
+
+        (plain,) = op.execute(OpContext(), pipe, 9, 2, 1.5, "euler",
+                              "normal", pos, pos, lat, 1.0)
+        # virtual net: zero-convs are zero -> exact no-op
+        (apod,) = get_op("ControlNetApply").execute(
+            OpContext(), pos, (module, params), hint, 1.0)
+        (zeroed,) = op.execute(OpContext(), pipe, 9, 2, 1.5, "euler",
+                               "normal", apod, pos, lat, 1.0)
+        np.testing.assert_array_equal(np.asarray(plain["samples"]),
+                                      np.asarray(zeroed["samples"]))
+        # "trained" net: un-zero everything -> output must change
+        params2 = jax.tree_util.tree_map(lambda a: a + 0.05, params)
+        (apod2,) = get_op("ControlNetApply").execute(
+            OpContext(), pos, (module, params2), hint, 1.0)
+        (ctrl,) = op.execute(OpContext(), pipe, 9, 2, 1.5, "euler",
+                             "normal", apod2, pos, lat, 1.0)
+        assert not np.allclose(np.asarray(plain["samples"]),
+                               np.asarray(ctrl["samples"]))
+        # strength 0 restores the plain result exactly? (residuals scaled
+        # to zero — the UNet sees zero additions)
+        (apod0,) = get_op("ControlNetApply").execute(
+            OpContext(), pos, (module, params2), hint, 0.0)
+        (s0,) = op.execute(OpContext(), pipe, 9, 2, 1.5, "euler",
+                           "normal", apod0, pos, lat, 1.0)
+        np.testing.assert_allclose(np.asarray(plain["samples"]),
+                                   np.asarray(s0["samples"]),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_loader_cached_and_virtual_deterministic(self):
+        a = reg.load_controlnet("depth.safetensors")
+        b = reg.load_controlnet("depth.safetensors")
+        assert a is b
+        reg.clear_pipeline_cache()
+        c = reg.load_controlnet("depth.safetensors")
+        la = jax.tree_util.tree_leaves(a[1])[0]
+        lc = jax.tree_util.tree_leaves(c[1])[0]
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lc))

@@ -230,7 +230,7 @@ class TestDenoiserPredictionTypes:
         from comfyui_distributed_tpu.models.denoiser import make_denoiser
         x0, noise, sigma, x = self._setup(ds, sigma_val)
 
-        def apply_fn(params, xin, ts, ctx, y=None):
+        def apply_fn(params, xin, ts, ctx, y=None, control=None):
             return noise                     # the true eps
 
         den = make_denoiser(apply_fn, {}, ds, prediction_type="eps")
@@ -247,7 +247,7 @@ class TestDenoiserPredictionTypes:
         alpha = 1.0 / jnp.sqrt(sigma ** 2 + 1.0)
         v_true = alpha * noise - (sigma * alpha) * x0
 
-        def apply_fn(params, xin, ts, ctx, y=None):
+        def apply_fn(params, xin, ts, ctx, y=None, control=None):
             return v_true                    # the true v
 
         den = make_denoiser(apply_fn, {}, ds, prediction_type="v")
