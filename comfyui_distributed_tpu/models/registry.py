@@ -427,7 +427,10 @@ def _virtual_params(module, seed: int, *shaped_args) -> Any:
     return jax.tree_util.tree_map_with_path(leaf, shapes)["params"]
 
 
-_pipeline_cache: Dict[str, DiffusionPipeline] = {}
+# pipelines under plain names, (module, params) tuples under "cn:" keys,
+# standalone-VAE pipelines under "vae:" keys — one model-asset cache, all
+# cleared together by clear_pipeline_cache
+_pipeline_cache: Dict[str, Any] = {}
 _pipeline_lock = threading.Lock()
 
 
@@ -563,10 +566,10 @@ def load_controlnet(cn_name: str, models_dir: Optional[str] = None,
         # random fill breaks: zero projections make a fresh net an exact
         # UNet no-op (the property real zero-init checkpoints have)
         from comfyui_distributed_tpu.models.controlnet import HINT_CHANNELS
-        zero_mods = {f"zero_conv_{i}" for i in range(64)} | {
-            "mid_out", f"hint_conv_{len(HINT_CHANNELS)}"}
+        final_hint = f"hint_conv_{len(HINT_CHANNELS)}"
         for name in list(params):
-            if name in zero_mods:
+            if name.startswith("zero_conv_") or name in ("mid_out",
+                                                         final_hint):
                 params[name] = jax.tree_util.tree_map(
                     lambda a: np.zeros_like(a), params[name])
         log(f"virtual ControlNet {cn_name!r} ({fam.name}): no file on "

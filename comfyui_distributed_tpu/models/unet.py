@@ -142,7 +142,9 @@ class UNet(nn.Module):
 
         if control is not None:
             ctrl_skips, ctrl_mid = control
-            skips = [s + c for s, c in zip(skips, ctrl_skips)]
+            # strict: a count mismatch (encoder drift between UNet and
+            # ControlNet) must fail loudly, not silently drop residuals
+            skips = [s + c for s, c in zip(skips, ctrl_skips, strict=True)]
 
         # middle
         mid_ch = ch * cfg.channel_mult[-1]

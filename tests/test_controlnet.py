@@ -148,3 +148,51 @@ class TestSamplingAndOps:
         la = jax.tree_util.tree_leaves(a[1])[0]
         lc = jax.tree_util.tree_leaves(c[1])[0]
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lc))
+
+
+class TestTorchParity:
+    def test_controlnet_matches_torch_reference(self):
+        """flax ControlNet residuals == the canonical-layout torch
+        ControlNet through the real control_model.* key mapping (hint
+        ladder strides, zero-conv enumeration, residual ordering)."""
+        import torch
+        from tests.torch_ref import TorchControlNet
+
+        torch.manual_seed(4)
+        tref = TorchControlNet().eval()
+        # un-zero the projections so parity is tested on NONTRIVIAL output
+        with torch.no_grad():
+            for zc in tref.zero_convs:
+                torch.nn.init.normal_(zc[0].weight, std=0.05)
+                torch.nn.init.normal_(zc[0].bias, std=0.05)
+            torch.nn.init.normal_(tref.middle_block_out[0].weight, std=0.05)
+            torch.nn.init.normal_(tref.input_hint_block[-1].weight, std=0.05)
+        sd = {"control_model." + k: v.detach().numpy()
+              for k, v in tref.state_dict().items()}
+        params = ckpt._run_controlnet(
+            ckpt._LoadMapper(sd, ckpt.CONTROLNET_PREFIX), TINY_CONFIG)
+
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((2, 8, 8, 4)).astype(np.float32)
+        t = np.asarray([5.0, 300.0], np.float32)
+        c = rng.standard_normal((2, 16, 64)).astype(np.float32)
+        hint = rng.uniform(0, 1, (2, 64, 64, 3)).astype(np.float32)
+
+        with torch.no_grad():
+            t_outs, t_mid = tref(
+                torch.from_numpy(x.transpose(0, 3, 1, 2)),
+                torch.from_numpy(t), torch.from_numpy(c),
+                torch.from_numpy(hint.transpose(0, 3, 1, 2)))
+        cn = ControlNet(dataclasses.replace(TINY_CONFIG, dtype=jnp.float32))
+        f_outs, f_mid = cn.apply({"params": params}, jnp.asarray(x),
+                                 jnp.asarray(t), jnp.asarray(c),
+                                 jnp.asarray(hint))
+        assert len(f_outs) == len(t_outs)
+        tol = dict(rtol=2e-4, atol=2e-4)
+        for i, (fo, to) in enumerate(zip(f_outs, t_outs)):
+            np.testing.assert_allclose(
+                np.asarray(fo), to.numpy().transpose(0, 2, 3, 1),
+                err_msg=f"residual {i}", **tol)
+        np.testing.assert_allclose(np.asarray(f_mid),
+                                   t_mid.numpy().transpose(0, 2, 3, 1),
+                                   **tol)

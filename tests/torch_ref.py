@@ -556,3 +556,90 @@ class TorchOpenClipText(nn.Module):
             x = blk(x, m)
             hidden.append(x)
         return hidden
+
+
+class TorchControlNet(nn.Module):
+    """Canonical torch ControlNet layout (control_model.*): the TorchUNet
+    encoder + input_hint_block ladder + per-skip zero_convs +
+    middle_block_out."""
+
+    def __init__(self, model_channels=32, channel_mult=(1, 2),
+                 num_res_blocks=1, transformer_depth=(1, 1),
+                 context_dim=64, num_head_channels=16, in_channels=4,
+                 hint_channels=(16, 16, 32, 32, 96, 96, 256),
+                 hint_strides=(1, 1, 2, 1, 2, 1, 2)):
+        super().__init__()
+        mc = model_channels
+        time_dim = mc * 4
+        self.model_channels = mc
+        self.time_embed = nn.Sequential(
+            nn.Linear(mc, time_dim), nn.SiLU(),
+            nn.Linear(time_dim, time_dim))
+
+        mods = []
+        cin = 3
+        for hc, st_ in zip(hint_channels, hint_strides):
+            mods += [nn.Conv2d(cin, hc, 3, padding=1, stride=st_),
+                     nn.SiLU()]
+            cin = hc
+        final = nn.Conv2d(cin, mc, 3, padding=1)
+        nn.init.zeros_(final.weight), nn.init.zeros_(final.bias)
+        mods.append(final)
+        self.input_hint_block = nn.Sequential(*mods)
+
+        def heads(c):
+            return max(c // num_head_channels, 1)
+
+        def st(c, depth):
+            return SpatialTransformer(c, context_dim, heads(c), depth)
+
+        def zc(c):
+            conv = nn.Conv2d(c, c, 1)
+            nn.init.zeros_(conv.weight), nn.init.zeros_(conv.bias)
+            return nn.Sequential(conv)
+
+        self.input_blocks = nn.ModuleList(
+            [nn.Sequential(nn.Conv2d(in_channels, mc, 3, padding=1))])
+        self.zero_convs = nn.ModuleList([zc(mc)])
+        ch = mc
+        for level, mult in enumerate(channel_mult):
+            out_ch = mc * mult
+            for _ in range(num_res_blocks):
+                blk = [ResBlock(ch, out_ch, time_dim)]
+                ch = out_ch
+                if transformer_depth[level] > 0:
+                    blk.append(st(ch, transformer_depth[level]))
+                self.input_blocks.append(nn.Sequential(*blk))
+                self.zero_convs.append(zc(ch))
+            if level != len(channel_mult) - 1:
+                self.input_blocks.append(nn.Sequential(Downsample(ch)))
+                self.zero_convs.append(zc(ch))
+
+        self.middle_block = nn.Sequential(
+            ResBlock(ch, ch, time_dim),
+            st(ch, max(transformer_depth[-1], 1)),
+            ResBlock(ch, ch, time_dim))
+        mo = nn.Conv2d(ch, ch, 1)
+        nn.init.zeros_(mo.weight), nn.init.zeros_(mo.bias)
+        self.middle_block_out = nn.Sequential(mo)
+
+    def forward(self, x, timesteps, context, hint):
+        emb = self.time_embed(timestep_embedding(timesteps,
+                                                 self.model_channels))
+        guided = self.input_hint_block(hint)
+        outs = []
+        h = x
+        for i, block in enumerate(self.input_blocks):
+            for mod in block:
+                if isinstance(mod, ResBlock):
+                    h = mod(h, emb)
+                elif isinstance(mod, SpatialTransformer):
+                    h = mod(h, context)
+                else:
+                    h = mod(h)
+            if i == 0:
+                h = h + guided
+            outs.append(self.zero_convs[i](h))
+        for mod in self.middle_block:
+            h = mod(h, emb) if isinstance(mod, ResBlock) else mod(h, context)
+        return outs, self.middle_block_out(h)
