@@ -367,7 +367,7 @@ class VAEDecode(Op):
             # from the SPMD/local paths (unclipped)
             img = jnp.clip(
                 vae.vae_decode(jnp.asarray(samples["samples"])), 0.0, 1.0)
-        return (ImageBatch(img, **_latent_meta(samples)),)
+        return (ImageBatch(img, **_image_meta(samples)),)
 
 
 @register_op
@@ -386,7 +386,7 @@ class VAEDecodeTiled(Op):
                 jnp.asarray(samples["samples"]), tile_size=int(tile_size),
                 overlap=int(overlap),
                 check_interrupt=ctx.check_interrupt), 0.0, 1.0)
-        return (ImageBatch(img, **_latent_meta(samples)),)
+        return (ImageBatch(img, **_image_meta(samples)),)
 
 
 @register_op
@@ -465,6 +465,12 @@ class VAEEncodeForInpaint(Op):
         m = np.asarray(mask, np.float32)
         if m.ndim == 2:
             m = m[None]
+        if m.shape[1:3] != img.shape[1:3]:
+            # ComfyUI interpolates the mask to the pixel size — the
+            # LoadImage mask keeps the ORIGINAL image's dims while the
+            # pixels may have gone through ImageScale
+            m = resize_image(m[..., None], img.shape[2],
+                             img.shape[1], "bilinear")[..., 0]
         grow = max(int(grow_mask_by), 0)
         if grow:
             # dilate by max-pooling: a (2g+1)-square structuring element
@@ -642,6 +648,15 @@ def _resize_maybe_center(arr: np.ndarray, width: int, height: int,
         y0 = (ih - height) // 2
         return arr[:, y0:y0 + height, x0:x0 + width, :]
     return resize_image(arr, width, height, method)
+
+
+def _image_meta(samples) -> dict:
+    """Batch metadata an IMAGE can carry — the latent->image boundary
+    filter.  Latent-only keys (noise_mask) stop here; ImageBatch accepts
+    exactly these keys, so a future latent-only meta key added to
+    _latent_meta can't crash a decode op."""
+    return {k: samples[k] for k in ("local_batch", "fanout")
+            if k in samples}
 
 
 def _latent_meta(samples) -> dict:
