@@ -206,36 +206,10 @@ class DiffusionPipeline:
         lo = max(overlap // ds, 1)
         if H <= lt and W <= lt:
             return self.vae_decode(latents)
-
-        def starts(total: int) -> List[int]:
-            if total <= lt:
-                return [0]
-            out, pos, step = [], 0, lt - lo
-            while pos + lt < total:
-                out.append(pos)
-                pos += step
-            out.append(total - lt)   # clamp: uniform tile, full coverage
-            return out
-
-        th = min(lt, H)
-        tw = min(lt, W)
-        canvas = np.zeros((B, H * ds, W * ds, 3), np.float32)
-        weight = np.zeros((1, H * ds, W * ds, 1), np.float32)
-        ramp_y = _feather_ramp(th * ds, lo * ds)
-        ramp_x = _feather_ramp(tw * ds, lo * ds)
-        mask = (ramp_y[:, None] * ramp_x[None, :])[None, :, :, None]
-        for y0 in starts(H):
-            for x0 in starts(W):
-                if check_interrupt is not None:
-                    # a 4K+ decode is minutes of sequential tiles — honor
-                    # /interrupt between tiles, like the samplers do per step
-                    check_interrupt()
-                tile = latents[:, y0:y0 + th, x0:x0 + tw, :]
-                dec = np.asarray(self.vae_decode(tile), np.float32)
-                ys, xs = y0 * ds, x0 * ds
-                canvas[:, ys:ys + th * ds, xs:xs + tw * ds] += dec * mask
-                weight[:, ys:ys + th * ds, xs:xs + tw * ds] += mask
-        return jnp.asarray(canvas / np.maximum(weight, 1e-8))
+        from comfyui_distributed_tpu.ops.tiling import tiled_apply
+        return jnp.asarray(tiled_apply(
+            self.vae_decode, np.asarray(latents, np.float32), lt, lo, ds,
+            out_channels=3, check_interrupt=check_interrupt))
 
     # --- denoising ----------------------------------------------------------
 
@@ -378,17 +352,6 @@ class DiffusionPipeline:
                 log(f"jit cache: evicting {old_key!r} "
                     f"(cap {self._jit_cache_cap})")
             return fn
-
-
-def _feather_ramp(length: int, edge: int) -> np.ndarray:
-    """1D blend weights: linear ramps over ``edge`` px at both ends."""
-    w = np.ones(length, np.float32)
-    e = min(edge, length // 2)
-    if e > 0:
-        ramp = (np.arange(e, dtype=np.float32) + 1.0) / (e + 1.0)
-        w[:e] = ramp
-        w[-e:] = ramp[::-1]
-    return w
 
 
 def _virtual_params(module, seed: int, *shaped_args) -> Any:

@@ -780,12 +780,45 @@ class UpscaleModelLoader(Op):
 class ImageUpscaleWithModel(Op):
     TYPE = "ImageUpscaleWithModel"
 
+    # beyond this many input pixels the SR net runs tiled: a whole-image
+    # 4K+ pass would hold conv activations for the full canvas at once
+    TILE_THRESHOLD = 1024 * 1024
+    TILE = 512
+    OVERLAP = 32
+
     def execute(self, ctx: OpContext, upscale_model, image):
         net, params, scale = upscale_model
         arr = as_image_array(image)
+        b, h, w, _ = arr.shape
         with Timer(f"sr_upscale[x{scale}]"):
-            out = net.apply({"params": params}, jnp.asarray(arr))
-        return (_keep_fanout_meta(image, np.asarray(out)),)
+            if h * w <= self.TILE_THRESHOLD:
+                out = np.asarray(net.apply({"params": params},
+                                           jnp.asarray(arr)))
+            else:
+                out = self._tiled(net, params, arr, int(scale))
+        return (_keep_fanout_meta(image, out),)
+
+    def _tiled(self, net, params, arr: np.ndarray,
+               scale: int) -> np.ndarray:
+        """The shared uniform-tile feather loop (ops/tiling.tiled_apply);
+        the jitted SR forward is cached at module level so repeated large
+        upscales (video frames, batch queues) never retrace."""
+        from comfyui_distributed_tpu.ops.tiling import tiled_apply
+        key = repr(net)  # flax module dataclass repr == architecture
+        fn = _sr_jit_cache.get(key)
+        if fn is None:
+            import jax as _jax
+            fn = _sr_jit_cache[key] = _jax.jit(
+                lambda p, z: net.apply({"params": p}, z))
+        return tiled_apply(
+            lambda tile: fn(params, jnp.asarray(tile)),
+            arr, self.TILE, self.OVERLAP, scale,
+            out_channels=arr.shape[-1])
+
+
+# jitted SR forwards keyed by net architecture (module repr): get_op()
+# returns a fresh op instance per call, so the cache must outlive them
+_sr_jit_cache: dict = {}
 
 
 @register_op

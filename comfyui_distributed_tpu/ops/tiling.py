@@ -153,3 +153,63 @@ def blend_tile(canvas: np.ndarray, tile: np.ndarray, x: int, y: int,
     out[y:y2, x:x2, :] = (region_tile * region_mask
                           + canvas[y:y2, x:x2, :] * (1.0 - region_mask))
     return out
+
+
+def feather_ramp(length: int, edge: int) -> np.ndarray:
+    """1D blend weights: linear ramps over ``edge`` px at both ends."""
+    w = np.ones(length, np.float32)
+    e = min(edge, length // 2)
+    if e > 0:
+        ramp = (np.arange(e, dtype=np.float32) + 1.0) / (e + 1.0)
+        w[:e] = ramp
+        w[-e:] = ramp[::-1]
+    return w
+
+
+def make_feather_mask(width: int, height: int, edge: int) -> np.ndarray:
+    """[H, W] accumulation weights for uniform overlapping tiles: ramps on
+    every side; overlapping contributions normalize by the summed mask."""
+    return np.outer(feather_ramp(height, edge), feather_ramp(width, edge))
+
+
+def uniform_tile_starts(total: int, tile: int, overlap: int) -> list:
+    """Unique clamped start positions covering [0, total) with uniform
+    ``tile``-sized windows stepping ``tile - overlap`` (last start clamps
+    to ``total - tile``; duplicates from the clamp are removed so no
+    window is computed twice)."""
+    if total <= tile:
+        return [0]
+    out, pos, step = [], 0, max(tile - overlap, 1)
+    while pos + tile < total:
+        out.append(pos)
+        pos += step
+    out.append(total - tile)
+    return sorted(set(out))
+
+
+def tiled_apply(fn, x: np.ndarray, tile: int, overlap: int, scale: int,
+                out_channels: int, check_interrupt=None) -> np.ndarray:
+    """Apply ``fn`` ([B,th,tw,C] -> [B,th*scale,tw*scale,out_channels])
+    over uniform overlapping windows of ``x``, feather-blending in output
+    space.  One window shape -> one compiled executable serves every
+    tile; the weight buffer broadcasts over the batch.  THE single copy
+    of the tile/accumulate loop (VAE tiled decode and tiled SR both ride
+    it)."""
+    b, h, w, _ = x.shape
+    th, tw = min(tile, h), min(tile, w)
+    canvas = np.zeros((b, h * scale, w * scale, out_channels), np.float32)
+    weight = np.zeros((1, h * scale, w * scale, 1), np.float32)
+    mask = make_feather_mask(tw * scale, th * scale,
+                             overlap * scale)[None, :, :, None]
+    for y0 in uniform_tile_starts(h, th, overlap):
+        for x0 in uniform_tile_starts(w, tw, overlap):
+            if check_interrupt is not None:
+                # a 4K+ pass is minutes of sequential tiles — honor
+                # /interrupt between tiles, like the samplers do per step
+                check_interrupt()
+            out = np.asarray(fn(x[:, y0:y0 + th, x0:x0 + tw, :]),
+                             np.float32)
+            ys, xs = y0 * scale, x0 * scale
+            canvas[:, ys:ys + th * scale, xs:xs + tw * scale] += out * mask
+            weight[:, ys:ys + th * scale, xs:xs + tw * scale] += mask
+    return canvas / np.maximum(weight, 1e-8)

@@ -598,3 +598,28 @@ class TestInpainting:
         (up,) = get_op("LatentUpscaleBy").execute(OpContext(), lat,
                                                   "bilinear", 2.0)
         assert "noise_mask" in up
+
+
+class TestTiledSR:
+    def test_tiled_sr_matches_whole_image(self, monkeypatch):
+        """Above the pixel threshold the SR net runs in overlapping
+        feathered tiles; result must closely match the whole-image pass
+        (identical away from seams — RRDB convs are local, unlike the
+        VAE's global attention)."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        from comfyui_distributed_tpu.ops.basic import ImageUpscaleWithModel
+        ul = get_op("UpscaleModelLoader").execute(
+            OpContext(), "2x_tiny_sr.pth")[0]
+        img = np.random.default_rng(4).uniform(
+            0, 1, (1, 48, 64, 3)).astype(np.float32)
+        op = get_op("ImageUpscaleWithModel")
+        (whole,) = op.execute(OpContext(), ul, img)
+        monkeypatch.setattr(ImageUpscaleWithModel, "TILE_THRESHOLD", 512)
+        monkeypatch.setattr(ImageUpscaleWithModel, "TILE", 32)
+        monkeypatch.setattr(ImageUpscaleWithModel, "OVERLAP", 8)
+        (tiled,) = op.execute(OpContext(), ul, img)
+        assert tiled.shape == whole.shape
+        # interior agreement: small RRDB receptive-field halo at seams
+        diff = np.abs(np.asarray(tiled) - np.asarray(whole))
+        assert np.median(diff) < 1e-4, float(np.median(diff))
+        assert np.mean(diff) < 0.02, float(np.mean(diff))
