@@ -153,12 +153,18 @@ def failure_payload(args, stage, detail, diagnostics=None):
     }
 
 
+_PAYLOAD_EMITTED = False
+
+
 def emit(args, payload):
+    global _PAYLOAD_EMITTED
     line = json.dumps(payload)
     print(line, flush=True)
     if args.out:
         with open(args.out, "w") as f:
             f.write(line + "\n")
+    # the SIGTERM watchdog must not clobber an already-delivered result
+    _PAYLOAD_EMITTED = True
 
 
 def collect_diagnostics():
@@ -604,8 +610,49 @@ def run_scaling_sweep(args):
     })
 
 
+def _install_sigterm_payload(args):
+    """A driver timeout delivers SIGTERM; die WITH a structured JSON line
+    (stage=timeout) instead of silently.
+
+    A plain Python signal handler can't run while the main thread is
+    blocked inside a native XLA compile — the exact case this exists for
+    — so the C-level trampoline writes to a wakeup fd and a WATCHDOG
+    THREAD does the emit regardless of what the main thread is doing.
+    Diagnostics are snapshotted at install time (a signal path shouldn't
+    walk /proc), and a payload already emitted is never clobbered."""
+    import signal
+    import threading
+
+    diag = collect_diagnostics()
+    r, w = os.pipe()
+    os.set_blocking(w, False)      # set_wakeup_fd requires non-blocking
+    try:
+        signal.set_wakeup_fd(w, warn_on_full_buffer=False)
+        # a (non-default) Python-level handler is required for the C
+        # trampoline to write the wakeup byte instead of killing us
+        signal.signal(signal.SIGTERM, lambda s, f: None)
+    except (ValueError, OSError):  # non-main thread / restricted env
+        return
+
+    def watch():
+        try:
+            os.read(r, 1)          # blocks until a signal arrives
+        except OSError:
+            return
+        if not _PAYLOAD_EMITTED:
+            emit(args, failure_payload(
+                args, "timeout",
+                "SIGTERM during run (driver timeout? cold compile can "
+                "take minutes — the persistent cache makes the retry "
+                "fast)", diagnostics=diag))
+        os._exit(124)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
 def main():
     args = parse_args()
+    _install_sigterm_payload(args)
     try:
         if args.scaling_sweep:
             run_scaling_sweep(args)
