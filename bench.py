@@ -158,13 +158,15 @@ _PAYLOAD_EMITTED = False
 
 def emit(args, payload):
     global _PAYLOAD_EMITTED
+    # flag BEFORE writing: the SIGTERM watchdog must not clobber a result
+    # whose delivery is already in progress (a timeout line overwriting a
+    # just-written success in args.out)
+    _PAYLOAD_EMITTED = True
     line = json.dumps(payload)
     print(line, flush=True)
     if args.out:
         with open(args.out, "w") as f:
             f.write(line + "\n")
-    # the SIGTERM watchdog must not clobber an already-delivered result
-    _PAYLOAD_EMITTED = True
 
 
 def collect_diagnostics():
@@ -635,17 +637,24 @@ def _install_sigterm_payload(args):
         return
 
     def watch():
+        while True:
+            try:
+                data = os.read(r, 1)   # blocks until a signal arrives
+            except OSError:
+                return
+            # the wakeup fd fires for EVERY Python-handled signal; only
+            # SIGTERM is ours (Ctrl+C must keep its KeyboardInterrupt)
+            if data and data[0] == signal.SIGTERM:
+                break
         try:
-            os.read(r, 1)          # blocks until a signal arrives
-        except OSError:
-            return
-        if not _PAYLOAD_EMITTED:
-            emit(args, failure_payload(
-                args, "timeout",
-                "SIGTERM during run (driver timeout? cold compile can "
-                "take minutes — the persistent cache makes the retry "
-                "fast)", diagnostics=diag))
-        os._exit(124)
+            if not _PAYLOAD_EMITTED:
+                emit(args, failure_payload(
+                    args, "timeout",
+                    "SIGTERM during run (driver timeout? cold compile "
+                    "can take minutes — the persistent cache makes the "
+                    "retry fast)", diagnostics=diag))
+        finally:
+            os._exit(124)          # even if emit raised (unwritable out)
 
     threading.Thread(target=watch, daemon=True).start()
 

@@ -105,3 +105,40 @@ class TestMaskBlend:
         edge = out[33, 48, 0]
         assert center > 0.95
         assert 0.0 < edge <= 1.0
+
+
+class TestUniformTileStarts:
+    def test_small_input_single_tile(self):
+        from comfyui_distributed_tpu.ops.tiling import uniform_tile_starts
+        assert uniform_tile_starts(500, 512, 32) == [0]
+
+    def test_clamped_last_start_deduplicated(self):
+        """When the clamped last start coincides with a step position the
+        window must appear ONCE — a duplicate would run the whole tile
+        through the model twice for nothing."""
+        from comfyui_distributed_tpu.ops.tiling import uniform_tile_starts
+        # step = 480; clamp 992-512 = 480 == the second step position
+        assert uniform_tile_starts(992, 512, 32) == [0, 480]
+
+    def test_full_coverage(self):
+        from comfyui_distributed_tpu.ops.tiling import uniform_tile_starts
+        for total, tile, ov in [(992, 512, 32), (1000, 512, 32),
+                                (64, 48, 8), (100, 32, 8)]:
+            starts = uniform_tile_starts(total, tile, ov)
+            assert starts == sorted(set(starts))
+            covered = np.zeros(total, bool)
+            for s in starts:
+                assert 0 <= s <= total - tile
+                covered[s:s + tile] = True
+            assert covered.all(), (total, tile, ov, starts)
+
+    def test_feather_mask_normalizes(self):
+        """Accumulated overlapping masks sum to ~1 in the overlap band
+        after weight normalization (the property tiled_apply relies on)."""
+        from comfyui_distributed_tpu.ops.tiling import make_feather_mask
+        m = make_feather_mask(32, 32, 8)
+        acc = np.zeros(56, np.float32)
+        acc[:32] += m[16]                 # two tiles overlapping by 8
+        acc[24:] += m[16]
+        assert acc[24:32].max() <= 1.2    # feather, not doubling
+        assert (acc[4:52] > 0.3).all()
