@@ -406,12 +406,24 @@ def _run_controlnet(m, cfg: UNetConfig):
 CONTROLNET_PREFIX = "control_model."
 
 
-def load_controlnet(path: str, cfg: UNetConfig):
+def load_controlnet(path: str, cfg: UNetConfig, state_dict=None):
     """ControlNet ``.pth``/``.safetensors`` -> flax params."""
-    sd = load_state_dict(path)
+    sd = state_dict if state_dict is not None else load_state_dict(path)
     prefix = CONTROLNET_PREFIX if any(
         k.startswith(CONTROLNET_PREFIX) for k in sd) else ""
     return _run_controlnet(_LoadMapper(sd, prefix), cfg)
+
+
+def controlnet_context_dim(sd) -> Optional[int]:
+    """Cross-attention width of a ControlNet state dict — the one
+    dimension that discriminates the SD families (768/1024/2048), used to
+    infer the right UNet config from the file itself (the reference
+    ecosystem infers ControlNet configs from the checkpoint, not from
+    whatever model the user happens to have loaded)."""
+    for k, v in sd.items():
+        if k.endswith("attn2.to_k.weight"):
+            return int(v.shape[-1])
+    return None
 
 
 def export_controlnet(params, cfg: UNetConfig):

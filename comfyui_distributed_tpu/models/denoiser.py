@@ -25,7 +25,11 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
     UNet.  ``control`` = (cn_apply, cn_params, hint, strength) runs a
     ControlNet on the SAME scaled input/timestep the UNet sees each call
     and feeds its residuals (scaled by strength) into the UNet; the hint
-    broadcasts over CFG's doubled batch.
+    broadcasts over CFG's doubled batch.  ``strength`` may be a scalar
+    (uniform) or a ``(s_cond, s_uncond)`` pair applied per CFG half —
+    ComfyUI attaches a ControlNet to ONE conditioning, so a
+    positive-only control must not also steer the uncond rows (the
+    doubled batch is [cond; uncond], samplers.cfg_denoiser).
     """
     log_sigmas = jnp.asarray(jnp.log(jnp.asarray(ds.sigmas)))
 
@@ -53,7 +57,19 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
             reps = xin.shape[0] // hint.shape[0]
             hb = jnp.concatenate([hint] * reps, axis=0) if reps > 1 else hint
             outs, mid = cn_apply(cn_params, xin, ts, context, hb, y)
-            ctrl = ([o * strength for o in outs], mid * strength)
+            if isinstance(strength, (tuple, list)):
+                s_cond, s_unc = float(strength[0]), float(strength[1])
+                if reps == 2:
+                    # CFG-doubled batch: first half cond, second uncond
+                    b = hint.shape[0]
+                    scale = jnp.concatenate(
+                        [jnp.full((b, 1, 1, 1), s_cond, xin.dtype),
+                         jnp.full((b, 1, 1, 1), s_unc, xin.dtype)], axis=0)
+                else:  # cfg==1: single pass evaluates the cond context only
+                    scale = s_cond
+            else:
+                scale = strength
+            ctrl = ([o * scale for o in outs], mid * scale)
         eps_or_v = apply_fn(params, xin, ts, context, y, ctrl)
         if prediction_type == "v":
             # v-prediction: denoised = c_skip*x - c_out*v  (VP parameterization)

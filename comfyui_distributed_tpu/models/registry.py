@@ -84,6 +84,15 @@ FAMILIES: Dict[str, ModelFamily] = {
 FAMILY_ENV = "DTPU_DEFAULT_FAMILY"
 
 
+def _strength_key(strength):
+    """ControlNet strength as a hashable static value: a scalar or a
+    per-CFG-half ``(s_cond, s_uncond)`` pair (ops/basic.py builds the
+    pair; see models/denoiser.py for the half semantics)."""
+    if isinstance(strength, (tuple, list)):
+        return (float(strength[0]), float(strength[1]))
+    return float(strength)
+
+
 def detect_family(ckpt_name: str) -> str:
     """Family from checkpoint-name heuristics; ``DTPU_DEFAULT_FAMILY``
     overrides (tests/CI force 'tiny')."""
@@ -266,7 +275,8 @@ class DiffusionPipeline:
                       polling_enabled(), start, end,
                       bool(force_full_denoise), noise_mask is not None,
                       control is not None,
-                      float(control[3]) if control is not None else 0.0)
+                      _strength_key(control[3]) if control is not None
+                      else 0.0)
 
         def make_core():
             has_y = y is not None
@@ -284,7 +294,8 @@ class DiffusionPipeline:
             def core(unet_params, latents, context, uncond_context, keys,
                      sigmas, y_in, mask_in, cn_params, hint_in):
                 ctrl_spec = (cn_apply, cn_params, hint_in,
-                             float(cn_strength)) if has_control else None
+                             _strength_key(cn_strength)) \
+                    if has_control else None
                 den = make_denoiser(self.raw_unet_apply, unet_params,
                                     self.schedule, self.prediction_type,
                                     control=ctrl_spec)
@@ -460,6 +471,7 @@ def clear_pipeline_cache() -> None:
     with _pipeline_lock:
         _pipeline_cache.clear()
         _derived_cache.clear()
+        _cn_family_cache.clear()
     from comfyui_distributed_tpu.models import lora as lora_mod
     lora_mod.clear_lora_cache()
 
@@ -470,6 +482,10 @@ def clear_pipeline_cache() -> None:
 _derived_cache: "collections.OrderedDict[Tuple, DiffusionPipeline]" = \
     collections.OrderedDict()
 _DERIVED_CACHE_CAP = 8
+
+# ControlNet file -> inferred family name (load_controlnet): lets the
+# repeat call hit the pipeline cache without re-reading the file
+_cn_family_cache: Dict[str, str] = {}
 
 
 def derive_pipeline(base: DiffusionPipeline, tag: str,
@@ -499,8 +515,39 @@ def load_controlnet(cn_name: str, models_dir: Optional[str] = None,
                     family_name: Optional[str] = None):
     """ControlNetLoader equivalent -> (module, params); virtual when no
     file exists (deterministic from the name, zero-convs start at zero so
-    a fresh virtual ControlNet is an exact no-op on the UNet)."""
+    a fresh virtual ControlNet is an exact no-op on the UNet).
+
+    When a file IS on disk the family comes from the checkpoint itself
+    (cross-attention width), not from env/default — an SDXL workflow
+    must not build a 768-context sd15 net just because the default says
+    so (parity with the reference ecosystem's infer-from-file loaders)."""
     fam = FAMILIES[family_name or os.environ.get(FAMILY_ENV) or "sd15"]
+    path = None
+    sd = None
+    if models_dir:
+        cand = os.path.join(models_dir, cn_name.replace("\\", "/"))
+        if os.path.exists(cand):
+            path = cand
+    if path is not None and family_name is None:
+        # inferred family memoized per path: the repeat call must hit the
+        # pipeline cache below without re-reading a multi-GB file
+        with _pipeline_lock:
+            cached_fam = _cn_family_cache.get(path)
+        if cached_fam is not None:
+            fam = FAMILIES[cached_fam]
+        else:
+            from comfyui_distributed_tpu.models.checkpoints import (
+                controlnet_context_dim, load_state_dict)
+            sd = load_state_dict(path)
+            ctx_dim = controlnet_context_dim(sd)
+            if ctx_dim is not None and ctx_dim != fam.unet.context_dim:
+                for cand_fam in ("sd15", "sd21", "sdxl", "tiny"):
+                    if FAMILIES[cand_fam].unet.context_dim == ctx_dim:
+                        fam = FAMILIES[cand_fam]
+                        break
+            with _pipeline_lock:
+                _cn_family_cache[path] = fam.name
+
     key = f"cn:{cn_name}:{fam.name}:{models_dir or ''}"
     with _pipeline_lock:
         if key in _pipeline_cache:
@@ -508,15 +555,10 @@ def load_controlnet(cn_name: str, models_dir: Optional[str] = None,
 
     from comfyui_distributed_tpu.models.controlnet import ControlNet
     module = ControlNet(fam.unet)
-    path = None
-    if models_dir:
-        cand = os.path.join(models_dir, cn_name.replace("\\", "/"))
-        if os.path.exists(cand):
-            path = cand
     if path is not None:
         from comfyui_distributed_tpu.models.checkpoints import (
             load_controlnet as load_cn_file)
-        params = load_cn_file(path, fam.unet)
+        params = load_cn_file(path, fam.unet, state_dict=sd)
         log(f"loaded ControlNet {cn_name} ({fam.name}) from {path}")
     else:
         seed = _name_seed(cn_name)

@@ -123,10 +123,9 @@ class ControlNetLoader(Op):
 @register_op
 class ControlNetApply(Op):
     """Attach a ControlNet + hint image to a conditioning at the given
-    strength.  One divergence from ComfyUI, by construction of the
-    TPU-friendly single doubled-batch CFG call: the control applies to
-    the whole CFG batch (cond AND uncond halves), equivalent to applying
-    it to both conditionings."""
+    strength.  ComfyUI semantics: the control steers only the CFG half
+    whose conditioning carries it (the doubled-batch call scales the
+    other half's residuals to zero — models/denoiser.py)."""
     TYPE = "ControlNetApply"
     WIDGETS = ["strength"]
     DEFAULTS = {"strength": 1.0}
@@ -244,6 +243,30 @@ class KSamplerAdvanced(Op):
         return (out_d,)
 
 
+def _cycle_batch(arr: np.ndarray, n: int) -> np.ndarray:
+    """One row per sample, cycling a short batch via modulo indexing — the
+    ONE copy of the pairing rule: fanned batches tile whole-block, so row
+    i of the cycled array pairs with batch row i exactly (and the
+    denoiser's CFG doubling then pairs [a;a] with [cond;uncond] rows
+    one-to-one)."""
+    if arr.shape[0] == n:
+        return arr
+    return np.take(arr, np.arange(n) % arr.shape[0], axis=0)
+
+
+def _safe_output_path(out_dir: str, rel: str) -> str:
+    """Join a user-supplied filename prefix into ``out_dir``, rejecting
+    '..'-style escapes (the reference ecosystem sanitizes save paths into
+    the output root the same way)."""
+    root = os.path.realpath(out_dir)
+    path = os.path.realpath(os.path.join(root, rel))
+    if os.path.commonpath([root, path]) != root:
+        raise ValueError(
+            f"filename prefix {rel!r} escapes the output directory "
+            f"{root!r}")
+    return path
+
+
 @dataclasses.dataclass
 class _SampleInputs:
     """Shared KSampler/KSamplerAdvanced preamble: latent unpack, replica
@@ -298,26 +321,44 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
             y = coll.shard_batch(y, mesh)
 
     # control may hang on either conditioning entry (ComfyUI honors both);
-    # positive wins when both carry one
-    control = getattr(positive, "control", None) \
-        or getattr(negative, "control", None)
+    # positive wins when both carry one.  The strength becomes a per-CFG-
+    # half (s_cond, s_uncond) pair: a control attached to only one
+    # conditioning must only steer that half of the doubled batch
+    pos_ctrl = getattr(positive, "control", None)
+    neg_ctrl = getattr(negative, "control", None)
+    control = pos_ctrl or neg_ctrl
     if control is not None:
+        s_cond = float(pos_ctrl[3]) if pos_ctrl is not None else 0.0
+        if neg_ctrl is None:
+            s_unc = 0.0
+        elif pos_ctrl is None or (neg_ctrl[0] is pos_ctrl[0]
+                                  and neg_ctrl[1] is pos_ctrl[1]
+                                  and (neg_ctrl[2] is pos_ctrl[2]
+                                       or np.array_equal(neg_ctrl[2],
+                                                         pos_ctrl[2]))):
+            s_unc = float(neg_ctrl[3])
+        else:
+            # a DIFFERENT net or hint on the negative (pos canny + neg
+            # depth, or one net with two hint images): the single
+            # doubled-batch call runs one net with one hint; honoring the
+            # negative's strength would steer its half with the wrong
+            # residuals — drop the negative's control loudly instead
+            debug_log("ControlNet: positive and negative carry different "
+                      "controls/hints; applying the positive's only "
+                      "(per-half nets/hints are unsupported)")
+            s_unc = 0.0
         # hint image -> the resolution the hint ladder expects (8x the
         # latent dims — families with other VAE downscales still align)
-        module, params, hint, strength = control
+        module, params, hint, _ = control
         hh, ww = lat.shape[1] * 8, lat.shape[2] * 8
         if hint.shape[1] != hh or hint.shape[2] != ww:
             hint = resize_image(hint, ww, hh, "bilinear")
-        if hint.shape[0] != total:
-            # exactly one hint per sample, cycling a short batch — the
-            # denoiser's CFG doubling then pairs [hint;hint] with
-            # [cond;uncond] rows one-to-one
-            hint = np.take(hint, np.arange(total) % hint.shape[0], axis=0)
+        hint = _cycle_batch(hint, total)
         hint_dev = hint
         if fanout > 1 and ctx.runtime is not None:
             hint_dev = coll.shard_batch(np.asarray(hint, np.float32),
                                         ctx.runtime.mesh)
-        control = (module, params, jnp.asarray(hint_dev), strength)
+        control = (module, params, jnp.asarray(hint_dev), (s_cond, s_unc))
 
     mask = latent_image.get("noise_mask")
     if mask is not None:
@@ -328,7 +369,12 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
             m = m[None]
         h, w = lat.shape[1], lat.shape[2]
         m = resize_image(m[..., None], w, h, "area")
-        mask = jnp.asarray(np.clip(m, 0.0, 1.0))
+        m = np.clip(m, 0.0, 1.0)
+        if m.shape[0] != 1:  # a single mask broadcasts; others fan out
+            m = _cycle_batch(m, total)
+        if fanout > 1 and ctx.runtime is not None and m.shape[0] == total:
+            m = coll.shard_batch(m, ctx.runtime.mesh)
+        mask = jnp.asarray(m)
 
     return _SampleInputs(latents=jnp.asarray(lat_dev), context=ctx_arr,
                          uncond=unc_arr, seeds=seeds, sample_idx=local_idx,
@@ -607,7 +653,18 @@ class LatentFromBatch(Op):
         i = min(max(int(batch_index), 0), lat.shape[0] - 1)
         n = min(max(int(length), 1), lat.shape[0] - i)
         # slicing breaks replica alignment: the result is a plain batch
-        return ({"samples": lat[i:i + n]},)
+        out = {"samples": lat[i:i + n]}
+        if "noise_mask" in samples:
+            # the mask travels with its rows (ComfyUI slices it alongside;
+            # dropping it would silently resample the whole image)
+            m = np.asarray(samples["noise_mask"], np.float32)
+            if m.ndim == 2:
+                m = m[None]
+            if m.shape[0] == 1:
+                out["noise_mask"] = m
+            else:  # short mask cycles the batch before slicing
+                out["noise_mask"] = _cycle_batch(m, lat.shape[0])[i:i + n]
+        return (out,)
 
 
 @register_op
@@ -623,8 +680,8 @@ class CheckpointSave(Op):
     def execute(self, ctx: OpContext, model, clip, vae,
                 filename_prefix: str = "checkpoints/save"):
         from comfyui_distributed_tpu.models.checkpoints import save_checkpoint
-        out_dir = ctx.output_dir or os.getcwd()
-        path = os.path.join(out_dir, f"{filename_prefix}.safetensors")
+        path = _safe_output_path(ctx.output_dir or os.getcwd(),
+                                 f"{filename_prefix}.safetensors")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         # model/clip/vae may be three different pipelines (VAELoader,
         # clip-skip, LoRA splits): take each tower from its own source
@@ -846,6 +903,8 @@ class SaveImage(Op):
         if ctx.output_dir:
             os.makedirs(ctx.output_dir, exist_ok=True)
             for i in range(arr.shape[0]):
-                tensor_to_pil(arr, i).save(os.path.join(
-                    ctx.output_dir, f"{filename_prefix}_{i:05d}.png"))
+                p = _safe_output_path(ctx.output_dir,
+                                      f"{filename_prefix}_{i:05d}.png")
+                os.makedirs(os.path.dirname(p), exist_ok=True)
+                tensor_to_pil(arr, i).save(p)
         return ()

@@ -139,6 +139,67 @@ class TestSamplingAndOps:
                                    np.asarray(s0["samples"]),
                                    rtol=1e-5, atol=1e-6)
 
+    def test_positive_only_control_does_not_steer_uncond(self):
+        """ADVICE r3: a control attached to ONE conditioning steers only
+        that CFG half.  Pre-fix, positive-only was (by construction of
+        the doubled-batch call) identical to attaching it to both conds —
+        so the three attachments must now produce three DIFFERENT
+        samples, and all must differ from no control at all."""
+        pipe = reg.load_pipeline("cn-halves.ckpt")
+        module, params = reg.load_controlnet("halves_cn.safetensors")
+        params = jax.tree_util.tree_map(lambda a: a + 0.05, params)
+        ctx_arr, _ = pipe.encode_prompt(["a house"])
+        pos = Conditioning(context=ctx_arr, pooled=None)
+        neg = Conditioning(context=ctx_arr * 0.5, pooled=None)
+        hint = np.random.default_rng(3).uniform(
+            0, 1, (1, 64, 64, 3)).astype(np.float32)
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        op = get_op("KSampler")
+        ap = get_op("ControlNetApply")
+        (pos_c,) = ap.execute(OpContext(), pos, (module, params), hint, 1.0)
+        (neg_c,) = ap.execute(OpContext(), neg, (module, params), hint, 1.0)
+
+        def run(p, n):
+            (o,) = op.execute(OpContext(), pipe, 9, 2, 1.5, "euler",
+                              "normal", p, n, lat, 1.0)
+            return np.asarray(o["samples"])
+
+        plain = run(pos, neg)
+        only_pos = run(pos_c, neg)
+        only_neg = run(pos, neg_c)
+        both = run(pos_c, neg_c)
+        for a, b, msg in [(only_pos, both, "pos-only == both (old bug)"),
+                          (only_neg, both, "neg-only == both"),
+                          (only_pos, only_neg, "pos-only == neg-only"),
+                          (only_pos, plain, "pos-only == no control"),
+                          (only_neg, plain, "neg-only == no control")]:
+            assert not np.allclose(a, b), msg
+
+    def test_family_inferred_from_checkpoint_file(self, tmp_path,
+                                                  monkeypatch):
+        """ADVICE r3: with a file on disk, the ControlNet family comes
+        from the checkpoint's cross-attn width — not the env default
+        (an SDXL workflow must not get a 768-context sd15 net)."""
+        from safetensors.numpy import save_file
+        cn = ControlNet(TINY_CONFIG)
+        x, ts, ctx, hint = _cn_inputs()
+        params = cn.init(jax.random.PRNGKey(4), x, ts, ctx, hint)["params"]
+        sd = ckpt.export_controlnet(params, TINY_CONFIG)
+        save_file({k: np.asarray(v, np.float32) for k, v in sd.items()},
+                  str(tmp_path / "tiny_cn.safetensors"))
+        monkeypatch.setenv(reg.FAMILY_ENV, "sd15")  # wrong default on purpose
+        module, loaded = reg.load_controlnet("tiny_cn.safetensors",
+                                             models_dir=str(tmp_path))
+        assert module.cfg.context_dim == TINY_CONFIG.context_dim
+        la = jax.tree_util.tree_leaves(params)
+        lb = jax.tree_util.tree_leaves(loaded)
+        assert len(la) == len(lb)
+        # an explicit family_name still wins over inference
+        mod2, _ = reg.load_controlnet("tiny_cn.safetensors",
+                                      models_dir=str(tmp_path),
+                                      family_name="tiny")
+        assert mod2.cfg.context_dim == TINY_CONFIG.context_dim
+
     def test_loader_cached_and_virtual_deterministic(self):
         a = reg.load_controlnet("depth.safetensors")
         b = reg.load_controlnet("depth.safetensors")

@@ -465,6 +465,51 @@ class TestUtilityOps:
         np.testing.assert_allclose(out[:, 77:], 0.5, atol=1e-6)  # zero pad
         np.testing.assert_allclose(np.asarray(avg.pooled), 3.0, atol=1e-6)
 
+    def test_latent_from_batch_slices_noise_mask(self):
+        """ADVICE r3: the mask travels with its rows through a batch
+        slice — dropping it would silently resample the whole image."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        lat = {"samples": np.zeros((4, 4, 4, 4), np.float32),
+               "noise_mask": np.stack([np.full((8, 8), float(i))
+                                       for i in range(4)])}
+        (sel,) = get_op("LatentFromBatch").execute(OpContext(), lat, 2, 2)
+        assert "noise_mask" in sel
+        np.testing.assert_array_equal(
+            np.asarray(sel["noise_mask"])[:, 0, 0], [2.0, 3.0])
+        # a single mask broadcasts: forwarded untouched
+        lat1 = {"samples": np.zeros((4, 4, 4, 4), np.float32),
+                "noise_mask": np.ones((1, 8, 8), np.float32)}
+        (sel1,) = get_op("LatentFromBatch").execute(OpContext(), lat1, 1, 2)
+        assert np.asarray(sel1["noise_mask"]).shape[0] == 1
+        # short (but >1) mask cycles the batch before slicing, ComfyUI-style
+        lat2 = {"samples": np.zeros((4, 4, 4, 4), np.float32),
+                "noise_mask": np.stack([np.full((8, 8), float(i))
+                                        for i in range(2)])}
+        (sel2,) = get_op("LatentFromBatch").execute(OpContext(), lat2, 2, 2)
+        np.testing.assert_array_equal(
+            np.asarray(sel2["noise_mask"])[:, 0, 0], [0.0, 1.0])
+
+    def test_checkpoint_save_rejects_escaping_prefix(self, tmp_path):
+        """ADVICE r3: a '../..'-style filename_prefix must not write
+        outside the output root."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        pipe = self._pipe()
+        out = tmp_path / "out"
+        out.mkdir()
+        octx = OpContext(output_dir=str(out))
+        with pytest.raises(ValueError, match="escapes"):
+            get_op("CheckpointSave").execute(octx, pipe, pipe, pipe,
+                                             "../escaped/evil")
+        assert not (tmp_path / "escaped").exists()
+        # SaveImage shares the guard (same user-supplied prefix join)
+        img = np.zeros((1, 8, 8, 3), np.float32)
+        with pytest.raises(ValueError, match="escapes"):
+            get_op("SaveImage").execute(octx, img, "../escaped/evil")
+        assert not (tmp_path / "escaped").exists()
+        # a legitimate subdirectory prefix still works
+        get_op("SaveImage").execute(octx, img, "subdir/ok")
+        assert (out / "subdir" / "ok_00000.png").exists()
+
     def test_checkpoint_save_round_trips(self, tmp_path):
         from comfyui_distributed_tpu.models import checkpoints as ckpt
         from comfyui_distributed_tpu.ops.base import OpContext, get_op

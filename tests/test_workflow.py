@@ -410,6 +410,35 @@ class TestInpaintE2E:
         assert np.isfinite(imgs).all()
 
 
+    def test_batch_gt1_mask_fans_out(self, ctx):
+        """ADVICE r3 (medium): a batch>1 noise_mask must fan out with the
+        latents — pre-fix only B=1 worked (by broadcasting) and B>1
+        crashed with a shape error inside the jitted sampler."""
+        from comfyui_distributed_tpu.ops.base import Conditioning, get_op
+        pipe = registry.load_pipeline("maskfan.ckpt")
+        ctx_arr, _ = pipe.encode_prompt(["x"])
+        pos = Conditioning(context=ctx_arr, pooled=None)
+        fanout = ctx.fanout = len(jax.devices())
+        assert fanout > 1
+        b = 2
+        lat = np.tile(np.zeros((b, 8, 8, 4), np.float32),
+                      (fanout, 1, 1, 1))
+        mask = np.zeros((b, 64, 64), np.float32)
+        mask[:, :, 32:] = 1.0                    # resample the right half
+        latent = {"samples": lat, "local_batch": b, "fanout": fanout,
+                  "noise_mask": mask}
+        (out,) = get_op("KSampler").execute(ctx, pipe, 7, 2, 1.5, "euler",
+                                            "normal", pos, pos, latent, 1.0)
+        s = np.asarray(out["samples"])
+        assert s.shape[0] == b * fanout
+        assert np.isfinite(s).all()
+        # unmasked (left) half anchored exactly to the zero source...
+        np.testing.assert_array_equal(s[:, :, :4, :],
+                                      np.zeros_like(s[:, :, :4, :]))
+        # ...masked half resampled
+        assert not np.allclose(s[:, :, 4:, :], 0.0)
+
+
 def _scaled_upscale(tile=32, padding=8, blur=2, steps=1):
     g = parse_workflow(UPSCALE)
     g.nodes["12"].inputs["image"] = "__missing__.png"   # synthetic test card
