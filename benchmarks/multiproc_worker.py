@@ -1,0 +1,89 @@
+"""Child process for the timed multi-process (DCN-analog) mini-bench.
+
+Joins a jax.distributed cluster through the framework's own entry points
+(the path ``cli.py`` takes on a real pod — ``force_cpu_platform`` +
+``initialize_multihost`` + ``build_mesh``), then times a fixed global
+workload: the tiny UNet forward over a data-sharded batch with a forced
+replicate-out (an ``all_gather`` across processes — the same collective
+the result-gather path rides).  CPU devices + gRPC/Gloo stand in for
+chips + DCN; the measurable quantity on one machine is multi-process
+dispatch+comm OVERHEAD, not scaling (same total devices in every
+config).
+
+Env: DTPU_BENCH_LOCAL_DEVICES, DTPU_BENCH_STEPS, DTPU_BENCH_REPEATS,
+plus the DTPU_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID trio when
+multi-process.  Process 0 prints one JSON line.
+"""
+
+import json
+import os
+import time
+
+from comfyui_distributed_tpu.parallel.mesh import (
+    build_mesh,
+    force_cpu_platform,
+    initialize_multihost,
+)
+
+LOCAL = int(os.environ.get("DTPU_BENCH_LOCAL_DEVICES", "2"))
+STEPS = int(os.environ.get("DTPU_BENCH_STEPS", "8"))
+REPEATS = int(os.environ.get("DTPU_BENCH_REPEATS", "5"))
+
+force_cpu_platform(LOCAL)
+initialize_multihost()
+
+import jax                     # noqa: E402  (after platform pin)
+import jax.numpy as jnp        # noqa: E402
+import numpy as np             # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+os.environ.setdefault("DTPU_DEFAULT_FAMILY", "tiny")
+from comfyui_distributed_tpu.models.registry import load_pipeline  # noqa: E402
+
+n_global = jax.device_count()
+mesh = build_mesh({"data": n_global})
+pipe = load_pipeline("bench-mp.ckpt", family_name="tiny")
+
+B = 8                                     # fixed GLOBAL batch
+assert B % n_global == 0
+local_b = B // n_global * jax.local_device_count()
+sh = NamedSharding(mesh, P("data"))
+rep = NamedSharding(mesh, P())
+
+rng = np.random.default_rng(0)            # identical in every process
+x_all = rng.standard_normal((B, 16, 16, 4)).astype(np.float32)
+start = jax.process_index() * local_b
+x = jax.make_array_from_process_local_data(
+    sh, x_all[start:start + local_b])
+ts = jnp.zeros((B,), jnp.float32)
+ctx = jnp.asarray(rng.standard_normal(
+    (B, 16, pipe.family.unet.context_dim)), jnp.float32)
+
+
+@jax.jit
+def step(params, xi, ti, ci):
+    out = pipe.unet.apply({"params": params}, xi, ti, ci)
+    # replicate-out = cross-process all_gather: the result-gather
+    # collective the framework's fan-out path performs
+    return jax.lax.with_sharding_constraint(out, rep)
+
+
+def run_once():
+    y = None
+    for _ in range(STEPS):
+        y = step(pipe.unet_params, x, ts, ctx)
+    jax.block_until_ready(y)
+
+
+run_once()                                 # compile
+t0 = time.time()
+for _ in range(REPEATS):
+    run_once()
+dt = (time.time() - t0) / REPEATS
+
+if jax.process_index() == 0:
+    print(json.dumps({"sec_per_batch": round(dt, 4),
+                      "processes": jax.process_count(),
+                      "global_devices": n_global,
+                      "steps": STEPS, "repeats": REPEATS,
+                      "global_batch": B}), flush=True)

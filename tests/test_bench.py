@@ -1,0 +1,67 @@
+"""bench.py surfaces that must not rot: the real-checkpoint smoke hook
+(VERDICT r3 #6) with a real single-file torch-layout checkpoint standing
+in at tiny scale — written by the framework's own exporter, loaded back
+through the converter by the bench, one image sampled, finite stats
+asserted, PNG artifact saved."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.integration
+def test_real_ckpt_smoke_hook(tmp_path):
+    from comfyui_distributed_tpu.models import registry
+    from comfyui_distributed_tpu.ops.base import OpContext, get_op
+
+    # a REAL checkpoint file on disk (tiny family, full torch layout)
+    pipe = registry.load_pipeline("bench-export.ckpt", family_name="tiny")
+    octx = OpContext(output_dir=str(tmp_path))
+    get_op("CheckpointSave").execute(octx, pipe, pipe, pipe, "tiny_real")
+    ckpt = tmp_path / "tiny_real.safetensors"
+    assert ckpt.exists()
+
+    out = tmp_path / "real_ckpt.json"
+    png = tmp_path / "real_ckpt.png"
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+           "DTPU_DEFAULT_FAMILY": "tiny",
+           "DISTRIBUTED_TPU_CONFIG": str(tmp_path / "c.json")}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--real-ckpt", str(ckpt), "--platform", "cpu",
+         "--height", "64", "--width", "64", "--steps", "2",
+         "--out", str(out), "--png-out", str(png)],
+        capture_output=True, text=True, timeout=420, cwd=str(tmp_path),
+        env=env)
+    assert r.returncode == 0, r.stderr[-3000:]
+    payload = json.loads(out.read_text())
+    assert payload["value"] > 0.0
+    assert payload["ckpt"] == "tiny_real.safetensors"
+    assert "latent_std" in payload and payload["latent_std"] > 0.0
+    assert png.exists() and png.stat().st_size > 0
+    # the loader must have consumed the FILE, not virtual-initialized
+    assert "virtual checkpoint" not in r.stderr
+
+
+@pytest.mark.integration
+def test_real_ckpt_missing_file_fails_structured(tmp_path):
+    out = tmp_path / "fail.json"
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+           "DTPU_DEFAULT_FAMILY": "tiny",
+           "DISTRIBUTED_TPU_CONFIG": str(tmp_path / "c.json")}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--real-ckpt", str(tmp_path / "nope.safetensors"),
+         "--platform", "cpu", "--out", str(out)],
+        capture_output=True, text=True, timeout=120, cwd=str(tmp_path),
+        env=env)
+    assert r.returncode != 0
+    payload = json.loads(out.read_text())
+    assert payload["error"]["stage"] == "config"
+    assert payload["value"] == 0.0
