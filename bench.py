@@ -86,12 +86,15 @@ def parse_args(argv=None):
     p.add_argument("--attn", default="xla", choices=["xla", "pallas", "ring"],
                    help="UNet attention impl — 'pallas' benchmarks the "
                         "custom flash kernel against the default XLA path")
-    p.add_argument("--init-patience", type=int, default=1500,
+    p.add_argument("--init-patience", type=int, default=1800,
                    help="total seconds to spend escaping a wedged backend "
-                        "(≥25 min: the server-side wedge can outlive short "
-                        "retry bursts)")
-    p.add_argument("--init-timeout", type=int, default=150,
-                   help="seconds per backend probe / in-process init")
+                        "(≥25 min: the server-side claim window)")
+    p.add_argument("--init-timeout", type=int, default=None,
+                   help="seconds per backend probe / in-process init "
+                        "(default: one LONG probe sized to the patience "
+                        "budget — killing a TPU client mid-claim wedges "
+                        "the server-side lease, so the probe must resolve "
+                        "naturally: devices or UNAVAILABLE)")
     p.add_argument("--scaling-sweep", action="store_true",
                    help="virtual-mesh SPMD overhead sweep instead of the "
                         "single-chip throughput bench")
@@ -266,9 +269,16 @@ def init_backend(args):
         force_cpu_platform(max(args.cpu_devices, 1))
     else:
         from comfyui_distributed_tpu.parallel.mesh import (
-            ensure_usable_backend)
+            claim_window_s, ensure_usable_backend)
+        # default: ONE probe sized past the server-side claim window
+        # (mesh.claim_window_s — single source of truth), so a wedged
+        # claim resolves naturally (devices or UNAVAILABLE) instead of
+        # being SIGKILLed mid-claim — each kill re-wedges the lease and
+        # poisons the next rung too
+        probe_timeout = args.init_timeout or max(args.init_patience - 120,
+                                                 claim_window_s() + 60)
         rep = ensure_usable_backend(patience_s=args.init_patience,
-                                    probe_timeout=args.init_timeout,
+                                    probe_timeout=probe_timeout,
                                     allow_cpu_fallback=False, force=True)
         if not rep["ok"]:
             diag = collect_diagnostics()
@@ -285,10 +295,11 @@ def init_backend(args):
     # The probe succeeding doesn't guarantee the in-process init can't wedge
     # (the flake is intermittent) — guard it with a hard-exit watchdog.
     done = threading.Event()
+    inproc_timeout = args.init_timeout or 600
 
     def watchdog():
-        if not done.wait(args.init_timeout):
-            log(f"in-process backend init hung >{args.init_timeout}s")
+        if not done.wait(inproc_timeout):
+            log(f"in-process backend init hung >{inproc_timeout}s")
             emit(args, failure_payload(
                 args, "backend_init_inprocess",
                 f"in-process jax.devices() wedged "

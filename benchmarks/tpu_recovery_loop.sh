@@ -1,0 +1,60 @@
+#!/bin/bash
+# TPU recovery loop: probe the chip with a natural-resolution window
+# (NEVER kill a client inside the ~25-min server-side claim window if
+# avoidable — a SIGKILLed claim wedges the lease), and the moment a
+# claim is granted, run the full TPU bench set + the on-chip Pallas
+# parity check, writing round-4 artifacts.  Exits after one full
+# successful set (sentinel: benchmarks/.tpu_bench_done_r4).
+#
+# Usage: nohup bash benchmarks/tpu_recovery_loop.sh >> benchmarks/tpu_recovery.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+SENTINEL=benchmarks/.tpu_bench_done_r4
+PROBE_WINDOW=1860         # > the ~25-min claim window: resolve, don't kill
+SLEEP_BETWEEN=480
+
+log() { echo "[recovery $(date -u +%H:%M:%S)] $*"; }
+
+[ -f "$SENTINEL" ] && { log "sentinel exists; nothing to do"; exit 0; }
+
+while true; do
+  log "probing backend (window ${PROBE_WINDOW}s)..."
+  if timeout "$PROBE_WINDOW" python - <<'EOF'
+import jax, sys
+ds = jax.devices()
+sys.exit(0 if ds[0].platform != "cpu" else 1)
+EOF
+  then
+    log "chip is UP — running the TPU bench set"
+    ok=1
+    # patience >= claim_window(1560)+120: bench's derived probe timeout
+    # then sits PAST the claim window, so a probe of a re-wedged client
+    # resolves naturally instead of being SIGKILLed mid-claim (the
+    # poison cycle this loop exists to break)
+    PAT=1700
+    # headline SDXL 1024
+    timeout 4200 python bench.py --init-patience $PAT \
+      --out benchmarks/sdxl_tpu_r4.json || ok=0
+    # pallas flash kernel vs xla, same workload
+    timeout 4200 python bench.py --init-patience $PAT --attn pallas \
+      --out benchmarks/sdxl_pallas_tpu_r4.json || ok=0
+    # on-chip pallas parity + VMEM fallback (VERDICT r3 #2)
+    timeout 1200 python benchmarks/pallas_onchip_check.py \
+      benchmarks/pallas_parity_tpu_r4.json || ok=0
+    # SD1.5 tiled upscale + img2img fixtures
+    timeout 4200 python bench.py --init-patience $PAT --upscale \
+      --out benchmarks/upscale_tpu_r4.json || ok=0
+    timeout 4200 python bench.py --init-patience $PAT --img2img \
+      --family sd15 --height 512 --width 512 \
+      --out benchmarks/img2img_tpu_r4.json || ok=0
+    if [ "$ok" = 1 ]; then
+      touch "$SENTINEL"
+      log "full TPU set done; exiting"
+      exit 0
+    fi
+    log "partial failure; will retry after sleep"
+  else
+    log "chip still unavailable"
+  fi
+  sleep "$SLEEP_BETWEEN"
+done

@@ -112,6 +112,14 @@ def _apply_platforms(value: Optional[str]) -> None:
 _backend_checked = False
 
 
+def claim_window_s() -> float:
+    """The server-side accelerator claim window (seconds): a client
+    killed INSIDE this window re-wedges the lease.  One source of truth
+    for the ladder, bench probe sizing, and the recovery loop
+    (override: ``DTPU_CLAIM_WINDOW_S``)."""
+    return float(os.environ.get("DTPU_CLAIM_WINDOW_S", "1560"))
+
+
 def ensure_usable_backend(patience_s: Optional[float] = None,
                           probe_timeout: Optional[float] = None,
                           allow_cpu_fallback: bool = True,
@@ -168,51 +176,84 @@ def ensure_usable_backend(patience_s: Optional[float] = None,
     # dedup: an env of '' or 'tpu' already IS that rung
     alternates = [(lbl, v) for lbl, v in alternates if v != (env_cfg or "")]
 
+    # Mid-claim-kill policy (one rule, both rungs): a probe that HUNG was
+    # likely inside the server-side claim window (~25 min) — SIGKILLing a
+    # client mid-claim re-wedges the lease, so a config that hung is never
+    # re-probed unless the remaining budget lets the retry resolve
+    # NATURALLY (devices or UNAVAILABLE).  Configs that failed FAST exited
+    # on their own (no kill happened) and stay freely retryable — the
+    # chip may recover between rounds.  Each alternate gets ONE shot
+    # regardless (a different path either comes up fast or tells us
+    # nothing more; its first kill is the price of the escape attempt).
+    claim_window = claim_window_s()
+    hung: Dict[str, bool] = {}
+
+    def _eligible_at(key: str, remaining: float) -> bool:
+        if remaining <= 0:
+            return False
+        return not hung.get(key) or remaining >= claim_window
+
+    def _eligible(key: str) -> bool:
+        return _eligible_at(key, deadline - time.monotonic())
+
+    def _probe_once(key, platforms, label_extra=""):
+        remaining = deadline - time.monotonic()
+        t = min(remaining, probe_timeout if not hung.get(key)
+                else max(probe_timeout, claim_window))
+        t0 = time.monotonic()
+        ok, info = _probe(platforms, max(t, 10.0))
+        entry = {"config": key, "ok": ok,
+                 "elapsed_s": round(time.monotonic() - t0, 1),
+                 "info": info if ok else str(info)}
+        if label_extra:
+            entry.update(label_extra)
+        report["attempts"].append(entry)
+        if not ok and str(info).startswith("probe hung"):
+            hung[key] = True
+        else:
+            # resolved NATURALLY (ok, or a clean error like UNAVAILABLE):
+            # no kill happened, the lease wasn't poisoned — the config is
+            # freely retryable again (a hung-once config whose full-window
+            # retry failed clean must not stay gated for the rest of the
+            # budget)
+            hung.pop(key, None)
+        return ok, info
+
     deadline = time.monotonic() + patience_s
     sleep_s, attempt = 60.0, 0
     while True:
         attempt += 1
-        t0 = time.monotonic()
-        ok, info = _probe(None, min(probe_timeout,
-                                    max(deadline - time.monotonic(), 10.0)))
-        report["attempts"].append(
-            {"config": "env", "attempt": attempt, "ok": ok,
-             "elapsed_s": round(time.monotonic() - t0, 1),
-             "info": info if ok else str(info)})
-        if ok and info.get("platform") != "cpu":
-            log(f"backend probe ok (env config, attempt {attempt}): {info}")
-            return report
-        if ok:
-            # the env config initialized CPU-ONLY — the accelerator client
-            # crashed fast and jax fell back (the round-1/2 flake's other
-            # face).  Never publish that as an accelerator success: with
-            # fallback allowed take CPU now, loudly (a genuinely CPU-only
-            # box must not wait out the full patience); for bench
-            # (no-fallback) keep laddering — the chip may come back
-            log(f"backend probe initialized CPU ONLY (env config, attempt "
-                f"{attempt}): {info}")
-            if allow_cpu_fallback:
-                force_cpu_platform(int(os.environ.get(
-                    "DTPU_CPU_FALLBACK_DEVICES", "1")))
-                report.update(ok=True, config="cpu", fell_back=True)
+        if _eligible("env"):
+            ok, info = _probe_once("env", None, {"attempt": attempt})
+            if ok and info.get("platform") != "cpu":
+                log(f"backend probe ok (env config, attempt {attempt}): "
+                    f"{info}")
                 return report
-        else:
-            log(f"backend probe failed (env config, attempt {attempt}): "
-                f"{info}")
-        # a hang (vs a clean error) suggests the wedge: try the alternates
-        # now — a different plugin path may come up even while the env
-        # one is stuck
+            if ok:
+                # the env config initialized CPU-ONLY — the accelerator
+                # client crashed fast and jax fell back (the round-1/2
+                # flake's other face).  Never publish that as an
+                # accelerator success: with fallback allowed take CPU
+                # now, loudly (a genuinely CPU-only box must not wait out
+                # the full patience); for bench (no-fallback) keep
+                # laddering — the chip may come back
+                log(f"backend probe initialized CPU ONLY (env config, "
+                    f"attempt {attempt}): {info}")
+                if allow_cpu_fallback:
+                    force_cpu_platform(int(os.environ.get(
+                        "DTPU_CPU_FALLBACK_DEVICES", "1")))
+                    report.update(ok=True, config="cpu", fell_back=True)
+                    return report
+            else:
+                log(f"backend probe failed (env config, attempt "
+                    f"{attempt}): {info}")
+        # a hang (vs a clean error) suggests the wedge: try the
+        # alternates — a different plugin path may come up even while
+        # the env one is stuck
         for lbl, val in alternates:
-            if time.monotonic() >= deadline:
-                break
-            t0 = time.monotonic()
-            ok, info = _probe(val, min(probe_timeout,
-                                       max(deadline - time.monotonic(),
-                                           10.0)))
-            report["attempts"].append(
-                {"config": lbl, "ok": ok,
-                 "elapsed_s": round(time.monotonic() - t0, 1),
-                 "info": info if ok else str(info)})
+            if not _eligible(lbl):
+                continue
+            ok, info = _probe_once(lbl, val)
             if ok and info.get("platform") != "cpu":
                 # a CPU-only success here is NOT an escape — it means the
                 # alternate config just dodged the accelerator entirely;
@@ -222,7 +263,11 @@ def ensure_usable_backend(patience_s: Optional[float] = None,
                 _apply_platforms(val)
                 report.update(config=lbl)
                 return report
-        if time.monotonic() + sleep_s >= deadline:
+        # sleep only if some config will still be eligible afterwards —
+        # otherwise the rest of the budget buys nothing
+        keys = ["env"] + [lbl for lbl, _ in alternates]
+        after = deadline - (time.monotonic() + sleep_s)
+        if after < 10 or not any(_eligible_at(k, after) for k in keys):
             break
         log(f"all configs down; sleeping {sleep_s:.0f}s "
             f"(wedge windows outlive short bursts)")

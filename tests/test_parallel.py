@@ -229,3 +229,41 @@ class TestBackendEscapeLadder:
         rep = self._run(monkeypatch, probe, patience_s=5,
                         allow_cpu_fallback=False)
         assert not rep["ok"] and rep["config"] is None
+
+    def test_hung_config_not_reprobed_without_claim_window(self,
+                                                           monkeypatch):
+        """Mid-claim-kill policy: after a config HANGS (its probe child
+        was killed, likely mid-claim), it is re-probed only when the
+        remaining budget lets a retry resolve naturally — short killed
+        retries of the same wedged path just re-wedge the lease."""
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("DTPU_CLAIM_WINDOW_S", "1000")
+
+        def probe(platforms, timeout):
+            return False, "probe hung >10s"
+
+        rep = self._run(monkeypatch, probe, patience_s=500,
+                        allow_cpu_fallback=False)
+        # one shot per config, no blind retries inside the window
+        assert [a["config"] for a in rep["attempts"]] == ["env", "auto",
+                                                          "tpu"]
+
+    def test_fast_failing_config_stays_retryable(self, monkeypatch):
+        """A config that fails FAST exited on its own (no kill): retries
+        are free and detect chip recovery between rounds."""
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("DTPU_CLAIM_WINDOW_S", "1000")
+        n = {"env": 0}
+
+        def probe(platforms, timeout):
+            if platforms is None:
+                n["env"] += 1
+                if n["env"] >= 3:     # chip comes back on the 3rd round
+                    return True, {"platform": "tpu", "kind": "v5e",
+                                  "count": 1}
+                return False, "rc=1: UNAVAILABLE"
+            return False, "rc=1: no backend"
+
+        rep = self._run(monkeypatch, probe, patience_s=100000)
+        assert rep["ok"] and rep["config"] == "env"
+        assert n["env"] == 3
