@@ -375,6 +375,178 @@ def sample_dpmpp_2m_sde(model: Model, x: jax.Array, sigmas: jax.Array,
         carry_init=(jnp.zeros_like(x), jnp.asarray(1.0, x.dtype)))
 
 
+def sample_dpmpp_sde(model: Model, x: jax.Array, sigmas: jax.Array,
+                     extra_args: Optional[Dict[str, Any]] = None,
+                     keys: Optional[jax.Array] = None,
+                     eta: float = 1.0, r: float = 1.0 / 2) -> jax.Array:
+    """DPM-Solver++ (stochastic): 2S with an ancestral noise split at BOTH
+    the midpoint and the full step (two model calls, two independent noise
+    draws per step; the per-sample streams use fold-ins 2i / 2i+1)."""
+    extra = extra_args or {}
+    if keys is None:
+        raise ValueError("dpmpp_sde requires per-sample keys")
+    noise_fn = make_noise_fn(keys)
+    sample_shape = x.shape[1:]
+    fac = 1.0 / (2.0 * r)
+
+    def step(carry, step_i, s, s_next):
+        x, _ = carry
+        denoised = model(x, s, **extra)
+
+        def euler_branch(_):
+            d = _to_d(x, s, denoised)
+            return x + d * (s_next - s)
+
+        def sde_branch(_):
+            t = -jnp.log(s)
+            h = -jnp.log(jnp.maximum(s_next, 1e-20)) - t
+            s_mid = jnp.exp(-(t + h * r))
+            # step 1: to the midpoint, ancestral split s -> s_mid.
+            # exp(t - t_of(sd)) = sd/s, so the k-diffusion update
+            # (sd/s)*x - expm1(log(sd/s))*denoised reduces to the
+            # interpolation below
+            sd1, su1 = _ancestral_sigmas(s, s_mid, eta)
+            x_2 = (sd1 / s) * (x - denoised) + denoised
+            x_2 = x_2 + noise_fn(step_i * 2, sample_shape) * su1
+            denoised_2 = model(x_2, s_mid, **extra)
+            # step 2: full step with the blended denoised
+            sd2, su2 = _ancestral_sigmas(s, s_next, eta)
+            denoised_d = (1 - fac) * denoised + fac * denoised_2
+            x_out = (sd2 / s) * (x - denoised_d) + denoised_d
+            return x_out + noise_fn(step_i * 2 + 1, sample_shape) * su2
+
+        x = jax.lax.cond(s_next > 0, sde_branch, euler_branch, None)
+        return (x, None), None
+
+    return _scan_sampler(step, x, sigmas)
+
+
+def sample_dpmpp_3m_sde(model: Model, x: jax.Array, sigmas: jax.Array,
+                        extra_args: Optional[Dict[str, Any]] = None,
+                        keys: Optional[jax.Array] = None,
+                        eta: float = 1.0) -> jax.Array:
+    """DPM-Solver++(3M) SDE: multistep, carries the TWO previous denoiseds
+    and step sizes; order ramps 1 -> 2 -> 3 over the first steps."""
+    extra = extra_args or {}
+    if keys is None:
+        raise ValueError("dpmpp_3m_sde requires per-sample keys")
+    noise_fn = make_noise_fn(keys)
+    sample_shape = x.shape[1:]
+
+    def step(carry, step_i, s, s_next):
+        x, (den_1, den_2, h_1, h_2) = carry
+        denoised = model(x, s, **extra)
+
+        def final(_):
+            return denoised, (den_1, den_2, h_1, h_2)
+
+        def sde_step(_):
+            h = -jnp.log(s_next) + jnp.log(s)
+            h_eta = h * (eta + 1.0)
+            x_out = jnp.exp(-h_eta) * x - jnp.expm1(-h_eta) * denoised
+            phi_2 = jnp.expm1(-h_eta) / h_eta + 1.0
+
+            def order1(_):
+                return x_out
+
+            def order2(_):
+                rr = h_1 / h
+                d = (denoised - den_1) / rr
+                return x_out + phi_2 * d
+
+            def order3(_):
+                r0, r1 = h_1 / h, h_2 / h
+                d1_0 = (denoised - den_1) / r0
+                d1_1 = (den_1 - den_2) / r1
+                d1 = d1_0 + (d1_0 - d1_1) * r0 / (r0 + r1)
+                d2 = (d1_0 - d1_1) / (r0 + r1)
+                phi_3 = phi_2 / h_eta - 0.5
+                return x_out + phi_2 * d1 - phi_3 * d2
+
+            x_out = jax.lax.switch(jnp.minimum(step_i, 2),
+                                   [order1, order2, order3], None)
+            if eta:
+                amt = s_next * jnp.sqrt(
+                    jnp.maximum(-jnp.expm1(-2.0 * h * eta), 0.0))
+                x_out = x_out + noise_fn(step_i, sample_shape) * amt
+            return x_out, (denoised, den_1, h, h_1)
+
+        x, new_carry = jax.lax.cond(s_next > 0, sde_step, final, None)
+        return (x, new_carry), None
+
+    zero = jnp.zeros_like(x)
+    one = jnp.asarray(1.0, x.dtype)
+    return _scan_sampler(step, x, sigmas,
+                         carry_init=(zero, zero, one, one))
+
+
+# 4-point Gauss-Legendre on [-1, 1]: exact for polynomials to degree 7 —
+# the LMS coefficient integrand is degree <= 3, so the quadrature is exact
+# (matching k-diffusion's adaptive quad without host-side scipy, which
+# cannot run under jit where sigmas are traced)
+_GL4_NODES = (-0.8611363115940526, -0.3399810435848563,
+              0.3399810435848563, 0.8611363115940526)
+_GL4_WEIGHTS = (0.3478548451374538, 0.6521451548625461,
+                0.6521451548625461, 0.3478548451374538)
+
+
+def _lms_coeff(order: int, sig_hist, s, s_next):
+    """∫_{s}^{s_next} Π_{k≠j} (τ - σ[i-k])/(σ[i-j] - σ[i-k]) dτ for each j
+    in range(order).  ``sig_hist[k]`` = σ[i-k] (k = 0..order-1)."""
+    half = (s_next - s) / 2.0
+    mid = (s_next + s) / 2.0
+    coeffs = []
+    for j in range(order):
+        total = 0.0
+        for node, w in zip(_GL4_NODES, _GL4_WEIGHTS):
+            tau = mid + half * node
+            prod = 1.0
+            for k in range(order):
+                if k == j:
+                    continue
+                prod = prod * (tau - sig_hist[k]) \
+                    / (sig_hist[j] - sig_hist[k])
+            total = total + w * prod
+        coeffs.append(half * total)
+    return coeffs
+
+
+def sample_lms(model: Model, x: jax.Array, sigmas: jax.Array,
+               extra_args: Optional[Dict[str, Any]] = None,
+               keys: Optional[jax.Array] = None,
+               order: int = 4) -> jax.Array:
+    """Linear multistep (Adams-Bashforth over the sigma axis): carries a
+    ring of the last ``order`` derivative estimates; the Lagrange-basis
+    integrals are computed in-graph by exact Gauss-Legendre quadrature."""
+    extra = extra_args or {}
+    sig = sigmas
+    order = max(1, min(int(order), 4))
+
+    def step(carry, step_i, s, s_next):
+        x, d_hist = carry                      # d_hist[k] = d at step i-k
+        denoised = model(x, s, **extra)
+        d = _to_d(x, s, denoised)
+        # shift the ring: newest first
+        d_hist = jnp.concatenate([d[None], d_hist[:-1]], axis=0)
+        sig_hist = [sig[jnp.maximum(step_i - k, 0)] for k in range(order)]
+
+        def make_branch(cur_order):
+            def branch(_):
+                cs = _lms_coeff(cur_order, sig_hist[:cur_order], s, s_next)
+                upd = x
+                for j in range(cur_order):
+                    upd = upd + cs[j] * d_hist[j]
+                return upd
+            return branch
+
+        branches = [make_branch(o + 1) for o in range(order)]
+        x = jax.lax.switch(jnp.minimum(step_i, order - 1), branches, None)
+        return (x, d_hist), None
+
+    d0 = jnp.zeros((order,) + x.shape, x.dtype)
+    return _scan_sampler(step, x, sigmas, carry_init=d0)
+
+
 def sample_lcm(model: Model, x: jax.Array, sigmas: jax.Array,
                extra_args: Optional[Dict[str, Any]] = None,
                keys: Optional[jax.Array] = None) -> jax.Array:
@@ -404,8 +576,11 @@ SAMPLERS: Dict[str, Callable] = {
     "dpm_2": sample_dpm_2,
     "dpm_2_ancestral": sample_dpm_2_ancestral,
     "dpmpp_2s_ancestral": sample_dpmpp_2s_ancestral,
+    "dpmpp_sde": sample_dpmpp_sde,
     "dpmpp_2m": sample_dpmpp_2m,
     "dpmpp_2m_sde": sample_dpmpp_2m_sde,
+    "dpmpp_3m_sde": sample_dpmpp_3m_sde,
+    "lms": sample_lms,
     "lcm": sample_lcm,
 }
 

@@ -253,3 +253,136 @@ class TestDenoiserPredictionTypes:
         den = make_denoiser(apply_fn, {}, ds, prediction_type="v")
         np.testing.assert_allclose(np.asarray(den(x, sigma)),
                                    np.asarray(x0), rtol=1e-4, atol=1e-4)
+
+
+class TestLoopOracles:
+    """The scan/carry mechanics of the multistep and 2-call samplers vs
+    straightforward per-step Python loops (where multistep bugs live):
+    same model, same keys, same noise streams — allclose required.  The
+    LMS loop integrates its coefficients with scipy.integrate.quad
+    (k-diffusion's method), independently validating the in-graph
+    Gauss-Legendre quadrature."""
+
+    def _setup(self, ds, steps=7, b=2):
+        import numpy as _np
+        sigmas = np.asarray(sch.compute_sigmas(ds, "karras", steps),
+                            _np.float64)
+        rng = _np.random.default_rng(5)
+        x = rng.standard_normal((b, 4, 4, 3)).astype(_np.float32) \
+            * sigmas[0]
+        keys = smp.sample_keys(_np.arange(b, dtype=_np.uint64) + 9)
+
+        def model(xx, s, **kw):
+            # nonlinear, sigma-dependent denoiser: exposes wrong-step
+            # bugs an ideal (constant) model hides
+            return jnp.tanh(xx) * (1.0 / (1.0 + s))
+
+        return sigmas, jnp.asarray(x), keys, model
+
+    @staticmethod
+    def _anc(s, s_next, eta=1.0):
+        import math
+        su = min(s_next, eta * math.sqrt(
+            max(s_next ** 2 * (s ** 2 - s_next ** 2) / s ** 2, 0.0)))
+        sd = math.sqrt(max(s_next ** 2 - su ** 2, 0.0))
+        return sd, su
+
+    def test_dpmpp_sde_matches_loop(self, ds):
+        import math
+        sigmas, x0, keys, model = self._setup(ds)
+        out = smp.sample_dpmpp_sde(model, x0, jnp.asarray(
+            np.asarray(sigmas, np.float32)), keys=keys)
+        noise_fn = smp.make_noise_fn(keys)
+        x = np.asarray(x0, np.float64)
+        r, fac = 0.5, 1.0
+        for i in range(len(sigmas) - 1):
+            s, s_next = sigmas[i], sigmas[i + 1]
+            den = np.asarray(model(jnp.asarray(x, jnp.float32), s),
+                             np.float64)
+            if s_next == 0:
+                x = x + (x - den) / s * (s_next - s)
+                continue
+            t = -math.log(s)
+            h = -math.log(s_next) - t
+            s_mid = math.exp(-(t + h * r))
+            sd1, su1 = self._anc(s, s_mid)
+            x2 = (sd1 / s) * (x - den) + den \
+                + np.asarray(noise_fn(2 * i, x.shape[1:]), np.float64) * su1
+            den2 = np.asarray(model(jnp.asarray(x2, jnp.float32), s_mid),
+                              np.float64)
+            sd2, su2 = self._anc(s, s_next)
+            dd = (1 - fac) * den + fac * den2
+            x = (sd2 / s) * (x - dd) + dd \
+                + np.asarray(noise_fn(2 * i + 1, x.shape[1:]),
+                             np.float64) * su2
+        np.testing.assert_allclose(np.asarray(out), x, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_dpmpp_3m_sde_matches_loop(self, ds):
+        import math
+        sigmas, x0, keys, model = self._setup(ds, steps=9)
+        out = smp.sample_dpmpp_3m_sde(model, x0, jnp.asarray(
+            np.asarray(sigmas, np.float32)), keys=keys)
+        noise_fn = smp.make_noise_fn(keys)
+        x = np.asarray(x0, np.float64)
+        eta = 1.0
+        den_1 = den_2 = None
+        h_1 = h_2 = None
+        for i in range(len(sigmas) - 1):
+            s, s_next = sigmas[i], sigmas[i + 1]
+            den = np.asarray(model(jnp.asarray(x, jnp.float32), s),
+                             np.float64)
+            if s_next == 0:
+                x = den
+                continue
+            h = math.log(s) - math.log(s_next)
+            h_eta = h * (eta + 1.0)
+            x = math.exp(-h_eta) * x - math.expm1(-h_eta) * den
+            phi_2 = math.expm1(-h_eta) / h_eta + 1.0
+            if h_2 is not None:
+                r0, r1 = h_1 / h, h_2 / h
+                d1_0 = (den - den_1) / r0
+                d1_1 = (den_1 - den_2) / r1
+                d1 = d1_0 + (d1_0 - d1_1) * r0 / (r0 + r1)
+                d2 = (d1_0 - d1_1) / (r0 + r1)
+                phi_3 = phi_2 / h_eta - 0.5
+                x = x + phi_2 * d1 - phi_3 * d2
+            elif h_1 is not None:
+                x = x + phi_2 * ((den - den_1) / (h_1 / h))
+            amt = s_next * math.sqrt(max(-math.expm1(-2 * h * eta), 0.0))
+            x = x + np.asarray(noise_fn(i, x.shape[1:]), np.float64) * amt
+            den_1, den_2 = den, den_1
+            h_1, h_2 = h, h_1
+        np.testing.assert_allclose(np.asarray(out), x, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_lms_matches_scipy_quad_loop(self, ds):
+        from scipy import integrate
+        sigmas, x0, keys, model = self._setup(ds, steps=8)
+        out = smp.sample_lms(model, x0, jnp.asarray(
+            np.asarray(sigmas, np.float32)))
+
+        def coeff(order, t, i, j):
+            def fn(tau):
+                prod = 1.0
+                for k in range(order):
+                    if j == k:
+                        continue
+                    prod *= (tau - t[i - k]) / (t[i - j] - t[i - k])
+                return prod
+            return integrate.quad(fn, t[i], t[i + 1], epsrel=1e-6)[0]
+
+        x = np.asarray(x0, np.float64)
+        dhist = []
+        for i in range(len(sigmas) - 1):
+            den = np.asarray(model(jnp.asarray(x, jnp.float32), sigmas[i]),
+                             np.float64)
+            d = (x - den) / sigmas[i]
+            dhist.append(d)
+            if len(dhist) > 4:
+                dhist.pop(0)
+            cur = min(i + 1, 4)
+            cs = [coeff(cur, sigmas, i, j) for j in range(cur)]
+            x = x + sum(c * dd for c, dd in zip(cs, reversed(dhist)))
+        np.testing.assert_allclose(np.asarray(out), x, rtol=2e-4,
+                                   atol=2e-4)
