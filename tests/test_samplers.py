@@ -71,7 +71,8 @@ class TestSamplers:
         out = sampler(ideal_model(x0), x, sigmas, keys=keys)
         assert np.allclose(np.asarray(out), np.asarray(x0), atol=1e-3), name
 
-    @pytest.mark.parametrize("name", ["euler_ancestral", "dpmpp_2m_sde", "lcm"])
+    @pytest.mark.parametrize("name", ["euler_ancestral", "dpmpp_2m_sde",
+                                      "lcm", "dpmpp_sde", "dpmpp_3m_sde"])
     def test_stochastic_requires_keys(self, ds, name):
         sigmas = jnp.asarray(sch.compute_sigmas(ds, "normal", 4))
         x = jnp.zeros((1, 2, 2, 1))
@@ -183,6 +184,27 @@ class TestPerStepInterrupt:
         expect = np.asarray(x) * float(sigmas[3] / sigmas[0])
         np.testing.assert_allclose(out, expect, rtol=1e-4)
         assert len(calls) == 3   # steps 4..20 never called the model
+
+    def test_preset_interrupt_never_calls_model_uni_pc(self, ds):
+        """uni_pc's priming call runs OUTSIDE the scan: it must honor the
+        poll too — an already-interrupted run pays ZERO model calls (the
+        latent-untouched check alone can't see a wasted forward)."""
+        from comfyui_distributed_tpu.runtime import interrupt as itr
+
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "karras", 6))
+        x = jnp.ones((1, 4, 4, 3), jnp.float32) * sigmas[0]
+        calls = []
+
+        def model(xin, sigma, **kw):
+            z = jax.pure_callback(
+                lambda _: (calls.append(1), np.float32(0.0))[1],
+                jax.ShapeDtypeStruct((), np.float32), xin.reshape(-1)[0])
+            return jnp.zeros_like(xin) + z
+
+        itr.request_interrupt()
+        out = np.asarray(smp.sample_uni_pc(model, x, sigmas))
+        np.testing.assert_allclose(out, np.asarray(x))
+        assert calls == []
 
 
 class TestCFG:
@@ -386,3 +408,95 @@ class TestLoopOracles:
             x = x + sum(c * dd for c, dd in zip(cs, reversed(dhist)))
         np.testing.assert_allclose(np.asarray(out), x, rtol=2e-4,
                                    atol=2e-4)
+
+    @staticmethod
+    def _unipc_loop(sigmas, x0, model, variant):
+        """Per-step Python UniPC loop with numpy solves (order ramp at
+        both ends, corrector-eval reuse, predictor-only final step on a
+        window ending above sigma 0)."""
+        import math
+
+        def m_of(xx, s):
+            return np.asarray(model(jnp.asarray(xx, jnp.float32), s),
+                              np.float64)
+
+        n = len(sigmas) - 1
+        x = np.asarray(x0, np.float64)
+        m_list = [m_of(x, sigmas[0])]          # priming call
+        for i in range(n):
+            s, s_next = sigmas[i], sigmas[i + 1]
+            m0 = m_list[-1]
+            if s_next == 0:
+                x = m0
+                continue
+            last_nonzero = i == n - 1          # window ending above 0
+            order = min(i + 1, 3, n - i)
+            lam0, lam_t = -math.log(s), -math.log(s_next)
+            h = lam_t - lam0
+            hh = -h
+            h_phi_1 = math.expm1(hh)
+            B_h = hh if variant == "bh1" else math.expm1(hh)
+            rks, d1s = [], []
+            for k in range(1, order):
+                lam_k = -math.log(sigmas[i - k])
+                rk = (lam_k - lam0) / h
+                rks.append(rk)
+                d1s.append((m_list[-1 - k] - m0) / rk)
+            rks.append(1.0)
+            b, h_phi_k, fact = [], h_phi_1 / hh - 1.0, 1.0
+            for j in range(1, order + 1):
+                b.append(h_phi_k * fact / B_h)
+                fact *= j + 1
+                h_phi_k = h_phi_k / hh - 1.0 / fact
+            R = np.vander(np.asarray(rks), order, increasing=True).T
+            x_t_ = (s_next / s) * x - h_phi_1 * m0
+            if order == 1:
+                x_pred = x_t_
+            elif order == 2:
+                x_pred = x_t_ - B_h * (0.5 * d1s[0])
+            else:
+                rhos_p = np.linalg.solve(R[:-1, :-1], np.asarray(b[:-1]))
+                x_pred = x_t_ - B_h * sum(
+                    rhos_p[k] * d1s[k] for k in range(order - 1))
+            if last_nonzero:
+                # reference: use_corrector=False on the last step of a
+                # window ending above sigma 0 (predictor-only)
+                x = x_pred
+                continue
+            m_t = m_of(x_pred, s_next)
+            d1_t = m_t - m0
+            if order == 1:
+                corr = 0.5 * d1_t
+            else:
+                rhos_c = np.linalg.solve(R, np.asarray(b))
+                corr = rhos_c[-1] * d1_t + sum(
+                    rhos_c[k] * d1s[k] for k in range(order - 1))
+            x = x_t_ - B_h * corr
+            m_list.append(m_t)
+        return x
+
+    @pytest.mark.parametrize("variant", ["bh1", "bh2"])
+    def test_uni_pc_matches_loop(self, ds, variant):
+        """UniPC vs the Python loop oracle on a full schedule (ends at
+        sigma 0)."""
+        sigmas, x0, keys, model = self._setup(ds, steps=8)
+        name = "uni_pc" if variant == "bh1" else "uni_pc_bh2"
+        out = smp.get_sampler(name)(model, x0, jnp.asarray(
+            np.asarray(sigmas, np.float32)))
+        ref = self._unipc_loop(sigmas, x0, model, variant)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-4,
+                                   atol=3e-4)
+
+    @pytest.mark.parametrize("variant", ["bh1", "bh2"])
+    def test_uni_pc_truncated_window_matches_loop(self, ds, variant):
+        """A schedule ending ABOVE sigma 0 (img2img-style window): the
+        last update must be predictor-only (reference use_corrector=False
+        on the final step)."""
+        sigmas_full, x0, keys, model = self._setup(ds, steps=7)
+        sigmas = sigmas_full[:-1]              # drop the trailing 0
+        name = "uni_pc" if variant == "bh1" else "uni_pc_bh2"
+        out = smp.get_sampler(name)(model, x0, jnp.asarray(
+            np.asarray(sigmas, np.float32)))
+        ref = self._unipc_loop(sigmas, x0, model, variant)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-4,
+                                   atol=3e-4)
