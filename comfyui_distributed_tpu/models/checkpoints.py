@@ -18,7 +18,10 @@ weights transposed, norm ``weight`` <-> ``scale``, OpenCLIP's packed
 ``in_proj_weight`` split into q/k/v.  The same mapping tables drive both
 directions (one ``_run_*`` walk per model, load/export mappers), so
 round-tripping is exact by construction.  Weights load as fp32 numpy; dtype
-policy (bf16 compute) is applied by the modules at apply time.
+policy (bf16 compute) is applied by the modules at apply time — EXCEPT
+that ``registry.load_pipeline`` may then drop UNet/CLIP STORAGE to bf16
+(``DTPU_BF16_WEIGHTS``, HBM bandwidth); an export after that is bf16, not
+a bit-exact round-trip of an fp32/fp16 source (CheckpointSave warns).
 """
 
 from __future__ import annotations

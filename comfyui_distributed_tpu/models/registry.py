@@ -456,6 +456,18 @@ def load_pipeline(ckpt_name: str, models_dir: Optional[str] = None,
         log(f"virtual checkpoint {ckpt_name!r} ({fam.name}): no file on disk, "
             f"deterministic init (seed {seed})")
 
+    if _bf16_weights_enabled(fam):
+        # bf16 WEIGHT STORAGE for the compute towers (UNet + CLIP): the
+        # UNet computes in bf16 anyway, so fp32 storage only doubles the
+        # HBM weight traffic every denoise step (and fp32 SDXL weights
+        # would crowd a 16 GB v5e chip).  The VAE stays fp32 — its
+        # GroupNorm/attention decode path is the one place bf16 weights
+        # visibly cost quality.  Opt out: DTPU_BF16_WEIGHTS=0.
+        unet_p = _cast_bf16(unet_p)
+        clip_ps = [_cast_bf16(p) for p in clip_ps]
+        log(f"{ckpt_name}: UNet/CLIP weights stored bf16 "
+            f"(DTPU_BF16_WEIGHTS=0 for fp32)")
+
     pipe = DiffusionPipeline(ckpt_name, fam, unet_p, clip_ps, vae_p,
                              prediction_type=fam.unet.prediction_type,
                              assets_dir=models_dir)
@@ -463,6 +475,22 @@ def load_pipeline(ckpt_name: str, models_dir: Optional[str] = None,
     with _pipeline_lock:
         _pipeline_cache[key] = pipe
     return pipe
+
+
+def _bf16_weights_enabled(fam: ModelFamily) -> bool:
+    """bf16 weight storage default: on for the real families (their UNet
+    dtype is bf16), off for 'tiny' (fp32 module — deterministic CPU
+    tests)."""
+    env = os.environ.get("DTPU_BF16_WEIGHTS")
+    if env is not None:
+        return env not in ("0", "false", "")
+    return fam.unet.dtype == jnp.bfloat16
+
+
+def _cast_bf16(tree):
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if hasattr(a, "dtype") and a.dtype == jnp.float32 else a, tree)
 
 
 def clear_pipeline_cache() -> None:

@@ -88,6 +88,25 @@ def _to_d(x: jax.Array, sigma: jax.Array, denoised: jax.Array) -> jax.Array:
     return (x - denoised) / jnp.maximum(sigma, 1e-20)
 
 
+def _interrupt_stop(operand) -> jax.Array:
+    """Traced poll of the process-global interrupt flag.
+
+    io_callback, not pure_callback: the poll reads mutable host state,
+    and an effectful callback can't be CSE'd/elided when the operand
+    repeats (it does once interrupted — the carry goes constant).
+    Ordering comes from the data-derived ``operand``, so ordered=False
+    keeps it compatible with sharded (SPMD) sampling.  The ONE copy of
+    this subtle idiom — the scan body and uni_pc's priming call both use
+    it."""
+    import numpy as _np
+
+    from jax.experimental import io_callback
+
+    from comfyui_distributed_tpu.runtime import interrupt as itr
+    return io_callback(itr.poll, jax.ShapeDtypeStruct((), _np.bool_),
+                       operand)
+
+
 def _scan_sampler(step_fn, x, sigmas, carry_init=None):
     """Run ``step_fn`` over consecutive sigma pairs with lax.scan.
 
@@ -108,17 +127,7 @@ def _scan_sampler(step_fn, x, sigmas, carry_init=None):
         step, (s, s_next) = inp
         if not poll:
             return step_fn(carry, step, s, s_next)
-        import numpy as _np
-
-        from jax.experimental import io_callback
-        # io_callback, not pure_callback: the poll reads mutable host state,
-        # and an effectful callback can't be CSE'd/elided when the operand
-        # repeats (it does once interrupted — the carry goes constant).
-        # Ordering comes from the carry-derived operand, so ordered=False
-        # keeps it compatible with sharded (SPMD) sampling.
-        stop = io_callback(
-            itr.poll, jax.ShapeDtypeStruct((), _np.bool_),
-            carry[0].reshape(-1)[0])
+        stop = _interrupt_stop(carry[0].reshape(-1)[0])
         new_carry = jax.lax.cond(
             stop,
             lambda c: c,
@@ -588,12 +597,7 @@ def _make_unipc(variant: str):
         # full model forward before the scan's own polls kick in)
         from comfyui_distributed_tpu.runtime import interrupt as itr
         if itr.polling_enabled():
-            import numpy as _np
-
-            from jax.experimental import io_callback
-            stop0 = io_callback(itr.poll,
-                                jax.ShapeDtypeStruct((), _np.bool_),
-                                x.reshape(-1)[0])
+            stop0 = _interrupt_stop(x.reshape(-1)[0])
             m_init = jax.lax.cond(
                 stop0, lambda _: jnp.zeros_like(x),
                 lambda _: model(x, sigmas[0], **extra), None)

@@ -29,7 +29,7 @@ from comfyui_distributed_tpu.utils.image import (
     resize_image,
     tensor_to_pil,
 )
-from comfyui_distributed_tpu.utils.logging import Timer, debug_log
+from comfyui_distributed_tpu.utils.logging import Timer, debug_log, log
 
 
 @register_op
@@ -683,6 +683,18 @@ class CheckpointSave(Op):
         path = _safe_output_path(ctx.output_dir or os.getcwd(),
                                  f"{filename_prefix}.safetensors")
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        import jax
+        import jax.numpy as jnp
+        if any(getattr(a, "dtype", None) == jnp.bfloat16
+               for a in jax.tree_util.tree_leaves(model.unet_params)):
+            # bf16 weight STORAGE (registry.load_pipeline) reaches the
+            # export: the saved file will be bf16 — fine for reuse, but
+            # not a bit-exact round-trip of an fp32/fp16 source.  For a
+            # full-precision export: DTPU_BF16_WEIGHTS=0 + reload first.
+            log("CheckpointSave: weights are stored bf16 "
+                "(DTPU_BF16_WEIGHTS); the exported file will be bf16 — "
+                "set DTPU_BF16_WEIGHTS=0 and reload for a full-precision "
+                "export")
         # model/clip/vae may be three different pipelines (VAELoader,
         # clip-skip, LoRA splits): take each tower from its own source
         save_checkpoint(path, model.unet_params, clip.clip_params,
@@ -901,10 +913,32 @@ class SaveImage(Op):
         arr = as_image_array(images)
         ctx.saved_images.extend(list(arr))
         if ctx.output_dir:
-            os.makedirs(ctx.output_dir, exist_ok=True)
+            probe = _safe_output_path(ctx.output_dir,
+                                      f"{filename_prefix}_00000.png")
+            d, fname = os.path.split(probe)
+            base = fname[:-len("_00000.png")]
+            os.makedirs(d, exist_ok=True)
+            # counters continue across runs — a second queue of the same
+            # workflow must never overwrite earlier outputs (ComfyUI's
+            # incrementing-counter save semantics)
+            start = _next_image_counter(d, base)
             for i in range(arr.shape[0]):
-                p = _safe_output_path(ctx.output_dir,
-                                      f"{filename_prefix}_{i:05d}.png")
-                os.makedirs(os.path.dirname(p), exist_ok=True)
-                tensor_to_pil(arr, i).save(p)
+                tensor_to_pil(arr, i).save(
+                    os.path.join(d, f"{base}_{start + i:05d}.png"))
         return ()
+
+
+def _next_image_counter(dirpath: str, base: str) -> int:
+    """First unused counter for ``base_#####.png`` files in ``dirpath``."""
+    import re
+    pat = re.compile(re.escape(base) + r"_(\d+)\.png$")  # \d+: the save
+    # format widens past 99999, and a 5-digit match would overwrite there
+    mx = -1
+    try:
+        for f in os.listdir(dirpath):
+            m = pat.match(f)
+            if m:
+                mx = max(mx, int(m.group(1)))
+    except OSError:
+        pass
+    return mx + 1

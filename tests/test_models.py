@@ -668,3 +668,58 @@ class TestTiledSR:
         diff = np.abs(np.asarray(tiled) - np.asarray(whole))
         assert np.median(diff) < 1e-4, float(np.median(diff))
         assert np.mean(diff) < 0.02, float(np.mean(diff))
+
+
+class TestBf16WeightStorage:
+    def test_flag_casts_unet_clip_not_vae(self, monkeypatch):
+        """DTPU_BF16_WEIGHTS: UNet/CLIP weight storage drops to bf16 (on
+        TPU, fp32 storage doubles HBM weight traffic per step and SDXL
+        fp32 wouldn't fit a 16 GB v5e); the VAE stays fp32.  Sampling
+        still produces finite output with bf16-stored params."""
+        import jax.numpy as jnp
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        monkeypatch.setenv("DTPU_BF16_WEIGHTS", "1")
+        registry.clear_pipeline_cache()
+        try:
+            pipe = registry.load_pipeline("bf16-flag.ckpt",
+                                          family_name="tiny")
+            u = jax.tree_util.tree_leaves(pipe.unet_params)
+            assert all(x.dtype == jnp.bfloat16 for x in u
+                       if x.dtype in (jnp.float32, jnp.bfloat16))
+            v = jax.tree_util.tree_leaves(pipe.vae_params)
+            assert any(x.dtype == jnp.float32 for x in v)
+            ctx_arr, _ = pipe.encode_prompt(["x"])
+            pos = Conditioning(context=ctx_arr, pooled=None)
+            lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+            (out,) = get_op("KSampler").execute(
+                OpContext(), pipe, 3, 2, 1.5, "euler", "normal",
+                pos, pos, lat, 1.0)
+            assert np.isfinite(np.asarray(out["samples"])).all()
+        finally:
+            registry.clear_pipeline_cache()
+
+    def test_default_off_for_tiny(self):
+        """tiny (fp32 module, deterministic CPU tests) keeps fp32 storage
+        by default — only the real bf16-compute families opt in."""
+        registry.clear_pipeline_cache()
+        pipe = registry.load_pipeline("fp32-default.ckpt",
+                                      family_name="tiny")
+        import jax.numpy as jnp
+        u = jax.tree_util.tree_leaves(pipe.unet_params)
+        assert all(x.dtype == jnp.float32 for x in u)
+        registry.clear_pipeline_cache()
+
+
+class TestSaveImageCounters:
+    def test_second_run_does_not_overwrite(self, tmp_path):
+        """ComfyUI save semantics: counters continue across runs — a
+        re-queued workflow appends new files instead of clobbering."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        img = np.zeros((2, 8, 8, 3), np.float32)
+        octx = OpContext(output_dir=str(tmp_path))
+        get_op("SaveImage").execute(octx, img, "run")
+        get_op("SaveImage").execute(octx, img + 0.5, "run")
+        names = sorted(p.name for p in tmp_path.glob("run_*.png"))
+        assert names == ["run_00000.png", "run_00001.png",
+                         "run_00002.png", "run_00003.png"]
