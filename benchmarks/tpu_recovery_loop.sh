@@ -35,6 +35,9 @@ EOF
     # headline SDXL 1024
     timeout 4200 python bench.py --init-patience $PAT \
       --out benchmarks/sdxl_tpu_r4.json || ok=0
+    # BASELINE config 2: SDXL 1024 batch=8 (the fan-out batch shape)
+    timeout 4200 python bench.py --init-patience $PAT --batch 8 \
+      --out benchmarks/sdxl_b8_tpu_r4.json || ok=0
     # pallas flash kernel vs xla, same workload
     timeout 4200 python bench.py --init-patience $PAT --attn pallas \
       --out benchmarks/sdxl_pallas_tpu_r4.json || ok=0

@@ -436,6 +436,49 @@ class VAEDecodeTiled(Op):
 
 
 @register_op
+class VAEEncodeTiled(Op):
+    """ComfyUI's VAEEncodeTiled: bounded-memory encode for large sources
+    (overlapping pixel tiles, latent-space feathered blend —
+    registry.vae_encode_tiled).  Fan-out semantics identical to
+    VAEEncode."""
+    TYPE = "VAEEncodeTiled"
+    WIDGETS = ["tile_size", "overlap"]
+    DEFAULTS = {"tile_size": 512, "overlap": 64}
+
+    def execute(self, ctx: OpContext, pixels, vae,
+                tile_size: int = 512, overlap: int = 64):
+        ctx.check_interrupt()
+        img = jnp.asarray(as_image_array(pixels))
+        with Timer("vae_encode_tiled"):
+            lat = vae.vae_encode_tiled(img, tile_size=int(tile_size),
+                                       overlap=int(overlap),
+                                       check_interrupt=ctx.check_interrupt)
+        return _expand_encoded_latent(ctx, pixels, lat)
+
+
+def _expand_encoded_latent(ctx: OpContext, pixels, lat):
+    """Shared VAEEncode/VAEEncodeTiled fan-out: tile a fresh batch to
+    ``batch * fanout``; pass an already-fanned hires-fix batch through."""
+    b = int(lat.shape[0])
+    in_fan = int(getattr(pixels, "fanout", 1) or 1)
+    if in_fan > 1:
+        # already-fanned pixels (hires-fix chain: KSampler -> VAEDecode
+        # -> ... -> VAEEncode): the batch holds one slice per replica
+        # — re-tiling would square the fan-out
+        local_b = int(getattr(pixels, "local_batch", None)
+                      or b // in_fan)
+        return ({"samples": lat, "local_batch": local_b,
+                 "fanout": in_fan},)
+    fanout = max(ctx.fanout, 1)
+    if fanout > 1:
+        # host-side tile (EmptyLatentImage convention): KSampler pulls
+        # the latent to host anyway, so duplicating on-device would add
+        # a fanout-times device->host transfer for identical bytes
+        lat = np.tile(np.asarray(lat), (fanout, 1, 1, 1))
+    return ({"samples": lat, "local_batch": b, "fanout": fanout},)
+
+
+@register_op
 class VAEEncode(Op):
     """Pixels -> latent.  In a distributed run the encoded batch expands to
     ``batch * fanout`` exactly like ``EmptyLatentImage`` — the img2img
@@ -448,23 +491,7 @@ class VAEEncode(Op):
         img = jnp.asarray(as_image_array(pixels))
         with Timer("vae_encode"):
             lat = vae.vae_encode(img)
-        b = int(lat.shape[0])
-        in_fan = int(getattr(pixels, "fanout", 1) or 1)
-        if in_fan > 1:
-            # already-fanned pixels (hires-fix chain: KSampler -> VAEDecode
-            # -> ... -> VAEEncode): the batch holds one slice per replica
-            # — re-tiling would square the fan-out
-            local_b = int(getattr(pixels, "local_batch", None)
-                          or b // in_fan)
-            return ({"samples": lat, "local_batch": local_b,
-                     "fanout": in_fan},)
-        fanout = max(ctx.fanout, 1)
-        if fanout > 1:
-            # host-side tile (EmptyLatentImage convention): KSampler pulls
-            # the latent to host anyway, so duplicating on-device would add
-            # a fanout-times device->host transfer for identical bytes
-            lat = np.tile(np.asarray(lat), (fanout, 1, 1, 1))
-        return ({"samples": lat, "local_batch": b, "fanout": fanout},)
+        return _expand_encoded_latent(ctx, pixels, lat)
 
 
 def _keep_fanout_meta(src, arr):
@@ -684,7 +711,6 @@ class CheckpointSave(Op):
                                  f"{filename_prefix}.safetensors")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         import jax
-        import jax.numpy as jnp
         if any(getattr(a, "dtype", None) == jnp.bfloat16
                for a in jax.tree_util.tree_leaves(model.unet_params)):
             # bf16 weight STORAGE (registry.load_pipeline) reaches the

@@ -187,6 +187,32 @@ def uniform_tile_starts(total: int, tile: int, overlap: int) -> list:
     return sorted(set(out))
 
 
+def tiled_apply_down(fn, x: np.ndarray, tile: int, overlap: int,
+                     down: int, out_channels: int,
+                     check_interrupt=None) -> np.ndarray:
+    """``tiled_apply`` for a DOWNSCALING fn ([B,th*down,tw*down,C] ->
+    [B,th,tw,out_channels], e.g. the VAE encoder): windows are laid out
+    in OUTPUT (latent) coordinates so every pixel-space window start
+    stays aligned to the downscale factor, and blending happens at
+    latent resolution."""
+    b, h, w, _ = x.shape
+    oh, ow = h // down, w // down
+    th, tw = min(tile, oh), min(tile, ow)
+    canvas = np.zeros((b, oh, ow, out_channels), np.float32)
+    weight = np.zeros((1, oh, ow, 1), np.float32)
+    mask = make_feather_mask(tw, th, overlap)[None, :, :, None]
+    for y0 in uniform_tile_starts(oh, th, overlap):
+        for x0 in uniform_tile_starts(ow, tw, overlap):
+            if check_interrupt is not None:
+                check_interrupt()
+            out = np.asarray(
+                fn(x[:, y0 * down:(y0 + th) * down,
+                     x0 * down:(x0 + tw) * down, :]), np.float32)
+            canvas[:, y0:y0 + th, x0:x0 + tw] += out * mask
+            weight[:, y0:y0 + th, x0:x0 + tw] += mask
+    return canvas / np.maximum(weight, 1e-8)
+
+
 def tiled_apply(fn, x: np.ndarray, tile: int, overlap: int, scale: int,
                 out_channels: int, check_interrupt=None) -> np.ndarray:
     """Apply ``fn`` ([B,th,tw,C] -> [B,th*scale,tw*scale,out_channels])

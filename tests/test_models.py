@@ -699,9 +699,10 @@ class TestBf16WeightStorage:
         finally:
             registry.clear_pipeline_cache()
 
-    def test_default_off_for_tiny(self):
+    def test_default_off_for_tiny(self, monkeypatch):
         """tiny (fp32 module, deterministic CPU tests) keeps fp32 storage
         by default — only the real bf16-compute families opt in."""
+        monkeypatch.delenv("DTPU_BF16_WEIGHTS", raising=False)
         registry.clear_pipeline_cache()
         pipe = registry.load_pipeline("fp32-default.ckpt",
                                       family_name="tiny")
@@ -723,3 +724,41 @@ class TestSaveImageCounters:
         names = sorted(p.name for p in tmp_path.glob("run_*.png"))
         assert names == ["run_00000.png", "run_00001.png",
                          "run_00002.png", "run_00003.png"]
+
+
+class TestVAEEncodeTiled:
+    def test_tiled_encode_close_to_full(self):
+        """Latent-space feathered blend of pixel tiles tracks the
+        one-shot encode (per-tile GroupNorm stats differ slightly, like
+        the tiled decode); one-tile inputs take the exact path."""
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("enc-tiled.ckpt")
+        ds = p.family.vae.downscale
+        img = jnp.asarray(np.random.default_rng(7).uniform(
+            0, 1, (1, 48, 48, 3)).astype(np.float32))
+        full = np.asarray(p.vae_encode(img))
+        same = np.asarray(p.vae_encode_tiled(img, tile_size=48,
+                                             overlap=8))
+        np.testing.assert_allclose(same, full, atol=1e-6)
+        tiled = np.asarray(p.vae_encode_tiled(img, tile_size=16 * ds,
+                                              overlap=4 * ds))
+        assert tiled.shape == full.shape
+        assert np.isfinite(tiled).all()
+        cc = np.corrcoef(tiled.ravel(), full.ravel())[0, 1]
+        assert cc > 0.98, cc
+        registry.clear_pipeline_cache()
+
+    def test_op_fans_out_like_vaeencode(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        p = registry.load_pipeline("enc-tiled-op.ckpt")
+        img = np.random.default_rng(8).uniform(
+            0, 1, (1, 32, 32, 3)).astype(np.float32)
+        octx = OpContext()
+        octx.fanout = 4
+        (lat,) = get_op("VAEEncodeTiled").execute(octx, img, p,
+                                                  tile_size=16, overlap=4)
+        assert lat["samples"].shape[0] == 4    # batch * fanout
+        assert lat["fanout"] == 4 and lat["local_batch"] == 1
+        # all replicas hold the SAME source latent (img2img sweep)
+        s = np.asarray(lat["samples"])
+        np.testing.assert_array_equal(s[0], s[3])
