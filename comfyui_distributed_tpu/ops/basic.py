@@ -448,7 +448,10 @@ class VAEEncodeTiled(Op):
     def execute(self, ctx: OpContext, pixels, vae,
                 tile_size: int = 512, overlap: int = 64):
         ctx.check_interrupt()
-        img = jnp.asarray(as_image_array(pixels))
+        # host array in: only per-tile slices ever need to reach the
+        # device — pushing a 4K source up just to pull it back for
+        # tiling would be two wasted full-array transfers
+        img = np.asarray(as_image_array(pixels), np.float32)
         with Timer("vae_encode_tiled"):
             lat = vae.vae_encode_tiled(img, tile_size=int(tile_size),
                                        overlap=int(overlap),
@@ -524,6 +527,49 @@ class SetLatentNoiseMask(Op):
 
 
 @register_op
+class ImagePadForOutpaint(Op):
+    """ComfyUI's outpaint prep: extend the canvas with mid-gray on the
+    requested sides and return (padded image, mask) where the mask is 1
+    over the new area and feathers quadratically to 0 inside the original
+    border — feed both into VAEEncodeForInpaint to outpaint."""
+    TYPE = "ImagePadForOutpaint"
+    WIDGETS = ["left", "top", "right", "bottom", "feathering"]
+    DEFAULTS = {"left": 0, "top": 0, "right": 0, "bottom": 0,
+                "feathering": 40}
+
+    def execute(self, ctx: OpContext, image, left: int = 0, top: int = 0,
+                right: int = 0, bottom: int = 0, feathering: int = 40):
+        img = np.asarray(as_image_array(image), np.float32)
+        b, h, w, c = img.shape
+        left, top = max(int(left), 0), max(int(top), 0)
+        right, bottom = max(int(right), 0), max(int(bottom), 0)
+        out = np.full((b, h + top + bottom, w + left + right, c), 0.5,
+                      np.float32)
+        out[:, top:top + h, left:left + w] = img
+        mask = np.ones((h + top + bottom, w + left + right), np.float32)
+        inner = np.zeros((h, w), np.float32)
+        f = int(feathering)
+        if f > 0 and f * 2 < h and f * 2 < w:
+            # distance to each EXTENDED edge (a side that isn't extended
+            # contributes no feather); v = ((f - d)/f)^2 inside the band
+            rows = np.arange(h, dtype=np.float32)[:, None]
+            cols = np.arange(w, dtype=np.float32)[None, :]
+            d = np.full((h, w), np.float32(max(h, w)))
+            if top:
+                d = np.minimum(d, rows)
+            if bottom:
+                d = np.minimum(d, h - rows)
+            if left:
+                d = np.minimum(d, cols)
+            if right:
+                d = np.minimum(d, w - cols)
+            v = np.clip((f - d) / f, 0.0, 1.0)
+            inner = (v * v).astype(np.float32)
+        mask[top:top + h, left:left + w] = inner
+        return (_keep_fanout_meta(image, out), mask)
+
+
+@register_op
 class VAEEncodeForInpaint(Op):
     """ComfyUI's inpaint encode: neutralize the masked region to mid-gray
     before encoding (so the encoder doesn't leak the old content into
@@ -557,13 +603,12 @@ class VAEEncodeForInpaint(Op):
         img = (img - 0.5) * (1.0 - hard[..., None]) + 0.5
         with Timer("vae_encode_inpaint"):
             lat = vae.vae_encode(jnp.asarray(img))
-        b = int(lat.shape[0])
-        fanout = max(ctx.fanout, 1)
-        lat_np = np.asarray(lat)
-        if fanout > 1:
-            lat_np = np.tile(lat_np, (fanout, 1, 1, 1))
-        return ({"samples": lat_np, "noise_mask": m,
-                 "local_batch": b, "fanout": fanout},)
+        # shared fan-out rule (already-fanned pixels pass through — a
+        # re-tile here would square the fan-out); the mask rides along at
+        # its own batch size, _prepare_sample_inputs cycles it
+        (out_d,) = _expand_encoded_latent(ctx, pixels, lat)
+        out_d["noise_mask"] = m
+        return (out_d,)
 
 
 class ImageBatch(np.ndarray):

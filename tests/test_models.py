@@ -762,3 +762,61 @@ class TestVAEEncodeTiled:
         # all replicas hold the SAME source latent (img2img sweep)
         s = np.asarray(lat["samples"])
         np.testing.assert_array_equal(s[0], s[3])
+
+
+class TestImagePadForOutpaint:
+    def test_pad_mask_and_feather(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        img = np.ones((1, 32, 32, 3), np.float32) * 0.25
+        (out, mask) = get_op("ImagePadForOutpaint").execute(
+            OpContext(), img, left=0, top=0, right=16, bottom=0,
+            feathering=8)
+        assert out.shape == (1, 32, 48, 3)
+        assert mask.shape == (32, 48)
+        # original content preserved; new area mid-gray
+        np.testing.assert_array_equal(out[:, :, :32], img)
+        np.testing.assert_allclose(out[:, :, 32:], 0.5)
+        # mask: 1 over the new area, quadratic feather into the original
+        np.testing.assert_allclose(mask[:, 32:], 1.0)
+        assert mask[16, 31] == pytest.approx((7 / 8) ** 2)  # d=1 to edge
+        assert mask[16, 25] == pytest.approx((1 / 8) ** 2)  # d=7, band rim
+        assert mask[16, 23] == 0.0     # d=9 >= feathering: outside band
+        assert mask[16, 0] == 0.0      # far side untouched (not extended)
+        assert mask[0, 0] == 0.0       # unextended top edge: no feather
+
+    def test_feeds_inpaint_encode(self):
+        """Outpaint chain: pad -> VAEEncodeForInpaint consumes the pair
+        (the mask rides along as noise_mask)."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("outpaint.ckpt")
+        img = np.ones((1, 32, 32, 3), np.float32) * 0.25
+        (out, mask) = get_op("ImagePadForOutpaint").execute(
+            OpContext(), img, right=16, feathering=4)
+        (lat,) = get_op("VAEEncodeForInpaint").execute(
+            OpContext(), out, p, mask, grow_mask_by=0)
+        assert "noise_mask" in lat
+        ds = p.family.vae.downscale
+        assert lat["samples"].shape[1:3] == (32 // ds, 48 // ds)
+        registry.clear_pipeline_cache()
+
+
+class TestInpaintEncodeFanout:
+    def test_fanned_pixels_pass_through(self):
+        """ADVICE-style regression: already-fanned pixels into
+        VAEEncodeForInpaint must pass through, not re-tile (the
+        fan-out-squaring bug the shared helper fixed for VAEEncode)."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        from comfyui_distributed_tpu.ops.basic import ImageBatch
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("inp-fan.ckpt")
+        img = ImageBatch(np.full((4, 16, 16, 3), 0.5, np.float32),
+                         local_batch=1, fanout=4)
+        octx = OpContext()
+        octx.fanout = 4
+        (lat,) = get_op("VAEEncodeForInpaint").execute(
+            octx, img, p, np.ones((16, 16), np.float32), 0)
+        assert lat["samples"].shape[0] == 4          # NOT 16
+        assert lat["fanout"] == 4 and lat["local_batch"] == 1
+        assert "noise_mask" in lat
+        registry.clear_pipeline_cache()

@@ -370,6 +370,7 @@ class TestHiresFixE2E:
 
 
 INPAINT = "/root/repo/workflows/distributed-inpaint.json"
+OUTPAINT = "/root/repo/workflows/distributed-outpaint.json"
 
 
 class TestInpaintE2E:
@@ -409,6 +410,30 @@ class TestInpaintE2E:
                 f"variation {i} masked region identical to master"
         assert np.isfinite(imgs).all()
 
+
+    def test_outpaint_fixture_extends_and_fans_out(self, ctx, tmp_path):
+        """The outpaint fixture: pad-right canvas extension, feathered
+        mask into VAEEncodeForInpaint, seed fan-out of the new area."""
+        from PIL import Image
+        rgb = np.full((32, 32, 3), 64, np.uint8)
+        (tmp_path / "in").mkdir()
+        Image.fromarray(rgb).save(tmp_path / "in" / "src.png")
+        ctx.input_dir = str(tmp_path / "in")
+
+        g = parse_workflow(OUTPAINT)
+        g.nodes["1"].inputs["image"] = "src.png"
+        g.nodes["2"].inputs.update(width=32, height=32)
+        g.nodes["10"].inputs.update(right=16, feathering=4)
+        g.nodes["5"].inputs.update(grow_mask_by=0)
+        g.nodes["3"].inputs.update(steps=2)
+        res = WorkflowExecutor(ctx).execute(g)
+        assert len(res.images) == 8
+        imgs = np.stack(res.images)
+        assert imgs.shape[1:] == (32, 48, 3)   # canvas extended right
+        assert np.isfinite(imgs).all()
+        # the outpainted right side varies across replicas (seed fan-out)
+        for i in range(1, 8):
+            assert not np.allclose(imgs[0][:, 32:], imgs[i][:, 32:]), i
 
     def test_batch_gt1_mask_fans_out(self, ctx):
         """ADVICE r3 (medium): a batch>1 noise_mask must fan out with the
