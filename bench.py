@@ -99,9 +99,12 @@ def parse_args(argv=None):
                    help="virtual-mesh SPMD overhead sweep instead of the "
                         "single-chip throughput bench")
     p.add_argument("--multiproc-sweep", action="store_true",
-                   help="timed 1-vs-2-process jax.distributed mini-bench "
+                   help="timed 1-vs-N-process jax.distributed mini-bench "
                         "over CPU/Gloo (the DCN-analog comm path): same "
-                        "total devices and work, efficiency = T1/T2")
+                        "total devices and work, efficiency = T1/TN")
+    p.add_argument("--multiproc-procs", type=int, default=2,
+                   help="N for --multiproc-sweep (total devices = N; the "
+                        "1-process config uses N local devices)")
     p.add_argument("--upscale", action="store_true",
                    help="BASELINE config 3: the distributed-upscale fixture "
                         "(ESRGAN 4x + tiled SD refine) wall-clock, in-process "
@@ -131,6 +134,11 @@ def parse_args(argv=None):
     p.add_argument("--out", default=None,
                    help="also write the JSON line (or sweep table) here")
     args = p.parse_args(argv)
+    if args.multiproc_sweep and (args.multiproc_procs < 2
+                                 or 8 % args.multiproc_procs):
+        # validate HERE so metric_name() and the sweep always agree on N
+        p.error("--multiproc-procs must be 2, 4, or 8 (must divide the "
+                "worker's fixed global batch of 8)")
     if args.real_ckpt is None and not (args.scaling_sweep
                                        or args.multiproc_sweep
                                        or args.upscale or args.img2img):
@@ -167,7 +175,8 @@ def metric_name(args):
         return (f"real_ckpt_{args.family}_{args.width}x{args.height}_"
                 f"{args.steps}step_sec_per_image")
     if args.multiproc_sweep:
-        return "tiny_multiproc_dcn_overhead_efficiency_2proc"
+        return (f"tiny_multiproc_dcn_overhead_efficiency_"
+                f"{args.multiproc_procs}proc")
     if args.scaling_sweep:
         return "tiny_virtual_mesh_spmd_efficiency_8dev"
     if args.upscale:
@@ -727,11 +736,11 @@ def run_real_ckpt(args):
 
 
 def run_multiproc_sweep(args):
-    """Timed 1-vs-2-process mini-bench over the DCN-analog comm backend
+    """Timed 1-vs-N-process mini-bench over the DCN-analog comm backend
     (jax.distributed on CPU/Gloo — the path `cli.py` takes on a real
-    pod).  Both configs use the SAME total devices (2) and the SAME fixed
+    pod).  Both configs use the SAME total devices (N) and the SAME fixed
     global workload (tiny UNet forwards with a replicate-out collective),
-    so efficiency = T(1 proc)/T(2 procs) isolates multi-process
+    so efficiency = T(1 proc)/T(N procs) isolates multi-process
     dispatch+comm overhead; BASELINE's ≥0.9 bar applies.  Reference
     analog: multi-machine mode, ``/root/reference/README.md:49-102``."""
     import socket
@@ -739,9 +748,10 @@ def run_multiproc_sweep(args):
 
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "benchmarks", "multiproc_worker.py")
+    n = int(args.multiproc_procs)   # validated in parse_args
     rows = []
-    for procs in (1, 2):
-        local_dev = 2 // procs
+    for procs in (1, n):
+        local_dev = n // procs
         repo = os.path.dirname(os.path.abspath(__file__))
         inherited = os.environ.get("PYTHONPATH")
         env_base = {**os.environ,
