@@ -25,11 +25,11 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
     UNet.  ``control`` = (cn_apply, cn_params, hint, strength) runs a
     ControlNet on the SAME scaled input/timestep the UNet sees each call
     and feeds its residuals (scaled by strength) into the UNet; the hint
-    broadcasts over CFG's doubled batch.  ``strength`` may be a scalar
-    (uniform) or a ``(s_cond, s_uncond)`` pair applied per CFG half —
-    ComfyUI attaches a ControlNet to ONE conditioning, so a
-    positive-only control must not also steer the uncond rows (the
-    doubled batch is [cond; uncond], samplers.cfg_denoiser).
+    broadcasts over the CFG-stacked batch.  ``strength`` may be a scalar
+    (uniform) or a tuple with ONE strength per stacked block
+    ([cond_1..cond_N, uncond_1..uncond_M] — registry.sample composes it):
+    ComfyUI attaches a ControlNet to individual conditioning entries, so
+    a control on one entry must only steer that entry's rows.
     """
     log_sigmas = jnp.asarray(jnp.log(jnp.asarray(ds.sigmas)))
 
@@ -58,15 +58,16 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
             hb = jnp.concatenate([hint] * reps, axis=0) if reps > 1 else hint
             outs, mid = cn_apply(cn_params, xin, ts, context, hb, y)
             if isinstance(strength, (tuple, list)):
-                s_cond, s_unc = float(strength[0]), float(strength[1])
-                if reps == 2:
-                    # CFG-doubled batch: first half cond, second uncond
+                # one strength per stacked block; the producer
+                # (registry.sample) sizes the tuple to the block layout
+                assert len(strength) == reps, (len(strength), reps)
+                if reps == 1:
+                    scale = float(strength[0])
+                else:
                     b = hint.shape[0]
                     scale = jnp.concatenate(
-                        [jnp.full((b, 1, 1, 1), s_cond, xin.dtype),
-                         jnp.full((b, 1, 1, 1), s_unc, xin.dtype)], axis=0)
-                else:  # cfg==1: single pass evaluates the cond context only
-                    scale = s_cond
+                        [jnp.full((b, 1, 1, 1), float(s), xin.dtype)
+                         for s in strength], axis=0)
             else:
                 scale = strength
             ctrl = ([o * scale for o in outs], mid * scale)

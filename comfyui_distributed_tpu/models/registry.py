@@ -85,11 +85,13 @@ FAMILY_ENV = "DTPU_DEFAULT_FAMILY"
 
 
 def _strength_key(strength):
-    """ControlNet strength as a hashable static value: a scalar or a
-    per-CFG-half ``(s_cond, s_uncond)`` pair (ops/basic.py builds the
-    pair; see models/denoiser.py for the half semantics)."""
+    """ControlNet strength as a hashable static value: a scalar, a flat
+    per-block tuple, or ops/basic.py's ``(pos_strengths, neg_strengths)``
+    nested pair (see models/denoiser.py for the block semantics)."""
     if isinstance(strength, (tuple, list)):
-        return (float(strength[0]), float(strength[1]))
+        return tuple(tuple(float(v) for v in s)
+                     if isinstance(s, (tuple, list)) else float(s)
+                     for s in strength)
     return float(strength)
 
 
@@ -273,7 +275,19 @@ class DiffusionPipeline:
         every model call sees the source re-noised to the current sigma
         outside the mask and its denoised output re-anchored to the clean
         source there.
+        ``context`` / ``uncond_context`` are single cond arrays OR LISTS
+        of ``(context, area_mask_or_None, strength)`` entries (ComfyUI
+        multi-entry cond lists — regional prompting): all entries of
+        both CFG sides evaluate in one stacked model call and blend by
+        mask (samplers.cfg_denoiser_multi).  ``y`` may be a single
+        per-sample ADM array (replicated over every block) or a list
+        with one array per entry, conds first then unconds.
         The denoise loop is jit-compiled and cached per static config."""
+        conds = context if isinstance(context, (list, tuple)) \
+            else [(context, None, 1.0)]
+        unconds = uncond_context if isinstance(uncond_context,
+                                               (list, tuple)) \
+            else [(uncond_context, None, 1.0)]
         sigmas = jnp.asarray(sch.compute_sigmas(
             self.schedule, scheduler, steps, denoise))
         start = max(int(start_step), 0)
@@ -289,9 +303,17 @@ class DiffusionPipeline:
         keys = smp.sample_keys(seeds, sample_idx)
 
         from comfyui_distributed_tpu.runtime.interrupt import polling_enabled
+
+        def _entries_key(entries):
+            return tuple((tuple(c.shape), m is not None,
+                          tuple(m.shape) if m is not None else (),
+                          float(s)) for c, m, s in entries)
+
+        y_is_list = isinstance(y, (list, tuple))
         static_key = ("sample", sampler_name, scheduler, steps, float(cfg),
                       float(denoise), bool(add_noise), y is not None,
-                      tuple(latents.shape), tuple(context.shape),
+                      y_is_list, tuple(latents.shape), _entries_key(conds),
+                      _entries_key(unconds),
                       polling_enabled(), start, end,
                       bool(force_full_denoise), noise_mask is not None,
                       control is not None,
@@ -303,6 +325,9 @@ class DiffusionPipeline:
             has_mask = noise_mask is not None
             has_control = control is not None
             cfg_scale = float(cfg)
+            n_conds, n_unconds = len(conds), len(unconds)
+            has_area = [m is not None for _, m, _ in conds + unconds]
+            strengths = [float(s) for _, _, s in conds + unconds]
             sampler = smp.get_sampler(sampler_name)
             if has_control:
                 cn_module, _, _, cn_strength = control
@@ -311,19 +336,41 @@ class DiffusionPipeline:
                     return cn_module.apply({"params": p}, xi, ts, ctx,
                                            hint, y_in)
 
-            def core(unet_params, latents, context, uncond_context, keys,
-                     sigmas, y_in, mask_in, cn_params, hint_in):
-                ctrl_spec = (cn_apply, cn_params, hint_in,
-                             _strength_key(cn_strength)) \
-                    if has_control else None
-                den = make_denoiser(self.raw_unet_apply, unet_params,
-                                    self.schedule, self.prediction_type,
-                                    control=ctrl_spec)
-                model = smp.cfg_denoiser(den, context, uncond_context,
-                                         cfg_scale)
-                y2 = y_in
-                if has_y and cfg_scale != 1.0:
-                    y2 = jnp.concatenate([y_in, y_in], axis=0)
+            def core(unet_params, latents, ctx_list, area_list,
+                     keys, sigmas, y_in, mask_in, cn_params, hint_in):
+                ctrl_spec = None
+                if has_control:
+                    sk = _strength_key(cn_strength)
+                    if (isinstance(sk, tuple) and len(sk) == 2
+                            and isinstance(sk[0], tuple)):
+                        # ops-layer (pos_strengths, neg_strengths): flat
+                        # per-block tuple sized to the actual layout
+                        pos_s, neg_s = sk
+                        sk = tuple(pos_s) + (tuple(neg_s)
+                                             if cfg_scale != 1.0 else ())
+                    ctrl_spec = (cn_apply, cn_params, hint_in, sk)
+                den = make_denoiser(
+                    self.raw_unet_apply, unet_params, self.schedule,
+                    self.prediction_type, control=ctrl_spec)
+                entries = [(ctx_list[i],
+                            area_list[i] if has_area[i] else None,
+                            strengths[i])
+                           for i in range(n_conds + n_unconds)]
+                model = smp.cfg_denoiser_multi(den, entries[:n_conds],
+                                               entries[n_conds:],
+                                               cfg_scale)
+                reps = n_conds + (n_unconds if cfg_scale != 1.0 else 0)
+                if not has_y:
+                    y2 = y_in
+                elif y_is_list:
+                    # one ADM vector per entry (regional SDXL: each
+                    # region's own pooled), conds first then unconds
+                    y2 = jnp.concatenate(list(y_in)[:reps], axis=0) \
+                        if reps > 1 else y_in[0]
+                else:
+                    # a single ADM vector rides every block
+                    y2 = jnp.concatenate([y_in] * reps, axis=0) \
+                        if reps > 1 else y_in
                 # init noise uses a reserved fold-in index so it never
                 # collides with per-step ancestral noise (steps from 0)
                 noise = smp.make_noise_fn(keys)(
@@ -358,14 +405,24 @@ class DiffusionPipeline:
             return jax.jit(core)
 
         core = self._cache_get_or_make(static_key, make_core)
-        y_arg = y if y is not None else jnp.zeros((latents.shape[0], 1))
+        if y is None:
+            y_arg = jnp.zeros((latents.shape[0], 1))
+        elif isinstance(y, (list, tuple)):
+            y_arg = [jnp.asarray(v) for v in y]
+        else:
+            y_arg = y
         mask_arg = noise_mask if noise_mask is not None \
             else jnp.ones((1, 1, 1, 1))
         cn_params_arg = control[1] if control is not None else {}
         hint_arg = control[2] if control is not None \
             else jnp.zeros((1, 8, 8, 3))
-        return core(self.unet_params, latents, context, uncond_context,
-                    keys, sigmas, y_arg, mask_arg, cn_params_arg, hint_arg)
+        ctx_list = [jnp.asarray(c) for c, _, _ in conds + unconds]
+        area_list = [jnp.asarray(m) if m is not None
+                     else jnp.ones((1, 1, 1, 1))
+                     for _, m, _ in conds + unconds]
+        return core(self.unet_params, latents, ctx_list, area_list,
+                    keys, sigmas, y_arg, mask_arg,
+                    cn_params_arg, hint_arg)
 
     # --- internals ----------------------------------------------------------
 

@@ -749,12 +749,58 @@ def cfg_denoiser(model: Model, cond: Any, uncond: Any,
     """Classifier-free guidance wrapper: one doubled-batch model call per step
     (cond rows then uncond rows) so the MXU sees a single large matmul —
     the TPU-friendly layout of what ComfyUI does per-sample."""
+    return cfg_denoiser_multi(model, [(cond, None, 1.0)], uncond, cfg_scale)
+
+
+def _mask_blend(entries, parts):
+    """sum_i(w_i * den_i) / max(sum_i(w_i), eps), w_i = strength_i *
+    mask_i (no mask -> ones) — the per-entry denoised blend both CFG
+    sides use."""
+    acc = None
+    wsum = None
+    for (c, m, s), p in zip(entries, parts):
+        w = jnp.full((1, 1, 1, 1), float(s), p.dtype) if m is None \
+            else jnp.asarray(m, p.dtype) * float(s)
+        term = p * w
+        wb = jnp.broadcast_to(w, p.shape[:-1] + (1,))
+        acc = term if acc is None else acc + term
+        wsum = wb if wsum is None else wsum + wb
+    return acc / jnp.maximum(wsum, 1e-9)
+
+
+def cfg_denoiser_multi(model: Model, conds, uncond: Any,
+                       cfg_scale: float) -> Model:
+    """Area/mask conditioning (ComfyUI's multi-entry cond lists): every
+    entry of BOTH CFG sides is evaluated in ONE stacked model call
+    ([cond_1..cond_N, uncond_1..uncond_M] rows — still a single large
+    matmul for the MXU), then each side's denoised predictions blend by
+    their latent-resolution masks and strengths (``_mask_blend``) before
+    the CFG combine.
+
+    ``conds`` (and optionally ``uncond``): list of ``(context [B,T,C],
+    mask [.,h,w,1] or None, strength)``; a plain ``uncond`` array is a
+    single unmasked entry.  Masks/strengths are trace-time constants of
+    the compiled program (static shapes, no dynamic control flow); a
+    region covered by no mask gets ~zero prediction — cover the canvas,
+    like ComfyUI (its uncovered regions behave the same way)."""
+    unconds = uncond if isinstance(uncond, (list, tuple)) \
+        else [(uncond, None, 1.0)]
+    n, nu = len(conds), len(unconds)
+
     def wrapped(x, sigma, **extra):
-        if cfg_scale == 1.0:
-            return model(x, sigma, context=cond, **extra)
-        x2 = jnp.concatenate([x, x], axis=0)
-        ctx = jnp.concatenate([cond, uncond], axis=0)
-        out = model(x2, sigma, context=ctx, **extra)
-        d_cond, d_uncond = jnp.split(out, 2, axis=0)
-        return d_uncond + (d_cond - d_uncond) * cfg_scale
+        use_uncond = cfg_scale != 1.0
+        reps = n + (nu if use_uncond else 0)
+        if reps == 1 and conds[0][1] is None:
+            return model(x, sigma, context=conds[0][0], **extra)
+        x_rep = jnp.concatenate([x] * reps, axis=0)
+        ctx = jnp.concatenate(
+            [c for c, _, _ in conds]
+            + ([c for c, _, _ in unconds] if use_uncond else []), axis=0)
+        out = model(x_rep, sigma, context=ctx, **extra)
+        parts = jnp.split(out, reps, axis=0)
+        den_cond = _mask_blend(conds, parts[:n])
+        if not use_uncond:
+            return den_cond
+        d_uncond = _mask_blend(unconds, parts[n:])
+        return d_uncond + (den_cond - d_uncond) * cfg_scale
     return wrapped

@@ -28,6 +28,15 @@ class Conditioning:
     # attached ControlNet: (module, params, hint_image, strength);
     # ComfyUI hangs control on conditioning entries the same way
     control: Any = None
+    # regional prompting (ComfyUI multi-entry cond lists): an optional
+    # image-resolution mask array OR a rect spec ("px", x, y, w, h —
+    # ComfyUI's //8 latent units) / ("pct", x, y, w, h — fractions),
+    # a blend strength, and sibling entries bundled by
+    # ConditioningCombine (each sibling is its own mask/strength entry;
+    # all entries evaluate in one stacked model call at sample time)
+    area_mask: Any = None
+    area_strength: float = 1.0
+    siblings: tuple = ()
 
 
 @dataclasses.dataclass

@@ -7,6 +7,7 @@ Schemas mirror ComfyUI node surfaces used by the reference workflows
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 from typing import Optional
 
@@ -123,9 +124,11 @@ class ControlNetLoader(Op):
 @register_op
 class ControlNetApply(Op):
     """Attach a ControlNet + hint image to a conditioning at the given
-    strength.  ComfyUI semantics: the control steers only the CFG half
-    whose conditioning carries it (the doubled-batch call scales the
-    other half's residuals to zero — models/denoiser.py)."""
+    strength.  ComfyUI semantics: the control steers only the entries
+    that carry it — per-entry strength blocks in the stacked CFG call
+    (models/denoiser.py); applied to EVERY entry of a multi-entry cond
+    list (ComfyUI loops the list), so a Combine upstream keeps both
+    prompts steered."""
     TYPE = "ControlNetApply"
     WIDGETS = ["strength"]
     DEFAULTS = {"strength": 1.0}
@@ -138,9 +141,11 @@ class ControlNetApply(Op):
             return (conditioning,)
         module, params = control_net
         hint = np.asarray(as_image_array(image), np.float32)
+        spec = (module, params, hint, float(strength))
         return (dataclasses.replace(
-            conditioning, control=(module, params, hint,
-                                   float(strength))),)
+            conditioning, control=spec,
+            siblings=tuple(dataclasses.replace(s, control=spec)
+                           for s in conditioning.siblings)),)
 
 
 @register_op
@@ -243,6 +248,45 @@ class KSamplerAdvanced(Op):
         return (out_d,)
 
 
+def _materialize_area_mask(cond: Conditioning, h: int, w: int, total: int):
+    """A Conditioning's area spec -> latent-resolution weight mask
+    [1_or_B, h, w, 1], or None.  Rect specs resolve against the ACTUAL
+    latent dims here ("px" uses ComfyUI's //8 latent-unit convention;
+    "pct" is resolution-independent fractions); array masks area-resize
+    like noise masks."""
+    am = getattr(cond, "area_mask", None)
+    if am is None:
+        return None
+    if isinstance(am, tuple):
+        kind, x, y, ww, hh = am
+        m = np.zeros((1, h, w, 1), np.float32)
+        if kind == "px":
+            x0, y0 = int(x) // 8, int(y) // 8
+            x1 = x0 + max(int(ww) // 8, 1)
+            y1 = y0 + max(int(hh) // 8, 1)
+        else:
+            x0, y0 = int(round(x * w)), int(round(y * h))
+            x1 = x0 + max(int(round(ww * w)), 1)
+            y1 = y0 + max(int(round(hh * h)), 1)
+        m[:, max(y0, 0):min(y1, h), max(x0, 0):min(x1, w), :] = 1.0
+        return jnp.asarray(m)
+    return jnp.asarray(_image_mask_to_latent(am, h, w, total))
+
+
+def _image_mask_to_latent(mask, h: int, w: int, total: int) -> np.ndarray:
+    """Image-res mask [H,W]/[B,H,W] -> latent-res weights
+    [1_or_total, h, w, 1]: area-downsample, clip to [0,1], short batches
+    cycle — the ONE copy of the convention (noise masks and area masks
+    must never drift apart)."""
+    m = np.asarray(mask, np.float32)
+    if m.ndim == 2:
+        m = m[None]
+    m = np.clip(resize_image(m[..., None], w, h, "area"), 0.0, 1.0)
+    if m.shape[0] != 1:  # a single mask broadcasts; others fan out
+        m = _cycle_batch(m, total)
+    return m
+
+
 def _cycle_batch(arr: np.ndarray, n: int) -> np.ndarray:
     """One row per sample, cycling a short batch via modulo indexing — the
     ONE copy of the pairing rule: fanned batches tile whole-block, so row
@@ -304,52 +348,120 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
     local_idx = np.tile(np.arange(local_b, dtype=np.uint32),
                         max(fanout, 1))[:total]
 
-    ctx_arr = jnp.repeat(positive.context, total, axis=0)
-    unc_arr = jnp.repeat(negative.context, total, axis=0)
-    y = None
-    if model.family.unet.adm_in_channels is not None:
-        y = _sdxl_vector_cond(model, positive, total,
-                              lat.shape[1] * 8, lat.shape[2] * 8)
+    # multi-entry cond lists (regional prompting), SYMMETRIC on both CFG
+    # sides: the primary plus any siblings bundled by ConditioningCombine;
+    # every entry's tokens align to the longest across BOTH sides (77 ->
+    # 154 repeats whole blocks, otherwise zero-pad) — the stacked CFG
+    # call concatenates all of them along batch
+    pos_entries = [positive] + list(getattr(positive, "siblings", ())
+                                    or ())
+    neg_entries = [negative] + list(getattr(negative, "siblings", ())
+                                    or ())
+    lengths = {int(e.context.shape[1]) for e in pos_entries + neg_entries}
+    t_max = max(lengths)
+    # ComfyUI repeats each cond to the lcm of the lengths (77-chunk
+    # multiples in practice) — semantically lossless, unlike zero-pad
+    # (zero keys still soak up softmax mass); fall back to zero-pad only
+    # if a pathological mix would explode the lcm
+    t_align = math.lcm(*lengths)
+    if t_align > 8 * t_max:
+        debug_log(f"conditioning token lengths {sorted(lengths)} have no "
+                  f"small common multiple; zero-padding to {t_max}")
+        t_align = t_max
+
+    def _align_tokens(c):
+        t = int(c.shape[1])
+        if t == t_align:
+            return c
+        if t_align % t == 0:
+            return jnp.tile(c, (1, t_align // t, 1))
+        return jnp.pad(c, ((0, 0), (0, t_align - t), (0, 0)))
 
     lat_dev = lat
-    if fanout > 1 and ctx.runtime is not None:
-        mesh = ctx.runtime.mesh
+    mesh = ctx.runtime.mesh if ctx.runtime is not None else None
+    if fanout > 1 and mesh is not None:
         lat_dev = coll.shard_batch(lat, mesh)
-        ctx_arr = coll.shard_batch(ctx_arr, mesh)
-        unc_arr = coll.shard_batch(unc_arr, mesh)
-        if y is not None:
-            y = coll.shard_batch(y, mesh)
 
-    # control may hang on either conditioning entry (ComfyUI honors both);
-    # positive wins when both carry one.  The strength becomes a per-CFG-
-    # half (s_cond, s_uncond) pair: a control attached to only one
-    # conditioning must only steer that half of the doubled batch
-    pos_ctrl = getattr(positive, "control", None)
-    neg_ctrl = getattr(negative, "control", None)
-    control = pos_ctrl or neg_ctrl
+    adm = model.family.unet.adm_in_channels is not None
+
+    def _build_entries(src):
+        out = []
+        ys = []
+        for e in src:
+            ce = jnp.repeat(_align_tokens(e.context), total, axis=0)
+            if fanout > 1 and mesh is not None:
+                ce = coll.shard_batch(ce, mesh)
+            am = _materialize_area_mask(e, lat.shape[1], lat.shape[2],
+                                        total)
+            if (am is not None and fanout > 1 and mesh is not None
+                    and am.shape[0] == total):
+                # per-sample masks ride the data axis like the noise
+                # mask; single-row masks stay replicated
+                am = coll.shard_batch(np.asarray(am), mesh)
+            out.append((ce, am,
+                        float(getattr(e, "area_strength", 1.0))))
+            if adm:
+                # each entry carries its OWN pooled ADM vector (regional
+                # SDXL: region B must not ride region A's pooled); an
+                # entry without one falls back to the primary positive's
+                ye = _sdxl_vector_cond(
+                    model, e if e.pooled is not None else positive,
+                    total, lat.shape[1] * 8, lat.shape[2] * 8)
+                if fanout > 1 and mesh is not None:
+                    ye = coll.shard_batch(ye, mesh)
+                ys.append(ye)
+        return out, ys
+
+    cond_entries, y_conds = _build_entries(pos_entries)
+    unc_entries, y_unconds = _build_entries(neg_entries)
+    multi = len(cond_entries) > 1 or len(unc_entries) > 1 \
+        or any(m is not None or s != 1.0
+               for _, m, s in cond_entries + unc_entries)
+    if multi:
+        ctx_arr = cond_entries
+        unc_arr = unc_entries
+        y = (y_conds + y_unconds) if adm else None
+    else:   # the unchanged single-entry path: plain arrays
+        ctx_arr = cond_entries[0][0]
+        unc_arr = unc_entries[0][0]
+        y = y_conds[0] if adm else None
+
+    # control may hang on ANY conditioning entry (ComfyUI honors all).
+    # One net/hint runs per step; its strength becomes a per-ENTRY tuple
+    # so only the carrying entries' blocks are steered (a control on the
+    # right-region sibling must not steer the left region).  Entries
+    # carrying a DIFFERENT net/hint are dropped loudly — the single
+    # stacked call can't run two nets
+    def _ctrl_of(e):
+        return getattr(e, "control", None)
+
+    control = next((c for c in map(_ctrl_of, pos_entries + neg_entries)
+                    if c is not None), None)
     if control is not None:
-        s_cond = float(pos_ctrl[3]) if pos_ctrl is not None else 0.0
-        if neg_ctrl is None:
-            s_unc = 0.0
-        elif pos_ctrl is None or (neg_ctrl[0] is pos_ctrl[0]
-                                  and neg_ctrl[1] is pos_ctrl[1]
-                                  and (neg_ctrl[2] is pos_ctrl[2]
-                                       or np.array_equal(neg_ctrl[2],
-                                                         pos_ctrl[2]))):
-            s_unc = float(neg_ctrl[3])
-        else:
-            # a DIFFERENT net or hint on the negative (pos canny + neg
-            # depth, or one net with two hint images): the single
-            # doubled-batch call runs one net with one hint; honoring the
-            # negative's strength would steer its half with the wrong
-            # residuals — drop the negative's control loudly instead
-            debug_log("ControlNet: positive and negative carry different "
-                      "controls/hints; applying the positive's only "
-                      "(per-half nets/hints are unsupported)")
-            s_unc = 0.0
+        module, params, hint, _ = control
+
+        def _same(c):
+            return (c[0] is module and c[1] is params
+                    and (c[2] is hint or np.array_equal(c[2], hint)))
+
+        if any(c is not None and not _same(c)
+               for c in map(_ctrl_of, pos_entries + neg_entries)):
+            debug_log("ControlNet: conditioning entries carry different "
+                      "controls/hints; applying the first only (one net "
+                      "runs per stacked call)")
+
+        def _entry_strengths(entries_):
+            return tuple(
+                float(_ctrl_of(e)[3])
+                if _ctrl_of(e) is not None and _same(_ctrl_of(e)) else 0.0
+                for e in entries_)
+
+        # strengths BEFORE the hint rebinds below: _same closes over
+        # ``hint`` and must compare against the entries' ORIGINAL array
+        pos_strengths = _entry_strengths(pos_entries)
+        neg_strengths = _entry_strengths(neg_entries)
         # hint image -> the resolution the hint ladder expects (8x the
         # latent dims — families with other VAE downscales still align)
-        module, params, hint, _ = control
         hh, ww = lat.shape[1] * 8, lat.shape[2] * 8
         if hint.shape[1] != hh or hint.shape[2] != ww:
             hint = resize_image(hint, ww, hh, "bilinear")
@@ -358,22 +470,16 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
         if fanout > 1 and ctx.runtime is not None:
             hint_dev = coll.shard_batch(np.asarray(hint, np.float32),
                                         ctx.runtime.mesh)
-        control = (module, params, jnp.asarray(hint_dev), (s_cond, s_unc))
+        control = (module, params, jnp.asarray(hint_dev),
+                   (pos_strengths, neg_strengths))
 
     mask = latent_image.get("noise_mask")
     if mask is not None:
-        # image-res [B,H,W] -> latent-res [B,h,w,1] (area-downsampled);
-        # a single mask broadcasts across the whole (fanned) batch
-        m = np.asarray(mask, np.float32)
-        if m.ndim == 2:
-            m = m[None]
-        h, w = lat.shape[1], lat.shape[2]
-        m = resize_image(m[..., None], w, h, "area")
-        m = np.clip(m, 0.0, 1.0)
-        if m.shape[0] != 1:  # a single mask broadcasts; others fan out
-            m = _cycle_batch(m, total)
-        if fanout > 1 and ctx.runtime is not None and m.shape[0] == total:
-            m = coll.shard_batch(m, ctx.runtime.mesh)
+        # image-res [B,H,W] -> latent-res [B,h,w,1]; a single mask
+        # broadcasts across the whole (fanned) batch
+        m = _image_mask_to_latent(mask, lat.shape[1], lat.shape[2], total)
+        if fanout > 1 and mesh is not None and m.shape[0] == total:
+            m = coll.shard_batch(m, mesh)
         mask = jnp.asarray(m)
 
     return _SampleInputs(latents=jnp.asarray(lat_dev), context=ctx_arr,
@@ -629,22 +735,35 @@ class ImageBatch(np.ndarray):
 
 @register_op
 class ConditioningConcat(Op):
-    """Concatenate conditionings along the TOKEN axis (prompt chaining)."""
+    """Concatenate conditionings along the TOKEN axis (prompt chaining).
+    Applies to EVERY entry of a multi-entry ``conditioning_to`` (ComfyUI
+    loops the cond list); only ``conditioning_from``'s primary entry is
+    used, like ComfyUI's warning-and-first behavior."""
     TYPE = "ConditioningConcat"
 
     def execute(self, ctx: OpContext, conditioning_to: Conditioning,
                 conditioning_from: Conditioning):
-        return (Conditioning(
-            context=jnp.concatenate([conditioning_to.context,
-                                     conditioning_from.context], axis=1),
-            pooled=conditioning_to.pooled,
-            control=conditioning_to.control
-            or conditioning_from.control),)
+        if getattr(conditioning_from, "siblings", ()):
+            debug_log("ConditioningConcat: conditioning_from has multiple "
+                      "entries; using the first (ComfyUI behavior)")
+        c_from = conditioning_from.context
+
+        def _cat(e: Conditioning) -> Conditioning:
+            return dataclasses.replace(
+                e, context=jnp.concatenate([e.context, c_from], axis=1),
+                control=e.control or conditioning_from.control)
+
+        return (dataclasses.replace(
+            _cat(conditioning_to),
+            siblings=tuple(_cat(s)
+                           for s in conditioning_to.siblings)),)
 
 
 @register_op
 class ConditioningAverage(Op):
-    """Weighted blend of two conditionings (same token length)."""
+    """Weighted blend of two conditionings.  Applies to EVERY entry of a
+    multi-entry ``conditioning_to`` (ComfyUI loops the cond list; only
+    ``conditioning_from``'s primary entry is blended in)."""
     TYPE = "ConditioningAverage"
     WIDGETS = ["conditioning_to_strength"]
     DEFAULTS = {"conditioning_to_strength": 1.0}
@@ -652,40 +771,116 @@ class ConditioningAverage(Op):
     def execute(self, ctx: OpContext, conditioning_to: Conditioning,
                 conditioning_from: Conditioning,
                 conditioning_to_strength: float = 1.0):
+        if getattr(conditioning_from, "siblings", ()):
+            debug_log("ConditioningAverage: conditioning_from has "
+                      "multiple entries; using the first (ComfyUI "
+                      "behavior)")
         w = float(conditioning_to_strength)
-        c_to, c_from = conditioning_to.context, conditioning_from.context
-        if c_from.shape[1] != c_to.shape[1]:
-            # ComfyUI zero-pads/truncates cond_from to cond_to's length
-            t0 = c_to.shape[1]
-            if c_from.shape[1] < t0:
-                c_from = jnp.pad(
-                    c_from, ((0, 0), (0, t0 - c_from.shape[1]), (0, 0)))
-            else:
-                c_from = c_from[:, :t0, :]
-        ctx_out = c_to * w + c_from * (1.0 - w)
-        # pooled fallback order matches ComfyUI: to's, else from's
-        pooled = conditioning_to.pooled
-        if pooled is not None and conditioning_from.pooled is not None:
-            pooled = pooled * w + conditioning_from.pooled * (1.0 - w)
-        elif pooled is None:
-            pooled = conditioning_from.pooled
-        return (Conditioning(context=ctx_out, pooled=pooled,
-                             control=conditioning_to.control
-                             or conditioning_from.control),)
+
+        def _blend(e: Conditioning) -> Conditioning:
+            c_to, c_from = e.context, conditioning_from.context
+            if c_from.shape[1] != c_to.shape[1]:
+                # ComfyUI zero-pads/truncates cond_from to cond_to's len
+                t0 = c_to.shape[1]
+                if c_from.shape[1] < t0:
+                    c_from = jnp.pad(
+                        c_from,
+                        ((0, 0), (0, t0 - c_from.shape[1]), (0, 0)))
+                else:
+                    c_from = c_from[:, :t0, :]
+            ctx_out = c_to * w + c_from * (1.0 - w)
+            # pooled fallback order matches ComfyUI: to's, else from's
+            pooled = e.pooled
+            if pooled is not None and conditioning_from.pooled is not None:
+                pooled = pooled * w + conditioning_from.pooled * (1.0 - w)
+            elif pooled is None:
+                pooled = conditioning_from.pooled
+            return dataclasses.replace(
+                e, context=ctx_out, pooled=pooled,
+                control=e.control or conditioning_from.control)
+
+        return (dataclasses.replace(
+            _blend(conditioning_to),
+            siblings=tuple(_blend(s)
+                           for s in conditioning_to.siblings)),)
 
 
 @register_op
 class ConditioningCombine(Op):
-    """ComfyUI combines conditionings as alternatives sampled together;
-    without per-cond area scheduling the faithful single-pass analog is
-    the equal-weight average."""
+    """ComfyUI's Combine: BOTH conditionings are evaluated at sample
+    time and their denoised predictions blend (by their masks/strengths
+    — regional prompting when paired with ConditioningSetMask/SetArea).
+    Bundled as sibling entries; the KSampler stacks every entry into one
+    model call (samplers.cfg_denoiser_multi)."""
     TYPE = "ConditioningCombine"
 
     def execute(self, ctx: OpContext, conditioning_1: Conditioning,
                 conditioning_2: Conditioning):
-        return ConditioningAverage().execute(
-            ctx, conditioning_1, conditioning_2,
-            conditioning_to_strength=0.5)
+        def flat(c: Conditioning):
+            return (dataclasses.replace(c, siblings=()),) + tuple(c.siblings)
+
+        merged = flat(conditioning_1) + flat(conditioning_2)
+        return (dataclasses.replace(merged[0], siblings=merged[1:]),)
+
+
+@register_op
+class ConditioningSetMask(Op):
+    """Restrict a conditioning's influence to a mask (ComfyUI regional
+    prompting).  ``set_cond_area="default"`` semantics: every entry still
+    evaluates on the full latent (static shapes) and the mask weights the
+    denoised blend — the "mask bounds" crop variant is intentionally not
+    implemented (dynamic shapes defeat XLA compilation)."""
+    TYPE = "ConditioningSetMask"
+    WIDGETS = ["strength", "set_cond_area"]
+    DEFAULTS = {"strength": 1.0, "set_cond_area": "default"}
+
+    def execute(self, ctx: OpContext, conditioning: Conditioning, mask,
+                strength: float = 1.0, set_cond_area: str = "default"):
+        m = np.asarray(mask, np.float32)
+        if m.ndim == 2:
+            m = m[None]
+        return (_set_area_on_all(conditioning, m, float(strength)),)
+
+
+@register_op
+class ConditioningSetArea(Op):
+    """Rectangular region in pixels (ComfyUI's //8 latent-unit
+    convention); materialized against the actual latent dims at sample
+    time."""
+    TYPE = "ConditioningSetArea"
+    WIDGETS = ["width", "height", "x", "y", "strength"]
+    DEFAULTS = {"strength": 1.0}
+
+    def execute(self, ctx: OpContext, conditioning: Conditioning,
+                width: int, height: int, x: int, y: int,
+                strength: float = 1.0):
+        rect = ("px", int(x), int(y), int(width), int(height))
+        return (_set_area_on_all(conditioning, rect, float(strength)),)
+
+
+@register_op
+class ConditioningSetAreaPercentage(Op):
+    """Rectangular region in canvas fractions (resolution-independent)."""
+    TYPE = "ConditioningSetAreaPercentage"
+    WIDGETS = ["width", "height", "x", "y", "strength"]
+    DEFAULTS = {"strength": 1.0}
+
+    def execute(self, ctx: OpContext, conditioning: Conditioning,
+                width: float, height: float, x: float, y: float,
+                strength: float = 1.0):
+        rect = ("pct", float(x), float(y), float(width), float(height))
+        return (_set_area_on_all(conditioning, rect, float(strength)),)
+
+
+def _set_area_on_all(cond: Conditioning, area, strength: float):
+    """Apply a mask/area to the conditioning AND every bundled sibling —
+    ComfyUI's Set nodes loop over all entries of a cond list, so masking
+    downstream of a Combine must restrict both prompts."""
+    return dataclasses.replace(
+        cond, area_mask=area, area_strength=strength,
+        siblings=tuple(dataclasses.replace(s, area_mask=area,
+                                           area_strength=strength)
+                       for s in cond.siblings))
 
 
 @register_op

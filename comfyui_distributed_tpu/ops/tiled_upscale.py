@@ -107,6 +107,17 @@ class UltimateSDUpscaleDistributed(Op):
         batch.  Per-tile seed = seed + tile_idx with a fixed fold index so
         results are layout-independent."""
         from comfyui_distributed_tpu.ops.basic import _sdxl_vector_cond
+        from comfyui_distributed_tpu.utils.logging import debug_log
+        if any(getattr(c, "siblings", ())
+               or getattr(c, "area_mask", None) is not None
+               for c in (positive, negative)):
+            # regional conds would need per-tile mask crops through the
+            # scatter — refine with the primary prompt only, loudly,
+            # rather than silently mis-applying a canvas-global mask to
+            # tile-local coordinates
+            debug_log("tiled upscale: regional conditioning entries are "
+                      "not supported in the tile refine; using the "
+                      "primary prompt only")
         n = tiles.shape[0]
         seeds = np.asarray([p["seed"] + int(t) for t in tile_indices],
                            np.uint64)

@@ -414,9 +414,17 @@ class TestUtilityOps:
                                    np.full((1, 77, 64), 0.25), atol=1e-6)
         np.testing.assert_allclose(np.asarray(avg.pooled),
                                    np.full((1, 64), 0.25), atol=1e-6)
+        # Combine bundles BOTH entries for a stacked sample-time eval
+        # (true ComfyUI semantics — no longer the average approximation)
         (comb,) = get_op("ConditioningCombine").execute(octx, a, b)
-        np.testing.assert_allclose(np.asarray(comb.context),
-                                   np.full((1, 77, 64), 0.5), atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(comb.context),
+                                      np.asarray(a.context))
+        assert len(comb.siblings) == 1
+        np.testing.assert_array_equal(np.asarray(comb.siblings[0].context),
+                                      np.asarray(b.context))
+        # combine of combines flattens
+        (comb2,) = get_op("ConditioningCombine").execute(octx, comb, a)
+        assert len(comb2.siblings) == 2
 
     def test_repeat_and_from_batch(self):
         from comfyui_distributed_tpu.ops.base import OpContext, get_op
@@ -819,4 +827,230 @@ class TestInpaintEncodeFanout:
         assert lat["samples"].shape[0] == 4          # NOT 16
         assert lat["fanout"] == 4 and lat["local_batch"] == 1
         assert "noise_mask" in lat
+        registry.clear_pipeline_cache()
+
+
+class TestRegionalPrompting:
+    """ConditioningSetArea/SetMask + Combine -> stacked multi-cond eval.
+
+    One-step oracle: with a single denoise step, the blended output's
+    left half must match the left half of a run conditioned only on
+    prompt A (same seed, same noise, same uncond — the blend is
+    per-pixel linear in the per-entry denoised predictions; tolerance
+    covers batch-size-dependent XLA reduction order)."""
+
+    def _run(self, p, pos, neg, seed=11):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        lat = {"samples": np.zeros((1, 16, 16, 4), np.float32)}
+        (out,) = get_op("KSampler").execute(
+            OpContext(), p, seed, 1, 4.0, "euler", "normal", pos, neg,
+            lat, 1.0)
+        return np.asarray(out["samples"])
+
+    def test_one_step_halves_match_single_cond_runs(self):
+        from comfyui_distributed_tpu.ops.base import Conditioning, get_op
+        from comfyui_distributed_tpu.ops.base import OpContext
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("region.ckpt")
+        ca, _ = p.encode_prompt(["a red square"])
+        cb, _ = p.encode_prompt(["a blue circle"])
+        cn, _ = p.encode_prompt([""])
+        A = Conditioning(context=ca, pooled=None)
+        B = Conditioning(context=cb, pooled=None)
+        N = Conditioning(context=cn, pooled=None)
+        octx = OpContext()
+        (setA,) = get_op("ConditioningSetAreaPercentage").execute(
+            octx, A, width=0.5, height=1.0, x=0.0, y=0.0)
+        (setB,) = get_op("ConditioningSetAreaPercentage").execute(
+            octx, B, width=0.5, height=1.0, x=0.5, y=0.0)
+        (comb,) = get_op("ConditioningCombine").execute(octx, setA, setB)
+
+        blended = self._run(p, comb, N)
+        only_a = self._run(p, A, N)
+        only_b = self._run(p, B, N)
+        assert not np.allclose(only_a, only_b)   # prompts actually differ
+        # tolerance: the blended run's stacked batch (3 rows) and the
+        # single runs (2 rows) take different XLA fusion paths — ULP-level
+        # reduction-order noise, far below the prompt-difference signal
+        np.testing.assert_allclose(blended[:, :, :8], only_a[:, :, :8],
+                                   rtol=5e-4, atol=5e-4)
+        np.testing.assert_allclose(blended[:, :, 8:], only_b[:, :, 8:],
+                                   rtol=5e-4, atol=5e-4)
+        registry.clear_pipeline_cache()
+
+    def test_mask_node_and_multistep_finite(self):
+        """SetMask with an image-res array mask through a multi-step
+        sample: finite, differs from the single-cond run, and a
+        full-coverage single mask equals the plain path exactly."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("region2.ckpt")
+        ca, _ = p.encode_prompt(["meadow"])
+        cb, _ = p.encode_prompt(["sky"])
+        cn, _ = p.encode_prompt([""])
+        A = Conditioning(context=ca, pooled=None)
+        B = Conditioning(context=cb, pooled=None)
+        N = Conditioning(context=cn, pooled=None)
+        octx = OpContext()
+        m = np.zeros((32, 32), np.float32)
+        m[:16] = 1.0                                   # top half
+        (setB,) = get_op("ConditioningSetMask").execute(octx, B, m, 0.8)
+        (comb,) = get_op("ConditioningCombine").execute(octx, A, setB)
+        out = self._run(p, comb, N, seed=3)
+        assert np.isfinite(out).all()
+        assert not np.allclose(out, self._run(p, A, N, seed=3))
+        # full-coverage unit mask on a single entry == plain path
+        ones = np.ones((32, 32), np.float32)
+        (setA1,) = get_op("ConditioningSetMask").execute(octx, A, ones,
+                                                         1.0)
+        np.testing.assert_allclose(self._run(p, setA1, N, seed=3),
+                                   self._run(p, A, N, seed=3),
+                                   rtol=1e-6, atol=1e-6)
+        registry.clear_pipeline_cache()
+
+
+class TestRegionalPromptingFixups:
+    """Review fixups: combined negatives, sibling controls, and
+    Set-after-Combine must all reach sampling."""
+
+    def _run(self, p, pos, neg, seed=21, steps=2):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (out,) = get_op("KSampler").execute(
+            OpContext(), p, seed, steps, 4.0, "euler", "normal", pos,
+            neg, lat, 1.0)
+        return np.asarray(out["samples"])
+
+    def test_combined_negative_reaches_sampling(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("multineg.ckpt")
+        pos = Conditioning(context=p.encode_prompt(["castle"])[0])
+        na = Conditioning(context=p.encode_prompt(["blurry"])[0])
+        nb = Conditioning(context=p.encode_prompt(["cropped"])[0])
+        (comb_n,) = get_op("ConditioningCombine").execute(OpContext(),
+                                                          na, nb)
+        combined = self._run(p, pos, comb_n)
+        only_na = self._run(p, pos, na)
+        assert np.isfinite(combined).all()
+        # the second negative influences the output (pre-fix it was
+        # silently dropped and combined == only_na)
+        assert not np.allclose(combined, only_na)
+        registry.clear_pipeline_cache()
+
+    def test_sibling_control_reaches_sampling(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("sibctrl.ckpt")
+        module, params = registry.load_controlnet("sib_cn.safetensors")
+        params = jax.tree_util.tree_map(lambda a: a + 0.05, params)
+        A = Conditioning(context=p.encode_prompt(["tree"])[0])
+        B = Conditioning(context=p.encode_prompt(["river"])[0])
+        N = Conditioning(context=p.encode_prompt([""])[0])
+        octx = OpContext()
+        hint = np.random.default_rng(2).uniform(
+            0, 1, (1, 64, 64, 3)).astype(np.float32)
+        (b_ctrl,) = get_op("ControlNetApply").execute(
+            octx, B, (module, params), hint, 1.0)
+        (comb,) = get_op("ConditioningCombine").execute(octx, A, b_ctrl)
+        with_ctrl = self._run(p, comb, N)
+        (comb_plain,) = get_op("ConditioningCombine").execute(octx, A, B)
+        without = self._run(p, comb_plain, N)
+        # the control on the SECOND combine input steers the sample
+        # (pre-fix it was silently dropped and the runs were identical)
+        assert not np.allclose(with_ctrl, without)
+        registry.clear_pipeline_cache()
+
+    def test_set_after_combine_masks_every_entry(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        octx = OpContext()
+        A = Conditioning(context=jnp.ones((1, 7, 8)))
+        B = Conditioning(context=jnp.zeros((1, 7, 8)))
+        (comb,) = get_op("ConditioningCombine").execute(octx, A, B)
+        m = np.ones((8, 8), np.float32)
+        (masked,) = get_op("ConditioningSetMask").execute(octx, comb, m,
+                                                          0.7)
+        assert masked.area_mask is not None
+        assert masked.area_strength == pytest.approx(0.7)
+        assert all(s.area_mask is not None
+                   and s.area_strength == pytest.approx(0.7)
+                   for s in masked.siblings)
+
+    def test_sibling_control_scoped_to_its_region(self):
+        """A control on the right-region sibling must NOT steer the left
+        region: per-entry strength blocks (one step; the left half of
+        the blended output matches the control-free run)."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("scopectrl.ckpt")
+        module, params = registry.load_controlnet("scope_cn.safetensors")
+        params = jax.tree_util.tree_map(lambda a: a + 0.05, params)
+        A = Conditioning(context=p.encode_prompt(["tree"])[0])
+        B = Conditioning(context=p.encode_prompt(["river"])[0])
+        N = Conditioning(context=p.encode_prompt([""])[0])
+        octx = OpContext()
+        hint = np.random.default_rng(4).uniform(
+            0, 1, (1, 16, 16, 3)).astype(np.float32)
+        (setA,) = get_op("ConditioningSetAreaPercentage").execute(
+            octx, A, width=0.5, height=1.0, x=0.0, y=0.0)
+        (b_ctrl,) = get_op("ControlNetApply").execute(
+            octx, B, (module, params), hint, 1.0)
+        (setB,) = get_op("ConditioningSetAreaPercentage").execute(
+            octx, b_ctrl, width=0.5, height=1.0, x=0.5, y=0.0)
+        (setB_plain,) = get_op("ConditioningSetAreaPercentage").execute(
+            octx, B, width=0.5, height=1.0, x=0.5, y=0.0)
+        (comb,) = get_op("ConditioningCombine").execute(octx, setA, setB)
+        (comb0,) = get_op("ConditioningCombine").execute(octx, setA,
+                                                         setB_plain)
+        with_c = self._run(p, comb, N, steps=1)
+        without = self._run(p, comb0, N, steps=1)
+        # right region steered by the control...
+        assert not np.allclose(with_c[:, :, 4:], without[:, :, 4:])
+        # ...left region untouched (per-entry scale; ULP-level tolerance
+        # for the batched-eval fusion differences)
+        np.testing.assert_allclose(with_c[:, :, :4], without[:, :, :4],
+                                   rtol=5e-4, atol=5e-4)
+        registry.clear_pipeline_cache()
+
+    def test_concat_and_average_apply_to_all_entries(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        octx = OpContext()
+        A = Conditioning(context=jnp.ones((1, 7, 8)))
+        B = Conditioning(context=jnp.zeros((1, 7, 8)))
+        C = Conditioning(context=jnp.full((1, 5, 8), 2.0))
+        (comb,) = get_op("ConditioningCombine").execute(octx, A, B)
+        (cat,) = get_op("ConditioningConcat").execute(octx, comb, C)
+        assert cat.context.shape == (1, 12, 8)
+        assert len(cat.siblings) == 1
+        assert cat.siblings[0].context.shape == (1, 12, 8)  # B + C too
+        (avg,) = get_op("ConditioningAverage").execute(
+            octx, comb, Conditioning(context=jnp.full((1, 7, 8), 4.0)),
+            0.5)
+        np.testing.assert_allclose(np.asarray(avg.context), 2.5)  # (1+4)/2
+        np.testing.assert_allclose(np.asarray(avg.siblings[0].context),
+                                   2.0)                           # (0+4)/2
+
+    def test_controlnet_after_combine_steers_all_entries(self):
+        """ControlNetApply downstream of Combine attaches to every entry
+        (ComfyUI loops the cond list) — both regions steered."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        octx = OpContext()
+        A = Conditioning(context=jnp.ones((1, 7, 8)))
+        B = Conditioning(context=jnp.zeros((1, 7, 8)))
+        (comb,) = get_op("ConditioningCombine").execute(octx, A, B)
+        registry.clear_pipeline_cache()
+        module, params = registry.load_controlnet("comb_cn.safetensors")
+        hint = np.zeros((1, 16, 16, 3), np.float32)
+        (ctl,) = get_op("ControlNetApply").execute(
+            octx, comb, (module, params), hint, 0.9)
+        assert ctl.control is not None
+        assert all(s.control is not None and s.control[3] == 0.9
+                   for s in ctl.siblings)
         registry.clear_pipeline_cache()
