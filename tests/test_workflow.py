@@ -540,3 +540,18 @@ class TestRepoFixtures:
         res = WorkflowExecutor(ctx).execute(g)
         assert len(res.images) == 1
         assert res.images[0].shape == (128, 128, 3)
+
+
+class TestRegionalE2E:
+    def test_regional_fixture_fans_out(self, ctx):
+        """The regional fixture: two prompts on canvas halves, combined,
+        seed-fanned; replicas differ, output finite."""
+        g = parse_workflow("/root/repo/workflows/distributed-regional.json")
+        g.nodes["2"].inputs.update(width=32, height=32)
+        g.nodes["3"].inputs.update(steps=2)
+        res = WorkflowExecutor(ctx).execute(g)
+        assert len(res.images) == 8
+        imgs = np.stack(res.images)
+        assert np.isfinite(imgs).all()
+        for i in range(1, 8):
+            assert not np.allclose(imgs[0], imgs[i]), i
