@@ -283,11 +283,13 @@ class DiffusionPipeline:
         per-sample ADM array (replicated over every block) or a list
         with one array per entry, conds first then unconds.
         The denoise loop is jit-compiled and cached per static config."""
-        conds = context if isinstance(context, (list, tuple)) \
-            else [(context, None, 1.0)]
-        unconds = uncond_context if isinstance(uncond_context,
-                                               (list, tuple)) \
-            else [(uncond_context, None, 1.0)]
+        def _norm(entries):
+            if not isinstance(entries, (list, tuple)):
+                return [(entries, None, 1.0, None)]
+            return smp._norm_entries(entries)  # ONE copy of the contract
+
+        conds = _norm(context)
+        unconds = _norm(uncond_context)
         sigmas = jnp.asarray(sch.compute_sigmas(
             self.schedule, scheduler, steps, denoise))
         start = max(int(start_step), 0)
@@ -307,7 +309,9 @@ class DiffusionPipeline:
         def _entries_key(entries):
             return tuple((tuple(c.shape), m is not None,
                           tuple(m.shape) if m is not None else (),
-                          float(s)) for c, m, s in entries)
+                          float(s),
+                          tuple(float(v) for v in sr) if sr is not None
+                          else None) for c, m, s, sr in entries)
 
         y_is_list = isinstance(y, (list, tuple))
         static_key = ("sample", sampler_name, scheduler, steps, float(cfg),
@@ -326,8 +330,9 @@ class DiffusionPipeline:
             has_control = control is not None
             cfg_scale = float(cfg)
             n_conds, n_unconds = len(conds), len(unconds)
-            has_area = [m is not None for _, m, _ in conds + unconds]
-            strengths = [float(s) for _, _, s in conds + unconds]
+            has_area = [m is not None for _, m, _, _ in conds + unconds]
+            strengths = [float(s) for _, _, s, _ in conds + unconds]
+            sranges = [sr for _, _, _, sr in conds + unconds]
             sampler = smp.get_sampler(sampler_name)
             if has_control:
                 cn_module, _, _, cn_strength = control
@@ -354,7 +359,7 @@ class DiffusionPipeline:
                     self.prediction_type, control=ctrl_spec)
                 entries = [(ctx_list[i],
                             area_list[i] if has_area[i] else None,
-                            strengths[i])
+                            strengths[i], sranges[i])
                            for i in range(n_conds + n_unconds)]
                 model = smp.cfg_denoiser_multi(den, entries[:n_conds],
                                                entries[n_conds:],
@@ -416,10 +421,10 @@ class DiffusionPipeline:
         cn_params_arg = control[1] if control is not None else {}
         hint_arg = control[2] if control is not None \
             else jnp.zeros((1, 8, 8, 3))
-        ctx_list = [jnp.asarray(c) for c, _, _ in conds + unconds]
+        ctx_list = [jnp.asarray(c) for c, _, _, _ in conds + unconds]
         area_list = [jnp.asarray(m) if m is not None
                      else jnp.ones((1, 1, 1, 1))
-                     for _, m, _ in conds + unconds]
+                     for _, m, _, _ in conds + unconds]
         return core(self.unet_params, latents, ctx_list, area_list,
                     keys, sigmas, y_arg, mask_arg,
                     cn_params_arg, hint_arg)

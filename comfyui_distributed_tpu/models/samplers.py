@@ -752,15 +752,27 @@ def cfg_denoiser(model: Model, cond: Any, uncond: Any,
     return cfg_denoiser_multi(model, [(cond, None, 1.0)], uncond, cfg_scale)
 
 
-def _mask_blend(entries, parts):
+def _norm_entries(entries):
+    """(ctx, mask, strength[, sigma_range]) -> uniform 4-tuples."""
+    return [e if len(e) == 4 else (*e, None) for e in entries]
+
+
+def _mask_blend(entries, parts, sigma):
     """sum_i(w_i * den_i) / max(sum_i(w_i), eps), w_i = strength_i *
-    mask_i (no mask -> ones) — the per-entry denoised blend both CFG
-    sides use."""
+    mask_i * active_i(sigma) — the per-entry denoised blend both CFG
+    sides use.  ``active_i``: ComfyUI's timestep-range gate (a traced
+    elementwise select on the step's sigma; entries outside their range
+    contribute nothing that step)."""
     acc = None
     wsum = None
-    for (c, m, s), p in zip(entries, parts):
+    for (c, m, s, srange), p in zip(entries, parts):
         w = jnp.full((1, 1, 1, 1), float(s), p.dtype) if m is None \
             else jnp.asarray(m, p.dtype) * float(s)
+        if srange is not None:
+            s_start, s_end = float(srange[0]), float(srange[1])
+            sig = jnp.max(jnp.asarray(sigma))
+            active = jnp.logical_and(sig <= s_start, sig >= s_end)
+            w = w * active.astype(p.dtype)
         term = p * w
         wb = jnp.broadcast_to(w, p.shape[:-1] + (1,))
         acc = term if acc is None else acc + term
@@ -778,29 +790,32 @@ def cfg_denoiser_multi(model: Model, conds, uncond: Any,
     the CFG combine.
 
     ``conds`` (and optionally ``uncond``): list of ``(context [B,T,C],
-    mask [.,h,w,1] or None, strength)``; a plain ``uncond`` array is a
-    single unmasked entry.  Masks/strengths are trace-time constants of
-    the compiled program (static shapes, no dynamic control flow); a
-    region covered by no mask gets ~zero prediction — cover the canvas,
-    like ComfyUI (its uncovered regions behave the same way)."""
-    unconds = uncond if isinstance(uncond, (list, tuple)) \
-        else [(uncond, None, 1.0)]
+    mask [.,h,w,1] or None, strength[, sigma_range])``; a plain
+    ``uncond`` array is a single unmasked entry.  Masks/strengths/ranges
+    are trace-time constants of the compiled program (static shapes, no
+    dynamic control flow); a region covered by no mask gets ~zero
+    prediction — cover the canvas, like ComfyUI (its uncovered regions
+    behave the same way)."""
+    conds = _norm_entries(conds)
+    unconds = _norm_entries(uncond) if isinstance(uncond, (list, tuple)) \
+        else [(uncond, None, 1.0, None)]
     n, nu = len(conds), len(unconds)
 
     def wrapped(x, sigma, **extra):
         use_uncond = cfg_scale != 1.0
         reps = n + (nu if use_uncond else 0)
-        if reps == 1 and conds[0][1] is None:
+        if reps == 1 and conds[0][1] is None and conds[0][3] is None:
             return model(x, sigma, context=conds[0][0], **extra)
         x_rep = jnp.concatenate([x] * reps, axis=0)
         ctx = jnp.concatenate(
-            [c for c, _, _ in conds]
-            + ([c for c, _, _ in unconds] if use_uncond else []), axis=0)
+            [c for c, _, _, _ in conds]
+            + ([c for c, _, _, _ in unconds] if use_uncond else []),
+            axis=0)
         out = model(x_rep, sigma, context=ctx, **extra)
         parts = jnp.split(out, reps, axis=0)
-        den_cond = _mask_blend(conds, parts[:n])
+        den_cond = _mask_blend(conds, parts[:n], sigma)
         if not use_uncond:
             return den_cond
-        d_uncond = _mask_blend(unconds, parts[n:])
+        d_uncond = _mask_blend(unconds, parts[n:], sigma)
         return d_uncond + (den_cond - d_uncond) * cfg_scale
     return wrapped

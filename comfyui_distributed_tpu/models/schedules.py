@@ -50,6 +50,21 @@ class DiscreteSchedule:
         return np.interp(np.asarray(t, dtype=np.float64),
                          np.arange(len(self.sigmas)), self.sigmas)
 
+    def percent_to_sigma(self, percent: float) -> float:
+        """ComfyUI's sampling-percent convention: 0.0 = the very start of
+        sampling (sigma_max side), 1.0 = the end (sigma 0) — used by
+        ConditioningSetTimestepRange."""
+        if percent <= 0.0:
+            return float(self.sigmas[-1]) * 1e3   # effectively +inf
+        if percent >= 1.0:
+            return 0.0
+        t = (1.0 - percent) * (len(self.sigmas) - 1)
+        # log-sigma interpolation, matching t_from_sigma's (and the
+        # reference's) convention — linear interp would shift the gate
+        # boundary by a fraction of a step
+        return float(np.exp(np.interp(t, np.arange(len(self.sigmas)),
+                                      np.log(self.sigmas))))
+
 
 def make_discrete_schedule(beta_schedule: str = "scaled_linear",
                            beta_start: float = 0.00085,

@@ -37,6 +37,10 @@ class Conditioning:
     area_mask: Any = None
     area_strength: float = 1.0
     siblings: tuple = ()
+    # prompt scheduling (ConditioningSetTimestepRange): (start, end)
+    # sampling-percent pair, 0.0 = start of sampling, 1.0 = end; the
+    # entry contributes only while the step sigma is inside the range
+    timestep_range: Any = None
 
 
 @dataclasses.dataclass

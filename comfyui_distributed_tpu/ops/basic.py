@@ -398,8 +398,15 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                 # per-sample masks ride the data axis like the noise
                 # mask; single-row masks stay replicated
                 am = coll.shard_batch(np.asarray(am), mesh)
+            tr = getattr(e, "timestep_range", None)
+            srange = None
+            if tr is not None:
+                # percents -> sigma bounds against THIS model's schedule
+                # (active while s_end <= sigma <= s_start)
+                srange = (model.schedule.percent_to_sigma(float(tr[0])),
+                          model.schedule.percent_to_sigma(float(tr[1])))
             out.append((ce, am,
-                        float(getattr(e, "area_strength", 1.0))))
+                        float(getattr(e, "area_strength", 1.0)), srange))
             if adm:
                 # each entry carries its OWN pooled ADM vector (regional
                 # SDXL: region B must not ride region A's pooled); an
@@ -415,8 +422,8 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
     cond_entries, y_conds = _build_entries(pos_entries)
     unc_entries, y_unconds = _build_entries(neg_entries)
     multi = len(cond_entries) > 1 or len(unc_entries) > 1 \
-        or any(m is not None or s != 1.0
-               for _, m, s in cond_entries + unc_entries)
+        or any(m is not None or s != 1.0 or sr is not None
+               for _, m, s, sr in cond_entries + unc_entries)
     if multi:
         ctx_arr = cond_entries
         unc_arr = unc_entries
@@ -870,6 +877,26 @@ class ConditioningSetAreaPercentage(Op):
                 strength: float = 1.0):
         rect = ("pct", float(x), float(y), float(width), float(height))
         return (_set_area_on_all(conditioning, rect, float(strength)),)
+
+
+@register_op
+class ConditioningSetTimestepRange(Op):
+    """ComfyUI's prompt scheduling: the conditioning contributes only
+    within [start, end) of the sampling run (percents; 0.0 = the very
+    start / sigma_max side).  Applied to every entry of a cond list; the
+    gate is a traced elementwise select on the step sigma — no dynamic
+    control flow under jit."""
+    TYPE = "ConditioningSetTimestepRange"
+    WIDGETS = ["start", "end"]
+    DEFAULTS = {"start": 0.0, "end": 1.0}
+
+    def execute(self, ctx: OpContext, conditioning: Conditioning,
+                start: float = 0.0, end: float = 1.0):
+        rng = (float(start), float(end))
+        return (dataclasses.replace(
+            conditioning, timestep_range=rng,
+            siblings=tuple(dataclasses.replace(s, timestep_range=rng)
+                           for s in conditioning.siblings)),)
 
 
 def _set_area_on_all(cond: Conditioning, area, strength: float):

@@ -1054,3 +1054,50 @@ class TestRegionalPromptingFixups:
         assert all(s.control is not None and s.control[3] == 0.9
                    for s in ctl.siblings)
         registry.clear_pipeline_cache()
+
+
+class TestTimestepRange:
+    def test_schedule_percent_to_sigma(self):
+        from comfyui_distributed_tpu.models import schedules as sch
+        ds = sch.make_discrete_schedule()
+        assert ds.percent_to_sigma(1.0) == 0.0
+        assert ds.percent_to_sigma(0.0) > ds.sigmas[-1]    # ~inf
+        mid = ds.percent_to_sigma(0.5)
+        assert ds.sigmas[0] < mid < ds.sigmas[-1]
+
+    def test_scheduled_prompts_change_sampling(self):
+        """Two prompts scheduled over halves of the run produce a result
+        different from either prompt alone; a [0,1] full-range schedule
+        on a single prompt equals the plain path."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("sched.ckpt")
+        A = Conditioning(context=p.encode_prompt(["oak tree"])[0])
+        B = Conditioning(context=p.encode_prompt(["pine tree"])[0])
+        N = Conditioning(context=p.encode_prompt([""])[0])
+        octx = OpContext()
+
+        def run(pos, seed=17, steps=4):
+            lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+            (out,) = get_op("KSampler").execute(
+                octx, p, seed, steps, 4.0, "euler", "normal", pos, N,
+                lat, 1.0)
+            return np.asarray(out["samples"])
+
+        (a_early,) = get_op("ConditioningSetTimestepRange").execute(
+            octx, A, 0.0, 0.5)
+        (b_late,) = get_op("ConditioningSetTimestepRange").execute(
+            octx, B, 0.5, 1.0)
+        (sched,) = get_op("ConditioningCombine").execute(octx, a_early,
+                                                         b_late)
+        out = run(sched)
+        assert np.isfinite(out).all()
+        assert not np.allclose(out, run(A))
+        assert not np.allclose(out, run(B))
+        # full-range schedule == plain (always-active gate is exact)
+        (a_full,) = get_op("ConditioningSetTimestepRange").execute(
+            octx, A, 0.0, 1.0)
+        np.testing.assert_allclose(run(a_full), run(A), rtol=1e-6,
+                                   atol=1e-6)
+        registry.clear_pipeline_cache()

@@ -551,3 +551,26 @@ class TestMultiCondCFG:
         b = smp.cfg_denoiser_multi(self._model(), [(cond, None, 1.0)],
                                    unc, 3.0)(x, jnp.asarray(1.0))
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_timestep_range_gates_entries(self, ds):
+        """ComfyUI prompt scheduling: an entry contributes only while
+        sigma is inside its range; outside it the other entry takes
+        over completely."""
+        def model(x, sigma, context=None):
+            per_row = jnp.mean(context, axis=(1, 2)).reshape(-1, 1, 1, 1)
+            return jnp.ones_like(x) * per_row
+
+        cond_a = jnp.full((1, 7, 8), 1.0)
+        cond_b = jnp.full((1, 7, 8), 3.0)
+        unc = jnp.zeros((1, 7, 8))
+        # a active for sigma in [5, inf); b active for sigma in [0, 5]
+        f = smp.cfg_denoiser_multi(
+            model, [(cond_a, None, 1.0, (1e9, 5.0)),
+                    (cond_b, None, 1.0, (5.0, 0.0))], unc, 1.0)
+        hi = np.asarray(f(jnp.zeros((1, 2, 2, 3)), jnp.asarray(9.0)))
+        lo = np.asarray(f(jnp.zeros((1, 2, 2, 3)), jnp.asarray(1.0)))
+        np.testing.assert_allclose(hi, 1.0, atol=1e-5)   # only a
+        np.testing.assert_allclose(lo, 3.0, atol=1e-5)   # only b
+        # at the boundary both are active: equal-weight mean
+        mid = np.asarray(f(jnp.zeros((1, 2, 2, 3)), jnp.asarray(5.0)))
+        np.testing.assert_allclose(mid, 2.0, atol=1e-5)
