@@ -48,6 +48,12 @@ class UNetConfig:
     # proj_in/proj_out as 1x1 convs (SD1.x) or nn.Linear (SD2.x/SDXL); the
     # flax module always uses Dense (mathematically identical)
     use_linear_in_transformer: bool = False
+    # FreeU (Si et al.): decoder backbone/skip re-weighting — (b1, b2,
+    # s1, s2) or None; version 2 scales by the normalized hidden mean.
+    # Static config: each setting compiles its own executable (the
+    # derived-pipeline cache keeps them apart)
+    freeu: Optional[Tuple[float, float, float, float]] = None
+    freeu_version: int = 1
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     prediction_type: str = "eps"  # "eps" | "v"
@@ -87,6 +93,49 @@ TINY_CONFIG = UNetConfig(
     num_head_channels=16,
     dtype=jnp.float32,  # deterministic CPU tests; real families use bf16
 )
+
+
+def _fourier_filter(x: jax.Array, threshold: int,
+                    scale: float) -> jax.Array:
+    """FreeU's skip-feature filter: scale the centered low-frequency box
+    of the 2D spectrum by ``scale`` (torch reference Fourier_filter)."""
+    dtype = x.dtype
+    xf = jnp.fft.fftn(x.astype(jnp.float32), axes=(1, 2))
+    xf = jnp.fft.fftshift(xf, axes=(1, 2))
+    _, H, W, _ = x.shape
+    cr, cc = H // 2, W // 2
+    mask = jnp.ones((1, H, W, 1), jnp.float32)
+    mask = mask.at[:, max(cr - threshold, 0):cr + threshold,
+                   max(cc - threshold, 0):cc + threshold, :].set(scale)
+    xf = jnp.fft.ifftshift(xf * mask, axes=(1, 2))
+    return jnp.real(jnp.fft.ifftn(xf, axes=(1, 2))).astype(dtype)
+
+
+def _apply_freeu(cfg: "UNetConfig", h: jax.Array, hsp: jax.Array):
+    """FreeU at a decoder concat: boost the first half of the backbone
+    channels (v2: scaled by the per-pixel normalized hidden mean) and
+    low-pass-attenuate the skip.  Applies only at the torch reference's
+    two channel widths (model_channels*4 / *2)."""
+    b1, b2, s1, s2 = cfg.freeu
+    scales = {cfg.model_channels * 4: (float(b1), float(s1)),
+              cfg.model_channels * 2: (float(b2), float(s2))}
+    sc = scales.get(int(h.shape[-1]))
+    if sc is None:
+        return h, hsp
+    b, s = sc
+    half = h.shape[-1] // 2
+    if cfg.freeu_version == 2:
+        hm = jnp.mean(h.astype(jnp.float32), axis=-1, keepdims=True)
+        hmin = jnp.min(hm.reshape(h.shape[0], -1), axis=1) \
+            .reshape(-1, 1, 1, 1)
+        hmax = jnp.max(hm.reshape(h.shape[0], -1), axis=1) \
+            .reshape(-1, 1, 1, 1)
+        hm = (hm - hmin) / jnp.maximum(hmax - hmin, 1e-6)
+        boost = ((b - 1.0) * hm + 1.0).astype(h.dtype)
+    else:
+        boost = jnp.asarray(b, h.dtype)
+    h = jnp.concatenate([h[..., :half] * boost, h[..., half:]], axis=-1)
+    return h, _fourier_filter(hsp, 1, s)
 
 
 class UNet(nn.Module):
@@ -160,7 +209,10 @@ class UNet(nn.Module):
         for level in reversed(range(cfg.num_levels)):
             out_ch = ch * cfg.channel_mult[level]
             for i in range(cfg.num_res_blocks + 1):
-                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                skip = skips.pop()
+                if cfg.freeu is not None:
+                    h, skip = _apply_freeu(cfg, h, skip)
+                h = jnp.concatenate([h, skip], axis=-1)
                 h = ResBlock(out_ch, dtype=cfg.dtype,
                              name=f"up_{level}_res_{i}")(h, emb)
                 if cfg.transformer_depth[level] > 0:

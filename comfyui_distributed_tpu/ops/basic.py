@@ -76,6 +76,45 @@ class LoraLoader(Op):
         return (m2, c2)
 
 
+def _freeu_pipeline(model, version: int, b1: float, b2: float,
+                    s1: float, s2: float):
+    """MODEL -> derived pipeline with FreeU decoder re-weighting baked
+    into the (static) UNet config; params shared with the base."""
+    fam = model.family
+    fam2 = dataclasses.replace(fam, unet=dataclasses.replace(
+        fam.unet, freeu=(float(b1), float(b2), float(s1), float(s2)),
+        freeu_version=int(version)))
+    tag = f"freeu{version}:{b1}:{b2}:{s1}:{s2}"
+    return registry.derive_pipeline(model, tag, family=fam2)
+
+
+@register_op
+class FreeU(Op):
+    """FreeU (Si et al.): decoder backbone boost + skip low-pass — free
+    quality lift, no weight change (reference ecosystem's FreeU node).
+    Static config: each setting compiles once, cached per pipeline."""
+    TYPE = "FreeU"
+    WIDGETS = ["b1", "b2", "s1", "s2"]
+    DEFAULTS = {"b1": 1.1, "b2": 1.2, "s1": 0.9, "s2": 0.2}
+
+    def execute(self, ctx: OpContext, model, b1: float = 1.1,
+                b2: float = 1.2, s1: float = 0.9, s2: float = 0.2):
+        return (_freeu_pipeline(model, 1, b1, b2, s1, s2),)
+
+
+@register_op
+class FreeU_V2(Op):
+    """FreeU v2: the backbone boost scales with the per-pixel normalized
+    hidden mean instead of uniformly."""
+    TYPE = "FreeU_V2"
+    WIDGETS = ["b1", "b2", "s1", "s2"]
+    DEFAULTS = {"b1": 1.3, "b2": 1.4, "s1": 0.9, "s2": 0.2}
+
+    def execute(self, ctx: OpContext, model, b1: float = 1.3,
+                b2: float = 1.4, s1: float = 0.9, s2: float = 0.2):
+        return (_freeu_pipeline(model, 2, b1, b2, s1, s2),)
+
+
 @register_op
 class CLIPSetLastLayer(Op):
     """ComfyUI's clip-skip: re-route cross-attention conditioning to an
@@ -882,8 +921,8 @@ class ConditioningSetAreaPercentage(Op):
 @register_op
 class ConditioningSetTimestepRange(Op):
     """ComfyUI's prompt scheduling: the conditioning contributes only
-    within [start, end) of the sampling run (percents; 0.0 = the very
-    start / sigma_max side).  Applied to every entry of a cond list; the
+    within the [start, end] sampling-percent window (inclusive sigma
+    bounds, matching ComfyUI; 0.0 = the very start / sigma_max side).  Applied to every entry of a cond list; the
     gate is a traced elementwise select on the step sigma — no dynamic
     control flow under jit."""
     TYPE = "ConditioningSetTimestepRange"

@@ -1101,3 +1101,65 @@ class TestTimestepRange:
         np.testing.assert_allclose(run(a_full), run(A), rtol=1e-6,
                                    atol=1e-6)
         registry.clear_pipeline_cache()
+
+
+class TestFreeU:
+    def test_fourier_filter_lowpass(self):
+        from comfyui_distributed_tpu.models.unet import _fourier_filter
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((1, 8, 8, 4)), jnp.float32)
+        # scale=1: identity (within fft round-trip noise)
+        same = _fourier_filter(x, 1, 1.0)
+        np.testing.assert_allclose(np.asarray(same), np.asarray(x),
+                                   atol=1e-5)
+        # scale=0: the DC/low box is removed -> per-channel mean ~0
+        killed = np.asarray(_fourier_filter(x, 1, 0.0))
+        assert abs(killed.mean()) < 1e-5
+        assert not np.allclose(killed, np.asarray(x))
+
+    def test_freeu_changes_output_and_params_shared(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("freeu.ckpt")
+        octx = OpContext()
+        (p1,) = get_op("FreeU").execute(octx, p, 1.5, 1.6, 0.5, 0.5)
+        (p2,) = get_op("FreeU_V2").execute(octx, p, 1.5, 1.6, 0.5, 0.5)
+        assert p1.unet_params is p.unet_params        # params shared
+        assert p1 is not p and p2 is not p1
+        # same settings -> cached derived pipeline
+        (p1b,) = get_op("FreeU").execute(octx, p, 1.5, 1.6, 0.5, 0.5)
+        assert p1b is p1
+        x = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (1, 8, 8, 4)), jnp.float32)
+        ts = jnp.zeros((1,))
+        ctx_a = jnp.asarray(np.random.default_rng(2).standard_normal(
+            (1, 16, 64)), jnp.float32)
+        base = np.asarray(p.unet.apply({"params": p.unet_params}, x, ts,
+                                       ctx_a))
+        v1 = np.asarray(p1.unet.apply({"params": p1.unet_params}, x, ts,
+                                      ctx_a))
+        v2 = np.asarray(p2.unet.apply({"params": p2.unet_params}, x, ts,
+                                      ctx_a))
+        # tiny's max width is model_channels*2 -> the b2/s2 pair engages
+        assert not np.allclose(base, v1)
+        assert not np.allclose(v1, v2)     # v2's mean-scaled boost differs
+        assert np.isfinite(v1).all() and np.isfinite(v2).all()
+
+    def test_freeu_sampling_e2e(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("freeu-e2e.ckpt")
+        octx = OpContext()
+        (pf,) = get_op("FreeU").execute(octx, p, 1.4, 1.6, 0.8, 0.4)
+        pos = Conditioning(context=p.encode_prompt(["hills"])[0])
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (out,) = get_op("KSampler").execute(
+            octx, pf, 5, 2, 4.0, "euler", "normal", pos, neg, lat, 1.0)
+        s = np.asarray(out["samples"])
+        assert np.isfinite(s).all()
+        (plain,) = get_op("KSampler").execute(
+            octx, p, 5, 2, 4.0, "euler", "normal", pos, neg, lat, 1.0)
+        assert not np.allclose(s, np.asarray(plain["samples"]))
+        registry.clear_pipeline_cache()

@@ -72,7 +72,8 @@ class TestSamplers:
         assert np.allclose(np.asarray(out), np.asarray(x0), atol=1e-3), name
 
     @pytest.mark.parametrize("name", ["euler_ancestral", "dpmpp_2m_sde",
-                                      "lcm", "dpmpp_sde", "dpmpp_3m_sde"])
+                                      "lcm", "dpmpp_sde", "dpmpp_3m_sde",
+                                      "ddpm"])
     def test_stochastic_requires_keys(self, ds, name):
         sigmas = jnp.asarray(sch.compute_sigmas(ds, "normal", 4))
         x = jnp.zeros((1, 2, 2, 1))
@@ -574,3 +575,58 @@ class TestMultiCondCFG:
         # at the boundary both are active: equal-weight mean
         mid = np.asarray(f(jnp.zeros((1, 2, 2, 3)), jnp.asarray(5.0)))
         np.testing.assert_allclose(mid, 2.0, atol=1e-5)
+
+
+class TestDdpmIpndmOracles:
+    _setup = TestLoopOracles._setup   # shared fixture-free helper
+    def test_ddpm_matches_loop(self, ds):
+        import math
+        sigmas, x0, keys, model = self._setup(ds, steps=7)
+        out = smp.sample_ddpm(model, x0, jnp.asarray(
+            np.asarray(sigmas, np.float32)), keys=keys)
+        noise_fn = smp.make_noise_fn(keys)
+        x = np.asarray(x0, np.float64)
+        for i in range(len(sigmas) - 1):
+            s, s_next = sigmas[i], sigmas[i + 1]
+            den = np.asarray(model(jnp.asarray(x, jnp.float32), s),
+                             np.float64)
+            eps = (x - den) / s
+            xs = x / math.sqrt(1.0 + s * s)
+            ac = 1.0 / (s * s + 1.0)
+            ac_prev = 1.0 / (s_next * s_next + 1.0)
+            alpha = ac / ac_prev
+            mu = math.sqrt(1.0 / alpha) * (
+                xs - (1.0 - alpha) * eps / math.sqrt(1.0 - ac))
+            if s_next > 0:
+                std = math.sqrt((1.0 - alpha) * (1.0 - ac_prev)
+                                / (1.0 - ac))
+                mu = mu + np.asarray(noise_fn(i, x.shape[1:]),
+                                     np.float64) * std
+                x = mu * math.sqrt(1.0 + s_next * s_next)
+            else:
+                x = mu
+        np.testing.assert_allclose(np.asarray(out), x, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_ipndm_matches_loop(self, ds):
+        sigmas, x0, keys, model = self._setup(ds, steps=8)
+        out = smp.sample_ipndm(model, x0, jnp.asarray(
+            np.asarray(sigmas, np.float32)))
+        coeffs = ((1.0,), (3 / 2, -1 / 2), (23 / 12, -16 / 12, 5 / 12),
+                  (55 / 24, -59 / 24, 37 / 24, -9 / 24))
+        x = np.asarray(x0, np.float64)
+        hist = []
+        for i in range(len(sigmas) - 1):
+            s, s_next = sigmas[i], sigmas[i + 1]
+            den = np.asarray(model(jnp.asarray(x, jnp.float32), s),
+                             np.float64)
+            d = (x - den) / s
+            order = min(i + 1, 4)
+            cs = coeffs[order - 1]
+            upd = cs[0] * d
+            for k in range(1, order):
+                upd = upd + cs[k] * hist[-k]
+            x = x + (s_next - s) * upd
+            hist.append(d)
+        np.testing.assert_allclose(np.asarray(out), x, rtol=2e-4,
+                                   atol=2e-4)
