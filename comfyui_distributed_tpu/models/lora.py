@@ -282,6 +282,8 @@ def apply_lora_to_pipeline(pipe, lora_name: str,
         pipe.vae_params,                # LoRA never touches the VAE
         prediction_type=pipe.prediction_type,
         assets_dir=getattr(pipe, "assets_dir", None))
+    # sampling patches ride derivation chains (RescaleCFG -> LoRA)
+    patched.cfg_rescale = getattr(pipe, "cfg_rescale", 0.0)
     with _lora_lock:
         _lora_cache[cache_key] = patched
         while len(_lora_cache) > _lora_cache_cap:

@@ -313,8 +313,10 @@ class DiffusionPipeline:
                           tuple(float(v) for v in sr) if sr is not None
                           else None) for c, m, s, sr in entries)
 
+        cfg_rescale = float(getattr(self, "cfg_rescale", 0.0) or 0.0)
         y_is_list = isinstance(y, (list, tuple))
-        static_key = ("sample", sampler_name, scheduler, steps, float(cfg),
+        static_key = ("sample", sampler_name, scheduler, steps,
+                      cfg_rescale, float(cfg),
                       float(denoise), bool(add_noise), y is not None,
                       y_is_list, tuple(latents.shape), _entries_key(conds),
                       _entries_key(unconds),
@@ -363,7 +365,8 @@ class DiffusionPipeline:
                            for i in range(n_conds + n_unconds)]
                 model = smp.cfg_denoiser_multi(den, entries[:n_conds],
                                                entries[n_conds:],
-                                               cfg_scale)
+                                               cfg_scale,
+                                               cfg_rescale=cfg_rescale)
                 reps = n_conds + (n_unconds if cfg_scale != 1.0 else 0)
                 if not has_y:
                     y2 = y_in
@@ -600,9 +603,12 @@ _cn_family_cache: Dict[str, str] = {}
 
 def derive_pipeline(base: DiffusionPipeline, tag: str,
                     family: Optional[ModelFamily] = None,
-                    vae_params: Any = None) -> DiffusionPipeline:
+                    vae_params: Any = None,
+                    cfg_rescale: Optional[float] = None
+                    ) -> DiffusionPipeline:
     """Cached clone of ``base`` with a replacement family (e.g. clip-skip
-    configs) and/or VAE params; everything else shared by reference."""
+    configs), VAE params, and/or sampling patches; everything else shared
+    by reference."""
     key = (base.cache_token, tag)
     with _pipeline_lock:
         if key in _derived_cache:
@@ -614,6 +620,11 @@ def derive_pipeline(base: DiffusionPipeline, tag: str,
         vae_params if vae_params is not None else base.vae_params,
         prediction_type=base.prediction_type,
         assets_dir=base.assets_dir)
+    # sampling patches ride derivation chains (RescaleCFG -> clip-skip
+    # -> LoRA must keep the rescale); set BEFORE the clone is published
+    # to the cache so a concurrent sampler can't observe the default
+    clone.cfg_rescale = cfg_rescale if cfg_rescale is not None \
+        else getattr(base, "cfg_rescale", 0.0)
     with _pipeline_lock:
         _derived_cache[key] = clone
         while len(_derived_cache) > _DERIVED_CACHE_CAP:

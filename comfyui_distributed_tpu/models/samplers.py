@@ -859,7 +859,8 @@ def _mask_blend(entries, parts, sigma):
 
 
 def cfg_denoiser_multi(model: Model, conds, uncond: Any,
-                       cfg_scale: float) -> Model:
+                       cfg_scale: float,
+                       cfg_rescale: float = 0.0) -> Model:
     """Area/mask conditioning (ComfyUI's multi-entry cond lists): every
     entry of BOTH CFG sides is evaluated in ONE stacked model call
     ([cond_1..cond_N, uncond_1..uncond_M] rows — still a single large
@@ -895,5 +896,31 @@ def cfg_denoiser_multi(model: Model, conds, uncond: Any,
         if not use_uncond:
             return den_cond
         d_uncond = _mask_blend(unconds, parts[n:], sigma)
+        if cfg_rescale:
+            return _rescale_cfg(x, sigma, den_cond, d_uncond, cfg_scale,
+                                cfg_rescale)
         return d_uncond + (den_cond - d_uncond) * cfg_scale
     return wrapped
+
+
+def _rescale_cfg(x: jax.Array, sigma: jax.Array, den_cond: jax.Array,
+                 den_uncond: jax.Array, cfg_scale: float,
+                 multiplier: float) -> jax.Array:
+    """RescaleCFG (Lin et al., "Common Diffusion Noise Schedules..."):
+    re-std the CFG combination toward the cond prediction's statistics in
+    v-space, blended by ``multiplier`` — tames the over-saturation of
+    high CFG, especially on v-prediction models.  Port of the reference
+    ecosystem's RescaleCFG patch (x0 predictions in, x0 out)."""
+    s = _broadcast_sigma(jnp.asarray(sigma, x.dtype), x)
+    s2 = s * s
+    xs = x / (s2 + 1.0)
+    root = jnp.sqrt(s2 + 1.0)
+    v_cond = (xs - (x - den_cond)) * root / s
+    v_unc = (xs - (x - den_uncond)) * root / s
+    v_cfg = v_unc + (v_cond - v_unc) * cfg_scale
+    axes = tuple(range(1, x.ndim))
+    ro_pos = jnp.std(v_cond, axis=axes, keepdims=True)
+    ro_cfg = jnp.std(v_cfg, axis=axes, keepdims=True)
+    v_res = v_cfg * (ro_pos / jnp.maximum(ro_cfg, 1e-9))
+    v_fin = multiplier * v_res + (1.0 - multiplier) * v_cfg
+    return x - (xs - v_fin * s / root)

@@ -89,6 +89,24 @@ def _freeu_pipeline(model, version: int, b1: float, b2: float,
 
 
 @register_op
+class RescaleCFG(Op):
+    """RescaleCFG: re-std the CFG combination toward the cond
+    prediction's v-space statistics (multiplier-blended) — the standard
+    fix for high-CFG over-saturation, essential on v-prediction (sd21)
+    models.  Derived pipeline; the patch rides further derivations."""
+    TYPE = "RescaleCFG"
+    WIDGETS = ["multiplier"]
+    DEFAULTS = {"multiplier": 0.7}
+
+    def execute(self, ctx: OpContext, model, multiplier: float = 0.7):
+        m = float(multiplier)
+        if m == 0.0:
+            return (model,)
+        return (registry.derive_pipeline(model, f"rescale:{m}",
+                                         cfg_rescale=m),)
+
+
+@register_op
 class FreeU(Op):
     """FreeU (Si et al.): decoder backbone boost + skip low-pass — free
     quality lift, no weight change (reference ecosystem's FreeU node).

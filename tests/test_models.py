@@ -1163,3 +1163,37 @@ class TestFreeU:
             octx, p, 5, 2, 4.0, "euler", "normal", pos, neg, lat, 1.0)
         assert not np.allclose(s, np.asarray(plain["samples"]))
         registry.clear_pipeline_cache()
+
+
+class TestRescaleCFG:
+    def test_node_patches_and_rides_derivations(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("rescale.ckpt")
+        octx = OpContext()
+        (pr,) = get_op("RescaleCFG").execute(octx, p, 0.7)
+        assert pr is not p and pr.cfg_rescale == 0.7
+        assert pr.unet_params is p.unet_params
+        # rides further derivations (clip-skip AND LoRA chains)
+        (pc,) = get_op("CLIPSetLastLayer").execute(octx, pr, -2)
+        assert getattr(pc, "cfg_rescale", 0.0) == 0.7
+        (pl, _) = get_op("LoraLoader").execute(octx, pr, pr,
+                                               "style.safetensors", 0.5,
+                                               0.5)
+        assert getattr(pl, "cfg_rescale", 0.0) == 0.7
+        # multiplier 0 is a no-op passthrough
+        (p0,) = get_op("RescaleCFG").execute(octx, p, 0.0)
+        assert p0 is p
+        # sampling: finite and different from the unpatched run
+        pos = Conditioning(context=p.encode_prompt(["dunes"])[0])
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (a,) = get_op("KSampler").execute(octx, pr, 9, 2, 7.0, "euler",
+                                          "normal", pos, neg, lat, 1.0)
+        (b,) = get_op("KSampler").execute(octx, p, 9, 2, 7.0, "euler",
+                                          "normal", pos, neg, lat, 1.0)
+        assert np.isfinite(np.asarray(a["samples"])).all()
+        assert not np.allclose(np.asarray(a["samples"]),
+                               np.asarray(b["samples"]))
+        registry.clear_pipeline_cache()

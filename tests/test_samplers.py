@@ -630,3 +630,38 @@ class TestDdpmIpndmOracles:
             hist.append(d)
         np.testing.assert_allclose(np.asarray(out), x, rtol=2e-4,
                                    atol=2e-4)
+
+    def test_rescale_cfg_math(self):
+        """RescaleCFG vs a direct numpy port of the reference patch;
+        multiplier=0 must equal plain CFG exactly."""
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((2, 4, 4, 3)), jnp.float32)
+        dc = jnp.asarray(rng.standard_normal((2, 4, 4, 3)), jnp.float32)
+        du = jnp.asarray(rng.standard_normal((2, 4, 4, 3)), jnp.float32)
+        sigma, scale, mult = 3.0, 7.0, 0.6
+        out = np.asarray(smp._rescale_cfg(x, jnp.asarray(sigma), dc, du,
+                                          scale, mult))
+        xn, dcn, dun = (np.asarray(a, np.float64) for a in (x, dc, du))
+        s2 = sigma * sigma
+        xs = xn / (s2 + 1.0)
+        root = np.sqrt(s2 + 1.0)
+        v_c = (xs - (xn - dcn)) * root / sigma
+        v_u = (xs - (xn - dun)) * root / sigma
+        v_cfg = v_u + (v_c - v_u) * scale
+        ro_pos = v_c.std(axis=(1, 2, 3), keepdims=True)
+        ro_cfg = v_cfg.std(axis=(1, 2, 3), keepdims=True)
+        v_fin = mult * (v_cfg * ro_pos / ro_cfg) + (1 - mult) * v_cfg
+        ref = xn - (xs - v_fin * sigma / root)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+        # multiplier path off == plain CFG
+        cond = jnp.full((2, 7, 8), 1.5)
+        unc = jnp.zeros((2, 7, 8))
+
+        def model(xx, s, context=None):
+            per = jnp.mean(context, axis=(1, 2)).reshape(-1, 1, 1, 1)
+            return xx * 0.1 + per
+
+        a = smp.cfg_denoiser_multi(model, [(cond, None, 1.0)], unc, scale,
+                                   cfg_rescale=0.0)(x, jnp.asarray(sigma))
+        b = smp.cfg_denoiser(model, cond, unc, scale)(x, jnp.asarray(sigma))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
