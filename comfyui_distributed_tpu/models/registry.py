@@ -260,7 +260,8 @@ class DiffusionPipeline:
                start_step: int = 0, end_step: Optional[int] = None,
                force_full_denoise: bool = False,
                noise_mask: Optional[jnp.ndarray] = None,
-               control=None) -> jnp.ndarray:
+               control=None,
+               sigmas_override=None) -> jnp.ndarray:
         """Full ksampler: schedule -> noise -> scan-sampler -> latents.
 
         ``seeds``: per-sample host seed array [B] (64-bit ok; replica offsets
@@ -290,18 +291,38 @@ class DiffusionPipeline:
 
         conds = _norm(context)
         unconds = _norm(uncond_context)
-        sigmas = jnp.asarray(sch.compute_sigmas(
-            self.schedule, scheduler, steps, denoise))
-        start = max(int(start_step), 0)
-        end = steps if end_step is None else min(int(end_step), steps)
-        if start >= end:
-            # degenerate window (start_at_step beyond the schedule):
-            # ComfyUI returns the latent unchanged rather than erroring
-            return latents
-        if start > 0 or end < steps:
-            sigmas = sigmas[start:end + 1]
-            if force_full_denoise:
-                sigmas = sigmas.at[-1].set(0.0)
+        if sigmas_override is not None:
+            # custom-sampling path (SamplerCustom): the caller supplies
+            # the exact sigma sequence; scheduler/steps/denoise/window
+            # args are ignored.  Only the LENGTH is static (scan trip
+            # count) — the values ride in as a traced argument, so a
+            # KarrasScheduler rho sweep reuses one executable per length
+            sig_np = np.asarray(sigmas_override, np.float32)
+            if sig_np.ndim != 1:
+                raise ValueError("sigmas_override must be a 1-D sigma "
+                                 "sequence (order is the sampler's "
+                                 "business — FlipSigmas feeds ascending)")
+            if sig_np.shape[0] < 2:
+                # ComfyUI's denoise<=0 / empty-schedule no-op: the
+                # latent passes through unchanged (same precedent as the
+                # degenerate KSamplerAdvanced window below)
+                return latents
+            sigmas = jnp.asarray(sig_np)
+            steps = int(sig_np.shape[0]) - 1
+            start, end = 0, steps
+        else:
+            sigmas = jnp.asarray(sch.compute_sigmas(
+                self.schedule, scheduler, steps, denoise))
+            start = max(int(start_step), 0)
+            end = steps if end_step is None else min(int(end_step), steps)
+            if start >= end:
+                # degenerate window (start_at_step beyond the schedule):
+                # ComfyUI returns the latent unchanged rather than erroring
+                return latents
+            if start > 0 or end < steps:
+                sigmas = sigmas[start:end + 1]
+                if force_full_denoise:
+                    sigmas = sigmas.at[-1].set(0.0)
         keys = smp.sample_keys(seeds, sample_idx)
 
         from comfyui_distributed_tpu.runtime.interrupt import polling_enabled
@@ -316,6 +337,7 @@ class DiffusionPipeline:
         cfg_rescale = float(getattr(self, "cfg_rescale", 0.0) or 0.0)
         y_is_list = isinstance(y, (list, tuple))
         static_key = ("sample", sampler_name, scheduler, steps,
+                      sigmas_override is not None,
                       cfg_rescale, float(cfg),
                       float(denoise), bool(add_noise), y is not None,
                       y_is_list, tuple(latents.shape), _entries_key(conds),

@@ -107,10 +107,17 @@ def normal_scheduler(ds: DiscreteSchedule, steps: int, sgm: bool = False) -> np.
     return _append_zero(ds.sigma_from_t(ts))
 
 
-def karras_scheduler(ds: DiscreteSchedule, steps: int, rho: float = 7.0) -> np.ndarray:
-    """Karras et al. 2022 rho-schedule."""
+def karras_scheduler(ds: Optional[DiscreteSchedule], steps: int,
+                     rho: float = 7.0,
+                     sigma_min: Optional[float] = None,
+                     sigma_max: Optional[float] = None) -> np.ndarray:
+    """Karras et al. 2022 rho-schedule.  Bounds default to the model
+    schedule's; explicit bounds serve the KarrasScheduler node (ds may
+    then be None) — ONE copy of the ramp math."""
+    lo = float(sigma_min if sigma_min is not None else ds.sigma_min)
+    hi = float(sigma_max if sigma_max is not None else ds.sigma_max)
     ramp = np.linspace(0, 1, steps)
-    min_r, max_r = ds.sigma_min ** (1 / rho), ds.sigma_max ** (1 / rho)
+    min_r, max_r = lo ** (1 / rho), hi ** (1 / rho)
     sigmas = (max_r + ramp * (min_r - max_r)) ** rho
     return _append_zero(sigmas)
 

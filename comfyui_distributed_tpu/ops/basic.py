@@ -233,6 +233,117 @@ class EmptyLatentImage(Op):
                  "fanout": max(ctx.fanout, 1)},)
 
 
+@dataclasses.dataclass
+class SamplerObject:
+    """SAMPLER wire type (ComfyUI custom sampling): a named sampler
+    selection carried between KSamplerSelect and SamplerCustom."""
+    name: str
+
+
+@register_op
+class KSamplerSelect(Op):
+    TYPE = "KSamplerSelect"
+    WIDGETS = ["sampler_name"]
+
+    def execute(self, ctx: OpContext, sampler_name: str):
+        from comfyui_distributed_tpu.models.samplers import get_sampler
+        get_sampler(str(sampler_name))    # fail at selection, not sampling
+        return (SamplerObject(str(sampler_name)),)
+
+
+@register_op
+class BasicScheduler(Op):
+    """-> SIGMAS from the model's own schedule (ComfyUI custom
+    sampling); denoise < 1 truncates to the final fraction of steps."""
+    TYPE = "BasicScheduler"
+    WIDGETS = ["scheduler", "steps", "denoise"]
+    DEFAULTS = {"denoise": 1.0}
+
+    def execute(self, ctx: OpContext, model, scheduler: str, steps: int,
+                denoise: float = 1.0):
+        from comfyui_distributed_tpu.models import schedules as sch
+        return (np.asarray(sch.compute_sigmas(
+            model.schedule, str(scheduler), int(steps), float(denoise)),
+            np.float32),)
+
+
+@register_op
+class KarrasScheduler(Op):
+    """-> SIGMAS: the Karras rho-schedule with explicit bounds."""
+    TYPE = "KarrasScheduler"
+    WIDGETS = ["steps", "sigma_max", "sigma_min", "rho"]
+    DEFAULTS = {"sigma_max": 14.614642, "sigma_min": 0.0291675,
+                "rho": 7.0}
+
+    def execute(self, ctx: OpContext, steps: int, sigma_max: float,
+                sigma_min: float, rho: float = 7.0):
+        from comfyui_distributed_tpu.models import schedules as sch
+        return (sch.karras_scheduler(None, int(steps), float(rho),
+                                     sigma_min=float(sigma_min),
+                                     sigma_max=float(sigma_max)),)
+
+
+@register_op
+class SplitSigmas(Op):
+    """-> (high_sigmas, low_sigmas) split at ``step`` — two-stage custom
+    chains (the KSamplerAdvanced window as explicit sigma IO)."""
+    TYPE = "SplitSigmas"
+    WIDGETS = ["step"]
+    DEFAULTS = {"step": 0}
+
+    def execute(self, ctx: OpContext, sigmas, step: int = 0):
+        s = np.asarray(sigmas, np.float32)
+        i = min(max(int(step), 0), s.shape[0] - 1)
+        return (s[:i + 1], s[i:])
+
+
+@register_op
+class FlipSigmas(Op):
+    """-> SIGMAS reversed (unsampling chains); a leading 0 becomes a tiny
+    epsilon so the first model call has a usable sigma (ComfyUI)."""
+    TYPE = "FlipSigmas"
+
+    def execute(self, ctx: OpContext, sigmas):
+        s = np.asarray(sigmas, np.float32)[::-1].copy()
+        if s.shape[0] and s[0] == 0.0:
+            s[0] = 1e-4
+        return (s,)
+
+
+@register_op
+class SamplerCustom(Op):
+    """ComfyUI's custom-sampling entry: explicit SAMPLER + SIGMAS instead
+    of the KSampler widget pair.  The sigma VALUES are baked into the
+    compiled program (static trip count).  Both latent outputs carry the
+    final result (the denoised preview stream is not separately
+    materialized — no callback sink exists headless)."""
+    TYPE = "SamplerCustom"
+    WIDGETS = ["add_noise", "noise_seed", "cfg"]
+    DEFAULTS = {"add_noise": True, "cfg": 8.0}
+
+    def execute(self, ctx: OpContext, model, add_noise, noise_seed, cfg,
+                positive: Conditioning, negative: Conditioning,
+                latent_image, sampler, sigmas):
+        ctx.check_interrupt()
+        prep = _prepare_sample_inputs(ctx, model, noise_seed, latent_image,
+                                      positive, negative)
+        name = sampler.name if isinstance(sampler, SamplerObject) \
+            else str(sampler)
+        with Timer(f"sampler_custom[{name}x{len(sigmas) - 1}]"):
+            out = model.sample(
+                prep.latents, prep.context, prep.uncond, prep.seeds,
+                steps=1, cfg=float(cfg), sampler_name=name,
+                scheduler="normal", y=prep.y,
+                add_noise=(str(add_noise).lower()
+                           not in ("disable", "false", "0")),
+                sample_idx=prep.sample_idx,
+                noise_mask=prep.noise_mask, control=prep.control,
+                sigmas_override=np.asarray(sigmas, np.float32))
+        out_d = {"samples": out, **_latent_meta(latent_image),
+                 "local_batch": prep.local_batch, "fanout": prep.fanout}
+        return (out_d, dict(out_d))
+
+
 @register_op
 class KSampler(Op):
     """Denoise loop.  Seed semantics (reference ``distributed.py:1491-1514``):

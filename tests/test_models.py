@@ -1197,3 +1197,94 @@ class TestRescaleCFG:
         assert not np.allclose(np.asarray(a["samples"]),
                                np.asarray(b["samples"]))
         registry.clear_pipeline_cache()
+
+
+class TestCustomSampling:
+    """SamplerCustom chain: KSamplerSelect + scheduler/sigma nodes."""
+
+    def test_sigma_nodes(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("custom-sig.ckpt")
+        octx = OpContext()
+        (sig,) = get_op("BasicScheduler").execute(octx, p, "karras", 8,
+                                                  1.0)
+        assert sig.shape == (9,) and sig[-1] == 0.0
+        assert np.all(np.diff(sig) < 1e-7)
+        (ksig,) = get_op("KarrasScheduler").execute(octx, 6, 10.0, 0.1,
+                                                    7.0)
+        assert ksig.shape == (7,)
+        assert ksig[0] == pytest.approx(10.0) and ksig[-1] == 0.0
+        hi, lo = get_op("SplitSigmas").execute(octx, sig, 3)
+        assert hi.shape == (4,) and lo.shape == (6,)
+        assert hi[-1] == lo[0]
+        (flipped,) = get_op("FlipSigmas").execute(octx, sig)
+        assert flipped[0] == pytest.approx(1e-4)     # leading 0 -> eps
+        assert flipped[-1] == sig[0]
+        # denoise<=0: 1-entry sigmas -> SamplerCustom is a no-op
+        # (ComfyUI passes the latent through unchanged)
+        (sig0,) = get_op("BasicScheduler").execute(octx, p, "karras", 8,
+                                                   0.0)
+        assert sig0.shape[0] < 2
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        c = Conditioning(context=p.encode_prompt(["x"])[0])
+        lat0 = {"samples": np.full((1, 8, 8, 4), 0.25, np.float32)}
+        (sampler0,) = get_op("KSamplerSelect").execute(octx, "euler")
+        noop, _ = get_op("SamplerCustom").execute(
+            octx, p, True, 1, 4.0, c, c, lat0, sampler0, sig0)
+        np.testing.assert_array_equal(np.asarray(noop["samples"]),
+                                      lat0["samples"])
+        with pytest.raises(ValueError):
+            get_op("KSamplerSelect").execute(octx, "not_a_sampler")
+
+    def test_sampler_custom_matches_ksampler(self):
+        """SamplerCustom with BasicScheduler sigmas must reproduce the
+        KSampler result for the same (sampler, scheduler, steps, seed) —
+        the custom chain is the exploded form of the same computation."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("custom-eq.ckpt")
+        octx = OpContext()
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0])
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (ks_out,) = get_op("KSampler").execute(
+            octx, p, 31, 4, 5.0, "dpmpp_2m", "karras", pos, neg, lat, 1.0)
+        (sampler,) = get_op("KSamplerSelect").execute(octx, "dpmpp_2m")
+        (sig,) = get_op("BasicScheduler").execute(octx, p, "karras", 4,
+                                                  1.0)
+        out, out2 = get_op("SamplerCustom").execute(
+            octx, p, True, 31, 5.0, pos, neg, lat, sampler, sig)
+        np.testing.assert_allclose(np.asarray(out["samples"]),
+                                   np.asarray(ks_out["samples"]),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(out["samples"]),
+                                      np.asarray(out2["samples"]))
+        registry.clear_pipeline_cache()
+
+    def test_split_sigmas_two_stage_roundtrip(self):
+        """hi/lo split driven through two SamplerCustom stages equals the
+        single full run (euler: the deterministic two-window identity)."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("custom-2stage.ckpt")
+        octx = OpContext()
+        pos = Conditioning(context=p.encode_prompt(["a bay"])[0])
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (sampler,) = get_op("KSamplerSelect").execute(octx, "euler")
+        (sig,) = get_op("BasicScheduler").execute(octx, p, "normal", 6,
+                                                  1.0)
+        full, _ = get_op("SamplerCustom").execute(
+            octx, p, True, 5, 4.0, pos, neg, lat, sampler, sig)
+        hi, lo = get_op("SplitSigmas").execute(octx, sig, 3)
+        stage1, _ = get_op("SamplerCustom").execute(
+            octx, p, True, 5, 4.0, pos, neg, lat, sampler, hi)
+        stage2, _ = get_op("SamplerCustom").execute(
+            octx, p, False, 5, 4.0, pos, neg, stage1, sampler, lo)
+        np.testing.assert_allclose(np.asarray(stage2["samples"]),
+                                   np.asarray(full["samples"]),
+                                   rtol=1e-4, atol=1e-4)
+        registry.clear_pipeline_cache()
