@@ -104,15 +104,52 @@ def scaled_dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return xla_attention(q, k, v, 1.0 / math.sqrt(q.shape[-1]))
 
 
-def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                  scale: float) -> jax.Array:
-    """The reference attention math (XLA-fused einsum -> fp32 softmax ->
-    einsum).  The single copy both the default impl and the flash kernel's
-    over-VMEM fallback use — duplicates would drift."""
+def _attn_scores_block(q: jax.Array, k: jax.Array, v: jax.Array,
+                       scale: float) -> jax.Array:
+    """One materialized-score attention block (einsum -> fp32 softmax ->
+    einsum)."""
     logits = jnp.einsum("bnhd,bmhd->bhnm", q, k,
                         preferred_element_type=jnp.float32) * scale
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     return jnp.einsum("bhnm,bmhd->bnhd", weights.astype(v.dtype), v)
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  scale: float) -> jax.Array:
+    """The reference attention math with a memory ceiling.  The single
+    copy both the default impl and the flash kernel's over-VMEM fallback
+    use — duplicates would drift.
+
+    The fp32 score tensor is [B, H, N, M]; at SDXL 1024px (N=M=4096)
+    with a CFG-stacked batch that is ~10 GB — more than a v5e chip's
+    HBM (the r4 on-chip OOM).  Softmax is per-QUERY-row, so scanning
+    over query chunks is numerically EXACT (no online rescaling
+    needed); each chunk materializes only [B, H, chunk, M].  The chunk
+    choice is static (shapes + env), so there is no dynamic control
+    flow under jit; ``DTPU_ATTN_SCORES_BYTES`` tunes the ceiling
+    (default 512 MB)."""
+    import os
+
+    B, N, H, D = q.shape
+    M = k.shape[1]
+    limit = int(os.environ.get("DTPU_ATTN_SCORES_BYTES",
+                               str(512 * 1024 * 1024)))
+    if 4 * B * H * N * M <= limit or N <= 128:
+        return _attn_scores_block(q, k, v, scale)
+    want = max(1, limit // (4 * B * H * M))
+    chunk = 1
+    for d in range(min(want, N), 0, -1):    # largest divisor of N <= want
+        if N % d == 0:
+            chunk = d
+            break
+    n_chunks = N // chunk
+    qr = q.reshape(B, n_chunks, chunk, H, D).transpose(1, 0, 2, 3, 4)
+
+    def body(_, qc):
+        return None, _attn_scores_block(qc, k, v, scale)
+
+    _, out = jax.lax.scan(body, None, qr)
+    return out.transpose(1, 0, 2, 3, 4).reshape(B, N, H, D)
 
 
 def _maybe_ring_attention(q: jax.Array, k: jax.Array,

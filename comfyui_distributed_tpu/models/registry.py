@@ -261,7 +261,9 @@ class DiffusionPipeline:
                force_full_denoise: bool = False,
                noise_mask: Optional[jnp.ndarray] = None,
                control=None,
-               sigmas_override=None) -> jnp.ndarray:
+               sigmas_override=None,
+               middle_context=None,
+               cfg2: float = 1.0) -> jnp.ndarray:
         """Full ksampler: schedule -> noise -> scan-sampler -> latents.
 
         ``seeds``: per-sample host seed array [B] (64-bit ok; replica offsets
@@ -291,6 +293,17 @@ class DiffusionPipeline:
 
         conds = _norm(context)
         unconds = _norm(uncond_context)
+        dual = middle_context is not None
+        if dual:
+            # DualCFGGuider path: plain [cond, middle, uncond] arrays only
+            # (ComfyUI's dual guider likewise takes bare conds — regional
+            # multi-entry lists don't compose with the 3-way combine)
+            if len(conds) != 1 or len(unconds) != 1 or any(
+                    m is not None or s != 1.0 or sr is not None
+                    for _, m, s, sr in conds + unconds):
+                raise ValueError("dual-CFG requires plain single-entry "
+                                 "positive/negative conditionings")
+            conds = conds + [(jnp.asarray(middle_context), None, 1.0, None)]
         if sigmas_override is not None:
             # custom-sampling path (SamplerCustom): the caller supplies
             # the exact sigma sequence; scheduler/steps/denoise/window
@@ -342,7 +355,7 @@ class DiffusionPipeline:
                       float(denoise), bool(add_noise), y is not None,
                       y_is_list, tuple(latents.shape), _entries_key(conds),
                       _entries_key(unconds),
-                      polling_enabled(), start, end,
+                      polling_enabled(), start, end, dual, float(cfg2),
                       bool(force_full_denoise), noise_mask is not None,
                       control is not None,
                       _strength_key(control[3]) if control is not None
@@ -385,11 +398,19 @@ class DiffusionPipeline:
                             area_list[i] if has_area[i] else None,
                             strengths[i], sranges[i])
                            for i in range(n_conds + n_unconds)]
-                model = smp.cfg_denoiser_multi(den, entries[:n_conds],
-                                               entries[n_conds:],
-                                               cfg_scale,
-                                               cfg_rescale=cfg_rescale)
-                reps = n_conds + (n_unconds if cfg_scale != 1.0 else 0)
+                if dual:
+                    # ctx_list rows: [cond, middle, uncond] (see sample())
+                    model = smp.cfg_denoiser_dual(
+                        den, ctx_list[0], ctx_list[1], ctx_list[2],
+                        cfg_scale, float(cfg2), cfg_rescale=cfg_rescale)
+                    reps = 3
+                else:
+                    model = smp.cfg_denoiser_multi(den, entries[:n_conds],
+                                                   entries[n_conds:],
+                                                   cfg_scale,
+                                                   cfg_rescale=cfg_rescale)
+                    reps = n_conds + (n_unconds if cfg_scale != 1.0
+                                      else 0)
                 if not has_y:
                     y2 = y_in
                 elif y_is_list:

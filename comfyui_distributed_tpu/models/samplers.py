@@ -903,6 +903,33 @@ def cfg_denoiser_multi(model: Model, conds, uncond: Any,
     return wrapped
 
 
+def cfg_denoiser_dual(model: Model, cond: jax.Array, middle: jax.Array,
+                      uncond: jax.Array, cfg1: float, cfg2: float,
+                      cfg_rescale: float = 0.0) -> Model:
+    """Dual-CFG guidance (ComfyUI's DualCFGGuider / the InstructPix2Pix
+    combine): one tripled-batch model call per step ([cond, middle,
+    uncond] rows — still a single large matmul for the MXU), combined as
+
+        result = (uncond + cfg2 * (middle - uncond)) + cfg1 * (cond - middle)
+
+    i.e. the middle conditioning is CFG'd against the negative at
+    ``cfg2``, then the positive steers against the middle at ``cfg1`` —
+    reference semantics: ComfyUI ``nodes_custom_sampler.Guider_DualCFG``.
+    A RescaleCFG patch applies to the middle/negative combine (ComfyUI:
+    the sampler_cfg_function rides ``cfg_function`` there)."""
+    def wrapped(x, sigma, **extra):
+        x_rep = jnp.concatenate([x, x, x], axis=0)
+        ctx = jnp.concatenate([cond, middle, uncond], axis=0)
+        out = model(x_rep, sigma, context=ctx, **extra)
+        pos, mid, neg = jnp.split(out, 3, axis=0)
+        if cfg_rescale:
+            base = _rescale_cfg(x, sigma, mid, neg, cfg2, cfg_rescale)
+        else:
+            base = neg + (mid - neg) * cfg2
+        return base + (pos - mid) * cfg1
+    return wrapped
+
+
 def _rescale_cfg(x: jax.Array, sigma: jax.Array, den_cond: jax.Array,
                  den_uncond: jax.Array, cfg_scale: float,
                  multiplier: float) -> jax.Array:

@@ -313,12 +313,15 @@ class FlipSigmas(Op):
 @register_op
 class SamplerCustom(Op):
     """ComfyUI's custom-sampling entry: explicit SAMPLER + SIGMAS instead
-    of the KSampler widget pair.  The sigma VALUES are baked into the
-    compiled program (static trip count).  Both latent outputs carry the
-    final result (the denoised preview stream is not separately
-    materialized — no callback sink exists headless)."""
+    of the KSampler widget pair.  Only the sigma COUNT is static (scan
+    trip count); the values ride in as a traced argument, so same-length
+    schedules share one executable (registry.sample).  Both latent
+    outputs carry the final result (the denoised preview stream is not
+    separately materialized — no callback sink exists headless)."""
     TYPE = "SamplerCustom"
-    WIDGETS = ["add_noise", "noise_seed", "cfg"]
+    # CONTROL: ComfyUI serializes seed widgets with a trailing
+    # control_after_generate value in UI-format exports
+    WIDGETS = ["add_noise", "noise_seed", CONTROL, "cfg"]
     DEFAULTS = {"add_noise": True, "cfg": 8.0}
 
     def execute(self, ctx: OpContext, model, add_noise, noise_seed, cfg,
@@ -339,6 +342,138 @@ class SamplerCustom(Op):
                 sample_idx=prep.sample_idx,
                 noise_mask=prep.noise_mask, control=prep.control,
                 sigmas_override=np.asarray(sigmas, np.float32))
+        out_d = {"samples": out, **_latent_meta(latent_image),
+                 "local_batch": prep.local_batch, "fanout": prep.fanout}
+        return (out_d, dict(out_d))
+
+
+@dataclasses.dataclass
+class NoiseObject:
+    """NOISE wire type (ComfyUI custom sampling): the initial-noise
+    policy carried between RandomNoise/DisableNoise and
+    SamplerCustomAdvanced.  ``seed`` may be a SeedValue (DistributedSeed
+    replica offsets ride through)."""
+    seed: object = 0
+    disable: bool = False
+
+
+@dataclasses.dataclass
+class GuiderObject:
+    """GUIDER wire type (ComfyUI custom sampling): model + conditioning
+    + guidance mode bundled by BasicGuider/CFGGuider/DualCFGGuider."""
+    model: object
+    positive: Conditioning
+    negative: Optional[Conditioning] = None
+    middle: Optional[Conditioning] = None
+    cfg: float = 1.0
+    cfg2: float = 1.0
+    mode: str = "cfg"          # "basic" | "cfg" | "dual"
+
+
+@register_op
+class RandomNoise(Op):
+    """-> NOISE seeded like KSampler's widget (ComfyUI custom sampling);
+    a DistributedSeed value keeps its per-replica offsets."""
+    TYPE = "RandomNoise"
+    WIDGETS = ["noise_seed", CONTROL]
+
+    def execute(self, ctx: OpContext, noise_seed):
+        return (NoiseObject(seed=noise_seed),)
+
+
+@register_op
+class DisableNoise(Op):
+    """-> NOISE that adds nothing (ComfyUI: later hires/refiner stages
+    where the latent already carries its noise)."""
+    TYPE = "DisableNoise"
+
+    def execute(self, ctx: OpContext):
+        return (NoiseObject(seed=0, disable=True),)
+
+
+@register_op
+class BasicGuider(Op):
+    """-> GUIDER: conditioning-only denoising (no CFG combine — the
+    cfg==1 fast path skips the uncond evaluation entirely)."""
+    TYPE = "BasicGuider"
+
+    def execute(self, ctx: OpContext, model, conditioning: Conditioning):
+        return (GuiderObject(model=model, positive=conditioning,
+                             mode="basic"),)
+
+
+@register_op
+class CFGGuider(Op):
+    """-> GUIDER: the standard positive/negative CFG combine at ``cfg``
+    as an explicit wire object (ComfyUI custom sampling)."""
+    TYPE = "CFGGuider"
+    WIDGETS = ["cfg"]
+    DEFAULTS = {"cfg": 8.0}
+
+    def execute(self, ctx: OpContext, model, positive: Conditioning,
+                negative: Conditioning, cfg: float = 8.0):
+        return (GuiderObject(model=model, positive=positive,
+                             negative=negative, cfg=float(cfg),
+                             mode="cfg"),)
+
+
+@register_op
+class DualCFGGuider(Op):
+    """-> GUIDER with two positives (ComfyUI DualCFGGuider — the
+    InstructPix2Pix combine): cond2 is CFG'd against the negative at
+    ``cfg_cond2_negative``, then cond1 steers against cond2 at
+    ``cfg_conds``; see samplers.cfg_denoiser_dual."""
+    TYPE = "DualCFGGuider"
+    WIDGETS = ["cfg_conds", "cfg_cond2_negative"]
+    DEFAULTS = {"cfg_conds": 8.0, "cfg_cond2_negative": 8.0}
+
+    def execute(self, ctx: OpContext, model, cond1: Conditioning,
+                cond2: Conditioning, negative: Conditioning,
+                cfg_conds: float = 8.0, cfg_cond2_negative: float = 8.0):
+        return (GuiderObject(model=model, positive=cond1, middle=cond2,
+                             negative=negative, cfg=float(cfg_conds),
+                             cfg2=float(cfg_cond2_negative), mode="dual"),)
+
+
+@register_op
+class SamplerCustomAdvanced(Op):
+    """ComfyUI's fully-modular sampling entry: NOISE + GUIDER + SAMPLER +
+    SIGMAS.  Same compiled path as SamplerCustom; the guider picks the
+    denoiser combine (basic / cfg / dual-cfg).  Both latent outputs carry
+    the final result (no separate preview stream headless)."""
+    TYPE = "SamplerCustomAdvanced"
+
+    @staticmethod
+    def _plain(e: Conditioning) -> bool:
+        return (not getattr(e, "siblings", ()) and e.area_mask is None
+                and e.timestep_range is None
+                and float(getattr(e, "area_strength", 1.0)) == 1.0)
+
+    def execute(self, ctx: OpContext, noise: NoiseObject,
+                guider: GuiderObject, sampler, sigmas, latent_image):
+        ctx.check_interrupt()
+        g = guider
+        neg = g.negative if g.negative is not None else g.positive
+        if g.mode == "dual" and not all(
+                self._plain(e) for e in (g.positive, g.middle, neg)):
+            raise ValueError("DualCFGGuider does not compose with "
+                             "regional multi-entry conditionings")
+        prep = _prepare_sample_inputs(
+            ctx, g.model, noise.seed, latent_image, g.positive, neg,
+            middle=g.middle if g.mode == "dual" else None)
+        cfg = 1.0 if g.mode == "basic" else float(g.cfg)
+        name = sampler.name if isinstance(sampler, SamplerObject) \
+            else str(sampler)
+        with Timer(f"sampler_custom_adv[{g.mode}:{name}"
+                   f"x{len(sigmas) - 1}]"):
+            out = g.model.sample(
+                prep.latents, prep.context, prep.uncond, prep.seeds,
+                steps=1, cfg=cfg, sampler_name=name, scheduler="normal",
+                y=prep.y, add_noise=not noise.disable,
+                sample_idx=prep.sample_idx, noise_mask=prep.noise_mask,
+                control=prep.control,
+                sigmas_override=np.asarray(sigmas, np.float32),
+                middle_context=prep.mid_context, cfg2=float(g.cfg2))
         out_d = {"samples": out, **_latent_meta(latent_image),
                  "local_batch": prep.local_batch, "fanout": prep.fanout}
         return (out_d, dict(out_d))
@@ -495,11 +630,21 @@ class _SampleInputs:
     fanout: int
     noise_mask: object = None
     control: object = None
+    # dual-CFG (SamplerCustomAdvanced): the middle conditioning's
+    # batch-repeated context, aligned to the same token length as
+    # context/uncond; None outside dual mode
+    mid_context: object = None
 
 
 def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                            positive: Conditioning,
-                           negative: Conditioning) -> _SampleInputs:
+                           negative: Conditioning,
+                           middle: Optional[Conditioning] = None,
+                           ) -> _SampleInputs:
+    """``middle`` (dual-CFG only): a third plain conditioning prepared in
+    the SAME pass — token alignment spans all three, it carries its OWN
+    pooled ADM vector, and a control on any of the three gets a flat
+    per-block [cond, middle, uncond] strength tuple."""
     lat = np.asarray(latent_image["samples"], np.float32)
     fanout = int(latent_image.get("fanout", 1))
     total = lat.shape[0]
@@ -525,7 +670,9 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                                     or ())
     neg_entries = [negative] + list(getattr(negative, "siblings", ())
                                     or ())
-    lengths = {int(e.context.shape[1]) for e in pos_entries + neg_entries}
+    mid_entries = [middle] if middle is not None else []
+    all_entries = pos_entries + neg_entries + mid_entries
+    lengths = {int(e.context.shape[1]) for e in all_entries}
     t_max = max(lengths)
     # ComfyUI repeats each cond to the lcm of the lengths (77-chunk
     # multiples in practice) — semantically lossless, unlike zero-pad
@@ -589,13 +736,28 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
 
     cond_entries, y_conds = _build_entries(pos_entries)
     unc_entries, y_unconds = _build_entries(neg_entries)
+    mid_built, y_mids = _build_entries(mid_entries)
     multi = len(cond_entries) > 1 or len(unc_entries) > 1 \
         or any(m is not None or s != 1.0 or sr is not None
-               for _, m, s, sr in cond_entries + unc_entries)
+               for _, m, s, sr in cond_entries + unc_entries + mid_built)
+    mid_ctx = None
+    if middle is not None:
+        if multi:
+            raise ValueError("dual-CFG requires plain single-entry "
+                             "positive/negative conditionings")
+        mid_ctx = mid_built[0][0]
     if multi:
         ctx_arr = cond_entries
         unc_arr = unc_entries
         y = (y_conds + y_unconds) if adm else None
+    elif middle is not None:
+        ctx_arr = cond_entries[0][0]
+        unc_arr = unc_entries[0][0]
+        # one ADM vector per [cond, middle, uncond] block; middle rides
+        # its OWN pooled (fallback to the positive's inside
+        # _build_entries), the negative rides the positive's like the
+        # plain single-entry path
+        y = [y_conds[0], y_mids[0], y_conds[0]] if adm else None
     else:   # the unchanged single-entry path: plain arrays
         ctx_arr = cond_entries[0][0]
         unc_arr = unc_entries[0][0]
@@ -610,7 +772,7 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
     def _ctrl_of(e):
         return getattr(e, "control", None)
 
-    control = next((c for c in map(_ctrl_of, pos_entries + neg_entries)
+    control = next((c for c in map(_ctrl_of, all_entries)
                     if c is not None), None)
     if control is not None:
         module, params, hint, _ = control
@@ -620,7 +782,7 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                     and (c[2] is hint or np.array_equal(c[2], hint)))
 
         if any(c is not None and not _same(c)
-               for c in map(_ctrl_of, pos_entries + neg_entries)):
+               for c in map(_ctrl_of, all_entries)):
             debug_log("ControlNet: conditioning entries carry different "
                       "controls/hints; applying the first only (one net "
                       "runs per stacked call)")
@@ -633,8 +795,16 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
 
         # strengths BEFORE the hint rebinds below: _same closes over
         # ``hint`` and must compare against the entries' ORIGINAL array
-        pos_strengths = _entry_strengths(pos_entries)
-        neg_strengths = _entry_strengths(neg_entries)
+        if middle is not None:
+            # flat per-block [cond, middle, uncond] tuple — the dual
+            # denoiser's 3-row layout (models/denoiser.py block rule)
+            strengths = (_entry_strengths(pos_entries)[0],
+                         _entry_strengths(mid_entries)[0],
+                         _entry_strengths(neg_entries)[0])
+        else:
+            pos_strengths = _entry_strengths(pos_entries)
+            neg_strengths = _entry_strengths(neg_entries)
+            strengths = (pos_strengths, neg_strengths)
         # hint image -> the resolution the hint ladder expects (8x the
         # latent dims — families with other VAE downscales still align)
         hh, ww = lat.shape[1] * 8, lat.shape[2] * 8
@@ -645,8 +815,7 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
         if fanout > 1 and ctx.runtime is not None:
             hint_dev = coll.shard_batch(np.asarray(hint, np.float32),
                                         ctx.runtime.mesh)
-        control = (module, params, jnp.asarray(hint_dev),
-                   (pos_strengths, neg_strengths))
+        control = (module, params, jnp.asarray(hint_dev), strengths)
 
     mask = latent_image.get("noise_mask")
     if mask is not None:
@@ -660,7 +829,8 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
     return _SampleInputs(latents=jnp.asarray(lat_dev), context=ctx_arr,
                          uncond=unc_arr, seeds=seeds, sample_idx=local_idx,
                          y=y, local_batch=local_b, fanout=fanout,
-                         noise_mask=mask, control=control)
+                         noise_mask=mask, control=control,
+                         mid_context=mid_ctx)
 
 
 def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,

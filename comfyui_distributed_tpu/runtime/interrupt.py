@@ -42,11 +42,29 @@ def is_interrupted() -> bool:
     return _event.is_set()
 
 
+def _backend_supports_callbacks() -> bool:
+    """Whether the active JAX backend can run host callbacks at all.
+    The axon PJRT plugin (the tunneled single-chip TPU used for
+    benching) raises UNIMPLEMENTED for host send/recv — polling must
+    compile out there or every sampled batch dies at runtime."""
+    try:
+        import jax
+        plat = jax.default_backend()
+    except Exception:
+        return True
+    return plat != "axon"
+
+
 def polling_enabled() -> bool:
-    """Whether compiled samplers poll the flag each step.  Default on;
-    ``DTPU_INTERRUPT_POLL=0`` opts out (e.g. microbenchmarks that don't
-    want the per-step host readback)."""
-    return os.environ.get("DTPU_INTERRUPT_POLL", "1") != "0"
+    """Whether compiled samplers poll the flag each step.  Default: on
+    wherever the backend supports host callbacks.  ``DTPU_INTERRUPT_POLL``
+    forces it: ``0`` opts out (e.g. microbenchmarks that don't want the
+    per-step host readback), ``1`` forces it on even for backends on the
+    no-callback list (e.g. a newer plugin that grew support)."""
+    forced = os.environ.get("DTPU_INTERRUPT_POLL")
+    if forced is not None:
+        return forced != "0"
+    return _backend_supports_callbacks()
 
 
 def poll(_sequencer=None) -> np.bool_:

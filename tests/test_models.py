@@ -1288,3 +1288,231 @@ class TestCustomSampling:
                                    np.asarray(full["samples"]),
                                    rtol=1e-4, atol=1e-4)
         registry.clear_pipeline_cache()
+
+
+class TestCustomSamplingAdvanced:
+    """NOISE/GUIDER suite: RandomNoise, DisableNoise, BasicGuider,
+    CFGGuider, DualCFGGuider -> SamplerCustomAdvanced."""
+
+    def _setup(self, name):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline(name)
+        octx = OpContext()
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0])
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (sampler,) = get_op("KSamplerSelect").execute(octx, "euler")
+        (sig,) = get_op("BasicScheduler").execute(octx, p, "normal", 4,
+                                                  1.0)
+        return octx, get_op, p, pos, neg, lat, sampler, sig
+
+    def test_cfg_guider_matches_sampler_custom(self):
+        octx, get_op, p, pos, neg, lat, sampler, sig = \
+            self._setup("adv-cfg.ckpt")
+        (noise,) = get_op("RandomNoise").execute(octx, 7)
+        (guider,) = get_op("CFGGuider").execute(octx, p, pos, neg, 5.0)
+        adv, adv2 = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, guider, sampler, sig, lat)
+        ref, _ = get_op("SamplerCustom").execute(
+            octx, p, True, 7, 5.0, pos, neg, lat, sampler, sig)
+        np.testing.assert_allclose(np.asarray(adv["samples"]),
+                                   np.asarray(ref["samples"]),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(adv["samples"]),
+                                      np.asarray(adv2["samples"]))
+        registry.clear_pipeline_cache()
+
+    def test_basic_guider_is_cfg_one(self):
+        octx, get_op, p, pos, neg, lat, sampler, sig = \
+            self._setup("adv-basic.ckpt")
+        (noise,) = get_op("RandomNoise").execute(octx, 3)
+        (guider,) = get_op("BasicGuider").execute(octx, p, pos)
+        adv, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, guider, sampler, sig, lat)
+        ref, _ = get_op("SamplerCustom").execute(
+            octx, p, True, 3, 1.0, pos, neg, lat, sampler, sig)
+        np.testing.assert_allclose(np.asarray(adv["samples"]),
+                                   np.asarray(ref["samples"]),
+                                   rtol=1e-5, atol=1e-5)
+        registry.clear_pipeline_cache()
+
+    def test_disable_noise(self):
+        octx, get_op, p, pos, neg, lat, sampler, sig = \
+            self._setup("adv-nonoise.ckpt")
+        lat = {"samples": np.full((1, 8, 8, 4), 0.4, np.float32)}
+        (noise,) = get_op("DisableNoise").execute(octx)
+        (guider,) = get_op("CFGGuider").execute(octx, p, pos, neg, 4.0)
+        adv, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, guider, sampler, sig, lat)
+        ref, _ = get_op("SamplerCustom").execute(
+            octx, p, False, 0, 4.0, pos, neg, lat, sampler, sig)
+        np.testing.assert_allclose(np.asarray(adv["samples"]),
+                                   np.asarray(ref["samples"]),
+                                   rtol=1e-5, atol=1e-5)
+        registry.clear_pipeline_cache()
+
+    def test_dual_cfg_collapses_to_cfg_when_cond2_is_negative(self):
+        """(neg + cfg2*(neg-neg)) + cfg1*(pos-neg) == plain CFG at cfg1 —
+        the dual combine's exact algebraic reduction, any cfg2."""
+        octx, get_op, p, pos, neg, lat, sampler, sig = \
+            self._setup("adv-dual-eq.ckpt")
+        (noise,) = get_op("RandomNoise").execute(octx, 11)
+        (dual,) = get_op("DualCFGGuider").execute(octx, p, pos, neg, neg,
+                                                  6.0, 3.3)
+        adv, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, dual, sampler, sig, lat)
+        (cfgg,) = get_op("CFGGuider").execute(octx, p, pos, neg, 6.0)
+        ref, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, cfgg, sampler, sig, lat)
+        np.testing.assert_allclose(np.asarray(adv["samples"]),
+                                   np.asarray(ref["samples"]),
+                                   rtol=1e-4, atol=1e-4)
+        registry.clear_pipeline_cache()
+
+    def test_dual_cfg_distinct_middle_finite_and_differs(self):
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        octx, get_op, p, pos, neg, lat, sampler, sig = \
+            self._setup("adv-dual.ckpt")
+        mid = Conditioning(context=p.encode_prompt(["oil painting"])[0])
+        (noise,) = get_op("RandomNoise").execute(octx, 5)
+        (dual,) = get_op("DualCFGGuider").execute(octx, p, pos, mid, neg,
+                                                  7.0, 1.5)
+        adv, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, dual, sampler, sig, lat)
+        s = np.asarray(adv["samples"])
+        assert np.isfinite(s).all()
+        (cfgg,) = get_op("CFGGuider").execute(octx, p, pos, neg, 7.0)
+        ref, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, cfgg, sampler, sig, lat)
+        assert not np.allclose(s, np.asarray(ref["samples"]))
+        registry.clear_pipeline_cache()
+
+    def test_dual_cfg_mixed_token_lengths(self):
+        """cond1 chained to 154 tokens via ConditioningConcat while
+        middle/negative stay 77: the tripled-batch concat must align all
+        three to one length (lcm-repeat), not crash at trace time."""
+        octx, get_op, p, pos, neg, lat, sampler, sig = \
+            self._setup("adv-dual-tok.ckpt")
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        mid = Conditioning(context=p.encode_prompt(["sketch"])[0])
+        (long_pos,) = get_op("ConditioningConcat").execute(octx, pos, pos)
+        assert long_pos.context.shape[1] == 2 * pos.context.shape[1]
+        (noise,) = get_op("RandomNoise").execute(octx, 13)
+        (dual,) = get_op("DualCFGGuider").execute(
+            octx, p, long_pos, mid, neg, 6.0, 2.0)
+        adv, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, dual, sampler, sig, lat)
+        assert np.isfinite(np.asarray(adv["samples"])).all()
+        registry.clear_pipeline_cache()
+
+    def test_dual_cfg_with_controlnet(self):
+        """Control on the positive rides the dual path with a per-block
+        [cond, middle, uncond] strength tuple; a fresh virtual net
+        (zero-convs) is bit-identical to no control."""
+        octx, get_op, p, pos, neg, lat, sampler, sig = \
+            self._setup("adv-dual-cn.ckpt")
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        mid = Conditioning(context=p.encode_prompt(["photo"])[0])
+        module, params = registry.load_controlnet("dual_cn.safetensors")
+        hint = np.random.default_rng(5).uniform(
+            0, 1, (1, 64, 64, 3)).astype(np.float32)
+        (noise,) = get_op("RandomNoise").execute(octx, 21)
+        (dual,) = get_op("DualCFGGuider").execute(octx, p, pos, mid, neg,
+                                                  5.0, 1.5)
+        plain, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, dual, sampler, sig, lat)
+        (posc,) = get_op("ControlNetApply").execute(
+            octx, pos, (module, params), hint, 1.0)
+        (dualc,) = get_op("DualCFGGuider").execute(octx, p, posc, mid,
+                                                   neg, 5.0, 1.5)
+        zeroed, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, dualc, sampler, sig, lat)
+        np.testing.assert_array_equal(np.asarray(plain["samples"]),
+                                      np.asarray(zeroed["samples"]))
+        import jax as _jax
+        params2 = _jax.tree_util.tree_map(lambda a: a + 0.05, params)
+        (posc2,) = get_op("ControlNetApply").execute(
+            octx, pos, (module, params2), hint, 1.0)
+        (dualc2,) = get_op("DualCFGGuider").execute(octx, p, posc2, mid,
+                                                    neg, 5.0, 1.5)
+        steered, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, dualc2, sampler, sig, lat)
+        assert not np.allclose(np.asarray(plain["samples"]),
+                               np.asarray(steered["samples"]))
+        registry.clear_pipeline_cache()
+
+    def test_dual_cfg_rejects_regional_conds(self):
+        octx, get_op, p, pos, neg, lat, sampler, sig = \
+            self._setup("adv-dual-rej.ckpt")
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        mid = Conditioning(context=p.encode_prompt(["left half"])[0])
+        mask = np.ones((64, 64), np.float32)
+        (masked_mid,) = get_op("ConditioningSetMask").execute(
+            octx, mid, mask, 0.8, "default")
+        (noise,) = get_op("RandomNoise").execute(octx, 2)
+        (dual,) = get_op("DualCFGGuider").execute(
+            octx, p, pos, masked_mid, neg, 5.0, 1.5)
+        with pytest.raises(ValueError, match="multi-entry"):
+            get_op("SamplerCustomAdvanced").execute(
+                octx, noise, dual, sampler, sig, lat)
+        registry.clear_pipeline_cache()
+
+    def test_dual_prep_middle_own_pooled_and_control(self):
+        """The middle entry carries its OWN pooled ADM vector (y list is
+        [cond, middle, uncond-rides-positive]) and a control attached to
+        the middle alone becomes a flat per-block strength tuple."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext)
+        from comfyui_distributed_tpu.ops.basic import \
+            _prepare_sample_inputs
+
+        class _U:
+            adm_in_channels = 2816
+
+        class _F:
+            unet = _U()
+
+        class _P:
+            family = _F()
+
+        pos = Conditioning(context=np.zeros((1, 77, 32), np.float32),
+                           pooled=np.full((1, 1280), 0.1, np.float32))
+        mid = Conditioning(context=np.zeros((1, 77, 32), np.float32),
+                           pooled=np.full((1, 1280), 0.9, np.float32),
+                           control=(object(), {"w": 1},
+                                    np.zeros((1, 64, 64, 3), np.float32),
+                                    0.7))
+        neg = Conditioning(context=np.zeros((1, 77, 32), np.float32))
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        prep = _prepare_sample_inputs(OpContext(), _P(), 0, lat, pos,
+                                      neg, middle=mid)
+        assert isinstance(prep.y, list) and len(prep.y) == 3
+        assert not np.allclose(np.asarray(prep.y[1]),
+                               np.asarray(prep.y[0]))
+        np.testing.assert_array_equal(np.asarray(prep.y[2]),
+                                      np.asarray(prep.y[0]))
+        assert prep.mid_context.shape == prep.context.shape
+        assert prep.control is not None
+        assert prep.control[3] == (0.0, 0.7, 0.0)
+
+    def test_dual_cfg_honors_rescale_patch(self):
+        octx, get_op, p, pos, neg, lat, sampler, sig = \
+            self._setup("adv-dual-rs.ckpt")
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        mid = Conditioning(context=p.encode_prompt(["ink wash"])[0])
+        (noise,) = get_op("RandomNoise").execute(octx, 8)
+        (dual,) = get_op("DualCFGGuider").execute(octx, p, pos, mid, neg,
+                                                  7.0, 3.0)
+        base, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, dual, sampler, sig, lat)
+        (pr,) = get_op("RescaleCFG").execute(octx, p, 0.7)
+        (dual_r,) = get_op("DualCFGGuider").execute(octx, pr, pos, mid,
+                                                    neg, 7.0, 3.0)
+        rs, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, dual_r, sampler, sig, lat)
+        r = np.asarray(rs["samples"])
+        assert np.isfinite(r).all()
+        assert not np.allclose(r, np.asarray(base["samples"]))
+        registry.clear_pipeline_cache()

@@ -182,3 +182,23 @@ class TestHealthPoller:
         from comfyui_distributed_tpu.runtime.health import probe_worker
         st = probe_worker({"id": "x", "port": 1}, timeout=0.2)
         assert st["status"] == "offline"
+
+
+class TestInterruptPolling:
+    def test_polling_compiles_out_on_no_callback_backends(self, monkeypatch):
+        """The axon PJRT plugin raises UNIMPLEMENTED for host callbacks;
+        polling_enabled() must gate on the backend (BENCH r4 failure) with
+        DTPU_INTERRUPT_POLL as a hard override in both directions."""
+        import jax
+
+        from comfyui_distributed_tpu.runtime import interrupt as itr
+        monkeypatch.delenv("DTPU_INTERRUPT_POLL", raising=False)
+        monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+        assert itr.polling_enabled() is False
+        monkeypatch.setenv("DTPU_INTERRUPT_POLL", "1")
+        assert itr.polling_enabled() is True
+        monkeypatch.setenv("DTPU_INTERRUPT_POLL", "0")
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert itr.polling_enabled() is False
+        monkeypatch.delenv("DTPU_INTERRUPT_POLL")
+        assert itr.polling_enabled() is True

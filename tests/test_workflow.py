@@ -555,3 +555,20 @@ class TestRegionalE2E:
         assert np.isfinite(imgs).all()
         for i in range(1, 8):
             assert not np.allclose(imgs[0], imgs[i]), i
+
+
+class TestCustomSamplerWidgetBinding:
+    def test_sampler_custom_ui_widgets_skip_control_slot(self):
+        """ComfyUI UI exports serialize seed widgets with a trailing
+        control_after_generate; SamplerCustom/RandomNoise must declare
+        the CONTROL slot so cfg doesn't receive 'randomize'."""
+        from comfyui_distributed_tpu.workflow.graph import \
+            _widgets_to_inputs
+        got = _widgets_to_inputs("SamplerCustom",
+                                 [True, 5, "randomize", 4.5])
+        assert got["add_noise"] is True
+        assert got["noise_seed"] == 5
+        assert got["cfg"] == 4.5
+        assert "control_after_generate" not in got
+        got = _widgets_to_inputs("RandomNoise", [7, "fixed"])
+        assert got["noise_seed"] == 7
