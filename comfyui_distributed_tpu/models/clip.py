@@ -90,13 +90,25 @@ class CLIPTextModel(nn.Module):
     cfg: CLIPConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    def __call__(self, tokens: jax.Array,
+                 emb_override: Optional[jax.Array] = None,
+                 emb_mask: Optional[jax.Array] = None,
+                 ) -> Tuple[jax.Array, jax.Array]:
         """tokens: [B, max_length] int32.  Returns (hidden [B, N, width],
-        pooled [B, width or projection_dim])."""
+        pooled [B, width or projection_dim]).
+
+        ``emb_override`` [B, N, width] + ``emb_mask`` [B, N] (textual
+        inversion): positions with mask=1 replace the looked-up token
+        embedding with the supplied vector (their token id is a
+        placeholder 0, which never wins the EOT argmax)."""
         cfg = self.cfg
         B, N = tokens.shape
         tok_emb = nn.Embed(cfg.vocab_size, cfg.width, name="token_embedding",
                            dtype=cfg.dtype)(tokens)
+        if emb_override is not None:
+            sel = emb_mask[..., None].astype(bool)
+            tok_emb = jnp.where(sel, emb_override.astype(tok_emb.dtype),
+                                tok_emb)
         pos_emb = self.param("position_embedding",
                              nn.initializers.normal(0.01),
                              (cfg.max_length, cfg.width))

@@ -164,26 +164,51 @@ class DiffusionPipeline:
 
     # --- text ---------------------------------------------------------------
 
-    def encode_prompt(self, texts: List[str]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    def encode_prompt(self, texts: List[str],
+                      texts_alt: Optional[List[str]] = None,
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Returns (context [B, 77, sum(widths)], pooled [B, pooled_dim]).
         Multi-encoder families (SDXL) concatenate hidden widths; pooled comes
         from the last encoder.  Token weights scale the hidden states around
-        the per-sequence mean (comfy-style emphasis)."""
-        ids = []
-        weights = []
-        for t in texts:
-            i, w = self.tokenizer.encode(t)
-            ids.append(i)
-            weights.append(w)
-        ids_arr = jnp.asarray(np.stack(ids))
-        w_arr = jnp.asarray(np.stack(weights))
+        the per-sequence mean (comfy-style emphasis).
+
+        ``texts_alt``: optional prompts for towers AFTER the first —
+        ComfyUI's CLIPTextEncodeSDXL text_g/text_l split (text_l feeds
+        CLIP-L, text_g the OpenCLIP tower whose pooled output becomes
+        the ADM vector).  Single-tower families ignore it.
+
+        ``embedding:name`` references (textual inversion) splice learned
+        vectors from ``<models_dir>/embeddings/`` into the token stream,
+        per tower (SDXL files carry clip_l/clip_g keys)."""
+        from comfyui_distributed_tpu.models.tokenizer import (
+            encode_with_embeddings, has_embedding_refs)
 
         outs, pooled = [], None
-        for m, p in zip(self.clip_models, self.clip_params):
-            fn = self._jitted(("clip", id(m)), partial(m.apply))
-            hidden, pool = fn({"params": p}, ids_arr)
+        for i, (m, p) in enumerate(zip(self.clip_models,
+                                       self.clip_params)):
+            ts = texts if i == 0 or texts_alt is None else texts_alt
+            width = int(m.cfg.width)
+            if any(has_embedding_refs(t) for t in ts):
+                def _look(nm, _i=i, _w=width):
+                    return load_textual_embedding(
+                        nm, self.assets_dir, _w, tower_idx=_i)
+
+                quads = [encode_with_embeddings(self.tokenizer, t,
+                                                _look, width) for t in ts]
+                ia = jnp.asarray(np.stack([q[0] for q in quads]))
+                wa = jnp.asarray(np.stack([q[1] for q in quads]))
+                ov = jnp.asarray(np.stack([q[2] for q in quads]))
+                mk = jnp.asarray(np.stack([q[3] for q in quads]))
+                fn = self._jitted(("clip_ov", id(m)), partial(m.apply))
+                hidden, pool = fn({"params": p}, ia, ov, mk)
+            else:
+                pairs = [self.tokenizer.encode(t) for t in ts]
+                ia = jnp.asarray(np.stack([x for x, _ in pairs]))
+                wa = jnp.asarray(np.stack([w for _, w in pairs]))
+                fn = self._jitted(("clip", id(m)), partial(m.apply))
+                hidden, pool = fn({"params": p}, ia)
             mean = hidden.mean(axis=1, keepdims=True)
-            hidden = mean + (hidden - mean) * w_arr[..., None]
+            hidden = mean + (hidden - mean) * wa[..., None]
             outs.append(hidden)
             pooled = pool
         return jnp.concatenate(outs, axis=-1), pooled
@@ -628,6 +653,7 @@ def clear_pipeline_cache() -> None:
         _pipeline_cache.clear()
         _derived_cache.clear()
         _cn_family_cache.clear()
+        _embedding_cache.clear()
     from comfyui_distributed_tpu.models import lora as lora_mod
     lora_mod.clear_lora_cache()
 
@@ -673,6 +699,56 @@ def derive_pipeline(base: DiffusionPipeline, tag: str,
         while len(_derived_cache) > _DERIVED_CACHE_CAP:
             _derived_cache.popitem(last=False)
     return clone
+
+
+_embedding_cache: Dict[tuple, Optional[np.ndarray]] = {}
+
+
+def load_textual_embedding(name: str, assets_dir: Optional[str],
+                           width: int, tower_idx: int = 0,
+                           ) -> Optional[np.ndarray]:
+    """Textual-inversion vectors for ``embedding:name`` prompt refs:
+    ``<assets_dir>/embeddings/<name>[.safetensors]``.  SDXL-style files
+    carry per-tower ``clip_l``/``clip_g`` keys (tower 0 / 1); SD1.x
+    A1111 exports carry a single ``emb_params`` tensor.  Returns
+    [K, width] float32, or None (missing file / width mismatch) — the
+    tokenizer drops the reference with a log, like ComfyUI's warning."""
+    if not assets_dir:
+        return None
+    key = (assets_dir, name, width, tower_idx)
+    if key in _embedding_cache:
+        return _embedding_cache[key]
+    base = os.path.join(assets_dir, "embeddings")
+    path = None
+    for cand in (name, name + ".safetensors"):
+        p = os.path.join(base, cand.replace("\\", "/"))
+        if os.path.isfile(p):
+            path = p
+            break
+    result = None
+    if path is not None and path.endswith(".safetensors"):
+        from safetensors import safe_open
+        with safe_open(path, framework="numpy") as f:
+            keys = set(f.keys())
+            per_tower = {0: "clip_l", 1: "clip_g"}
+            if keys & {"clip_l", "clip_g"}:
+                chosen = per_tower.get(tower_idx)
+                chosen = chosen if chosen in keys else None
+            elif "emb_params" in keys:
+                chosen = "emb_params"
+            else:
+                chosen = next(iter(sorted(keys)), None)
+            if chosen is not None:
+                arr = np.asarray(f.get_tensor(chosen), np.float32)
+                arr = arr.reshape(-1, arr.shape[-1])
+                if arr.shape[-1] == width:
+                    result = arr
+                else:
+                    log(f"textual inversion {name!r}: width "
+                        f"{arr.shape[-1]} != tower width {width}; "
+                        "dropping")
+    _embedding_cache[key] = result
+    return result
 
 
 def load_controlnet(cn_name: str, models_dir: Optional[str] = None,

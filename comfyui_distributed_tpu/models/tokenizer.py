@@ -99,13 +99,17 @@ class HashTokenizer:
         usable = max(self.start - 1, 1)
         return 1 + (h % (usable - 1))
 
+    def _frag_ids(self, frag: str) -> List[int]:
+        return [self._word_id(w)
+                for w in re.findall(r"[a-z0-9]+|[^\sa-z0-9]", frag.lower())]
+
     def encode(self, text: str) -> Tuple[np.ndarray, np.ndarray]:
         """Returns (ids [max_length] int32, weights [max_length] float32)."""
         ids: List[int] = [self.start]
         weights: List[float] = [1.0]
         for frag, w in parse_weighted_prompt(text):
-            for word in re.findall(r"[a-z0-9]+|[^\sa-z0-9]", frag.lower()):
-                ids.append(self._word_id(word))
+            for wid in self._frag_ids(frag):
+                ids.append(wid)
                 weights.append(w)
         ids = ids[: self.max_length - 1] + [self.end]
         weights = weights[: self.max_length - 1] + [1.0]
@@ -162,21 +166,91 @@ class BPETokenizer:
         self._cache[token] = list(word)
         return list(word)
 
+    def _frag_ids(self, frag: str) -> List[int]:
+        pat = re.compile(r"[a-z0-9]+|[^\sa-z0-9]+")
+        out: List[int] = []
+        for word in pat.findall(frag.lower()):
+            for piece in self._bpe(word):
+                out.append(self.encoder.get(
+                    piece, self.encoder.get(piece + "</w>", 0)))
+        return out
+
     def encode(self, text: str) -> Tuple[np.ndarray, np.ndarray]:
         ids: List[int] = [self.start]
         weights: List[float] = [1.0]
-        pat = re.compile(r"[a-z0-9]+|[^\sa-z0-9]+")
         for frag, w in parse_weighted_prompt(text):
-            for word in pat.findall(frag.lower()):
-                for piece in self._bpe(word):
-                    ids.append(self.encoder.get(
-                        piece, self.encoder.get(piece + "</w>", 0)))
-                    weights.append(w)
+            for wid in self._frag_ids(frag):
+                ids.append(wid)
+                weights.append(w)
         ids = ids[: self.max_length - 1] + [self.end]
         weights = weights[: self.max_length - 1] + [1.0]
         pad = self.max_length - len(ids)
         return (np.asarray(ids + [self.pad_id] * pad, dtype=np.int32),
                 np.asarray(weights + [1.0] * pad, dtype=np.float32))
+
+
+EMBEDDING_RE = re.compile(r"embedding:([\w\.\-]+)", re.IGNORECASE)
+
+
+def has_embedding_refs(text: str) -> bool:
+    return bool(EMBEDDING_RE.search(text))
+
+
+def encode_with_embeddings(tok, text: str, lookup, emb_dim: int):
+    """Tokenize with ComfyUI's ``embedding:name`` textual-inversion
+    syntax: each reference splices the embedding's learned vectors into
+    the token stream at that position (id 0 placeholder; the CLIP tower
+    swaps its looked-up embedding for the supplied vector where
+    ``mask`` is set — models/clip.py).  Emphasis weights apply to
+    spliced vectors like any other token.
+
+    ``lookup(name) -> np [K, emb_dim] | None``; unknown names are
+    dropped with a debug log (ComfyUI warns and skips the same way).
+    Returns (ids [T] int32, weights [T] f32, override [T, emb_dim] f32,
+    mask [T] f32)."""
+    from comfyui_distributed_tpu.utils.logging import debug_log
+
+    ids: List[int] = [tok.start]
+    weights: List[float] = [1.0]
+    override = [np.zeros((emb_dim,), np.float32)]
+    mask: List[float] = [0.0]
+    for frag, w in parse_weighted_prompt(text):
+        # re.split with one capture group alternates [text, name, text,
+        # name, ...]: odd indices are embedding names
+        for j, piece in enumerate(EMBEDDING_RE.split(frag)):
+            if not piece:
+                continue
+            if j % 2 == 1:
+                vecs = lookup(piece)
+                if vecs is None:
+                    debug_log(f"textual inversion {piece!r} not found; "
+                              "dropping the reference")
+                    continue
+                for v in np.asarray(vecs,
+                                    np.float32).reshape(-1, emb_dim):
+                    ids.append(0)
+                    weights.append(w)
+                    override.append(v)
+                    mask.append(1.0)
+                continue
+            for wid in tok._frag_ids(piece):
+                ids.append(wid)
+                weights.append(w)
+                override.append(np.zeros((emb_dim,), np.float32))
+                mask.append(0.0)
+    T = tok.max_length
+    ids = ids[: T - 1] + [tok.end]
+    weights = weights[: T - 1] + [1.0]
+    override = override[: T - 1] + [np.zeros((emb_dim,), np.float32)]
+    mask = mask[: T - 1] + [0.0]
+    pad = T - len(ids)
+    ids += [tok.pad_id] * pad
+    weights += [1.0] * pad
+    override += [np.zeros((emb_dim,), np.float32)] * pad
+    mask += [0.0] * pad
+    return (np.asarray(ids, np.int32), np.asarray(weights, np.float32),
+            np.stack(override).astype(np.float32),
+            np.asarray(mask, np.float32))
 
 
 def make_tokenizer(assets_dir: Optional[str] = None,

@@ -41,6 +41,13 @@ class Conditioning:
     # sampling-percent pair, 0.0 = start of sampling, 1.0 = end; the
     # entry contributes only while the step sigma is inside the range
     timestep_range: Any = None
+    # SDXL size conditioning (CLIPTextEncodeSDXL / ...Refiner): tuple of
+    # scalars each embedded at 256 sinusoidal dims and appended to the
+    # pooled text emb in the ADM vector — base order (height, width,
+    # crop_h, crop_w, target_height, target_width); refiner (height,
+    # width, crop_h, crop_w, aesthetic_score).  None -> the sampler
+    # derives (H, W, 0, 0, H, W) from the actual latent dims
+    size_cond: Any = None
 
 
 @dataclasses.dataclass

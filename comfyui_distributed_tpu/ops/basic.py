@@ -216,6 +216,49 @@ class CLIPTextEncode(Op):
 
 
 @register_op
+class CLIPTextEncodeSDXL(Op):
+    """ComfyUI's SDXL dual-prompt encode: text_l feeds the CLIP-L tower,
+    text_g the OpenCLIP tower (whose pooled output becomes the ADM
+    vector), and the size widgets ride the conditioning as explicit ADM
+    scalars (height, width, crop_h, crop_w, target_height,
+    target_width) instead of being derived from the latent dims."""
+    TYPE = "CLIPTextEncodeSDXL"
+    WIDGETS = ["width", "height", "crop_w", "crop_h", "target_width",
+               "target_height", "text_g", "text_l"]
+    DEFAULTS = {"crop_w": 0, "crop_h": 0}
+
+    def execute(self, ctx: OpContext, clip, width: int, height: int,
+                crop_w: int = 0, crop_h: int = 0,
+                target_width: int = 0, target_height: int = 0,
+                text_g: str = "", text_l: str = ""):
+        tw = int(target_width) or int(width)
+        th = int(target_height) or int(height)
+        context, pooled = clip.encode_prompt([str(text_l)],
+                                             texts_alt=[str(text_g)])
+        return (Conditioning(
+            context=context, pooled=pooled,
+            size_cond=(int(height), int(width), int(crop_h), int(crop_w),
+                       th, tw)),)
+
+
+@register_op
+class CLIPTextEncodeSDXLRefiner(Op):
+    """ComfyUI's SDXL-refiner encode: single prompt, ADM scalars
+    (height, width, crop_h, crop_w, aesthetic_score) — the refiner
+    family's 5-scalar embedder layout."""
+    TYPE = "CLIPTextEncodeSDXLRefiner"
+    WIDGETS = ["ascore", "width", "height", "text"]
+    DEFAULTS = {"ascore": 6.0}
+
+    def execute(self, ctx: OpContext, clip, ascore: float, width: int,
+                height: int, text: str):
+        context, pooled = clip.encode_prompt([str(text)])
+        return (Conditioning(
+            context=context, pooled=pooled,
+            size_cond=(int(height), int(width), 0, 0, float(ascore))),)
+
+
+@register_op
 class EmptyLatentImage(Op):
     """Zero latent batch; in a distributed run the batch expands to
     ``batch_size * fanout`` — the SPMD analog of every participant creating
@@ -835,12 +878,18 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
 
 def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,
                       height: int, width: int):
-    """SDXL ADM vector: pooled text emb + size conditioning embeddings."""
+    """SDXL ADM vector: pooled text emb + size conditioning embeddings.
+    A Conditioning carrying ``size_cond`` (CLIPTextEncodeSDXL /
+    ...Refiner) supplies its own scalar tuple; otherwise the actual
+    latent dims stand in as (H, W, 0, 0, H, W)."""
     from comfyui_distributed_tpu.models.layers import timestep_embedding
     pooled = cond.pooled
     if pooled is None:
         pooled = jnp.zeros((1, 1280))
-    sizes = jnp.asarray([[height, width, 0, 0, height, width]], jnp.float32)
+    sc = getattr(cond, "size_cond", None)
+    sizes = jnp.asarray([[float(v) for v in sc]] if sc is not None
+                        else [[height, width, 0, 0, height, width]],
+                        jnp.float32)
     emb = timestep_embedding(sizes.reshape(-1), 256).reshape(1, -1)
     vec = jnp.concatenate([pooled, emb], axis=-1)
     want = pipe.family.unet.adm_in_channels
@@ -957,6 +1006,302 @@ def _keep_fanout_meta(src, arr):
         return ImageBatch(arr, local_batch=getattr(src, "local_batch", None),
                           fanout=src.fanout)
     return arr
+
+
+def _overlap_window(H: int, W: int, h: int, w: int, x: int, y: int):
+    """Visible paste window: ((y0, y1, x0, x1) in dest, (sy0, sy1, sx0,
+    sx1) in src) or None when fully out of bounds — the ONE copy of the
+    clamp/offset math every composite node uses."""
+    x0, y0 = max(int(x), 0), max(int(y), 0)
+    x1, y1 = min(int(x) + w, W), min(int(y) + h, H)
+    if x0 >= x1 or y0 >= y1:
+        return None
+    sx0, sy0 = x0 - int(x), y0 - int(y)
+    return ((y0, y1, x0, x1),
+            (sy0, sy0 + (y1 - y0), sx0, sx0 + (x1 - x0)))
+
+
+def _paste(dest: np.ndarray, src: np.ndarray, x: int, y: int,
+           mask=None) -> np.ndarray:
+    """Composite core shared by Image/Latent/Mask composite nodes:
+    paste ``src`` [Bs,h,w,C] onto ``dest`` [B,H,W,C] at (x, y), blending
+    by ``mask`` [.,h,w] where given.  Out-of-bounds regions crop away
+    (ComfyUI's composite clamps the visible window); a short source
+    batch cycles over the destination batch."""
+    out = dest.copy()
+    B, H, W, _ = dest.shape
+    h, w = src.shape[1], src.shape[2]
+    win = _overlap_window(H, W, h, w, x, y)
+    if win is None:
+        return out
+    (y0, y1, x0, x1), (sy0, sy1, sx0, sx1) = win
+    src_b = _cycle_batch(src, B)[:, sy0:sy1, sx0:sx1]
+    if mask is None:
+        out[:, y0:y1, x0:x1] = src_b
+        return out
+    m = np.asarray(mask, np.float32)
+    if m.ndim == 2:
+        m = m[None]
+    if m.shape[1] != h or m.shape[2] != w:
+        m = resize_image(m[..., None], w, h, "area")[..., 0]
+    m = np.clip(_cycle_batch(m, B)[:, sy0:sy1, sx0:sx1, None], 0.0, 1.0)
+    out[:, y0:y1, x0:x1] = src_b * m + out[:, y0:y1, x0:x1] * (1.0 - m)
+    return out
+
+
+@register_op
+class SolidMask(Op):
+    """-> MASK [1, H, W] filled with ``value``."""
+    TYPE = "SolidMask"
+    WIDGETS = ["value", "width", "height"]
+    DEFAULTS = {"value": 1.0, "width": 512, "height": 512}
+
+    def execute(self, ctx: OpContext, value: float = 1.0,
+                width: int = 512, height: int = 512):
+        return (np.full((1, int(height), int(width)), float(value),
+                        np.float32),)
+
+
+@register_op
+class InvertMask(Op):
+    TYPE = "InvertMask"
+
+    def execute(self, ctx: OpContext, mask):
+        return (1.0 - np.asarray(mask, np.float32),)
+
+
+@register_op
+class GrowMask(Op):
+    """Morphological grow/shrink by ``expand`` steps of a 3x3 kernel
+    (corners zeroed when ``tapered_corners`` — ComfyUI's shape);
+    negative expand erodes."""
+    TYPE = "GrowMask"
+    WIDGETS = ["expand", "tapered_corners"]
+    DEFAULTS = {"expand": 0, "tapered_corners": True}
+
+    def execute(self, ctx: OpContext, mask, expand: int = 0,
+                tapered_corners: bool = True):
+        m = np.asarray(mask, np.float32)
+        if m.ndim == 2:
+            m = m[None]
+        n = int(expand)
+        erode = n < 0
+        if erode:
+            m = 1.0 - m
+        tapered = str(tapered_corners).lower() not in ("false", "0")
+        shifts = [(0, 0), (-1, 0), (1, 0), (0, -1), (0, 1)]
+        if not tapered:
+            shifts += [(-1, -1), (-1, 1), (1, -1), (1, 1)]
+        Hm, Wm = m.shape[1], m.shape[2]
+        for _ in range(abs(n)):
+            padded = np.pad(m, ((0, 0), (1, 1), (1, 1)))
+            m = np.max(np.stack(
+                [padded[:, 1 + dy:1 + dy + Hm, 1 + dx:1 + dx + Wm]
+                 for dy, dx in shifts]), axis=0)
+        if erode:
+            m = 1.0 - m
+        return (m,)
+
+
+@register_op
+class MaskComposite(Op):
+    """Combine ``source`` into ``destination`` at (x, y):
+    multiply / add / subtract / and / or / xor (ComfyUI's set)."""
+    TYPE = "MaskComposite"
+    WIDGETS = ["x", "y", "operation"]
+    DEFAULTS = {"x": 0, "y": 0, "operation": "multiply"}
+
+    def execute(self, ctx: OpContext, destination, source, x: int = 0,
+                y: int = 0, operation: str = "multiply"):
+        d = np.asarray(destination, np.float32)
+        if d.ndim == 2:
+            d = d[None]
+        s = np.asarray(source, np.float32)
+        if s.ndim == 2:
+            s = s[None]
+        B, H, W = d.shape
+        out = d.copy()
+        win = _overlap_window(H, W, s.shape[1], s.shape[2], x, y)
+        if win is None:
+            return (out,)
+        (y0, y1, x0, x1), (sy0, sy1, sx0, sx1) = win
+        sb = _cycle_batch(s, B)[:, sy0:sy1, sx0:sx1]
+        reg = out[:, y0:y1, x0:x1]
+        op = str(operation)
+        if op == "multiply":
+            reg = reg * sb
+        elif op == "add":
+            reg = reg + sb
+        elif op == "subtract":
+            reg = reg - sb
+        elif op == "and":
+            reg = np.minimum(np.round(reg), np.round(sb))
+        elif op == "or":
+            reg = np.maximum(np.round(reg), np.round(sb))
+        elif op == "xor":
+            reg = np.abs(np.round(reg) - np.round(sb))
+        else:
+            raise ValueError(f"unknown mask operation {op!r}")
+        out[:, y0:y1, x0:x1] = np.clip(reg, 0.0, 1.0)
+        return (out,)
+
+
+@register_op
+class LoadImageMask(Op):
+    """Load one channel of an image as a MASK (alpha inverts: fully
+    transparent = 1 = resample, matching LoadImage's mask output)."""
+    TYPE = "LoadImageMask"
+    WIDGETS = ["image", "channel", CONTROL]
+    DEFAULTS = {"channel": "alpha"}
+
+    def execute(self, ctx: OpContext, image: str, channel: str = "alpha"):
+        from PIL import Image
+        path = image
+        if ctx.input_dir and not os.path.isabs(path):
+            path = os.path.join(ctx.input_dir, image)
+        ch = str(channel)[:1].upper()
+        if os.path.exists(path):
+            im = Image.open(path).convert("RGBA")
+            arr = np.asarray(im, np.float32) / 255.0
+        else:
+            debug_log(f"LoadImageMask: {image!r} not found, synthesizing "
+                      "512x512")
+            h = w = 512
+            yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+            arr = np.stack([xx / w, yy / h, (xx + yy) / (h + w),
+                            np.ones((h, w), np.float32)], axis=-1)
+        idx = {"R": 0, "G": 1, "B": 2, "A": 3}.get(ch, 3)
+        m = arr[..., idx]
+        if idx == 3:
+            m = 1.0 - m
+        return (m[None],)
+
+
+@register_op
+class ImageInvert(Op):
+    TYPE = "ImageInvert"
+
+    def execute(self, ctx: OpContext, image):
+        return (1.0 - as_image_array(image),)
+
+
+@register_op
+class ImageBatch(Op):
+    """Concatenate two image batches; the second resizes to the first's
+    dims when they differ (ComfyUI bilinear)."""
+    TYPE = "ImageBatch"
+
+    def execute(self, ctx: OpContext, image1, image2):
+        a = as_image_array(image1)
+        b = as_image_array(image2)
+        if a.shape[1:3] != b.shape[1:3]:
+            b = resize_image(b, a.shape[2], a.shape[1], "bilinear")
+        return (np.concatenate([a, b], axis=0),)
+
+
+@register_op
+class ImageCrop(Op):
+    TYPE = "ImageCrop"
+    WIDGETS = ["width", "height", "x", "y"]
+
+    def execute(self, ctx: OpContext, image, width: int, height: int,
+                x: int = 0, y: int = 0):
+        img = as_image_array(image)
+        H, W = img.shape[1], img.shape[2]
+        x0 = min(max(int(x), 0), W - 1)
+        y0 = min(max(int(y), 0), H - 1)
+        x1 = min(x0 + max(int(width), 1), W)
+        y1 = min(y0 + max(int(height), 1), H)
+        return (img[:, y0:y1, x0:x1],)
+
+
+@register_op
+class EmptyImage(Op):
+    TYPE = "EmptyImage"
+    WIDGETS = ["width", "height", "batch_size", "color"]
+    DEFAULTS = {"width": 512, "height": 512, "batch_size": 1, "color": 0}
+
+    def execute(self, ctx: OpContext, width: int = 512, height: int = 512,
+                batch_size: int = 1, color: int = 0):
+        c = int(color)
+        rgb = np.asarray([(c >> 16) & 0xFF, (c >> 8) & 0xFF, c & 0xFF],
+                         np.float32) / 255.0
+        return (np.broadcast_to(
+            rgb, (int(batch_size), int(height), int(width), 3)).copy(),)
+
+
+@register_op
+class ImageCompositeMasked(Op):
+    """Paste ``source`` over ``destination`` at pixel (x, y), optionally
+    through a MASK; ``resize_source`` first scales the source to the
+    destination's dims."""
+    TYPE = "ImageCompositeMasked"
+    WIDGETS = ["x", "y", "resize_source"]
+    DEFAULTS = {"x": 0, "y": 0, "resize_source": False}
+
+    def execute(self, ctx: OpContext, destination, source, x: int = 0,
+                y: int = 0, resize_source=False, mask=None):
+        dest = as_image_array(destination)
+        src = as_image_array(source)
+        if str(resize_source).lower() not in ("false", "0", ""):
+            src = resize_image(src, dest.shape[2], dest.shape[1],
+                               "bilinear")
+        return (_paste(dest, src, int(x), int(y), mask),)
+
+
+@register_op
+class LatentCompositeMasked(Op):
+    """LatentComposite through an optional mask; x/y are pixels, //8 to
+    latent units (ComfyUI convention)."""
+    TYPE = "LatentCompositeMasked"
+    WIDGETS = ["x", "y", "resize_source"]
+    DEFAULTS = {"x": 0, "y": 0, "resize_source": False}
+
+    def execute(self, ctx: OpContext, destination, source, x: int = 0,
+                y: int = 0, resize_source=False, mask=None):
+        dest = np.asarray(destination["samples"], np.float32)
+        src = np.asarray(source["samples"], np.float32)
+        if str(resize_source).lower() not in ("false", "0", ""):
+            src = resize_image(src, dest.shape[2], dest.shape[1],
+                               "bilinear")
+        out = _paste(dest, src, int(x) // 8, int(y) // 8, mask)
+        return ({**_latent_meta(destination), "samples": out},)
+
+
+@register_op
+class LatentComposite(Op):
+    """Paste one latent onto another at pixel (x, y) (//8 latent units)
+    with a ``feather``-pixel edge ramp on the pasted rect."""
+    TYPE = "LatentComposite"
+    WIDGETS = ["x", "y", "feather"]
+    DEFAULTS = {"x": 0, "y": 0, "feather": 0}
+
+    def execute(self, ctx: OpContext, samples_to, samples_from,
+                x: int = 0, y: int = 0, feather: int = 0):
+        dest = np.asarray(samples_to["samples"], np.float32)
+        src = np.asarray(samples_from["samples"], np.float32)
+        xl, yl = int(x) // 8, int(y) // 8
+        f = max(int(feather), 0) // 8
+        mask = None
+        if f > 0:
+            h, w = src.shape[1], src.shape[2]
+            H, W = dest.shape[1], dest.shape[2]
+            mask = np.ones((1, h, w), np.float32)
+            # ComfyUI semantics: an edge ramps only when destination
+            # content exists beyond it (border-flush pastes stay solid)
+            # and corner rates MULTIPLY
+            for t in range(min(f, h, w)):
+                rate = (t + 1) / f
+                if yl != 0:
+                    mask[:, t, :] *= rate
+                if yl + h < H:
+                    mask[:, h - 1 - t, :] *= rate
+                if xl != 0:
+                    mask[:, :, t] *= rate
+                if xl + w < W:
+                    mask[:, :, w - 1 - t] *= rate
+        out = _paste(dest, src, xl, yl, mask)
+        return ({**_latent_meta(samples_to), "samples": out},)
 
 
 @register_op

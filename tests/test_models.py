@@ -1516,3 +1516,163 @@ class TestCustomSamplingAdvanced:
         assert np.isfinite(r).all()
         assert not np.allclose(r, np.asarray(base["samples"]))
         registry.clear_pipeline_cache()
+
+
+class TestSDXLTextEncodeNodes:
+    """CLIPTextEncodeSDXL / CLIPTextEncodeSDXLRefiner: per-tower prompts
+    + explicit ADM size scalars."""
+
+    def test_texts_alt_feeds_later_towers_only(self):
+        """Duplicate the tiny family's single tower into a 2-tower
+        pipeline: text_l drives the first half of the context, text_g
+        the second."""
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("sdxl-enc.ckpt")
+        p.clip_models = [p.clip_models[0], p.clip_models[0]]
+        p.clip_params = [p.clip_params[0], p.clip_params[0]]
+        same, _ = p.encode_prompt(["a fox"], texts_alt=["a fox"])
+        split, _ = p.encode_prompt(["a fox"], texts_alt=["a crow"])
+        base, _ = p.encode_prompt(["a fox"])
+        np.testing.assert_array_equal(np.asarray(same), np.asarray(base))
+        half = same.shape[-1] // 2
+        np.testing.assert_array_equal(np.asarray(split[..., :half]),
+                                      np.asarray(base[..., :half]))
+        assert not np.allclose(np.asarray(split[..., half:]),
+                               np.asarray(base[..., half:]))
+        registry.clear_pipeline_cache()
+
+    def test_size_cond_rides_adm_vector(self):
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        from comfyui_distributed_tpu.ops.basic import _sdxl_vector_cond
+
+        class _U:
+            adm_in_channels = 2816
+
+        class _F:
+            unet = _U()
+
+        class _P:
+            family = _F()
+
+        pooled = np.full((1, 1280), 0.2, np.float32)
+        derived = _sdxl_vector_cond(
+            _P(), Conditioning(context=None, pooled=pooled), 2, 512, 512)
+        explicit = _sdxl_vector_cond(
+            _P(), Conditioning(context=None, pooled=pooled,
+                               size_cond=(512, 512, 0, 0, 512, 512)),
+            2, 512, 512)
+        np.testing.assert_array_equal(np.asarray(derived),
+                                      np.asarray(explicit))
+        shifted = _sdxl_vector_cond(
+            _P(), Conditioning(context=None, pooled=pooled,
+                               size_cond=(1024, 1024, 0, 0, 512, 512)),
+            2, 512, 512)
+        assert shifted.shape == (2, 2816)
+        assert not np.allclose(np.asarray(shifted), np.asarray(derived))
+        # refiner 5-scalar layout: pooled 1280 + 5*256 = 2560, padded to
+        # the family's adm width
+        ref = _sdxl_vector_cond(
+            _P(), Conditioning(context=None, pooled=pooled,
+                               size_cond=(512, 512, 0, 0, 6.0)),
+            1, 512, 512)
+        assert ref.shape == (1, 2816)
+        assert not np.allclose(np.asarray(ref)[:, :2560],
+                               np.asarray(derived)[:1, :2560])
+
+    def test_nodes_build_size_cond(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("sdxl-enc2.ckpt")
+        octx = OpContext()
+        (c,) = get_op("CLIPTextEncodeSDXL").execute(
+            octx, p, 1024, 1024, 0, 0, 1024, 1024, "a fox", "a fox")
+        assert c.size_cond == (1024, 1024, 0, 0, 1024, 1024)
+        assert c.context.shape[0] == 1
+        (r,) = get_op("CLIPTextEncodeSDXLRefiner").execute(
+            octx, p, 6.0, 1024, 1024, "a fox")
+        assert r.size_cond == (1024, 1024, 0, 0, 6.0)
+        registry.clear_pipeline_cache()
+
+
+class TestTextualInversion:
+    """embedding:name prompt refs splice learned vectors into the token
+    stream (ComfyUI textual-inversion syntax)."""
+
+    def _write_embedding(self, models_dir, name, arr, key="emb_params"):
+        import os
+
+        from safetensors.numpy import save_file
+        os.makedirs(os.path.join(models_dir, "embeddings"), exist_ok=True)
+        save_file({key: arr}, os.path.join(models_dir, "embeddings",
+                                           name + ".safetensors"))
+
+    def test_embedding_changes_encoding(self, tmp_path):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("ti-base.ckpt",
+                                   models_dir=str(tmp_path))
+        width = int(p.clip_models[0].cfg.width)
+        rng = np.random.default_rng(5)
+        self._write_embedding(str(tmp_path), "mystyle",
+                              rng.standard_normal((2, width))
+                              .astype(np.float32))
+        octx = OpContext()
+        (plain,) = get_op("CLIPTextEncode").execute(octx, p, "a fox")
+        (with_emb,) = get_op("CLIPTextEncode").execute(
+            octx, p, "a fox embedding:mystyle")
+        assert with_emb.context.shape == plain.context.shape
+        assert not np.allclose(np.asarray(with_emb.context),
+                               np.asarray(plain.context))
+        # unknown name: dropped -> identical to the plain prompt
+        (dropped,) = get_op("CLIPTextEncode").execute(
+            octx, p, "a fox embedding:doesnotexist")
+        np.testing.assert_array_equal(np.asarray(dropped.context),
+                                      np.asarray(plain.context))
+        registry.clear_pipeline_cache()
+
+    def test_spliced_positions_and_weights(self, tmp_path):
+        from comfyui_distributed_tpu.models.registry import \
+            load_textual_embedding
+        from comfyui_distributed_tpu.models.tokenizer import (
+            encode_with_embeddings, make_tokenizer)
+        width = 16
+        rng = np.random.default_rng(3)
+        vecs = rng.standard_normal((3, width)).astype(np.float32)
+        self._write_embedding(str(tmp_path), "tivec", vecs)
+        tok = make_tokenizer()
+
+        def look(nm):
+            return load_textual_embedding(nm, str(tmp_path), width)
+
+        ids, w, ov, mask = encode_with_embeddings(
+            tok, "a (embedding:tivec:1.5) fox", look, width)
+        assert ids.shape == (tok.max_length,)
+        assert mask.sum() == 3.0
+        pos = np.nonzero(mask)[0]
+        np.testing.assert_array_equal(ov[pos], vecs)
+        np.testing.assert_array_equal(ids[pos], np.zeros(3, np.int32))
+        np.testing.assert_allclose(w[pos], 1.5)
+        # width mismatch -> None -> dropped
+        assert load_textual_embedding("tivec", str(tmp_path), 32) is None
+
+    def test_per_tower_keys(self, tmp_path):
+        import os
+
+        from safetensors.numpy import save_file
+        from comfyui_distributed_tpu.models.registry import \
+            load_textual_embedding
+        os.makedirs(os.path.join(str(tmp_path), "embeddings"),
+                    exist_ok=True)
+        l = np.ones((1, 8), np.float32)
+        g = np.full((1, 12), 2.0, np.float32)
+        save_file({"clip_l": l, "clip_g": g},
+                  os.path.join(str(tmp_path), "embeddings",
+                               "xl.safetensors"))
+        np.testing.assert_array_equal(
+            load_textual_embedding("xl", str(tmp_path), 8, tower_idx=0), l)
+        np.testing.assert_array_equal(
+            load_textual_embedding("xl", str(tmp_path), 12, tower_idx=1),
+            g)
+        # tower 0 must not fall back to the g-tensor
+        assert load_textual_embedding("xl", str(tmp_path), 12,
+                                      tower_idx=0) is None

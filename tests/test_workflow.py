@@ -572,3 +572,108 @@ class TestCustomSamplerWidgetBinding:
         assert "control_after_generate" not in got
         got = _widgets_to_inputs("RandomNoise", [7, "fixed"])
         assert got["noise_seed"] == 7
+
+
+class TestMaskCompositeNodes:
+    """SolidMask / InvertMask / GrowMask / MaskComposite / Image* /
+    Latent* composite family (ComfyUI mask toolchain)."""
+
+    def _op(self, name):
+        from comfyui_distributed_tpu.ops.base import get_op
+        return get_op(name)
+
+    def _ctx(self):
+        from comfyui_distributed_tpu.ops.base import OpContext
+        return OpContext()
+
+    def test_solid_invert_grow(self):
+        octx = self._ctx()
+        (m,) = self._op("SolidMask").execute(octx, 0.25, 8, 6)
+        assert m.shape == (1, 6, 8) and np.all(m == 0.25)
+        (inv,) = self._op("InvertMask").execute(octx, m)
+        assert np.allclose(inv, 0.75)
+        point = np.zeros((1, 7, 7), np.float32)
+        point[0, 3, 3] = 1.0
+        (grown,) = self._op("GrowMask").execute(octx, point, 1, True)
+        assert grown[0, 3, 3] == 1 and grown[0, 2, 3] == 1
+        assert grown[0, 2, 2] == 0          # tapered: no corners
+        (grown2,) = self._op("GrowMask").execute(octx, point, 1, False)
+        assert grown2[0, 2, 2] == 1         # full 3x3
+        (shrunk,) = self._op("GrowMask").execute(octx, grown, -1, True)
+        np.testing.assert_array_equal(shrunk, point)
+
+    def test_mask_composite_ops(self):
+        octx = self._ctx()
+        d = np.ones((1, 4, 4), np.float32)
+        s = np.full((1, 2, 2), 1.0, np.float32)
+        (sub,) = self._op("MaskComposite").execute(octx, d, s, 1, 1,
+                                                   "subtract")
+        assert sub[0, 1, 1] == 0.0 and sub[0, 0, 0] == 1.0
+        (xor,) = self._op("MaskComposite").execute(octx, d, s, 0, 0,
+                                                   "xor")
+        assert xor[0, 0, 0] == 0.0 and xor[0, 3, 3] == 1.0
+        with pytest.raises(ValueError):
+            self._op("MaskComposite").execute(octx, d, s, 0, 0, "nope")
+
+    def test_empty_image_and_crop_and_batch(self):
+        octx = self._ctx()
+        (img,) = self._op("EmptyImage").execute(octx, 8, 4, 2, 0xFF0000)
+        assert img.shape == (2, 4, 8, 3)
+        assert np.allclose(img[..., 0], 1.0) and np.allclose(img[..., 1:],
+                                                             0.0)
+        (crop,) = self._op("ImageCrop").execute(octx, img, 4, 2, 2, 1)
+        assert crop.shape == (2, 2, 4, 3)
+        (inv,) = self._op("ImageInvert").execute(octx, img)
+        assert np.allclose(inv[..., 0], 0.0)
+        small = np.zeros((1, 2, 4, 3), np.float32)
+        (batch,) = self._op("ImageBatch").execute(octx, img, small)
+        assert batch.shape == (3, 4, 8, 3)
+
+    def test_image_composite_masked(self):
+        octx = self._ctx()
+        dest = np.zeros((1, 4, 4, 3), np.float32)
+        src = np.ones((1, 2, 2, 3), np.float32)
+        (out,) = self._op("ImageCompositeMasked").execute(
+            octx, dest, src, 1, 1, False, None)
+        assert out[0, 1, 1, 0] == 1.0 and out[0, 0, 0, 0] == 0.0
+        mask = np.zeros((1, 2, 2), np.float32)
+        mask[0, 0, 0] = 1.0
+        (mout,) = self._op("ImageCompositeMasked").execute(
+            octx, dest, src, 1, 1, False, mask)
+        assert mout[0, 1, 1, 0] == 1.0 and mout[0, 2, 2, 0] == 0.0
+        # negative offset crops the source, no wraparound
+        (neg,) = self._op("ImageCompositeMasked").execute(
+            octx, dest, src, -1, -1, False, None)
+        assert neg[0, 0, 0, 0] == 1.0 and neg[0, 1, 1, 0] == 0.0
+        assert neg[0, 3, 3, 0] == 0.0
+
+    def test_latent_composites_preserve_meta(self):
+        octx = self._ctx()
+        to = {"samples": np.zeros((2, 8, 8, 4), np.float32),
+              "fanout": 2, "local_batch": 1}
+        frm = {"samples": np.ones((1, 4, 4, 4), np.float32)}
+        (out,) = self._op("LatentComposite").execute(octx, to, frm,
+                                                     16, 16, 0)
+        assert out["fanout"] == 2 and out["local_batch"] == 1
+        s = out["samples"]
+        assert s[0, 2, 2, 0] == 1.0 and s[0, 1, 1, 0] == 0.0
+        assert s[1, 2, 2, 0] == 1.0          # short batch cycles
+        (fe,) = self._op("LatentComposite").execute(octx, to, frm,
+                                                    16, 16, 16)
+        sf = fe["samples"]
+        assert 0.0 < sf[0, 2, 2, 0] < 1.0    # feather edge ramp
+        # border-flush paste: no ramp on the flush (top/left) edges,
+        # ramp only toward interior dest content (ComfyUI edge rule)
+        (flush,) = self._op("LatentComposite").execute(octx, to, frm,
+                                                       0, 0, 16)
+        sfl = flush["samples"]
+        assert sfl[0, 0, 0, 0] == 1.0        # flush corner stays solid
+        assert 0.0 < sfl[0, 3, 3, 0] < 1.0   # interior edge ramps
+        # corner toward interior: rates multiply, not min
+        assert np.isclose(sfl[0, 3, 3, 0], 0.25)
+        mask = np.ones((1, 4, 4), np.float32)
+        mask[0, :, :2] = 0.0
+        (lm,) = self._op("LatentCompositeMasked").execute(
+            octx, to, frm, 16, 16, False, mask)
+        sm = lm["samples"]
+        assert sm[0, 2, 2, 0] == 0.0 and sm[0, 2, 5, 0] == 1.0
