@@ -77,6 +77,10 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
             c_skip = 1.0 / (sigma ** 2 + 1.0)
             c_out = sigma / jnp.sqrt(sigma ** 2 + 1.0)
             return x * c_skip - eps_or_v * c_out
+        if prediction_type == "x0":
+            # the model predicts the clean sample directly
+            # (ModelSamplingDiscrete sampling="x0")
+            return eps_or_v
         return x - eps_or_v * sigma
 
     return denoiser

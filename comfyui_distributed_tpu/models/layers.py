@@ -220,13 +220,32 @@ class TransformerBlock(nn.Module):
         return x
 
 
+def _hypertile_divisor(n: int, min_tile: int) -> int:
+    """Largest divisor d of n with n // d >= min_tile (the most tiling
+    that keeps tiles at least ``min_tile`` on a side).  Static shapes:
+    deterministic, unlike the reference ecosystem's random divisor."""
+    best = 1
+    for d in range(1, n + 1):
+        if n % d == 0 and n // d >= min_tile:
+            best = d
+    return best
+
+
 class SpatialTransformer(nn.Module):
     """Project NHWC feature map to tokens, run transformer blocks with
-    text cross-attention, project back (SD UNet attention block)."""
+    text cross-attention, project back (SD UNet attention block).
+
+    ``hypertile_tile`` > 0 (HyperTile patch): the token grid splits into
+    spatial tiles of >= that many latent units per side, riding the
+    BATCH axis through the blocks — self-attention then costs
+    O(tiles * (N/tiles)^2).  Cross-attention and the FF are per-token /
+    per-query, so tiling changes nothing for them (context repeats per
+    tile); only self-attention is approximated, by construction."""
     num_heads: int
     depth: int = 1
     dtype: Dtype = jnp.bfloat16
     attn_impl: str = "xla"
+    hypertile_tile: int = 0
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
@@ -235,12 +254,31 @@ class SpatialTransformer(nn.Module):
         # ResBlock GroupNorm32 uses torch's 1e-5 default instead)
         h = GroupNorm32(epsilon=1e-6, name="norm")(x)
         h = nn.Dense(C, dtype=self.dtype, name="proj_in")(h)
-        h = h.reshape(B, H * W, C)
+        nh = nw = 1
+        if self.hypertile_tile > 0:
+            nh = _hypertile_divisor(H, self.hypertile_tile)
+            nw = _hypertile_divisor(W, self.hypertile_tile)
+        ctx = context
+        if nh * nw > 1:
+            th, tw = H // nh, W // nw
+            h = h.reshape(B, nh, th, nw, tw, C) \
+                .transpose(0, 1, 3, 2, 4, 5) \
+                .reshape(B * nh * nw, th * tw, C)
+            if context is not None:
+                ctx = jnp.repeat(context, nh * nw, axis=0)
+        else:
+            h = h.reshape(B, H * W, C)
         for i in range(self.depth):
             h = TransformerBlock(self.num_heads, dtype=self.dtype,
                                  attn_impl=self.attn_impl,
-                                 name=f"blocks_{i}")(h, context)
-        h = h.reshape(B, H, W, C)
+                                 name=f"blocks_{i}")(h, ctx)
+        if nh * nw > 1:
+            th, tw = H // nh, W // nw
+            h = h.reshape(B, nh, nw, th, tw, C) \
+                .transpose(0, 1, 3, 2, 4, 5) \
+                .reshape(B, H, W, C)
+        else:
+            h = h.reshape(B, H, W, C)
         h = nn.Dense(C, dtype=self.dtype, name="proj_out")(h)
         return x + h
 

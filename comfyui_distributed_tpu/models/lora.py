@@ -276,14 +276,16 @@ def apply_lora_to_pipeline(pipe, lora_name: str,
                    for c, p in zip(fam.clips, ckpt._clip_prefixes(fam))]
     else:
         clip_ps = pipe.clip_params
-    from comfyui_distributed_tpu.models.registry import DiffusionPipeline
+    from comfyui_distributed_tpu.models.registry import (
+        DiffusionPipeline, copy_sampler_patches)
     patched = DiffusionPipeline(
         f"{pipe.name}+{lora_name}", fam, unet_p, clip_ps,
         pipe.vae_params,                # LoRA never touches the VAE
         prediction_type=pipe.prediction_type,
         assets_dir=getattr(pipe, "assets_dir", None))
-    # sampling patches ride derivation chains (RescaleCFG -> LoRA)
-    patched.cfg_rescale = getattr(pipe, "cfg_rescale", 0.0)
+    # sampling patches ride derivation chains (RescaleCFG / zsnr
+    # schedule / PerpNeg -> LoRA): the ONE copy in registry
+    copy_sampler_patches(pipe, patched)
     with _lora_lock:
         _lora_cache[cache_key] = patched
         while len(_lora_cache) > _lora_cache_cap:

@@ -288,7 +288,8 @@ class DiffusionPipeline:
                control=None,
                sigmas_override=None,
                middle_context=None,
-               cfg2: float = 1.0) -> jnp.ndarray:
+               cfg2: float = 1.0,
+               guidance: str = "dual") -> jnp.ndarray:
         """Full ksampler: schedule -> noise -> scan-sampler -> latents.
 
         ``seeds``: per-sample host seed array [B] (64-bit ok; replica offsets
@@ -326,8 +327,9 @@ class DiffusionPipeline:
             if len(conds) != 1 or len(unconds) != 1 or any(
                     m is not None or s != 1.0 or sr is not None
                     for _, m, s, sr in conds + unconds):
-                raise ValueError("dual-CFG requires plain single-entry "
-                                 "positive/negative conditionings")
+                raise ValueError(
+                    f"3-row guidance ({guidance}) requires plain "
+                    "single-entry positive/negative conditionings")
             conds = conds + [(jnp.asarray(middle_context), None, 1.0, None)]
         if sigmas_override is not None:
             # custom-sampling path (SamplerCustom): the caller supplies
@@ -381,6 +383,7 @@ class DiffusionPipeline:
                       y_is_list, tuple(latents.shape), _entries_key(conds),
                       _entries_key(unconds),
                       polling_enabled(), start, end, dual, float(cfg2),
+                      guidance,
                       bool(force_full_denoise), noise_mask is not None,
                       control is not None,
                       _strength_key(control[3]) if control is not None
@@ -425,7 +428,9 @@ class DiffusionPipeline:
                            for i in range(n_conds + n_unconds)]
                 if dual:
                     # ctx_list rows: [cond, middle, uncond] (see sample())
-                    model = smp.cfg_denoiser_dual(
+                    combine = smp.cfg_denoiser_perp_neg \
+                        if guidance == "perp_neg" else smp.cfg_denoiser_dual
+                    model = combine(
                         den, ctx_list[0], ctx_list[1], ctx_list[2],
                         cfg_scale, float(cfg2), cfg_rescale=cfg_rescale)
                     reps = 3
@@ -670,10 +675,29 @@ _DERIVED_CACHE_CAP = 8
 _cn_family_cache: Dict[str, str] = {}
 
 
+def copy_sampler_patches(src: DiffusionPipeline,
+                         dst: DiffusionPipeline) -> None:
+    """Sampler-visible patches that must ride EVERY derivation chain
+    (derive_pipeline AND the LoRA loader's direct construction):
+    RescaleCFG's rescale, a zsnr-patched schedule, and every attr ever
+    applied via derive_pipeline(extra_attrs=...) (PerpNeg's empty cond +
+    scale, ...)."""
+    dst.cfg_rescale = getattr(src, "cfg_rescale", 0.0)
+    dst.schedule = src.schedule
+    riding = set(getattr(src, "_riding_attrs", ()))
+    for attr in riding:
+        if hasattr(src, attr):
+            setattr(dst, attr, getattr(src, attr))
+    dst._riding_attrs = frozenset(riding)
+
+
 def derive_pipeline(base: DiffusionPipeline, tag: str,
                     family: Optional[ModelFamily] = None,
                     vae_params: Any = None,
-                    cfg_rescale: Optional[float] = None
+                    cfg_rescale: Optional[float] = None,
+                    prediction_type: Optional[str] = None,
+                    schedule: Any = None,
+                    extra_attrs: Optional[Dict[str, Any]] = None
                     ) -> DiffusionPipeline:
     """Cached clone of ``base`` with a replacement family (e.g. clip-skip
     configs), VAE params, and/or sampling patches; everything else shared
@@ -687,13 +711,24 @@ def derive_pipeline(base: DiffusionPipeline, tag: str,
         f"{base.name}|{tag}", family or base.family,
         base.unet_params, base.clip_params,
         vae_params if vae_params is not None else base.vae_params,
-        prediction_type=base.prediction_type,
+        prediction_type=prediction_type or base.prediction_type,
         assets_dir=base.assets_dir)
     # sampling patches ride derivation chains (RescaleCFG -> clip-skip
     # -> LoRA must keep the rescale); set BEFORE the clone is published
     # to the cache so a concurrent sampler can't observe the default
-    clone.cfg_rescale = cfg_rescale if cfg_rescale is not None \
-        else getattr(base, "cfg_rescale", 0.0)
+    copy_sampler_patches(base, clone)
+    if cfg_rescale is not None:
+        clone.cfg_rescale = cfg_rescale
+    # a patched schedule (ModelSamplingDiscrete zsnr) must also survive
+    # further derivations (LoRA/clip-skip after the patch)
+    if schedule is not None:
+        clone.schedule = schedule
+    # new patch attrs join the riding set (see copy_sampler_patches)
+    if extra_attrs:
+        for k, v in extra_attrs.items():
+            setattr(clone, k, v)
+        clone._riding_attrs = frozenset(
+            set(clone._riding_attrs) | set(extra_attrs))
     with _pipeline_lock:
         _derived_cache[key] = clone
         while len(_derived_cache) > _DERIVED_CACHE_CAP:

@@ -1033,9 +1033,10 @@ def sample_dpm_adaptive(model: Model, x: jax.Array, sigmas: jax.Array,
         x_high = (x_ - jnp.exp(-t) * jnp.expm1(hh) * eps
                   - jnp.exp(-t) / r2 * (jnp.expm1(hh) / hh - 1.0)
                   * (eps_r2 - eps))
+        # elementwise tolerance (k-diffusion): low-magnitude regions get
+        # their own |x|-scaled delta, not the tensor-global max
         delta = jnp.maximum(
-            atol, rtol * jnp.maximum(jnp.abs(x_low).max(),
-                                     jnp.abs(x_prev).max()))
+            atol, rtol * jnp.maximum(jnp.abs(x_low), jnp.abs(x_prev)))
         error = jnp.sqrt(jnp.sum(((x_low - x_high) / delta) ** 2)) \
             / n_sqrt
         e0 = 1.0 / (1e-8 + error)
@@ -1225,6 +1226,47 @@ def cfg_denoiser_dual(model: Model, cond: jax.Array, middle: jax.Array,
         else:
             base = neg + (mid - neg) * cfg2
         return base + (pos - mid) * cfg1
+    return wrapped
+
+
+def cfg_denoiser_perp_neg(model: Model, cond: jax.Array,
+                          empty: jax.Array, uncond: jax.Array,
+                          cfg_scale: float, neg_scale: float,
+                          cfg_rescale: float = 0.0) -> Model:
+    """Perp-Neg guidance (Armandpour et al.; ComfyUI's PerpNeg /
+    PerpNegGuider): one tripled-batch call with rows [cond, empty,
+    uncond]; the negative's component PERPENDICULAR to the positive
+    direction (both relative to the empty prompt) is subtracted at
+    ``neg_scale`` — the parallel component, which CFG would misread as
+    "less positive", is discarded:
+
+        pos  = den_cond - den_empty
+        neg  = den_unc - den_empty
+        perp = neg - (<neg, pos>/|pos|^2) pos       (per sample)
+        out  = den_empty + cfg * (pos - neg_scale * perp)
+
+    Projections reduce per-SAMPLE (the reference ecosystem's global-sum
+    reduction cross-talks a batch; x0-space is equivalent to its
+    eps-space math — the shared -sigma factor cancels in the
+    projection).  A RescaleCFG patch re-stds the combine toward the
+    cond prediction like the plain CFG path."""
+    def wrapped(x, sigma, **extra):
+        x_rep = jnp.concatenate([x, x, x], axis=0)
+        ctx = jnp.concatenate([cond, empty, uncond], axis=0)
+        out = model(x_rep, sigma, context=ctx, **extra)
+        den_cond, den_empty, den_unc = jnp.split(out, 3, axis=0)
+        pos = den_cond - den_empty
+        neg = den_unc - den_empty
+        axes = tuple(range(1, x.ndim))
+        dot = jnp.sum(neg * pos, axis=axes, keepdims=True)
+        sq = jnp.maximum(jnp.sum(pos * pos, axis=axes, keepdims=True),
+                         1e-12)
+        perp = neg - (dot / sq) * pos
+        direction = pos - neg_scale * perp
+        if cfg_rescale:
+            return _rescale_cfg(x, sigma, den_empty + direction,
+                                den_empty, cfg_scale, cfg_rescale)
+        return den_empty + cfg_scale * direction
     return wrapped
 
 

@@ -96,6 +96,22 @@ def _append_zero(sigmas: np.ndarray) -> np.ndarray:
     return np.concatenate([sigmas, [0.0]]).astype(np.float32)
 
 
+def rescale_zero_terminal_snr(ds: DiscreteSchedule) -> DiscreteSchedule:
+    """Zero-terminal-SNR rescale (Lin et al., "Common Diffusion Noise
+    Schedules and Sample Steps are Flawed") — ModelSamplingDiscrete's
+    ``zsnr`` toggle: shift+scale sqrt(abar) so the final step carries
+    zero signal.  The exact rescale sends the terminal sigma to
+    infinity; the terminal abar clamps at 1e-8 (sigma ~ 1e4) to keep
+    the schedule finite for the samplers."""
+    abar_sqrt = np.sqrt(ds.alphas_cumprod)
+    a0, aT = abar_sqrt[0], abar_sqrt[-1]
+    abar_sqrt = (abar_sqrt - aT) * (a0 / (a0 - aT))
+    abar = np.clip(abar_sqrt ** 2, 1e-8, 1.0)
+    sigmas = np.sqrt((1.0 - abar) / abar)
+    return DiscreteSchedule(sigmas=sigmas.astype(np.float32),
+                            alphas_cumprod=abar.astype(np.float32))
+
+
 def normal_scheduler(ds: DiscreteSchedule, steps: int, sgm: bool = False) -> np.ndarray:
     """Uniform in timestep space over the model's sigma table."""
     start = ds.t_from_sigma(ds.sigma_max)

@@ -54,6 +54,11 @@ class UNetConfig:
     # derived-pipeline cache keeps them apart)
     freeu: Optional[Tuple[float, float, float, float]] = None
     freeu_version: int = 1
+    # HyperTile: (tile_size_px, max_depth, scale_depth) or None — levels
+    # <= max_depth tile their self-attention into >= tile_size//8-latent
+    # blocks (models/layers.py SpatialTransformer).  Static config like
+    # freeu: each setting compiles its own executable
+    hypertile: Optional[Tuple[int, int, bool]] = None
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     prediction_type: str = "eps"  # "eps" | "v"
@@ -170,6 +175,16 @@ class UNet(nn.Module):
                 return cfg.num_heads
             return max(c // cfg.num_head_channels, 1)
 
+        def ht_tile(level: int) -> int:
+            """HyperTile minimum latent tile for this level (0 = off)."""
+            if cfg.hypertile is None:
+                return 0
+            tile_px, max_depth, scale_depth = cfg.hypertile
+            if level > int(max_depth):
+                return 0
+            lt = max(32, int(tile_px)) // 8
+            return lt * (2 ** level if scale_depth else 1)
+
         h = nn.Conv(ch, (3, 3), padding=1, dtype=cfg.dtype, name="conv_in")(x)
         skips = [h]
 
@@ -183,6 +198,7 @@ class UNet(nn.Module):
                     h = SpatialTransformer(
                         heads(out_ch), depth=cfg.transformer_depth[level],
                         dtype=cfg.dtype, attn_impl=cfg.attn_impl,
+                        hypertile_tile=ht_tile(level),
                         name=f"down_{level}_attn_{i}")(h, context)
                 skips.append(h)
             if level != cfg.num_levels - 1:
@@ -200,7 +216,9 @@ class UNet(nn.Module):
         h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_0")(h, emb)
         h = SpatialTransformer(
             heads(mid_ch), depth=max(cfg.transformer_depth[-1], 1),
-            dtype=cfg.dtype, attn_impl=cfg.attn_impl, name="mid_attn")(h, context)
+            dtype=cfg.dtype, attn_impl=cfg.attn_impl,
+            hypertile_tile=ht_tile(cfg.num_levels - 1),
+            name="mid_attn")(h, context)
         h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_1")(h, emb)
         if control is not None:
             h = h + ctrl_mid
@@ -219,6 +237,7 @@ class UNet(nn.Module):
                     h = SpatialTransformer(
                         heads(out_ch), depth=cfg.transformer_depth[level],
                         dtype=cfg.dtype, attn_impl=cfg.attn_impl,
+                        hypertile_tile=ht_tile(level),
                         name=f"up_{level}_attn_{i}")(h, context)
             if level != 0:
                 h = Upsample(dtype=cfg.dtype, name=f"up_{level}_us")(h)

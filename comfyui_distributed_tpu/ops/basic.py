@@ -107,6 +107,94 @@ class RescaleCFG(Op):
 
 
 @register_op
+class ModelSamplingDiscrete(Op):
+    """ComfyUI's ModelSamplingDiscrete: re-declare how the model's
+    output parameterizes the denoised sample (eps / v_prediction / x0 —
+    v-pred finetunes of eps bases) and optionally rescale the schedule
+    to zero terminal SNR.  Derived pipeline; patch rides further
+    derivations (LoRA/clip-skip)."""
+    TYPE = "ModelSamplingDiscrete"
+    WIDGETS = ["sampling", "zsnr"]
+    DEFAULTS = {"sampling": "eps", "zsnr": False}
+
+    _MAP = {"eps": "eps", "v_prediction": "v", "x0": "x0",
+            "lcm": "eps"}
+
+    def execute(self, ctx: OpContext, model, sampling: str = "eps",
+                zsnr=False):
+        from comfyui_distributed_tpu.models import schedules as sch
+        s = str(sampling)
+        if s not in self._MAP:
+            raise ValueError(f"unknown sampling {s!r}; "
+                             f"available: {tuple(self._MAP)}")
+        if s == "lcm":
+            debug_log("ModelSamplingDiscrete: 'lcm' timestep scaling is "
+                      "not modeled; treating as eps (use the lcm "
+                      "sampler for LCM checkpoints)")
+        z = str(zsnr).lower() not in ("false", "0", "")
+        schedule = sch.rescale_zero_terminal_snr(model.schedule) if z \
+            else None
+        return (registry.derive_pipeline(
+            model, f"msd:{s}:{int(z)}",
+            prediction_type=self._MAP[s], schedule=schedule),)
+
+
+@register_op
+class HyperTile(Op):
+    """HyperTile: tile self-attention spatially (tiles ride the batch
+    axis) so its cost drops from O(N^2) to O(tiles*(N/tiles)^2) — the
+    reference ecosystem's speed patch for large canvases.  Static,
+    deterministic tiling (largest divisor with tiles >= tile_size//8
+    latent units; the reference's random divisor swap is jit-hostile,
+    so ``swap_size`` is accepted and ignored with a log)."""
+    TYPE = "HyperTile"
+    WIDGETS = ["tile_size", "swap_size", "max_depth", "scale_depth"]
+    DEFAULTS = {"tile_size": 256, "swap_size": 2, "max_depth": 0,
+                "scale_depth": False}
+
+    def execute(self, ctx: OpContext, model, tile_size: int = 256,
+                swap_size: int = 2, max_depth: int = 0,
+                scale_depth=False):
+        if int(swap_size) != 2:
+            debug_log("HyperTile: swap_size has no effect (deterministic "
+                      "static tiling)")
+        sd = str(scale_depth).lower() not in ("false", "0", "")
+        fam = model.family
+        fam2 = dataclasses.replace(fam, unet=dataclasses.replace(
+            fam.unet, hypertile=(int(tile_size), int(max_depth), sd)))
+        tag = f"hypertile:{tile_size}:{max_depth}:{int(sd)}"
+        return (registry.derive_pipeline(model, tag, family=fam2),)
+
+
+@register_op
+class PerpNeg(Op):
+    """ComfyUI's PerpNeg model patch: sampling evaluates a third, EMPTY
+    conditioning and subtracts only the negative's perpendicular
+    component (samplers.cfg_denoiser_perp_neg).  Derived pipeline;
+    rides further derivations."""
+    TYPE = "PerpNeg"
+    WIDGETS = ["neg_scale"]
+    DEFAULTS = {"neg_scale": 1.0}
+
+    def execute(self, ctx: OpContext, model,
+                empty_conditioning: Conditioning, neg_scale: float = 1.0):
+        import zlib
+
+        # the empty conditioning is part of the derived pipeline's
+        # identity — two patches with the same scale but different empty
+        # prompts must not share a cache slot
+        e = empty_conditioning
+        sig = zlib.crc32(np.asarray(e.context, np.float32).tobytes())
+        if e.pooled is not None:
+            sig = zlib.crc32(np.asarray(e.pooled, np.float32).tobytes(),
+                             sig)
+        return (registry.derive_pipeline(
+            model, f"perpneg:{float(neg_scale)}:{sig:08x}",
+            extra_attrs={"perp_neg_cond": empty_conditioning,
+                         "perp_neg_scale": float(neg_scale)}),)
+
+
+@register_op
 class FreeU(Op):
     """FreeU (Si et al.): decoder backbone boost + skip low-pass — free
     quality lift, no weight change (reference ecosystem's FreeU node).
@@ -384,7 +472,9 @@ class SamplerCustom(Op):
                            not in ("disable", "false", "0")),
                 sample_idx=prep.sample_idx,
                 noise_mask=prep.noise_mask, control=prep.control,
-                sigmas_override=np.asarray(sigmas, np.float32))
+                sigmas_override=np.asarray(sigmas, np.float32),
+                middle_context=prep.mid_context, cfg2=prep.cfg2,
+                guidance=prep.guidance)
         out_d = {"samples": out, **_latent_meta(latent_image),
                  "local_batch": prep.local_batch, "fanout": prep.fanout}
         return (out_d, dict(out_d))
@@ -479,11 +569,30 @@ class DualCFGGuider(Op):
 
 
 @register_op
+class PerpNegGuider(Op):
+    """-> GUIDER: Perp-Neg as an explicit custom-sampling wire (ComfyUI
+    PerpNegGuider) — positive/negative/empty conditionings, CFG at
+    ``cfg``, perpendicular negative at ``neg_scale``."""
+    TYPE = "PerpNegGuider"
+    WIDGETS = ["cfg", "neg_scale"]
+    DEFAULTS = {"cfg": 8.0, "neg_scale": 1.0}
+
+    def execute(self, ctx: OpContext, model, positive: Conditioning,
+                negative: Conditioning, empty_conditioning: Conditioning,
+                cfg: float = 8.0, neg_scale: float = 1.0):
+        return (GuiderObject(model=model, positive=positive,
+                             negative=negative,
+                             middle=empty_conditioning, cfg=float(cfg),
+                             cfg2=float(neg_scale), mode="perp"),)
+
+
+@register_op
 class SamplerCustomAdvanced(Op):
     """ComfyUI's fully-modular sampling entry: NOISE + GUIDER + SAMPLER +
     SIGMAS.  Same compiled path as SamplerCustom; the guider picks the
-    denoiser combine (basic / cfg / dual-cfg).  Both latent outputs carry
-    the final result (no separate preview stream headless)."""
+    denoiser combine (basic / cfg / dual-cfg / perp-neg).  Both latent
+    outputs carry the final result (no separate preview stream
+    headless)."""
     TYPE = "SamplerCustomAdvanced"
 
     @staticmethod
@@ -497,13 +606,19 @@ class SamplerCustomAdvanced(Op):
         ctx.check_interrupt()
         g = guider
         neg = g.negative if g.negative is not None else g.positive
-        if g.mode == "dual" and not all(
+        three_row = g.mode in ("dual", "perp")
+        if three_row and not all(
                 self._plain(e) for e in (g.positive, g.middle, neg)):
-            raise ValueError("DualCFGGuider does not compose with "
+            raise ValueError(f"{g.mode} guidance does not compose with "
                              "regional multi-entry conditionings")
         prep = _prepare_sample_inputs(
             ctx, g.model, noise.seed, latent_image, g.positive, neg,
-            middle=g.middle if g.mode == "dual" else None)
+            middle=g.middle if three_row else None)
+        if three_row:
+            guidance = "perp_neg" if g.mode == "perp" else "dual"
+            cfg2 = float(g.cfg2)
+        else:   # incl. a PerpNeg-patched model under a plain guider
+            guidance, cfg2 = prep.guidance, prep.cfg2
         cfg = 1.0 if g.mode == "basic" else float(g.cfg)
         name = sampler.name if isinstance(sampler, SamplerObject) \
             else str(sampler)
@@ -516,7 +631,8 @@ class SamplerCustomAdvanced(Op):
                 sample_idx=prep.sample_idx, noise_mask=prep.noise_mask,
                 control=prep.control,
                 sigmas_override=np.asarray(sigmas, np.float32),
-                middle_context=prep.mid_context, cfg2=float(g.cfg2))
+                middle_context=prep.mid_context, cfg2=cfg2,
+                guidance=guidance)
         out_d = {"samples": out, **_latent_meta(latent_image),
                  "local_batch": prep.local_batch, "fanout": prep.fanout}
         return (out_d, dict(out_d))
@@ -545,7 +661,9 @@ class KSampler(Op):
                 sampler_name=str(sampler_name), scheduler=str(scheduler),
                 denoise=float(denoise), y=prep.y,
                 sample_idx=prep.sample_idx,
-                noise_mask=prep.noise_mask, control=prep.control)
+                noise_mask=prep.noise_mask, control=prep.control,
+                middle_context=prep.mid_context, cfg2=prep.cfg2,
+                guidance=prep.guidance)
         out_d = {"samples": out, "local_batch": prep.local_batch,
                  "fanout": prep.fanout}
         if "noise_mask" in latent_image:   # ComfyUI keeps the mask on the
@@ -586,7 +704,9 @@ class KSamplerAdvanced(Op):
                 start_step=int(start_at_step),
                 end_step=min(int(end_at_step), int(steps)),
                 force_full_denoise=(
-                    str(return_with_leftover_noise) == "disable"))
+                    str(return_with_leftover_noise) == "disable"),
+                middle_context=prep.mid_context, cfg2=prep.cfg2,
+                guidance=prep.guidance)
         out_d = {"samples": out, "local_batch": prep.local_batch,
                  "fanout": prep.fanout}
         if "noise_mask" in latent_image:
@@ -673,10 +793,14 @@ class _SampleInputs:
     fanout: int
     noise_mask: object = None
     control: object = None
-    # dual-CFG (SamplerCustomAdvanced): the middle conditioning's
+    # 3-row guidance (dual-CFG / PerpNeg): the middle conditioning's
     # batch-repeated context, aligned to the same token length as
-    # context/uncond; None outside dual mode
+    # context/uncond; None for plain CFG.  ``guidance``/``cfg2`` are the
+    # matching registry.sample kwargs (perp-neg auto-detected from the
+    # pipeline patch)
     mid_context: object = None
+    guidance: str = "dual"
+    cfg2: float = 1.0
 
 
 def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
@@ -684,10 +808,19 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                            negative: Conditioning,
                            middle: Optional[Conditioning] = None,
                            ) -> _SampleInputs:
-    """``middle`` (dual-CFG only): a third plain conditioning prepared in
-    the SAME pass — token alignment spans all three, it carries its OWN
-    pooled ADM vector, and a control on any of the three gets a flat
-    per-block [cond, middle, uncond] strength tuple."""
+    """``middle`` (dual-CFG / PerpNeg): a third plain conditioning
+    prepared in the SAME pass — token alignment spans all three, it
+    carries its OWN pooled ADM vector, and a control on any of the three
+    gets a flat per-block [cond, middle, uncond] strength tuple.  A
+    PerpNeg-patched pipeline injects its empty conditioning when no
+    explicit middle is given."""
+    guidance, cfg2 = "dual", 1.0
+    if middle is None:
+        pn = getattr(model, "perp_neg_cond", None)
+        if pn is not None:
+            middle = pn
+            guidance = "perp_neg"
+            cfg2 = float(getattr(model, "perp_neg_scale", 1.0))
     lat = np.asarray(latent_image["samples"], np.float32)
     fanout = int(latent_image.get("fanout", 1))
     total = lat.shape[0]
@@ -786,8 +919,11 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
     mid_ctx = None
     if middle is not None:
         if multi:
-            raise ValueError("dual-CFG requires plain single-entry "
-                             "positive/negative conditionings")
+            raise ValueError(
+                f"3-row guidance ({guidance}: "
+                f"{'PerpNeg patch' if guidance == 'perp_neg' else 'DualCFG'}"
+                ") requires plain single-entry positive/negative "
+                "conditionings")
         mid_ctx = mid_built[0][0]
     if multi:
         ctx_arr = cond_entries
@@ -873,7 +1009,8 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                          uncond=unc_arr, seeds=seeds, sample_idx=local_idx,
                          y=y, local_batch=local_b, fanout=fanout,
                          noise_mask=mask, control=control,
-                         mid_context=mid_ctx)
+                         mid_context=mid_ctx, guidance=guidance,
+                         cfg2=cfg2)
 
 
 def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,

@@ -128,6 +128,26 @@ class UltimateSDUpscaleDistributed(Op):
         if pipe.family.unet.adm_in_channels is not None:
             y = _sdxl_vector_cond(pipe, positive, n,
                                   tiles.shape[1], tiles.shape[2])
+        # a PerpNeg-patched pipeline's empty conditioning steers the tile
+        # refine too (the patch rides derive_pipeline; dropping it here
+        # would silently degrade to plain CFG)
+        mid_arr = None
+        guidance, cfg2 = "dual", 1.0
+        pn = getattr(pipe, "perp_neg_cond", None)
+        if pn is not None:
+            c = jnp.asarray(pn.context)
+            tm = int(positive.context.shape[1])
+            if int(c.shape[1]) != tm:   # align to the prompt's tokens
+                t = int(c.shape[1])
+                if tm % t == 0:
+                    c = jnp.tile(c, (1, tm // t, 1))
+                elif t > tm:
+                    c = c[:, :tm]
+                else:
+                    c = jnp.pad(c, ((0, 0), (0, tm - t), (0, 0)))
+            mid_arr = jnp.repeat(c, n, axis=0)
+            guidance = "perp_neg"
+            cfg2 = float(getattr(pipe, "perp_neg_scale", 1.0))
         tiles_dev = jnp.asarray(tiles)
         if shard and ctx.runtime is not None:
             mesh = ctx.runtime.mesh
@@ -136,12 +156,15 @@ class UltimateSDUpscaleDistributed(Op):
             unc_arr = coll.shard_batch(np.asarray(unc_arr), mesh)
             if y is not None:
                 y = coll.shard_batch(np.asarray(y), mesh)
+            if mid_arr is not None:
+                mid_arr = coll.shard_batch(np.asarray(mid_arr), mesh)
         lat = pipe.vae_encode(tiles_dev)
         out_lat = pipe.sample(
             lat, ctx_arr, unc_arr, seeds,
             steps=p["steps"], cfg=p["cfg"], sampler_name=p["sampler_name"],
             scheduler=p["scheduler"], denoise=p["denoise"],
-            add_noise=True, sample_idx=idx, y=y)
+            add_noise=True, sample_idx=idx, y=y,
+            middle_context=mid_arr, cfg2=cfg2, guidance=guidance)
         # clamp at the decode boundary (ComfyUI VAEDecode parity): the
         # worker->master PNG wire clips to [0,1], so unclamped local tiles
         # would blend differently from the same tile shipped over HTTP

@@ -1676,3 +1676,207 @@ class TestTextualInversion:
         # tower 0 must not fall back to the g-tensor
         assert load_textual_embedding("xl", str(tmp_path), 12,
                                       tower_idx=0) is None
+
+
+class TestModelPatchesRound4:
+    """ModelSamplingDiscrete / PerpNeg / HyperTile."""
+
+    def test_model_sampling_discrete(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("msd.ckpt")
+        octx = OpContext()
+        (pv,) = get_op("ModelSamplingDiscrete").execute(octx, p,
+                                                        "v_prediction",
+                                                        False)
+        assert pv.prediction_type == "v" and pv.unet_params is p.unet_params
+        (pz,) = get_op("ModelSamplingDiscrete").execute(octx, p, "eps",
+                                                        True)
+        assert pz.schedule.sigma_max > p.schedule.sigma_max * 10
+        assert np.isclose(pz.schedule.sigmas[0], p.schedule.sigmas[0],
+                          rtol=0.15)       # clean end barely moves
+        # patch rides a LoRA derivation
+        (pl, _) = get_op("LoraLoader").execute(octx, pv, pv,
+                                               "style.safetensors", 0.5,
+                                               0.5)
+        assert pl.prediction_type == "v"
+        with pytest.raises(ValueError):
+            get_op("ModelSamplingDiscrete").execute(octx, p, "nope",
+                                                    False)
+        # sampling: v-interpretation of the same weights differs from eps
+        pos = Conditioning(context=p.encode_prompt(["dunes"])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (a,) = get_op("KSampler").execute(octx, p, 5, 2, 4.0, "euler",
+                                          "normal", pos, pos, lat, 1.0)
+        (b,) = get_op("KSampler").execute(octx, pv, 5, 2, 4.0, "euler",
+                                          "normal", pos, pos, lat, 1.0)
+        assert np.isfinite(np.asarray(b["samples"])).all()
+        assert not np.allclose(np.asarray(a["samples"]),
+                               np.asarray(b["samples"]))
+        registry.clear_pipeline_cache()
+
+    def test_perp_neg_reduces_to_cfg_when_empty_is_negative(self):
+        """neg == empty -> the perpendicular component vanishes and the
+        combine is EXACTLY plain CFG against the empty prompt."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("pn-eq.ckpt")
+        octx = OpContext()
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0])
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (pp,) = get_op("PerpNeg").execute(octx, p, neg, 1.0)
+        (a,) = get_op("KSampler").execute(octx, pp, 5, 2, 6.0, "euler",
+                                          "normal", pos, neg, lat, 1.0)
+        (b,) = get_op("KSampler").execute(octx, p, 5, 2, 6.0, "euler",
+                                          "normal", pos, neg, lat, 1.0)
+        # tripled- vs doubled-batch executables fuse differently; the
+        # reduction is algebraically exact, numerically ~1e-6 relative
+        np.testing.assert_allclose(np.asarray(a["samples"]),
+                                   np.asarray(b["samples"]),
+                                   rtol=1e-3, atol=1e-4)
+        # a DISTINCT empty changes the guidance
+        emp = Conditioning(context=p.encode_prompt(["photo"])[0])
+        (pd,) = get_op("PerpNeg").execute(octx, p, emp, 1.0)
+        (c,) = get_op("KSampler").execute(octx, pd, 5, 2, 6.0, "euler",
+                                          "normal", pos, neg, lat, 1.0)
+        s = np.asarray(c["samples"])
+        assert np.isfinite(s).all()
+        assert not np.allclose(s, np.asarray(b["samples"]))
+        registry.clear_pipeline_cache()
+
+    def test_perp_neg_guider_matches_patch(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("pn-g.ckpt")
+        octx = OpContext()
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0])
+        neg = Conditioning(context=p.encode_prompt(["blurry"])[0])
+        emp = Conditioning(context=p.encode_prompt([""])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (sampler,) = get_op("KSamplerSelect").execute(octx, "euler")
+        (sig,) = get_op("BasicScheduler").execute(octx, p, "normal", 3,
+                                                  1.0)
+        (noise,) = get_op("RandomNoise").execute(octx, 9)
+        (guider,) = get_op("PerpNegGuider").execute(octx, p, pos, neg,
+                                                    emp, 6.0, 1.0)
+        a, _ = get_op("SamplerCustomAdvanced").execute(
+            octx, noise, guider, sampler, sig, lat)
+        (pp,) = get_op("PerpNeg").execute(octx, p, emp, 1.0)
+        b, _ = get_op("SamplerCustom").execute(
+            octx, pp, True, 9, 6.0, pos, neg, lat, sampler, sig)
+        np.testing.assert_allclose(np.asarray(a["samples"]),
+                                   np.asarray(b["samples"]),
+                                   rtol=1e-5, atol=1e-5)
+        registry.clear_pipeline_cache()
+
+    def test_hypertile_module_level(self):
+        import jax as _jax
+
+        from comfyui_distributed_tpu.models.layers import (
+            SpatialTransformer, _hypertile_divisor)
+        assert _hypertile_divisor(32, 4) == 8
+        assert _hypertile_divisor(32, 32) == 1
+        assert _hypertile_divisor(30, 7) == 3   # 30/3=10 >= 7
+        st = SpatialTransformer(num_heads=2, dtype=jnp.float32)
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.standard_normal((1, 8, 8, 32)), jnp.float32)
+        ctx = jnp.asarray(rng.standard_normal((1, 7, 64)), jnp.float32)
+        params = st.init(_jax.random.PRNGKey(0), x, ctx)
+        base = st.apply(params, x, ctx)
+        tiled = SpatialTransformer(num_heads=2, dtype=jnp.float32,
+                                   hypertile_tile=4)
+        out = tiled.apply(params, x, ctx)
+        assert out.shape == base.shape
+        assert not np.allclose(np.asarray(out), np.asarray(base))
+        # a tile >= the whole map is a no-op (nh = nw = 1)
+        whole = SpatialTransformer(num_heads=2, dtype=jnp.float32,
+                                   hypertile_tile=8)
+        np.testing.assert_array_equal(np.asarray(whole.apply(params, x,
+                                                             ctx)),
+                                      np.asarray(base))
+
+    def test_hypertile_node_runs(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("ht.ckpt")
+        octx = OpContext()
+        (ph,) = get_op("HyperTile").execute(octx, p, 32, 2, 1, False)
+        assert ph.family.unet.hypertile == (32, 1, False)
+        assert ph.unet_params is p.unet_params
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0])
+        lat = {"samples": np.zeros((1, 16, 16, 4), np.float32)}
+        (a,) = get_op("KSampler").execute(octx, ph, 5, 2, 4.0, "euler",
+                                          "normal", pos, pos, lat, 1.0)
+        s = np.asarray(a["samples"])
+        assert np.isfinite(s).all()
+        (b,) = get_op("KSampler").execute(octx, p, 5, 2, 4.0, "euler",
+                                          "normal", pos, pos, lat, 1.0)
+        assert not np.allclose(s, np.asarray(b["samples"]))
+        registry.clear_pipeline_cache()
+
+
+class TestPerpNegIntegration:
+    def test_cache_keyed_by_empty_cond_and_rides_chains(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("pn-cache.ckpt")
+        octx = OpContext()
+        e1 = Conditioning(context=p.encode_prompt(["a"])[0])
+        e2 = Conditioning(context=p.encode_prompt(["b"])[0])
+        (p1,) = get_op("PerpNeg").execute(octx, p, e1, 1.0)
+        (p2,) = get_op("PerpNeg").execute(octx, p, e2, 1.0)
+        assert p1 is not p2            # distinct empties: distinct clones
+        assert p2.perp_neg_cond is e2
+        (p1b,) = get_op("PerpNeg").execute(octx, p, e1, 1.0)
+        assert p1b is p1               # same empty: cache hit
+        (pl, _) = get_op("LoraLoader").execute(octx, p1, p1,
+                                               "s.safetensors", 0.5, 0.5)
+        assert getattr(pl, "perp_neg_cond", None) is e1
+        assert getattr(pl, "perp_neg_scale", None) == 1.0
+        registry.clear_pipeline_cache()
+
+    def test_refine_batch_passes_perp_neg(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        import jax.numpy as jnp
+        captured = {}
+
+        class _U:
+            adm_in_channels = None
+
+        class _F:
+            unet = _U()
+
+        class _Pipe:
+            family = _F()
+            perp_neg_cond = Conditioning(
+                context=np.ones((1, 77, 8), np.float32))
+            perp_neg_scale = 0.7
+
+            def vae_encode(self, t):
+                return jnp.zeros((t.shape[0], 4, 4, 4))
+
+            def sample(self, lat, c, u, seeds, **kw):
+                captured.update(kw)
+                return lat
+
+            def vae_decode(self, lat):
+                return np.zeros((lat.shape[0], 8, 8, 3), np.float32)
+
+        op = get_op("UltimateSDUpscaleDistributed")
+        pos = Conditioning(context=np.zeros((1, 77, 8), np.float32))
+        params = {"seed": 1, "steps": 1, "cfg": 4.0,
+                  "sampler_name": "euler", "scheduler": "normal",
+                  "denoise": 0.5}
+        op._refine_batch(OpContext(), _Pipe(),
+                         np.zeros((2, 8, 8, 3), np.float32), [0, 1],
+                         pos, pos, params)
+        assert captured["guidance"] == "perp_neg"
+        assert captured["cfg2"] == 0.7
+        assert captured["middle_context"].shape == (2, 77, 8)
