@@ -6,25 +6,56 @@
 # parity check, writing round-4 artifacts.  Exits after one full
 # successful set (sentinel: benchmarks/.tpu_bench_done_r4).
 #
+# v2 (mid-round-4): the tunnel can drop MID-CYCLE (04:54 drop burned
+# ~28 min of escape-ladder patience per remaining bench) — so every
+# bench is now gated by a cheap re-probe, a dead backend aborts the
+# cycle back to the outer sleep, and startup waits out any orphaned
+# bench from a previous loop instance (two clients must not fight for
+# the single claim).
+#
 # Usage: nohup bash benchmarks/tpu_recovery_loop.sh >> benchmarks/tpu_recovery.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
 SENTINEL=benchmarks/.tpu_bench_done_r4
 PROBE_WINDOW=1860         # > the ~25-min claim window: resolve, don't kill
+QUICK_PROBE=240           # mid-cycle re-probe (chip was just up)
 SLEEP_BETWEEN=480
 
 log() { echo "[recovery $(date -u +%H:%M:%S)] $*"; }
 
-[ -f "$SENTINEL" ] && { log "sentinel exists; nothing to do"; exit 0; }
-
-while true; do
-  log "probing backend (window ${PROBE_WINDOW}s)..."
-  if timeout "$PROBE_WINDOW" python - <<'EOF'
+probe() {  # $1 = window seconds
+  timeout "$1" python - <<'EOF'
 import jax, sys
 ds = jax.devices()
 sys.exit(0 if ds[0].platform != "cpu" else 1)
 EOF
-  then
+}
+
+[ -f "$SENTINEL" ] && { log "sentinel exists; nothing to do"; exit 0; }
+
+while pgrep -f "bench.py --init" >/dev/null 2>&1; do
+  log "waiting for an orphaned bench to finish (no double-claim)"
+  sleep 60
+done
+
+GATE_RC=97   # sentinel for "backend gone": must not collide with real
+             # exit codes (python argparse exits 2; timeout exits 124)
+
+run_gated() {  # $1 = timeout, rest = command
+  local to=$1; shift
+  if ! probe "$QUICK_PROBE"; then
+    log "backend gone mid-cycle; aborting the rest of this cycle"
+    return $GATE_RC
+  fi
+  timeout "$to" "$@"
+  local rc=$?
+  [ $rc = $GATE_RC ] && rc=1   # a real command must not fake the gate
+  return $rc
+}
+
+while true; do
+  log "probing backend (window ${PROBE_WINDOW}s)..."
+  if probe "$PROBE_WINDOW"; then
     log "chip is UP — running the TPU bench set"
     ok=1
     # patience >= claim_window(1560)+120: bench's derived probe timeout
@@ -33,23 +64,29 @@ EOF
     # poison cycle this loop exists to break)
     PAT=1700
     # headline SDXL 1024
-    timeout 4200 python bench.py --init-patience $PAT \
-      --out benchmarks/sdxl_tpu_r4.json || ok=0
+    run_gated 4200 python bench.py --init-patience $PAT \
+      --out benchmarks/sdxl_tpu_r4.json; rc=$?
+    [ $rc = $GATE_RC ] && continue; [ $rc != 0 ] && ok=0
     # BASELINE config 2: SDXL 1024 batch=8 (the fan-out batch shape)
-    timeout 4200 python bench.py --init-patience $PAT --batch 8 \
-      --out benchmarks/sdxl_b8_tpu_r4.json || ok=0
+    run_gated 4200 python bench.py --init-patience $PAT --batch 8 \
+      --out benchmarks/sdxl_b8_tpu_r4.json; rc=$?
+    [ $rc = $GATE_RC ] && continue; [ $rc != 0 ] && ok=0
     # pallas flash kernel vs xla, same workload
-    timeout 4200 python bench.py --init-patience $PAT --attn pallas \
-      --out benchmarks/sdxl_pallas_tpu_r4.json || ok=0
+    run_gated 4200 python bench.py --init-patience $PAT --attn pallas \
+      --out benchmarks/sdxl_pallas_tpu_r4.json; rc=$?
+    [ $rc = $GATE_RC ] && continue; [ $rc != 0 ] && ok=0
     # on-chip pallas parity + VMEM fallback (VERDICT r3 #2)
-    timeout 1200 python benchmarks/pallas_onchip_check.py \
-      benchmarks/pallas_parity_tpu_r4.json || ok=0
+    run_gated 1200 python benchmarks/pallas_onchip_check.py \
+      benchmarks/pallas_parity_tpu_r4.json; rc=$?
+    [ $rc = $GATE_RC ] && continue; [ $rc != 0 ] && ok=0
     # SD1.5 tiled upscale + img2img fixtures
-    timeout 4200 python bench.py --init-patience $PAT --upscale \
-      --out benchmarks/upscale_tpu_r4.json || ok=0
-    timeout 4200 python bench.py --init-patience $PAT --img2img \
+    run_gated 4200 python bench.py --init-patience $PAT --upscale \
+      --out benchmarks/upscale_tpu_r4.json; rc=$?
+    [ $rc = $GATE_RC ] && continue; [ $rc != 0 ] && ok=0
+    run_gated 4200 python bench.py --init-patience $PAT --img2img \
       --family sd15 --height 512 --width 512 \
-      --out benchmarks/img2img_tpu_r4.json || ok=0
+      --out benchmarks/img2img_tpu_r4.json; rc=$?
+    [ $rc = $GATE_RC ] && continue; [ $rc != 0 ] && ok=0
     if [ "$ok" = 1 ]; then
       touch "$SENTINEL"
       log "full TPU set done; exiting"

@@ -18,7 +18,9 @@ from comfyui_distributed_tpu.models.schedules import DiscreteSchedule
 
 def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
                   prediction_type: str = "eps",
-                  control: Optional[tuple] = None) -> Callable:
+                  control: Optional[tuple] = None,
+                  capture: bool = False,
+                  concat: Optional[jax.Array] = None) -> Callable:
     """Build ``model(x, sigma, context=..., y=...) -> denoised``.
 
     ``apply_fn(params, x, timesteps, context, y, control)`` is the raw
@@ -30,6 +32,15 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
     ([cond_1..cond_N, uncond_1..uncond_M] — registry.sample composes it):
     ComfyUI attaches a ControlNet to individual conditioning entries, so
     a control on one entry must only steer that entry's rows.
+
+    ``capture``: ``apply_fn`` returns ``(prediction, attn_probs)`` (a
+    sow-capturing apply — SAG) and the denoiser returns ``(denoised,
+    attn_probs)``.
+
+    ``concat`` [B_base, h, w, K]: inpaint-model channels ([mask,
+    masked-image latent]) appended to every call's scaled input along
+    the channel axis — NOT noise-scaled (they are clean latents), and
+    tiled over the CFG-stacked batch like the control hint.
     """
     log_sigmas = jnp.asarray(jnp.log(jnp.asarray(ds.sigmas)))
 
@@ -71,16 +82,26 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
             else:
                 scale = strength
             ctrl = ([o * scale for o in outs], mid * scale)
-        eps_or_v = apply_fn(params, xin, ts, context, y, ctrl)
+        if concat is not None:
+            # AFTER the control block: a ControlNet sees the plain
+            # 4-channel scaled input, only the UNet gets the 9 channels
+            creps = xin.shape[0] // concat.shape[0]
+            cb = jnp.concatenate([concat] * creps, axis=0) \
+                if creps > 1 else concat
+            xin = jnp.concatenate([xin, cb.astype(xin.dtype)], axis=-1)
+        out = apply_fn(params, xin, ts, context, y, ctrl)
+        eps_or_v, probs = out if capture else (out, None)
         if prediction_type == "v":
             # v-prediction: denoised = c_skip*x - c_out*v  (VP parameterization)
             c_skip = 1.0 / (sigma ** 2 + 1.0)
             c_out = sigma / jnp.sqrt(sigma ** 2 + 1.0)
-            return x * c_skip - eps_or_v * c_out
-        if prediction_type == "x0":
+            den = x * c_skip - eps_or_v * c_out
+        elif prediction_type == "x0":
             # the model predicts the clean sample directly
             # (ModelSamplingDiscrete sampling="x0")
-            return eps_or_v
-        return x - eps_or_v * sigma
+            den = eps_or_v
+        else:
+            den = x - eps_or_v * sigma
+        return (den, probs) if capture else den
 
     return denoiser

@@ -65,6 +65,11 @@ class Attention(nn.Module):
     head_dim: Optional[int] = None
     dtype: Dtype = jnp.bfloat16
     attn_impl: str = "xla"
+    # SAG capture: materialize + sow the softmax weights so the sampler
+    # can read them back (mutable=["intermediates"]).  Only the UNet
+    # mid-block's self-attention sets this — its token count is small,
+    # so the explicit [B, H, N, N] weights are cheap
+    sow_probs: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -84,7 +89,17 @@ class Attention(nn.Module):
         k = k.reshape(B, M, self.num_heads, hd)
         v = v.reshape(B, M, self.num_heads, hd)
 
-        out = scaled_dot_product_attention(q, k, v, impl=self.attn_impl)
+        if self.sow_probs:
+            logits = jnp.einsum("bnhd,bmhd->bhnm", q, k,
+                                preferred_element_type=jnp.float32) \
+                * (1.0 / math.sqrt(hd))
+            weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            self.sow("intermediates", "attn_probs", weights)
+            out = jnp.einsum("bhnm,bmhd->bnhd", weights.astype(v.dtype),
+                             v)
+        else:
+            out = scaled_dot_product_attention(q, k, v,
+                                               impl=self.attn_impl)
         out = out.reshape(B, N, inner)
         return nn.Dense(c, dtype=self.dtype, name="to_out")(out)
 
@@ -206,11 +221,13 @@ class TransformerBlock(nn.Module):
     num_heads: int
     dtype: Dtype = jnp.bfloat16
     attn_impl: str = "xla"
+    sow_probs: bool = False        # SAG: capture attn1's softmax weights
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
         x = x + Attention(self.num_heads, dtype=self.dtype,
-                          attn_impl=self.attn_impl, name="attn1")(
+                          attn_impl=self.attn_impl,
+                          sow_probs=self.sow_probs, name="attn1")(
             nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(x))
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           attn_impl=self.attn_impl, name="attn2")(
@@ -246,6 +263,7 @@ class SpatialTransformer(nn.Module):
     dtype: Dtype = jnp.bfloat16
     attn_impl: str = "xla"
     hypertile_tile: int = 0
+    sow_probs: bool = False        # SAG: first block's attn1 sows
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
@@ -271,6 +289,7 @@ class SpatialTransformer(nn.Module):
         for i in range(self.depth):
             h = TransformerBlock(self.num_heads, dtype=self.dtype,
                                  attn_impl=self.attn_impl,
+                                 sow_probs=self.sow_probs and i == 0,
                                  name=f"blocks_{i}")(h, ctx)
         if nh * nw > 1:
             th, tw = H // nh, W // nw

@@ -73,9 +73,24 @@ FAMILIES: Dict[str, ModelFamily] = {
         vae=vae_mod.SD_VAE_CONFIG,
         clips=(clip_mod.OPEN_CLIP_H_CONFIG,),
     ),
+    # inpaint model lines: the UNet consumes [latent(4), mask(1),
+    # masked-image latent(4)] = 9 input channels (RunwayML
+    # sd-v1.5-inpainting layout); everything else matches the base family
+    "sd15_inpaint": ModelFamily(
+        name="sd15_inpaint",
+        unet=dataclasses.replace(unet_mod.SD15_CONFIG, in_channels=9),
+        vae=vae_mod.SD_VAE_CONFIG,
+        clips=(clip_mod.CLIP_L_CONFIG,),
+    ),
     "tiny": ModelFamily(
         name="tiny",
         unet=unet_mod.TINY_CONFIG,
+        vae=vae_mod.TINY_VAE_CONFIG,
+        clips=(clip_mod.TINY_CLIP_CONFIG,),
+    ),
+    "tiny_inpaint": ModelFamily(
+        name="tiny_inpaint",
+        unet=dataclasses.replace(unet_mod.TINY_CONFIG, in_channels=9),
         vae=vae_mod.TINY_VAE_CONFIG,
         clips=(clip_mod.TINY_CLIP_CONFIG,),
     ),
@@ -103,9 +118,12 @@ def detect_family(ckpt_name: str) -> str:
         return env
     lowered = ckpt_name.lower()
     if "tiny" in lowered or "test" in lowered:
-        return "tiny"
+        return "tiny_inpaint" if "inpaint" in lowered else "tiny"
     if "xl" in lowered:
         return "sdxl"
+    if "inpaint" in lowered:
+        # sd-v1-5-inpainting / *-inpainting finetunes (9-channel UNet)
+        return "sd15_inpaint"
     # Stability SD2 naming only — a bare "v2" would misroute SD1.5
     # community finetunes like anything-v2 / counterfeit-v2.5
     if ("sd2" in lowered or "v2-0" in lowered or "v2-1" in lowered
@@ -273,6 +291,22 @@ class DiffusionPipeline:
         return self.unet.apply({"params": params}, x, t, context, y=y,
                                control=control)
 
+    def raw_unet_apply_capture(self, params, x, t, context, y=None,
+                               control=None):
+        """Like raw_unet_apply but returns (prediction, attn_probs): the
+        sag_capture family flag makes the mid-block attn1 sow its
+        softmax weights (SAG's blur mask source)."""
+        out, inters = self.unet.apply(
+            {"params": params}, x, t, context, y=y, control=control,
+            mutable=["intermediates"])
+        leaves = jax.tree_util.tree_leaves(inters)
+        if len(leaves) != 1:
+            raise RuntimeError(
+                f"SAG capture expected exactly one sown attn-probs "
+                f"tensor, got {len(leaves)} (is sag_capture set on the "
+                "family?)")
+        return out, leaves[0]
+
     def denoiser(self):
         return make_denoiser(self.raw_unet_apply, self.unet_params,
                              self.schedule, self.prediction_type)
@@ -289,7 +323,8 @@ class DiffusionPipeline:
                sigmas_override=None,
                middle_context=None,
                cfg2: float = 1.0,
-               guidance: str = "dual") -> jnp.ndarray:
+               guidance: str = "dual",
+               c_concat=None) -> jnp.ndarray:
         """Full ksampler: schedule -> noise -> scan-sampler -> latents.
 
         ``seeds``: per-sample host seed array [B] (64-bit ok; replica offsets
@@ -375,6 +410,25 @@ class DiffusionPipeline:
                           else None) for c, m, s, sr in entries)
 
         cfg_rescale = float(getattr(self, "cfg_rescale", 0.0) or 0.0)
+        sag = getattr(self, "sag_params", None)
+        sag_ok = False
+        if sag is not None:
+            sag_ok = (not dual and float(cfg) != 1.0
+                      and len(conds) == 1 and len(unconds) == 1
+                      and control is None
+                      and not any(m is not None or s != 1.0
+                                  or sr is not None
+                                  for _, m, s, sr in conds + unconds))
+            if not sag_ok:
+                log("SAG: unsupported combination (regional/dual/"
+                    "control/cfg==1); sampling WITHOUT self-attention "
+                    "guidance")
+        if sag_ok:
+            # mid-block spatial dims (stride-2 SAME convs: ceil halving
+            # per level) — the attn-probs token grid the mask reshapes to
+            mh, mw = int(latents.shape[1]), int(latents.shape[2])
+            for _ in range(self.family.unet.num_levels - 1):
+                mh, mw = (mh + 1) // 2, (mw + 1) // 2
         y_is_list = isinstance(y, (list, tuple))
         static_key = ("sample", sampler_name, scheduler, steps,
                       sigmas_override is not None,
@@ -384,6 +438,10 @@ class DiffusionPipeline:
                       _entries_key(unconds),
                       polling_enabled(), start, end, dual, float(cfg2),
                       guidance,
+                      (tuple(float(v) for v in sag), ) if sag_ok else (),
+                      c_concat is not None,
+                      tuple(c_concat.shape) if c_concat is not None
+                      else (),
                       bool(force_full_denoise), noise_mask is not None,
                       control is not None,
                       _strength_key(control[3]) if control is not None
@@ -406,8 +464,11 @@ class DiffusionPipeline:
                     return cn_module.apply({"params": p}, xi, ts, ctx,
                                            hint, y_in)
 
+            has_concat = c_concat is not None
+
             def core(unet_params, latents, ctx_list, area_list,
-                     keys, sigmas, y_in, mask_in, cn_params, hint_in):
+                     keys, sigmas, y_in, mask_in, cn_params, hint_in,
+                     concat_in):
                 ctrl_spec = None
                 if has_control:
                     sk = _strength_key(cn_strength)
@@ -421,7 +482,8 @@ class DiffusionPipeline:
                     ctrl_spec = (cn_apply, cn_params, hint_in, sk)
                 den = make_denoiser(
                     self.raw_unet_apply, unet_params, self.schedule,
-                    self.prediction_type, control=ctrl_spec)
+                    self.prediction_type, control=ctrl_spec,
+                    concat=concat_in if has_concat else None)
                 entries = [(ctx_list[i],
                             area_list[i] if has_area[i] else None,
                             strengths[i], sranges[i])
@@ -434,6 +496,17 @@ class DiffusionPipeline:
                         den, ctx_list[0], ctx_list[1], ctx_list[2],
                         cfg_scale, float(cfg2), cfg_rescale=cfg_rescale)
                     reps = 3
+                elif sag_ok:
+                    den_cap = make_denoiser(
+                        self.raw_unet_apply_capture, unet_params,
+                        self.schedule, self.prediction_type,
+                        capture=True,
+                        concat=concat_in if has_concat else None)
+                    model = smp.cfg_denoiser_sag(
+                        den_cap, den, ctx_list[0], ctx_list[1],
+                        cfg_scale, float(sag[0]), float(sag[1]),
+                        (mh, mw), cfg_rescale=cfg_rescale)
+                    reps = 2
                 else:
                     model = smp.cfg_denoiser_multi(den, entries[:n_conds],
                                                    entries[n_conds:],
@@ -501,9 +574,11 @@ class DiffusionPipeline:
         area_list = [jnp.asarray(m) if m is not None
                      else jnp.ones((1, 1, 1, 1))
                      for _, m, _, _ in conds + unconds]
+        concat_arg = c_concat if c_concat is not None \
+            else jnp.zeros((1, 1, 1, 1))
         return core(self.unet_params, latents, ctx_list, area_list,
                     keys, sigmas, y_arg, mask_arg,
-                    cn_params_arg, hint_arg)
+                    cn_params_arg, hint_arg, concat_arg)
 
     # --- internals ----------------------------------------------------------
 
@@ -596,11 +671,12 @@ def load_pipeline(ckpt_name: str, models_dir: Optional[str] = None,
         log(f"loaded checkpoint {ckpt_name} ({fam.name}) from {path}")
     else:
         seed = _name_seed(ckpt_name)
-        lat = fam.latent_channels
         ds = fam.vae.downscale
         h = w = 8 * ds
         ctx_dim = fam.unet.context_dim
-        x = jnp.zeros((1, h // ds, w // ds, lat))
+        # the UNet's input width, not the latent width: inpaint models
+        # consume [latent, mask, masked-latent] = 9 channels
+        x = jnp.zeros((1, h // ds, w // ds, fam.unet.in_channels))
         ts = jnp.zeros((1,))
         ctx = jnp.zeros((1, 77, ctx_dim))
         unet_p = _virtual_params(unet_mod.UNet(fam.unet), seed, x, ts, ctx)

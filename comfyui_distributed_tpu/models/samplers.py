@@ -1229,6 +1229,73 @@ def cfg_denoiser_dual(model: Model, cond: jax.Array, middle: jax.Array,
     return wrapped
 
 
+def _gaussian_blur_nhwc(x: jax.Array, ksize: int = 9,
+                        sigma: float = 2.0) -> jax.Array:
+    """Separable gaussian blur with reflect padding (the SAG reference's
+    gaussian_blur_2d), [B, H, W, C]."""
+    r = ksize // 2
+    xs = jnp.arange(-r, r + 1, dtype=jnp.float32)
+    k = jnp.exp(-(xs ** 2) / max(2.0 * sigma * sigma, 1e-8))
+    k = (k / k.sum()).astype(x.dtype)
+    h = jnp.pad(x, ((0, 0), (r, r), (0, 0), (0, 0)), mode="reflect")
+    x = sum(k[i] * h[:, i:i + x.shape[1]] for i in range(ksize))
+    h = jnp.pad(x, ((0, 0), (0, 0), (r, r), (0, 0)), mode="reflect")
+    return sum(k[i] * h[:, :, i:i + x.shape[2]] for i in range(ksize))
+
+
+def cfg_denoiser_sag(model_capture: Model, model_plain: Model,
+                     cond: jax.Array, uncond: jax.Array,
+                     cfg_scale: float, sag_scale: float,
+                     blur_sigma: float, mid_hw: tuple,
+                     cfg_rescale: float = 0.0) -> Model:
+    """Self-Attention Guidance (Hong et al.; the reference ecosystem's
+    SelfAttentionGuidance patch): per step, the stacked CFG call also
+    captures the mid-block self-attention weights; tokens the UNCOND
+    pass attends strongly (mean over heads, summed over queries > 1)
+    mark where the uncond denoised image gets gaussian-blurred, the
+    degraded latent is re-noised and denoised once more under the
+    uncond prompt, and the result steers away from what degradation
+    would produce:
+
+        out = cfg(cond, uncond) + sag_scale * (degraded - den_degraded)
+
+    (the reference's post-CFG combine; in eps-space this is the paper's
+    s*(eps(x̂) - eps(x)) direction).  3 UNet evals per step, like the
+    reference."""
+    mh, mw = mid_hw
+
+    def wrapped(x, sigma, **extra):
+        B = x.shape[0]
+        x_rep = jnp.concatenate([x, x], axis=0)
+        ctx = jnp.concatenate([cond, uncond], axis=0)
+        out, probs = model_capture(x_rep, sigma, context=ctx, **extra)
+        den_cond, den_unc = jnp.split(out, 2, axis=0)
+        # probs [2B, heads, N, N]: uncond rows second; mean over heads,
+        # sum over the QUERY axis -> per-key attention mass
+        a = probs[B:].mean(axis=1).sum(axis=1)          # [B, N]
+        mask = (a > 1.0).astype(x.dtype)
+        mask = mask.reshape(B, mh, mw, 1)
+        mask = jax.image.resize(mask, (B, x.shape[1], x.shape[2], 1),
+                                method="nearest")
+        blurred = _gaussian_blur_nhwc(den_unc, 9, float(blur_sigma))
+        degraded = blurred * mask + den_unc * (1.0 - mask)
+        # re-noise the degraded estimate to the current level and run
+        # one more UNCOND denoise on it
+        degraded_noised = degraded + x - den_unc
+        extra_1 = dict(extra)
+        if extra_1.get("y") is not None:
+            extra_1["y"] = extra_1["y"][B:2 * B]
+        den_sag = model_plain(degraded_noised, sigma, context=uncond,
+                              **extra_1)
+        if cfg_rescale:
+            cfg_out = _rescale_cfg(x, sigma, den_cond, den_unc,
+                                   cfg_scale, cfg_rescale)
+        else:
+            cfg_out = den_unc + (den_cond - den_unc) * cfg_scale
+        return cfg_out + (degraded - den_sag) * sag_scale
+    return wrapped
+
+
 def cfg_denoiser_perp_neg(model: Model, cond: jax.Array,
                           empty: jax.Array, uncond: jax.Array,
                           cfg_scale: float, neg_scale: float,

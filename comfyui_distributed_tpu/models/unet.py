@@ -59,6 +59,9 @@ class UNetConfig:
     # blocks (models/layers.py SpatialTransformer).  Static config like
     # freeu: each setting compiles its own executable
     hypertile: Optional[Tuple[int, int, bool]] = None
+    # SAG: the mid-block's first self-attention materializes + sows its
+    # softmax weights for the sampler's blur mask (models/layers.py)
+    sag_capture: bool = False
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     prediction_type: str = "eps"  # "eps" | "v"
@@ -218,6 +221,7 @@ class UNet(nn.Module):
             heads(mid_ch), depth=max(cfg.transformer_depth[-1], 1),
             dtype=cfg.dtype, attn_impl=cfg.attn_impl,
             hypertile_tile=ht_tile(cfg.num_levels - 1),
+            sow_probs=cfg.sag_capture,
             name="mid_attn")(h, context)
         h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_1")(h, emb)
         if control is not None:

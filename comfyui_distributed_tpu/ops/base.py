@@ -41,6 +41,10 @@ class Conditioning:
     # sampling-percent pair, 0.0 = start of sampling, 1.0 = end; the
     # entry contributes only while the step sigma is inside the range
     timestep_range: Any = None
+    # inpaint-MODEL channels (InpaintModelConditioning): [1_or_B, h, w,
+    # 1 + C] latent-resolution array of [mask, masked-image latent],
+    # concatenated to the UNet input every call (9-channel families)
+    concat_latent: Any = None
     # SDXL size conditioning (CLIPTextEncodeSDXL / ...Refiner): tuple of
     # scalars each embedded at 256 sinusoidal dims and appended to the
     # pooled text emb in the ADM vector — base order (height, width,

@@ -167,6 +167,29 @@ class HyperTile(Op):
 
 
 @register_op
+class SelfAttentionGuidance(Op):
+    """SAG (Hong et al.): blur what the model itself attends to, denoise
+    the degraded latent once more, and steer away from it — the
+    reference ecosystem's SelfAttentionGuidance patch.  Derived pipeline
+    with mid-block attention capture baked into the (static) UNet
+    config; 3 UNet evals per step."""
+    TYPE = "SelfAttentionGuidance"
+    WIDGETS = ["scale", "blur_sigma"]
+    DEFAULTS = {"scale": 0.5, "blur_sigma": 2.0}
+
+    def execute(self, ctx: OpContext, model, scale: float = 0.5,
+                blur_sigma: float = 2.0):
+        fam = model.family
+        fam2 = dataclasses.replace(fam, unet=dataclasses.replace(
+            fam.unet, sag_capture=True))
+        tag = f"sag:{float(scale)}:{float(blur_sigma)}"
+        return (registry.derive_pipeline(
+            model, tag, family=fam2,
+            extra_attrs={"sag_params": (float(scale),
+                                        float(blur_sigma))}),)
+
+
+@register_op
 class PerpNeg(Op):
     """ComfyUI's PerpNeg model patch: sampling evaluates a third, EMPTY
     conditioning and subtracts only the negative's perpendicular
@@ -474,7 +497,7 @@ class SamplerCustom(Op):
                 noise_mask=prep.noise_mask, control=prep.control,
                 sigmas_override=np.asarray(sigmas, np.float32),
                 middle_context=prep.mid_context, cfg2=prep.cfg2,
-                guidance=prep.guidance)
+                guidance=prep.guidance, c_concat=prep.c_concat)
         out_d = {"samples": out, **_latent_meta(latent_image),
                  "local_batch": prep.local_batch, "fanout": prep.fanout}
         return (out_d, dict(out_d))
@@ -632,7 +655,7 @@ class SamplerCustomAdvanced(Op):
                 control=prep.control,
                 sigmas_override=np.asarray(sigmas, np.float32),
                 middle_context=prep.mid_context, cfg2=cfg2,
-                guidance=guidance)
+                guidance=guidance, c_concat=prep.c_concat)
         out_d = {"samples": out, **_latent_meta(latent_image),
                  "local_batch": prep.local_batch, "fanout": prep.fanout}
         return (out_d, dict(out_d))
@@ -663,7 +686,7 @@ class KSampler(Op):
                 sample_idx=prep.sample_idx,
                 noise_mask=prep.noise_mask, control=prep.control,
                 middle_context=prep.mid_context, cfg2=prep.cfg2,
-                guidance=prep.guidance)
+                guidance=prep.guidance, c_concat=prep.c_concat)
         out_d = {"samples": out, "local_batch": prep.local_batch,
                  "fanout": prep.fanout}
         if "noise_mask" in latent_image:   # ComfyUI keeps the mask on the
@@ -706,7 +729,7 @@ class KSamplerAdvanced(Op):
                 force_full_denoise=(
                     str(return_with_leftover_noise) == "disable"),
                 middle_context=prep.mid_context, cfg2=prep.cfg2,
-                guidance=prep.guidance)
+                guidance=prep.guidance, c_concat=prep.c_concat)
         out_d = {"samples": out, "local_batch": prep.local_batch,
                  "fanout": prep.fanout}
         if "noise_mask" in latent_image:
@@ -801,6 +824,8 @@ class _SampleInputs:
     mid_context: object = None
     guidance: str = "dual"
     cfg2: float = 1.0
+    # inpaint-model channels (Conditioning.concat_latent), batch-matched
+    c_concat: object = None
 
 
 def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
@@ -1005,12 +1030,28 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
             m = coll.shard_batch(m, mesh)
         mask = jnp.asarray(m)
 
+    # inpaint-MODEL channels: any conditioning entry may carry them
+    # (ComfyUI sets them on positive AND negative); one array rides every
+    # model call, cycled to the fanned batch like the control hint
+    c_concat = next((getattr(e, "concat_latent", None)
+                     for e in all_entries
+                     if getattr(e, "concat_latent", None) is not None),
+                    None)
+    if c_concat is not None:
+        cc = np.asarray(c_concat, np.float32)
+        if cc.shape[1:3] != (lat.shape[1], lat.shape[2]):
+            cc = resize_image(cc, lat.shape[2], lat.shape[1], "bilinear")
+        cc = _cycle_batch(cc, total)
+        if fanout > 1 and mesh is not None:
+            cc = coll.shard_batch(cc, mesh)
+        c_concat = jnp.asarray(cc)
+
     return _SampleInputs(latents=jnp.asarray(lat_dev), context=ctx_arr,
                          uncond=unc_arr, seeds=seeds, sample_idx=local_idx,
                          y=y, local_batch=local_b, fanout=fanout,
                          noise_mask=mask, control=control,
                          mid_context=mid_ctx, guidance=guidance,
-                         cfg2=cfg2)
+                         cfg2=cfg2, c_concat=c_concat)
 
 
 def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,
@@ -1323,9 +1364,10 @@ class ImageInvert(Op):
 
 
 @register_op
-class ImageBatch(Op):
+class ImageBatchOp(Op):
     """Concatenate two image batches; the second resizes to the first's
-    dims when they differ (ComfyUI bilinear)."""
+    dims when they differ (ComfyUI bilinear).  (Class named ...Op: the
+    module's ``ImageBatch`` is the fan-out-metadata ndarray wrapper.)"""
     TYPE = "ImageBatch"
 
     def execute(self, ctx: OpContext, image1, image2):
@@ -1544,6 +1586,46 @@ class VAEEncodeForInpaint(Op):
         return (out_d,)
 
 
+@register_op
+class InpaintModelConditioning(Op):
+    """ComfyUI's inpaint-MODEL prep (9-channel checkpoints like
+    sd-v1-5-inpainting): encode BOTH the original pixels (the sampled
+    latent) and a masked-neutralized copy (the UNet's extra concat
+    channels), attach [mask, masked-latent] to both conditionings, and
+    optionally ride the mask as a noise_mask too."""
+    TYPE = "InpaintModelConditioning"
+    WIDGETS = ["noise_mask"]
+    DEFAULTS = {"noise_mask": True}
+
+    def execute(self, ctx: OpContext, positive: Conditioning,
+                negative: Conditioning, vae, pixels, mask,
+                noise_mask=True):
+        img = np.asarray(as_image_array(pixels), np.float32)
+        m = np.asarray(mask, np.float32)
+        if m.ndim == 2:
+            m = m[None]
+        if m.shape[1:3] != img.shape[1:3]:
+            m = resize_image(m[..., None], img.shape[2],
+                             img.shape[1], "bilinear")[..., 0]
+        hard = (m > 0.5).astype(np.float32)
+        neutral = (img - 0.5) * (1.0 - hard[..., None]) + 0.5
+        with Timer("inpaint_model_cond_encode"):
+            orig_lat = np.asarray(vae.vae_encode(jnp.asarray(img)),
+                                  np.float32)
+            masked_lat = np.asarray(vae.vae_encode(jnp.asarray(neutral)),
+                                    np.float32)
+        h, w = orig_lat.shape[1], orig_lat.shape[2]
+        m_lat = _image_mask_to_latent(m, h, w, orig_lat.shape[0])
+        m_lat = _cycle_batch(m_lat, orig_lat.shape[0])
+        concat = np.concatenate([m_lat, masked_lat], axis=-1)
+        pos2 = dataclasses.replace(positive, concat_latent=concat)
+        neg2 = dataclasses.replace(negative, concat_latent=concat)
+        (out_d,) = _expand_encoded_latent(ctx, pixels, orig_lat)
+        if str(noise_mask).lower() not in ("false", "0", ""):
+            out_d["noise_mask"] = m
+        return (pos2, neg2, out_d)
+
+
 class ImageBatch(np.ndarray):
     """IMAGE ndarray carrying fan-out metadata through image-space ops."""
 
@@ -1728,6 +1810,223 @@ def _set_area_on_all(cond: Conditioning, area, strength: float):
         siblings=tuple(dataclasses.replace(s, area_mask=area,
                                            area_strength=strength)
                        for s in cond.siblings))
+
+
+@register_op
+class LatentFlip(Op):
+    TYPE = "LatentFlip"
+    WIDGETS = ["flip_method"]
+    DEFAULTS = {"flip_method": "x-axis: vertically"}
+
+    def execute(self, ctx: OpContext, samples,
+                flip_method: str = "x-axis: vertically"):
+        lat = np.asarray(samples["samples"], np.float32)
+        axis = 1 if str(flip_method).startswith("x") else 2
+        return ({**_latent_meta(samples),
+                 "samples": np.flip(lat, axis=axis).copy()},)
+
+
+@register_op
+class LatentRotate(Op):
+    TYPE = "LatentRotate"
+    WIDGETS = ["rotation"]
+    DEFAULTS = {"rotation": "none"}
+
+    def execute(self, ctx: OpContext, samples, rotation: str = "none"):
+        lat = np.asarray(samples["samples"], np.float32)
+        r = str(rotation)
+        k = 0
+        if r.startswith("90"):
+            k = 3          # 90 deg clockwise (ComfyUI's orientation)
+        elif r.startswith("180"):
+            k = 2
+        elif r.startswith("270"):
+            k = 1
+        out = np.rot90(lat, k=k, axes=(1, 2)).copy() if k else lat
+        return ({**_latent_meta(samples), "samples": out},)
+
+
+@register_op
+class LatentCrop(Op):
+    """Crop a latent batch; x/y/width/height are PIXELS, //8 to latent
+    units (ComfyUI convention)."""
+    TYPE = "LatentCrop"
+    WIDGETS = ["width", "height", "x", "y"]
+
+    def execute(self, ctx: OpContext, samples, width: int, height: int,
+                x: int = 0, y: int = 0):
+        lat = np.asarray(samples["samples"], np.float32)
+        H, W = lat.shape[1], lat.shape[2]
+        w = max(int(width) // 8, 1)
+        h = max(int(height) // 8, 1)
+        x0 = min(max(int(x) // 8, 0), max(W - w, 0))
+        y0 = min(max(int(y) // 8, 0), max(H - h, 0))
+        out = lat[:, y0:y0 + h, x0:x0 + w]
+        return ({**_latent_meta(samples), "samples": out.copy()},)
+
+
+@register_op
+class LatentBlend(Op):
+    """samples1 * blend_factor + samples2 * (1 - blend_factor); the
+    second latent resizes to the first's dims when they differ."""
+    TYPE = "LatentBlend"
+    WIDGETS = ["blend_factor"]
+    DEFAULTS = {"blend_factor": 0.5}
+
+    def execute(self, ctx: OpContext, samples1, samples2,
+                blend_factor: float = 0.5):
+        a = np.asarray(samples1["samples"], np.float32)
+        b = np.asarray(samples2["samples"], np.float32)
+        if a.shape[1:3] != b.shape[1:3]:
+            b = resize_image(b, a.shape[2], a.shape[1], "bilinear")
+        b = _cycle_batch(b, a.shape[0])
+        f = float(blend_factor)
+        return ({**_latent_meta(samples1), "samples": a * f
+                 + b * (1.0 - f)},)
+
+
+@register_op
+class LatentBatch(Op):
+    """Concatenate two latent batches (the second spatially resizes to
+    the first).  The result is a plain re-batched latent — fan-out meta
+    does not survive an arbitrary concat."""
+    TYPE = "LatentBatch"
+
+    def execute(self, ctx: OpContext, samples1, samples2):
+        a = np.asarray(samples1["samples"], np.float32)
+        b = np.asarray(samples2["samples"], np.float32)
+        if a.shape[1:3] != b.shape[1:3]:
+            b = resize_image(b, a.shape[2], a.shape[1], "bilinear")
+        return ({"samples": np.concatenate([a, b], axis=0)},)
+
+
+@register_op
+class ConditioningZeroOut(Op):
+    """Zero the context and pooled outputs (ComfyUI's 'negative that is
+    truly nothing' — SDXL-refiner style unconditional)."""
+    TYPE = "ConditioningZeroOut"
+
+    def execute(self, ctx: OpContext, conditioning: Conditioning):
+        z = dataclasses.replace(
+            conditioning,
+            context=jnp.zeros_like(jnp.asarray(conditioning.context)),
+            pooled=(jnp.zeros_like(jnp.asarray(conditioning.pooled))
+                    if conditioning.pooled is not None else None),
+            siblings=tuple(
+                dataclasses.replace(
+                    s, context=jnp.zeros_like(jnp.asarray(s.context)),
+                    pooled=(jnp.zeros_like(jnp.asarray(s.pooled))
+                            if s.pooled is not None else None))
+                for s in getattr(conditioning, "siblings", ()) or ()))
+        return (z,)
+
+
+@register_op
+class ConditioningSetAreaStrength(Op):
+    TYPE = "ConditioningSetAreaStrength"
+    WIDGETS = ["strength"]
+    DEFAULTS = {"strength": 1.0}
+
+    def execute(self, ctx: OpContext, conditioning: Conditioning,
+                strength: float = 1.0):
+        s = float(strength)
+        return (dataclasses.replace(
+            conditioning, area_strength=s,
+            siblings=tuple(dataclasses.replace(e, area_strength=s)
+                           for e in getattr(conditioning, "siblings",
+                                            ()) or ())),)
+
+
+def _gaussian_kernel(radius: int, sigma: float) -> np.ndarray:
+    xs = np.arange(-radius, radius + 1, dtype=np.float32)
+    k = np.exp(-(xs ** 2) / max(2.0 * sigma * sigma, 1e-8))
+    return k / k.sum()
+
+
+def _gaussian_blur(img: np.ndarray, radius: int,
+                   sigma: float) -> np.ndarray:
+    """Separable gaussian blur, reflect padding (ComfyUI's ImageBlur
+    border convention), [B,H,W,C]."""
+    k = _gaussian_kernel(radius, sigma)
+    pad = [(0, 0), (radius, radius), (0, 0), (0, 0)]
+    x = np.pad(img, pad, mode="reflect")
+    x = sum(k[i] * x[:, i:i + img.shape[1]] for i in range(len(k)))
+    pad = [(0, 0), (0, 0), (radius, radius), (0, 0)]
+    x = np.pad(x, pad, mode="reflect")
+    return sum(k[i] * x[:, :, i:i + img.shape[2]] for i in range(len(k)))
+
+
+@register_op
+class ImageBlur(Op):
+    TYPE = "ImageBlur"
+    WIDGETS = ["blur_radius", "sigma"]
+    DEFAULTS = {"blur_radius": 1, "sigma": 1.0}
+
+    def execute(self, ctx: OpContext, image, blur_radius: int = 1,
+                sigma: float = 1.0):
+        img = as_image_array(image)
+        r = int(blur_radius)
+        if r < 1:
+            return (img,)
+        return (_gaussian_blur(img, r, float(sigma)).astype(np.float32),)
+
+
+@register_op
+class ImageSharpen(Op):
+    """Unsharp mask: image + alpha * (image - gaussian_blur(image))."""
+    TYPE = "ImageSharpen"
+    WIDGETS = ["sharpen_radius", "sigma", "alpha"]
+    DEFAULTS = {"sharpen_radius": 1, "sigma": 1.0, "alpha": 1.0}
+
+    def execute(self, ctx: OpContext, image, sharpen_radius: int = 1,
+                sigma: float = 1.0, alpha: float = 1.0):
+        img = as_image_array(image)
+        r = int(sharpen_radius)
+        if r < 1:
+            return (img,)
+        blurred = _gaussian_blur(img, r, float(sigma))
+        out = img + float(alpha) * (img - blurred)
+        return (np.clip(out, 0.0, 1.0).astype(np.float32),)
+
+
+@register_op
+class ImageQuantize(Op):
+    """Reduce to ``colors`` palette entries via PIL quantization
+    (dither: none / floyd-steinberg)."""
+    TYPE = "ImageQuantize"
+    WIDGETS = ["colors", "dither"]
+    DEFAULTS = {"colors": 256, "dither": "floyd-steinberg"}
+
+    def execute(self, ctx: OpContext, image, colors: int = 256,
+                dither: str = "floyd-steinberg"):
+        from PIL import Image
+        img = as_image_array(image)
+        dm = Image.Dither.FLOYDSTEINBERG \
+            if str(dither).startswith("floyd") else Image.Dither.NONE
+        out = []
+        for frame in img:
+            pil = Image.fromarray(
+                (np.clip(frame, 0, 1) * 255).astype(np.uint8))
+            q = pil.quantize(colors=max(int(colors), 1), dither=dm)
+            out.append(np.asarray(q.convert("RGB"), np.float32) / 255.0)
+        return (np.stack(out),)
+
+
+@register_op
+class ImageScaleToTotalPixels(Op):
+    TYPE = "ImageScaleToTotalPixels"
+    WIDGETS = ["upscale_method", "megapixels"]
+    DEFAULTS = {"upscale_method": "lanczos", "megapixels": 1.0}
+
+    def execute(self, ctx: OpContext, image,
+                upscale_method: str = "lanczos",
+                megapixels: float = 1.0):
+        img = as_image_array(image)
+        H, W = img.shape[1], img.shape[2]
+        scale = math.sqrt(float(megapixels) * 1024 * 1024 / (H * W))
+        w = max(int(round(W * scale)), 1)
+        h = max(int(round(H * scale)), 1)
+        return (resize_image(img, w, h, str(upscale_method)),)
 
 
 @register_op

@@ -677,3 +677,82 @@ class TestMaskCompositeNodes:
             octx, to, frm, 16, 16, False, mask)
         sm = lm["samples"]
         assert sm[0, 2, 2, 0] == 0.0 and sm[0, 2, 5, 0] == 1.0
+
+
+class TestLatentImageUtilityNodes:
+    """Round-4 utility batch: latent transforms, image filters,
+    conditioning utils."""
+
+    def _op(self, name):
+        from comfyui_distributed_tpu.ops.base import get_op
+        return get_op(name)
+
+    def _ctx(self):
+        from comfyui_distributed_tpu.ops.base import OpContext
+        return OpContext()
+
+    def test_latent_flip_rotate_crop(self):
+        octx = self._ctx()
+        lat = {"samples": np.arange(2 * 4 * 6 * 1, dtype=np.float32)
+               .reshape(2, 4, 6, 1), "fanout": 2, "local_batch": 1}
+        (fx,) = self._op("LatentFlip").execute(octx, lat,
+                                               "x-axis: vertically")
+        np.testing.assert_array_equal(fx["samples"][:, ::-1],
+                                      lat["samples"])
+        assert fx["fanout"] == 2
+        (fy,) = self._op("LatentFlip").execute(octx, lat,
+                                               "y-axis: horizontally")
+        np.testing.assert_array_equal(fy["samples"][:, :, ::-1],
+                                      lat["samples"])
+        (r90,) = self._op("LatentRotate").execute(octx, lat, "90 degrees")
+        assert r90["samples"].shape == (2, 6, 4, 1)
+        (r360s,) = self._op("LatentRotate").execute(
+            octx, r90, "270 degrees")
+        np.testing.assert_array_equal(r360s["samples"], lat["samples"])
+        (cr,) = self._op("LatentCrop").execute(octx, lat, 16, 16, 8, 8)
+        assert cr["samples"].shape == (2, 2, 2, 1)
+        np.testing.assert_array_equal(cr["samples"],
+                                      lat["samples"][:, 1:3, 1:3])
+
+    def test_latent_blend_and_batch(self):
+        octx = self._ctx()
+        a = {"samples": np.ones((2, 4, 4, 4), np.float32)}
+        b = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (bl,) = self._op("LatentBlend").execute(octx, a, b, 0.25)
+        assert bl["samples"].shape == (2, 4, 4, 4)
+        np.testing.assert_allclose(bl["samples"], 0.25)
+        (bt,) = self._op("LatentBatch").execute(octx, a, b)
+        assert bt["samples"].shape == (3, 4, 4, 4)
+
+    def test_conditioning_zero_out_and_strength(self):
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        octx = self._ctx()
+        c = Conditioning(context=np.ones((1, 77, 16), np.float32),
+                         pooled=np.ones((1, 32), np.float32))
+        (z,) = self._op("ConditioningZeroOut").execute(octx, c)
+        assert np.all(np.asarray(z.context) == 0)
+        assert np.all(np.asarray(z.pooled) == 0)
+        (s,) = self._op("ConditioningSetAreaStrength").execute(octx, c,
+                                                               0.4)
+        assert s.area_strength == 0.4
+
+    def test_image_blur_sharpen_quantize_scale(self):
+        octx = self._ctx()
+        rng = np.random.default_rng(2)
+        img = rng.uniform(0, 1, (1, 16, 16, 3)).astype(np.float32)
+        (bl,) = self._op("ImageBlur").execute(octx, img, 2, 1.5)
+        assert bl.shape == img.shape
+        assert bl.std() < img.std()          # blur reduces variance
+        flat = np.full((1, 8, 8, 3), 0.5, np.float32)
+        (blf,) = self._op("ImageBlur").execute(octx, flat, 3, 2.0)
+        np.testing.assert_allclose(blf, 0.5, atol=1e-6)  # edge replicate
+        (sh,) = self._op("ImageSharpen").execute(octx, img, 2, 1.5, 1.0)
+        assert sh.shape == img.shape
+        assert sh.std() > bl.std()
+        (q,) = self._op("ImageQuantize").execute(octx, img, 4, "none")
+        assert q.shape == img.shape
+        assert len(np.unique(q.reshape(-1, 3), axis=0)) <= 4
+        (sc,) = self._op("ImageScaleToTotalPixels").execute(
+            octx, img, "bilinear", 0.001)
+        assert abs(sc.shape[1] * sc.shape[2] - 0.001 * 1024 * 1024) \
+            < 0.25 * 0.001 * 1024 * 1024
