@@ -82,6 +82,20 @@ FAMILIES: Dict[str, ModelFamily] = {
         vae=vae_mod.SD_VAE_CONFIG,
         clips=(clip_mod.CLIP_L_CONFIG,),
     ),
+    "sd21_inpaint": ModelFamily(       # 512-inpainting-ema (eps line)
+        name="sd21_inpaint",
+        unet=dataclasses.replace(unet_mod.SD21_BASE_CONFIG,
+                                 in_channels=9),
+        vae=vae_mod.SD_VAE_CONFIG,
+        clips=(clip_mod.OPEN_CLIP_H_CONFIG,),
+    ),
+    "sdxl_inpaint": ModelFamily(
+        name="sdxl_inpaint",
+        unet=dataclasses.replace(unet_mod.SDXL_CONFIG, in_channels=9),
+        vae=vae_mod.SDXL_VAE_CONFIG,
+        clips=(clip_mod.CLIP_L_SDXL_CONFIG,
+               clip_mod.OPEN_CLIP_BIGG_CONFIG),
+    ),
     "tiny": ModelFamily(
         name="tiny",
         unet=unet_mod.TINY_CONFIG,
@@ -117,22 +131,25 @@ def detect_family(ckpt_name: str) -> str:
     if env:
         return env
     lowered = ckpt_name.lower()
+    inpaint = "inpaint" in lowered
     if "tiny" in lowered or "test" in lowered:
-        return "tiny_inpaint" if "inpaint" in lowered else "tiny"
+        return "tiny_inpaint" if inpaint else "tiny"
     if "xl" in lowered:
-        return "sdxl"
-    if "inpaint" in lowered:
-        # sd-v1-5-inpainting / *-inpainting finetunes (9-channel UNet)
-        return "sd15_inpaint"
+        return "sdxl_inpaint" if inpaint else "sdxl"
     # Stability SD2 naming only — a bare "v2" would misroute SD1.5
     # community finetunes like anything-v2 / counterfeit-v2.5
+    # (512-inpainting-ema is the SD2 line's inpaint checkpoint)
     if ("sd2" in lowered or "v2-0" in lowered or "v2-1" in lowered
-            or "768-v" in lowered or "512-base" in lowered):
+            or "768-v" in lowered or "512-base" in lowered
+            or "512-inpainting" in lowered):
+        if inpaint:
+            return "sd21_inpaint"
         # v2-1_768-ema-pruned is the v-pred line; v2-1_512-ema-pruned /
         # 512-base-ema the eps line
         return "sd21" if ("768" in lowered or "v-pred" in lowered
                           or "vpred" in lowered) else "sd21_base"
-    return "sd15"
+    # sd-v1-5-inpainting / *-inpainting finetunes (9-channel UNet)
+    return "sd15_inpaint" if inpaint else "sd15"
 
 
 def _name_seed(name: str) -> int:
@@ -410,19 +427,33 @@ class DiffusionPipeline:
                           else None) for c, m, s, sr in entries)
 
         cfg_rescale = float(getattr(self, "cfg_rescale", 0.0) or 0.0)
+        ds_spec = getattr(self, "deep_shrink_spec", None)
+        if ds_spec is not None and control is not None:
+            log("deep shrink: ControlNet residual shapes can't follow "
+                "the shrunk encoder; sampling WITHOUT the downscale "
+                "patch")
+            ds_spec = None
         sag = getattr(self, "sag_params", None)
         sag_ok = False
         if sag is not None:
+            ht = self.family.unet.hypertile
+            mid_hypertiled = (ht is not None
+                              and self.family.unet.num_levels - 1
+                              <= int(ht[1]))
             sag_ok = (not dual and float(cfg) != 1.0
                       and len(conds) == 1 and len(unconds) == 1
-                      and control is None
+                      and control is None and not mid_hypertiled
                       and not any(m is not None or s != 1.0
                                   or sr is not None
                                   for _, m, s, sr in conds + unconds))
             if not sag_ok:
                 log("SAG: unsupported combination (regional/dual/"
-                    "control/cfg==1); sampling WITHOUT self-attention "
-                    "guidance")
+                    "control/cfg==1/hypertiled mid-block); sampling "
+                    "WITHOUT self-attention guidance")
+        if ds_spec is not None and sag_ok:
+            log("deep shrink: does not compose with SAG's capture "
+                "branch; sampling WITHOUT the downscale patch")
+            ds_spec = None
         if sag_ok:
             # mid-block spatial dims (stride-2 SAME convs: ceil halving
             # per level) — the attn-probs token grid the mask reshapes to
@@ -439,6 +470,8 @@ class DiffusionPipeline:
                       polling_enabled(), start, end, dual, float(cfg2),
                       guidance,
                       (tuple(float(v) for v in sag), ) if sag_ok else (),
+                      tuple(float(v) for v in ds_spec)
+                      if ds_spec is not None else (),
                       c_concat is not None,
                       tuple(c_concat.shape) if c_concat is not None
                       else (),
@@ -480,8 +513,31 @@ class DiffusionPipeline:
                         sk = tuple(pos_s) + (tuple(neg_s)
                                              if cfg_scale != 1.0 else ())
                     ctrl_spec = (cn_apply, cn_params, hint_in, sk)
+                use_apply = self.raw_unet_apply
+                if ds_spec is not None:
+                    # deep shrink: a lax.cond over two config-variant
+                    # UNet applies SHARING one param tree — the shrunk
+                    # branch runs only inside the sigma window, so the
+                    # early steps pay the small graph
+                    lvl, fac, t_lo, t_hi = ds_spec
+                    shrunk_mod = unet_mod.UNet(dataclasses.replace(
+                        self.family.unet,
+                        deep_shrink=(int(lvl), float(fac))))
+
+                    def _shrunk(p, x, t, c, y=None, control=None):
+                        return shrunk_mod.apply({"params": p}, x, t, c,
+                                                y=y, control=control)
+
+                    def use_apply(p, x, t, c, y=None, control=None):
+                        pred = jnp.logical_and(t[0] > t_lo, t[0] <= t_hi)
+                        return jax.lax.cond(
+                            pred,
+                            lambda a: _shrunk(*a),
+                            lambda a: self.raw_unet_apply(*a),
+                            (p, x, t, c, y, control))
+
                 den = make_denoiser(
-                    self.raw_unet_apply, unet_params, self.schedule,
+                    use_apply, unet_params, self.schedule,
                     self.prediction_type, control=ctrl_spec,
                     concat=concat_in if has_concat else None)
                 entries = [(ctx_list[i],

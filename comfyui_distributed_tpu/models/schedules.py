@@ -138,6 +138,77 @@ def karras_scheduler(ds: Optional[DiscreteSchedule], steps: int,
     return _append_zero(sigmas)
 
 
+def polyexponential_sigmas(steps: int, sigma_max: float,
+                           sigma_min: float,
+                           rho: float = 1.0) -> np.ndarray:
+    """k-diffusion get_sigmas_polyexponential: polynomial ramp in
+    log-sigma (PolyexponentialScheduler node)."""
+    ramp = np.linspace(1.0, 0.0, steps) ** rho
+    sig = np.exp(ramp * (math.log(sigma_max) - math.log(sigma_min))
+                 + math.log(sigma_min))
+    return np.concatenate([sig, [0.0]]).astype(np.float32)
+
+
+def vp_sigmas(steps: int, beta_d: float = 19.9, beta_min: float = 0.1,
+              eps_s: float = 1e-3) -> np.ndarray:
+    """k-diffusion get_sigmas_vp: the continuous VP-SDE noise schedule
+    (VPScheduler node)."""
+    t = np.linspace(1.0, eps_s, steps)
+    sig = np.sqrt(np.exp(beta_d * t ** 2 / 2 + beta_min * t) - 1.0)
+    return np.concatenate([sig, [0.0]]).astype(np.float32)
+
+
+def laplace_sigmas(steps: int, sigma_max: float, sigma_min: float,
+                   mu: float = 0.0, beta: float = 0.5) -> np.ndarray:
+    """k-diffusion get_sigmas_laplace (LaplaceScheduler node): inverse
+    Laplace CDF spacing in log-sigma, clipped to the bounds."""
+    epsilon = 1e-5
+    x = np.linspace(0.0, 1.0, steps)
+    lmb = mu - beta * np.sign(0.5 - x) * np.log(1 - 2 * np.abs(0.5 - x)
+                                                + epsilon)
+    sig = np.clip(np.exp(lmb), sigma_min, sigma_max)
+    return np.concatenate([sig, [0.0]]).astype(np.float32)
+
+
+# NVIDIA Align-Your-Steps 10-step reference tables (the public release's
+# noise levels); other step counts log-linearly interpolate like the
+# reference ecosystem's AlignYourStepsScheduler
+AYS_TABLES = {
+    "SD1": [14.615, 6.475, 3.861, 2.697, 1.886, 1.396, 0.963, 0.652,
+            0.399, 0.152, 0.029],
+    "SDXL": [14.615, 6.315, 3.771, 2.181, 1.342, 0.862, 0.555, 0.380,
+             0.234, 0.113, 0.029],
+    "SVD": [700.00, 54.5, 15.886, 7.977, 4.248, 1.789, 0.981, 0.403,
+            0.173, 0.034, 0.002],
+}
+
+
+def ays_sigmas(model_type: str, steps: int) -> np.ndarray:
+    """AlignYourSteps: log-linear interpolation of the model line's
+    reference table to the requested step count, trailing 0."""
+    key = str(model_type).upper().replace("1.5", "1").replace("SD15",
+                                                              "SD1")
+    if key not in AYS_TABLES:
+        raise ValueError(f"unknown AYS model type {model_type!r}; "
+                         f"available: {tuple(AYS_TABLES)}")
+    table = np.asarray(AYS_TABLES[key], np.float64)
+    xs = np.linspace(0.0, 1.0, table.shape[0])
+    xq = np.linspace(0.0, 1.0, int(steps) + 1)
+    return np.exp(np.interp(xq, xs, np.log(table))).astype(np.float32)
+
+
+def sd_turbo_sigmas(ds: DiscreteSchedule, steps: int,
+                    denoise: float = 1.0) -> np.ndarray:
+    """SDTurboScheduler: the distilled-model schedule samples the LAST
+    ``steps`` of 1000//denoise-spaced timesteps (the reference node's
+    arange/flip indexing), trailing 0."""
+    steps = max(int(steps), 1)
+    start = max(int(10 - 10 * float(denoise)), 0)
+    ts = np.flip(np.arange(1, 11) * 100 - 1)[start:start + steps]
+    sig = ds.sigmas[ts.astype(int)]
+    return np.concatenate([sig, [0.0]]).astype(np.float32)
+
+
 def exponential_scheduler(ds: DiscreteSchedule, steps: int) -> np.ndarray:
     sigmas = np.exp(np.linspace(math.log(ds.sigma_max),
                                 math.log(ds.sigma_min), steps))

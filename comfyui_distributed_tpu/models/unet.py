@@ -62,6 +62,12 @@ class UNetConfig:
     # SAG: the mid-block's first self-attention materializes + sows its
     # softmax weights for the sampler's blur mask (models/layers.py)
     sag_capture: bool = False
+    # Deep shrink (PatchModelAddDownscale): (level, factor) — THIS trace
+    # bilinearly downscales the hidden at the given level's entry and
+    # upsamples at the first skip-concat mismatch.  The sigma-window
+    # branch lives OUTSIDE the module (registry builds a lax.cond over a
+    # shrunk-config and a plain-config apply sharing one param tree)
+    deep_shrink: Optional[Tuple[int, float]] = None
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     prediction_type: str = "eps"  # "eps" | "v"
@@ -193,6 +199,13 @@ class UNet(nn.Module):
 
         # down path
         for level, mult in enumerate(cfg.channel_mult):
+            if cfg.deep_shrink is not None and level == cfg.deep_shrink[0]:
+                f = float(cfg.deep_shrink[1])
+                nh = max(1, int(round(h.shape[1] / f)))
+                nw = max(1, int(round(h.shape[2] / f)))
+                h = jax.image.resize(
+                    h, (h.shape[0], nh, nw, h.shape[3]),
+                    method="bilinear").astype(h.dtype)
             out_ch = ch * mult
             for i in range(cfg.num_res_blocks):
                 h = ResBlock(out_ch, dtype=cfg.dtype,
@@ -232,6 +245,13 @@ class UNet(nn.Module):
             out_ch = ch * cfg.channel_mult[level]
             for i in range(cfg.num_res_blocks + 1):
                 skip = skips.pop()
+                if h.shape[1:3] != skip.shape[1:3]:
+                    # deep shrink: back to full size at the first
+                    # mismatching skip (the reference's output patch)
+                    h = jax.image.resize(
+                        h, (h.shape[0], skip.shape[1], skip.shape[2],
+                            h.shape[3]),
+                        method="bilinear").astype(h.dtype)
                 if cfg.freeu is not None:
                     h, skip = _apply_freeu(cfg, h, skip)
                 h = jnp.concatenate([h, skip], axis=-1)

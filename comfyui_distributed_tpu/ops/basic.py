@@ -167,6 +167,57 @@ class HyperTile(Op):
 
 
 @register_op
+class PatchModelAddDownscale(Op):
+    """Kohya deep shrink: for the early (high-sigma) part of sampling,
+    the encoder downscales its hidden at the given input block and
+    upsamples back at the first skip mismatch — large canvases keep
+    global composition without doubling the trained resolution's cost.
+    TPU shape: a lax.cond between a shrunk-graph and a plain-graph UNet
+    apply over ONE param tree (static shapes inside each branch);
+    ``downscale_method``/``upscale_method`` are accepted for schema
+    parity (both paths use bilinear)."""
+    TYPE = "PatchModelAddDownscale"
+    WIDGETS = ["block_number", "downscale_factor", "start_percent",
+               "end_percent", "downscale_after_skip",
+               "downscale_method", "upscale_method"]
+    DEFAULTS = {"block_number": 3, "downscale_factor": 2.0,
+                "start_percent": 0.0, "end_percent": 0.35,
+                "downscale_after_skip": True,
+                "downscale_method": "bicubic",
+                "upscale_method": "bicubic"}
+
+    def execute(self, ctx: OpContext, model, block_number: int = 3,
+                downscale_factor: float = 2.0,
+                start_percent: float = 0.0, end_percent: float = 0.35,
+                downscale_after_skip=True,
+                downscale_method: str = "bicubic",
+                upscale_method: str = "bicubic"):
+        ucfg = model.family.unet
+        nrb = int(ucfg.num_res_blocks)
+        b = max(int(block_number), 1)
+        # torch input_blocks index -> our level: blocks 1..nrb are level
+        # 0, the level's trailing Downsample belongs to the NEXT level
+        lvl = (b - 1) // (nrb + 1)
+        if (b - 1) % (nrb + 1) == nrb:
+            lvl += 1
+        lvl = min(lvl, ucfg.num_levels - 1)
+        sched = model.schedule
+        s_hi = sched.percent_to_sigma(float(start_percent))
+        s_lo = sched.percent_to_sigma(float(end_percent))
+        t_hi = float(np.asarray(sched.t_from_sigma(
+            np.asarray([s_hi], np.float32)))[0]) + 1e-3
+        t_lo = float(np.asarray(sched.t_from_sigma(
+            np.asarray([s_lo], np.float32)))[0])
+        tag = (f"deepshrink:{lvl}:{float(downscale_factor)}"
+               f":{start_percent}:{end_percent}")
+        return (registry.derive_pipeline(
+            model, tag,
+            extra_attrs={"deep_shrink_spec":
+                         (float(lvl), float(downscale_factor),
+                          t_lo, t_hi)}),)
+
+
+@register_op
 class SelfAttentionGuidance(Op):
     """SAG (Hong et al.): blur what the model itself attends to, denoise
     the degraded latent once more, and steer away from it — the
@@ -435,6 +486,132 @@ class KarrasScheduler(Op):
         return (sch.karras_scheduler(None, int(steps), float(rho),
                                      sigma_min=float(sigma_min),
                                      sigma_max=float(sigma_max)),)
+
+
+@register_op
+class ExponentialScheduler(Op):
+    """-> SIGMAS: log-linear ramp with explicit bounds."""
+    TYPE = "ExponentialScheduler"
+    WIDGETS = ["steps", "sigma_max", "sigma_min"]
+    DEFAULTS = {"sigma_max": 14.614642, "sigma_min": 0.0291675}
+
+    def execute(self, ctx: OpContext, steps: int, sigma_max: float,
+                sigma_min: float):
+        from comfyui_distributed_tpu.models import schedules as sch
+        return (sch.polyexponential_sigmas(int(steps), float(sigma_max),
+                                           float(sigma_min), rho=1.0),)
+
+
+@register_op
+class PolyexponentialScheduler(Op):
+    TYPE = "PolyexponentialScheduler"
+    WIDGETS = ["steps", "sigma_max", "sigma_min", "rho"]
+    DEFAULTS = {"sigma_max": 14.614642, "sigma_min": 0.0291675,
+                "rho": 1.0}
+
+    def execute(self, ctx: OpContext, steps: int, sigma_max: float,
+                sigma_min: float, rho: float = 1.0):
+        from comfyui_distributed_tpu.models import schedules as sch
+        return (sch.polyexponential_sigmas(int(steps), float(sigma_max),
+                                           float(sigma_min),
+                                           rho=float(rho)),)
+
+
+@register_op
+class VPScheduler(Op):
+    TYPE = "VPScheduler"
+    WIDGETS = ["steps", "beta_d", "beta_min", "eps_s"]
+    DEFAULTS = {"beta_d": 19.9, "beta_min": 0.1, "eps_s": 0.001}
+
+    def execute(self, ctx: OpContext, steps: int, beta_d: float = 19.9,
+                beta_min: float = 0.1, eps_s: float = 0.001):
+        from comfyui_distributed_tpu.models import schedules as sch
+        return (sch.vp_sigmas(int(steps), float(beta_d),
+                              float(beta_min), float(eps_s)),)
+
+
+@register_op
+class LaplaceScheduler(Op):
+    TYPE = "LaplaceScheduler"
+    WIDGETS = ["steps", "sigma_max", "sigma_min", "mu", "beta"]
+    DEFAULTS = {"sigma_max": 14.614642, "sigma_min": 0.0291675,
+                "mu": 0.0, "beta": 0.5}
+
+    def execute(self, ctx: OpContext, steps: int, sigma_max: float,
+                sigma_min: float, mu: float = 0.0, beta: float = 0.5):
+        from comfyui_distributed_tpu.models import schedules as sch
+        return (sch.laplace_sigmas(int(steps), float(sigma_max),
+                                   float(sigma_min), float(mu),
+                                   float(beta)),)
+
+
+@register_op
+class BetaSamplingScheduler(Op):
+    """-> SIGMAS: beta-distribution spacing over the MODEL's schedule."""
+    TYPE = "BetaSamplingScheduler"
+    WIDGETS = ["steps", "alpha", "beta"]
+    DEFAULTS = {"alpha": 0.6, "beta": 0.6}
+
+    def execute(self, ctx: OpContext, model, steps: int,
+                alpha: float = 0.6, beta: float = 0.6):
+        from comfyui_distributed_tpu.models import schedules as sch
+        return (np.asarray(sch.beta_scheduler(
+            model.schedule, int(steps), float(alpha), float(beta)),
+            np.float32),)
+
+
+@register_op
+class AlignYourStepsScheduler(Op):
+    """-> SIGMAS: NVIDIA Align-Your-Steps reference tables (SD1 / SDXL /
+    SVD), log-linearly interpolated to the step count."""
+    TYPE = "AlignYourStepsScheduler"
+    WIDGETS = ["model_type", "steps", "denoise"]
+    DEFAULTS = {"model_type": "SD1", "denoise": 1.0}
+
+    def execute(self, ctx: OpContext, model_type: str, steps: int,
+                denoise: float = 1.0):
+        from comfyui_distributed_tpu.models import schedules as sch
+        d = float(denoise)
+        if d <= 0.0:
+            return (np.zeros((0,), np.float32),)
+        # reference semantics: interp to steps+1, keep the LAST
+        # round(steps*denoise)+1 entries, force the terminal 0
+        total = round(int(steps) * d) if d < 1.0 else int(steps)
+        sig = sch.ays_sigmas(str(model_type), int(steps)).copy()
+        sig = sig[-(total + 1):]
+        sig[-1] = 0.0
+        return (sig,)
+
+
+@register_op
+class SDTurboScheduler(Op):
+    """-> SIGMAS for distilled turbo models: the last ``steps`` of the
+    model schedule's 100-spaced timesteps."""
+    TYPE = "SDTurboScheduler"
+    WIDGETS = ["steps", "denoise"]
+    DEFAULTS = {"steps": 1, "denoise": 1.0}
+
+    def execute(self, ctx: OpContext, model, steps: int = 1,
+                denoise: float = 1.0):
+        from comfyui_distributed_tpu.models import schedules as sch
+        return (sch.sd_turbo_sigmas(model.schedule, int(steps),
+                                    float(denoise)),)
+
+
+@register_op
+class SplitSigmasDenoise(Op):
+    """-> (high_sigmas, low_sigmas) split at the denoise fraction (the
+    img2img split as explicit sigma IO)."""
+    TYPE = "SplitSigmasDenoise"
+    WIDGETS = ["denoise"]
+    DEFAULTS = {"denoise": 1.0}
+
+    def execute(self, ctx: OpContext, sigmas, denoise: float = 1.0):
+        s = np.asarray(sigmas, np.float32)
+        steps = s.shape[0] - 1
+        keep = int(steps * float(denoise))
+        i = max(steps - keep, 0)
+        return (s[:i + 1], s[i:])
 
 
 @register_op
@@ -1812,6 +1989,67 @@ def _set_area_on_all(cond: Conditioning, area, strength: float):
                        for s in cond.siblings))
 
 
+def _latent_pair(samples1, samples2):
+    a = np.asarray(samples1["samples"], np.float32)
+    b = np.asarray(samples2["samples"], np.float32)
+    if a.shape[1:3] != b.shape[1:3]:
+        b = resize_image(b, a.shape[2], a.shape[1], "bilinear")
+    return a, _cycle_batch(b, a.shape[0])
+
+
+@register_op
+class LatentAdd(Op):
+    TYPE = "LatentAdd"
+
+    def execute(self, ctx: OpContext, samples1, samples2):
+        a, b = _latent_pair(samples1, samples2)
+        return ({**_latent_meta(samples1), "samples": a + b},)
+
+
+@register_op
+class LatentSubtract(Op):
+    TYPE = "LatentSubtract"
+
+    def execute(self, ctx: OpContext, samples1, samples2):
+        a, b = _latent_pair(samples1, samples2)
+        return ({**_latent_meta(samples1), "samples": a - b},)
+
+
+@register_op
+class LatentMultiply(Op):
+    TYPE = "LatentMultiply"
+    WIDGETS = ["multiplier"]
+    DEFAULTS = {"multiplier": 1.0}
+
+    def execute(self, ctx: OpContext, samples, multiplier: float = 1.0):
+        lat = np.asarray(samples["samples"], np.float32)
+        return ({**_latent_meta(samples),
+                 "samples": lat * float(multiplier)},)
+
+
+@register_op
+class LatentInterpolate(Op):
+    """Direction-magnitude interpolation (ComfyUI nodes_latent): unit
+    directions blend by ``ratio`` per pixel across channels, then the
+    result rescales to the interpolated magnitudes."""
+    TYPE = "LatentInterpolate"
+    WIDGETS = ["ratio"]
+    DEFAULTS = {"ratio": 1.0}
+
+    def execute(self, ctx: OpContext, samples1, samples2,
+                ratio: float = 1.0):
+        a, b = _latent_pair(samples1, samples2)
+        t = float(ratio)
+        m1 = np.linalg.norm(a, axis=-1, keepdims=True)
+        m2 = np.linalg.norm(b, axis=-1, keepdims=True)
+        d1 = a / np.maximum(m1, 1e-10)
+        d2 = b / np.maximum(m2, 1e-10)
+        out = d1 * t + d2 * (1.0 - t)
+        mo = np.linalg.norm(out, axis=-1, keepdims=True)
+        out = out / np.maximum(mo, 1e-10) * (m1 * t + m2 * (1.0 - t))
+        return ({**_latent_meta(samples1), "samples": out},)
+
+
 @register_op
 class LatentFlip(Op):
     TYPE = "LatentFlip"
@@ -1875,11 +2113,7 @@ class LatentBlend(Op):
 
     def execute(self, ctx: OpContext, samples1, samples2,
                 blend_factor: float = 0.5):
-        a = np.asarray(samples1["samples"], np.float32)
-        b = np.asarray(samples2["samples"], np.float32)
-        if a.shape[1:3] != b.shape[1:3]:
-            b = resize_image(b, a.shape[2], a.shape[1], "bilinear")
-        b = _cycle_batch(b, a.shape[0])
+        a, b = _latent_pair(samples1, samples2)
         f = float(blend_factor)
         return ({**_latent_meta(samples1), "samples": a * f
                  + b * (1.0 - f)},)
@@ -2007,7 +2241,12 @@ class ImageQuantize(Op):
         for frame in img:
             pil = Image.fromarray(
                 (np.clip(frame, 0, 1) * 255).astype(np.uint8))
-            q = pil.quantize(colors=max(int(colors), 1), dither=dm)
+            # two-pass like the reference: PIL ignores ``dither`` unless
+            # quantizing AGAINST a palette image, so build the median-cut
+            # palette first, then re-quantize with dithering
+            pal = pil.quantize(colors=max(int(colors), 1))
+            q = pil.quantize(colors=max(int(colors), 1), palette=pal,
+                             dither=dm)
             out.append(np.asarray(q.convert("RGB"), np.float32) / 255.0)
         return (np.stack(out),)
 

@@ -1998,3 +1998,118 @@ class TestInpaintModelFamily:
             octx, pos, neg, p, img, mask, False)
         assert "noise_mask" not in lat_nm
         registry.clear_pipeline_cache()
+
+
+class TestDeepShrink:
+    def test_unet_shrunk_config_shapes(self):
+        import jax as _jax
+
+        from comfyui_distributed_tpu.models import unet as unet_mod
+        cfg = unet_mod.TINY_CONFIG
+        mod = unet_mod.UNet(cfg)
+        x = jnp.zeros((1, 16, 16, 4), jnp.float32)
+        ts = jnp.zeros((1,))
+        c = jnp.zeros((1, 77, cfg.context_dim), jnp.float32)
+        params = registry._virtual_params(mod, 3, x, ts, c)
+        plain = mod.apply({"params": params}, x, ts, c)
+        import dataclasses as dc
+        sh_mod = unet_mod.UNet(dc.replace(cfg, deep_shrink=(1, 2.0)))
+        shrunk = sh_mod.apply({"params": params}, x, ts, c)
+        assert shrunk.shape == plain.shape
+        assert not np.allclose(np.asarray(shrunk), np.asarray(plain))
+
+    def test_node_patch_and_window(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("dshrink.ckpt")
+        octx = OpContext()
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0])
+        lat = {"samples": np.zeros((1, 16, 16, 4), np.float32)}
+        (plain,) = get_op("KSampler").execute(octx, p, 3, 3, 4.0,
+                                              "euler", "normal", pos,
+                                              pos, lat, 1.0)
+        (pd,) = get_op("PatchModelAddDownscale").execute(
+            octx, p, 3, 2.0, 0.0, 0.35, True, "bicubic", "bicubic")
+        lvl, fac, t_lo, t_hi = pd.deep_shrink_spec
+        assert lvl == 1.0 and fac == 2.0 and t_hi > t_lo
+        (out,) = get_op("KSampler").execute(octx, pd, 3, 3, 4.0,
+                                            "euler", "normal", pos, pos,
+                                            lat, 1.0)
+        s = np.asarray(out["samples"])
+        assert np.isfinite(s).all()
+        assert not np.allclose(s, np.asarray(plain["samples"]))
+        # window [0, 0): never active -> results match the plain run
+        (p0,) = get_op("PatchModelAddDownscale").execute(
+            octx, p, 3, 2.0, 0.0, 0.0, True, "bicubic", "bicubic")
+        (same,) = get_op("KSampler").execute(octx, p0, 3, 3, 4.0,
+                                             "euler", "normal", pos,
+                                             pos, lat, 1.0)
+        np.testing.assert_allclose(np.asarray(same["samples"]),
+                                   np.asarray(plain["samples"]),
+                                   rtol=1e-4, atol=1e-5)
+        # rides a LoRA derivation
+        (pl, _) = get_op("LoraLoader").execute(octx, pd, pd,
+                                               "s.safetensors", 0.5, 0.5)
+        assert getattr(pl, "deep_shrink_spec", None) is not None
+        registry.clear_pipeline_cache()
+
+    def test_block_number_level_mapping(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("dshrink-map.ckpt")   # tiny: nrb=1
+        octx = OpContext()
+        # tiny num_res_blocks=1: block1 -> level0, block2 (its
+        # downsample) -> level1
+        (a,) = get_op("PatchModelAddDownscale").execute(
+            octx, p, 1, 2.0, 0.0, 0.5, True, "bicubic", "bicubic")
+        assert a.deep_shrink_spec[0] == 0.0
+        (b,) = get_op("PatchModelAddDownscale").execute(
+            octx, p, 2, 2.0, 0.0, 0.5, True, "bicubic", "bicubic")
+        assert b.deep_shrink_spec[0] == 1.0
+        registry.clear_pipeline_cache()
+
+
+class TestRound4ReviewFixes:
+    def test_inpaint_family_routing(self, monkeypatch):
+        monkeypatch.delenv(registry.FAMILY_ENV, raising=False)
+        assert registry.detect_family("512-inpainting-ema.ckpt") \
+            == "sd21_inpaint"
+        assert registry.detect_family("sd2-inpainting.safetensors") \
+            == "sd21_inpaint"
+        assert registry.detect_family("sd_xl_inpainting_0.1.safetensors") \
+            == "sdxl_inpaint"
+        assert registry.detect_family("sd-v1-5-inpainting.ckpt") \
+            == "sd15_inpaint"
+        assert registry.FAMILIES["sd21_inpaint"].unet.context_dim == 1024
+        assert registry.FAMILIES["sdxl_inpaint"].unet.in_channels == 9
+
+    def test_image_quantize_dither_has_effect(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        octx = OpContext()
+        rng = np.random.default_rng(6)
+        grad = np.linspace(0, 1, 64, dtype=np.float32)
+        img = np.broadcast_to(grad, (32, 64)).astype(np.float32)
+        img = np.stack([img, img, img], axis=-1)[None]
+        img = img + rng.uniform(0, 0.02, img.shape).astype(np.float32)
+        (nd,) = get_op("ImageQuantize").execute(octx, img, 4, "none")
+        (fd,) = get_op("ImageQuantize").execute(octx, img, 4,
+                                                "floyd-steinberg")
+        assert not np.array_equal(nd, fd)    # dithering actually runs
+
+    def test_sag_falls_back_with_hypertiled_mid(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("sag-ht.ckpt")
+        octx = OpContext()
+        (ph,) = get_op("HyperTile").execute(octx, p, 32, 2, 3, False)
+        (ps,) = get_op("SelfAttentionGuidance").execute(octx, ph, 0.5,
+                                                        2.0)
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0])
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        lat = {"samples": np.zeros((1, 16, 16, 4), np.float32)}
+        (out,) = get_op("KSampler").execute(octx, ps, 3, 2, 5.0, "euler",
+                                            "normal", pos, neg, lat, 1.0)
+        assert np.isfinite(np.asarray(out["samples"])).all()
+        registry.clear_pipeline_cache()

@@ -750,3 +750,130 @@ class TestNewSamplersRound4:
         out = smp.sample_deis(ideal_model(x0), x, sigmas)
         np.testing.assert_allclose(np.asarray(out), np.asarray(x0),
                                    atol=1e-3)
+
+
+class TestSchedulerNodesRound4:
+    """Scheduler node suite: Exponential/Polyexponential/VP/Laplace/
+    Beta/AYS/SDTurbo + SplitSigmasDenoise."""
+
+    def _op(self, name):
+        from comfyui_distributed_tpu.ops.base import get_op
+        return get_op(name)
+
+    def _ctx(self):
+        from comfyui_distributed_tpu.ops.base import OpContext
+        return OpContext()
+
+    def test_exponential_and_poly(self):
+        octx = self._ctx()
+        (e,) = self._op("ExponentialScheduler").execute(octx, 8, 10.0,
+                                                        0.1)
+        assert e.shape == (9,) and e[-1] == 0.0
+        np.testing.assert_allclose(e[0], 10.0, rtol=1e-5)
+        np.testing.assert_allclose(e[-2], 0.1, rtol=1e-5)
+        # exponential == polyexponential at rho=1; rho=2 bends the ramp
+        (p1,) = self._op("PolyexponentialScheduler").execute(
+            octx, 8, 10.0, 0.1, 1.0)
+        np.testing.assert_array_equal(e, p1)
+        (p2,) = self._op("PolyexponentialScheduler").execute(
+            octx, 8, 10.0, 0.1, 2.0)
+        assert p2[4] < p1[4]        # rho>1 front-loads low sigmas
+        # exact log-linear ramp: e[i] = exp(lerp(log 10, log 0.1, i/7))
+        expect = np.exp(np.linspace(np.log(10.0), np.log(0.1), 8))
+        np.testing.assert_allclose(e[:-1], expect, rtol=1e-5)
+
+    def test_vp_and_laplace(self):
+        octx = self._ctx()
+        (v,) = self._op("VPScheduler").execute(octx, 10, 19.9, 0.1,
+                                               0.001)
+        assert v.shape == (11,) and v[-1] == 0.0
+        assert np.all(np.diff(v[:-1]) < 0)
+        (la,) = self._op("LaplaceScheduler").execute(octx, 10, 14.6,
+                                                     0.03, 0.0, 0.5)
+        assert la.shape == (11,) and la[-1] == 0.0
+        assert la[0] <= 14.6 and la[-2] >= 0.03
+
+    def test_beta_node_matches_scheduler(self, ds):
+        octx = self._ctx()
+
+        class _M:
+            schedule = ds
+        (b,) = self._op("BetaSamplingScheduler").execute(octx, _M(), 9,
+                                                         0.6, 0.6)
+        np.testing.assert_array_equal(
+            b, np.asarray(sch.beta_scheduler(ds, 9, 0.6, 0.6),
+                          np.float32))
+
+    def test_ays_tables_and_denoise(self):
+        octx = self._ctx()
+        (s10,) = self._op("AlignYourStepsScheduler").execute(octx, "SD1",
+                                                             10, 1.0)
+        np.testing.assert_allclose(
+            s10[:-1], sch.AYS_TABLES["SD1"][:-1], rtol=1e-5)
+        assert s10[-1] == 0.0
+        (s20,) = self._op("AlignYourStepsScheduler").execute(octx,
+                                                             "SDXL", 20,
+                                                             1.0)
+        assert s20.shape == (21,)
+        assert np.all(np.diff(s20[:-1]) < 0)
+        (half,) = self._op("AlignYourStepsScheduler").execute(octx,
+                                                              "SD1", 10,
+                                                              0.5)
+        assert half.shape == (6,)
+        np.testing.assert_allclose(half[:-1], s10[5:-1], rtol=1e-6)
+        with pytest.raises(ValueError):
+            self._op("AlignYourStepsScheduler").execute(octx, "nope", 10,
+                                                        1.0)
+
+    def test_sd_turbo(self, ds):
+        octx = self._ctx()
+
+        class _M:
+            schedule = ds
+        (s1,) = self._op("SDTurboScheduler").execute(octx, _M(), 1, 1.0)
+        assert s1.shape == (2,) and s1[-1] == 0.0
+        np.testing.assert_allclose(s1[0], ds.sigmas[999], rtol=1e-6)
+        (s4,) = self._op("SDTurboScheduler").execute(octx, _M(), 4, 1.0)
+        assert s4.shape == (5,)
+        np.testing.assert_allclose(
+            s4[:-1], ds.sigmas[[999, 899, 799, 699]], rtol=1e-6)
+        # denoise 0.5: starts mid-schedule (img2img for turbo)
+        (sd,) = self._op("SDTurboScheduler").execute(octx, _M(), 2, 0.5)
+        np.testing.assert_allclose(sd[0], ds.sigmas[499], rtol=1e-6)
+
+    def test_split_sigmas_denoise(self):
+        octx = self._ctx()
+        sig = np.asarray([10, 8, 6, 4, 2, 0], np.float32)
+        hi, lo = self._op("SplitSigmasDenoise").execute(octx, sig, 0.4)
+        assert lo.shape == (3,)          # 2 of 5 steps kept
+        np.testing.assert_array_equal(lo, sig[3:])
+        np.testing.assert_array_equal(hi, sig[:4])
+        hi1, lo1 = self._op("SplitSigmasDenoise").execute(octx, sig, 1.0)
+        np.testing.assert_array_equal(lo1, sig)
+
+
+class TestLatentArithmeticNodes:
+    def _op(self, name):
+        from comfyui_distributed_tpu.ops.base import get_op
+        return get_op(name)
+
+    def test_add_subtract_multiply_interpolate(self):
+        from comfyui_distributed_tpu.ops.base import OpContext
+        octx = OpContext()
+        a = {"samples": np.full((1, 4, 4, 4), 2.0, np.float32),
+             "fanout": 1, "local_batch": 1}
+        b = {"samples": np.full((1, 4, 4, 4), 0.5, np.float32)}
+        (add,) = self._op("LatentAdd").execute(octx, a, b)
+        np.testing.assert_allclose(add["samples"], 2.5)
+        (sub,) = self._op("LatentSubtract").execute(octx, a, b)
+        np.testing.assert_allclose(sub["samples"], 1.5)
+        (mul,) = self._op("LatentMultiply").execute(octx, a, 0.25)
+        np.testing.assert_allclose(mul["samples"], 0.5)
+        # interpolate: ratio 1 -> exactly a; ratio 0 -> exactly b
+        (i1,) = self._op("LatentInterpolate").execute(octx, a, b, 1.0)
+        np.testing.assert_allclose(i1["samples"], 2.0, rtol=1e-5)
+        (i0,) = self._op("LatentInterpolate").execute(octx, a, b, 0.0)
+        np.testing.assert_allclose(i0["samples"], 0.5, rtol=1e-5)
+        # parallel directions: magnitudes lerp
+        (ih,) = self._op("LatentInterpolate").execute(octx, a, b, 0.5)
+        np.testing.assert_allclose(ih["samples"], 1.25, rtol=1e-5)
