@@ -20,7 +20,8 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
                   prediction_type: str = "eps",
                   control: Optional[tuple] = None,
                   capture: bool = False,
-                  concat: Optional[jax.Array] = None) -> Callable:
+                  concat: Optional[jax.Array] = None,
+                  hypernet: Optional[tuple] = None) -> Callable:
     """Build ``model(x, sigma, context=..., y=...) -> denoised``.
 
     ``apply_fn(params, x, timesteps, context, y, control)`` is the raw
@@ -41,6 +42,15 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
     masked-image latent]) appended to every call's scaled input along
     the channel axis — NOT noise-scaled (they are clean latents), and
     tiled over the CFG-stacked batch like the control hint.
+
+    ``hypernet``: tuple of (parsed_hypernet, strength) entries applied
+    in order — chained loaders COMPOSE like the reference's stacked attn
+    patches.  Each transforms the text context into separate k/v streams
+    ONCE per call (the context is layer-independent, so this equals the
+    reference's per-attn2 patch at 1/N the evaluations).  A ControlNet
+    keeps the untransformed context.  KNOWN LIMITATION (logged at load):
+    self-attention entries (hidden-width dims) do not apply — only the
+    text cross-attention streams are transformed.
     """
     log_sigmas = jnp.asarray(jnp.log(jnp.asarray(ds.sigmas)))
 
@@ -89,7 +99,17 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
             cb = jnp.concatenate([concat] * creps, axis=0) \
                 if creps > 1 else concat
             xin = jnp.concatenate([xin, cb.astype(xin.dtype)], axis=-1)
-        out = apply_fn(params, xin, ts, context, y, ctrl)
+        ctx_in, kw = context, {}
+        if hypernet is not None and context is not None:
+            from comfyui_distributed_tpu.models.hypernetwork import \
+                apply_hypernetwork
+            ctx_in = ctx_v = context
+            for hn, s in hypernet:
+                k2, v2 = apply_hypernetwork(hn, float(s), ctx_in)
+                _, v3 = apply_hypernetwork(hn, float(s), ctx_v)
+                ctx_in, ctx_v = k2, v3
+            kw = {"context_v": ctx_v}
+        out = apply_fn(params, xin, ts, ctx_in, y, ctrl, **kw)
         eps_or_v, probs = out if capture else (out, None)
         if prediction_type == "v":
             # v-prediction: denoised = c_skip*x - c_out*v  (VP parameterization)

@@ -1,0 +1,213 @@
+"""A1111-format hypernetworks: per-context-width residual MLPs applied
+to the cross-attention k/v context streams.
+
+The reference ecosystem's HypernetworkLoader patches every attn2 call:
+``k = to_k(ctx + MLP_k(ctx) * strength)`` (same for v with its own MLP).
+The MLPs are tiny relative to the UNet, and the text context is
+layer-independent, so this framework applies the transform ONCE per
+model call (models/denoiser.py) and threads the two streams through the
+UNet as (context, context_v) — identical math, one evaluation instead
+of sixteen.
+
+File format (torch pickle): integer keys map context widths to a
+``[k_state_dict, v_state_dict]`` pair of ``nn.Sequential`` exports
+(``linear.N.weight``/``bias``; 2-D weights are Linears, 1-D pairs are
+LayerNorms), plus metadata (``layer_structure``, ``activation_func``,
+``is_layer_norm``, ``activate_output``).  Dropout is an inference
+no-op.  Loads with ``weights_only=True`` — hypernetwork files need no
+arbitrary pickle execution.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from comfyui_distributed_tpu.utils.logging import log
+
+_ACTS = {
+    "linear": None,
+    "relu": jax.nn.relu,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "elu": jax.nn.elu,
+    "swish": jax.nn.silu,
+    "silu": jax.nn.silu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "softsign": jax.nn.soft_sign,
+}
+
+# one parsed stream: ordered layer list of ("linear", w[in,out], b) /
+# ("ln", scale, bias) / ("act", name)
+Layers = List[Tuple]
+# dim -> (k_layers, v_layers)
+Hypernet = Dict[int, Tuple[Layers, Layers]]
+
+
+def _parse_stream(sd: Dict[str, Any], activation: str,
+                  activate_output: bool) -> Layers:
+    """One Sequential export -> ordered layer ops.  Activations carry no
+    params, so they are re-inserted from metadata: after every Linear
+    except the last (plus the last when ``activate_output``)."""
+    import re
+    entries = []
+    for key in sd:
+        m = re.fullmatch(r"(?:linear\.)?(\d+)\.weight", key)
+        if not m:
+            continue
+        idx = int(m.group(1))
+        prefix = key[: -len("weight")]
+        w = np.asarray(sd[key], np.float32)
+        b = np.asarray(sd.get(prefix + "bias", np.zeros(w.shape[0])),
+                       np.float32)
+        entries.append((idx, w, b))
+    entries.sort(key=lambda e: e[0])
+    linear_count = sum(1 for _, w, _ in entries if w.ndim == 2)
+    layers: Layers = []
+    seen_linear = 0
+    for _, w, b in entries:
+        if w.ndim == 2:
+            seen_linear += 1
+            # torch Linear stores [out, in]; jnp matmul wants [in, out]
+            layers.append(("linear", jnp.asarray(w.T), jnp.asarray(b)))
+            if activation != "linear" and (
+                    seen_linear < linear_count or activate_output):
+                layers.append(("act", activation))
+        else:
+            layers.append(("ln", jnp.asarray(w), jnp.asarray(b)))
+    return layers
+
+
+def parse_hypernetwork(sd: Dict[str, Any]) -> Hypernet:
+    activation = str(sd.get("activation_func", "linear")).lower()
+    if activation not in _ACTS:
+        log(f"hypernetwork: unknown activation {activation!r}; "
+            "treating as linear")
+        activation = "linear"
+    activate_output = bool(sd.get("activate_output", False))
+    out: Hypernet = {}
+    for key, value in sd.items():
+        if not isinstance(key, int):
+            continue
+        k_sd, v_sd = value[0], value[1]
+        out[int(key)] = (_parse_stream(k_sd, activation, activate_output),
+                         _parse_stream(v_sd, activation,
+                                       activate_output))
+    return out
+
+
+def _run_stack(layers: Layers, x: jax.Array) -> jax.Array:
+    h = x.astype(jnp.float32)
+    for entry in layers:
+        kind = entry[0]
+        if kind == "linear":
+            _, w, b = entry
+            h = h @ w + b
+        elif kind == "ln":
+            _, scale, bias = entry
+            mean = h.mean(axis=-1, keepdims=True)
+            var = h.var(axis=-1, keepdims=True)
+            h = (h - mean) / jnp.sqrt(var + 1e-5) * scale + bias
+        else:
+            h = _ACTS[entry[1]](h)
+    return h.astype(x.dtype)
+
+
+def apply_hypernetwork(hn: Hypernet, strength: float,
+                       context: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """context -> (context_k, context_v): ``x + MLP(x) * strength`` per
+    stream when the context width has an entry, else passthrough."""
+    dim = int(context.shape[-1])
+    if dim not in hn:
+        return context, context
+    k_layers, v_layers = hn[dim]
+    ctx_k = context + _run_stack(k_layers, context) * strength
+    ctx_v = context + _run_stack(v_layers, context) * strength
+    return ctx_k, ctx_v
+
+
+def _virtual_hypernet(name: str, dims: Tuple[int, ...],
+                      seed: int) -> Hypernet:
+    """Deterministic random hypernet (zero-egress fallback, same policy
+    as virtual checkpoints): small-scale residual MLPs so sampling stays
+    finite while still visibly steering."""
+    out: Hypernet = {}
+    for d in dims:
+        rng = np.random.default_rng((seed, d))
+
+        def stream():
+            w1 = rng.standard_normal((d, d * 2)).astype(np.float32) \
+                / np.sqrt(d) * 0.3
+            w2 = rng.standard_normal((d * 2, d)).astype(np.float32) \
+                / np.sqrt(d * 2) * 0.3
+            return [("linear", jnp.asarray(w1),
+                     jnp.zeros((d * 2,), jnp.float32)),
+                    ("act", "relu"),
+                    ("linear", jnp.asarray(w2),
+                     jnp.zeros((d,), jnp.float32))]
+
+        out[d] = (stream(), stream())
+    return out
+
+
+_cache: Dict[tuple, Hypernet] = {}
+
+
+def load_hypernetwork(name: str, models_dir: Optional[str] = None,
+                      virtual_dims: Tuple[int, ...] = (64, 320, 640,
+                                                       768, 1024, 1280),
+                      ) -> Hypernet:
+    """``<models_dir>/hypernetworks/<name>`` (A1111 .pt); a missing file
+    virtual-initializes deterministically from the name."""
+    key = (models_dir or "", name)
+    if key in _cache:
+        return _cache[key]
+    path = None
+    if models_dir:
+        for cand in (name, name + ".pt"):
+            p = os.path.join(models_dir, "hypernetworks",
+                             cand.replace("\\", "/"))
+            if os.path.isfile(p):
+                path = p
+                break
+    if path is not None:
+        import torch
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+
+        def _denumpy(v):
+            if hasattr(v, "numpy"):
+                return v.numpy()
+            if isinstance(v, dict):
+                return {k: _denumpy(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [_denumpy(x) for x in v]
+            return v
+
+        hn = parse_hypernetwork({k: _denumpy(v) for k, v in sd.items()})
+        log(f"loaded hypernetwork {name} "
+            f"(dims {sorted(hn)}) from {path}")
+        self_attn_dims = sorted(d for d in hn
+                                if d not in (768, 1024, 2048))
+        if self_attn_dims:
+            log(f"hypernetwork {name}: entries at hidden widths "
+                f"{self_attn_dims} target SELF-attention, which this "
+                "framework does not patch — only the text cross-"
+                "attention streams apply (known parity limitation)")
+    else:
+        import zlib
+        seed = zlib.crc32(name.encode())
+        hn = _virtual_hypernet(name, virtual_dims, seed)
+        log(f"virtual hypernetwork {name!r}: no file on disk, "
+            f"deterministic init (seed {seed})")
+    _cache[key] = hn
+    return hn
+
+
+def clear_hypernetwork_cache() -> None:
+    _cache.clear()

@@ -73,15 +73,21 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array,
-                 context: Optional[jax.Array] = None) -> jax.Array:
+                 context: Optional[jax.Array] = None,
+                 context_v: Optional[jax.Array] = None) -> jax.Array:
+        """``context_v``: separate value-side context (hypernetworks
+        transform the k and v context streams independently); defaults
+        to ``context``."""
         c = x.shape[-1]
         hd = self.head_dim or c // self.num_heads
         inner = hd * self.num_heads
         ctx = x if context is None else context
+        ctx_v = ctx if context_v is None else context_v
 
         q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
         k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
-        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype,
+                     name="to_v")(ctx_v)
 
         B, N, _ = q.shape
         M = k.shape[1]
@@ -224,14 +230,16 @@ class TransformerBlock(nn.Module):
     sow_probs: bool = False        # SAG: capture attn1's softmax weights
 
     @nn.compact
-    def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
+    def __call__(self, x: jax.Array, context: Optional[jax.Array],
+                 context_v: Optional[jax.Array] = None) -> jax.Array:
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           attn_impl=self.attn_impl,
                           sow_probs=self.sow_probs, name="attn1")(
             nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(x))
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           attn_impl=self.attn_impl, name="attn2")(
-            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x), context=context)
+            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x), context=context,
+            context_v=context_v)
         x = x + FeedForward(dtype=self.dtype, name="ff")(
             nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm3")(x))
         return x
@@ -266,7 +274,8 @@ class SpatialTransformer(nn.Module):
     sow_probs: bool = False        # SAG: first block's attn1 sows
 
     @nn.compact
-    def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
+    def __call__(self, x: jax.Array, context: Optional[jax.Array],
+                 context_v: Optional[jax.Array] = None) -> jax.Array:
         B, H, W, C = x.shape
         # CompVis attention.py Normalize: GroupNorm eps=1e-6 (the UNet's
         # ResBlock GroupNorm32 uses torch's 1e-5 default instead)
@@ -277,6 +286,7 @@ class SpatialTransformer(nn.Module):
             nh = _hypertile_divisor(H, self.hypertile_tile)
             nw = _hypertile_divisor(W, self.hypertile_tile)
         ctx = context
+        ctx_v = context_v
         if nh * nw > 1:
             th, tw = H // nh, W // nw
             h = h.reshape(B, nh, th, nw, tw, C) \
@@ -284,13 +294,16 @@ class SpatialTransformer(nn.Module):
                 .reshape(B * nh * nw, th * tw, C)
             if context is not None:
                 ctx = jnp.repeat(context, nh * nw, axis=0)
+            if context_v is not None:
+                ctx_v = jnp.repeat(context_v, nh * nw, axis=0)
         else:
             h = h.reshape(B, H * W, C)
         for i in range(self.depth):
             h = TransformerBlock(self.num_heads, dtype=self.dtype,
                                  attn_impl=self.attn_impl,
                                  sow_probs=self.sow_probs and i == 0,
-                                 name=f"blocks_{i}")(h, ctx)
+                                 name=f"blocks_{i}")(h, ctx,
+                                                     context_v=ctx_v)
         if nh * nw > 1:
             th, tw = H // nh, W // nw
             h = h.reshape(B, nh, nw, th, tw, C) \

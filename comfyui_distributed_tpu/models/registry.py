@@ -304,18 +304,19 @@ class DiffusionPipeline:
 
     # --- denoising ----------------------------------------------------------
 
-    def raw_unet_apply(self, params, x, t, context, y=None, control=None):
+    def raw_unet_apply(self, params, x, t, context, y=None, control=None,
+                       context_v=None):
         return self.unet.apply({"params": params}, x, t, context, y=y,
-                               control=control)
+                               control=control, context_v=context_v)
 
     def raw_unet_apply_capture(self, params, x, t, context, y=None,
-                               control=None):
+                               control=None, context_v=None):
         """Like raw_unet_apply but returns (prediction, attn_probs): the
         sag_capture family flag makes the mid-block attn1 sow its
         softmax weights (SAG's blur mask source)."""
         out, inters = self.unet.apply(
             {"params": params}, x, t, context, y=y, control=control,
-            mutable=["intermediates"])
+            context_v=context_v, mutable=["intermediates"])
         leaves = jax.tree_util.tree_leaves(inters)
         if len(leaves) != 1:
             raise RuntimeError(
@@ -427,6 +428,7 @@ class DiffusionPipeline:
                           else None) for c, m, s, sr in entries)
 
         cfg_rescale = float(getattr(self, "cfg_rescale", 0.0) or 0.0)
+        hn_spec = getattr(self, "hypernets", None) or None
         ds_spec = getattr(self, "deep_shrink_spec", None)
         if ds_spec is not None and control is not None:
             log("deep shrink: ControlNet residual shapes can't follow "
@@ -472,6 +474,9 @@ class DiffusionPipeline:
                       (tuple(float(v) for v in sag), ) if sag_ok else (),
                       tuple(float(v) for v in ds_spec)
                       if ds_spec is not None else (),
+                      tuple((float(s), tuple(sorted(h)))
+                            for h, s in hn_spec)
+                      if hn_spec is not None else (),
                       c_concat is not None,
                       tuple(c_concat.shape) if c_concat is not None
                       else (),
@@ -524,22 +529,26 @@ class DiffusionPipeline:
                         self.family.unet,
                         deep_shrink=(int(lvl), float(fac))))
 
-                    def _shrunk(p, x, t, c, y=None, control=None):
+                    def _shrunk(p, x, t, c, y=None, control=None,
+                                context_v=None):
                         return shrunk_mod.apply({"params": p}, x, t, c,
-                                                y=y, control=control)
+                                                y=y, control=control,
+                                                context_v=context_v)
 
-                    def use_apply(p, x, t, c, y=None, control=None):
+                    def use_apply(p, x, t, c, y=None, control=None,
+                                  context_v=None):
                         pred = jnp.logical_and(t[0] > t_lo, t[0] <= t_hi)
                         return jax.lax.cond(
                             pred,
                             lambda a: _shrunk(*a),
                             lambda a: self.raw_unet_apply(*a),
-                            (p, x, t, c, y, control))
+                            (p, x, t, c, y, control, context_v))
 
                 den = make_denoiser(
                     use_apply, unet_params, self.schedule,
                     self.prediction_type, control=ctrl_spec,
-                    concat=concat_in if has_concat else None)
+                    concat=concat_in if has_concat else None,
+                    hypernet=hn_spec)
                 entries = [(ctx_list[i],
                             area_list[i] if has_area[i] else None,
                             strengths[i], sranges[i])
@@ -557,7 +566,8 @@ class DiffusionPipeline:
                         self.raw_unet_apply_capture, unet_params,
                         self.schedule, self.prediction_type,
                         capture=True,
-                        concat=concat_in if has_concat else None)
+                        concat=concat_in if has_concat else None,
+                        hypernet=hn_spec)
                     model = smp.cfg_denoiser_sag(
                         den_cap, den, ctx_list[0], ctx_list[1],
                         cfg_scale, float(sag[0]), float(sag[1]),
@@ -791,8 +801,10 @@ def clear_pipeline_cache() -> None:
         _derived_cache.clear()
         _cn_family_cache.clear()
         _embedding_cache.clear()
+    from comfyui_distributed_tpu.models import hypernetwork as hn_mod
     from comfyui_distributed_tpu.models import lora as lora_mod
     lora_mod.clear_lora_cache()
+    hn_mod.clear_hypernetwork_cache()
 
 
 # derived pipelines (clip-skip variants, external VAEs): param trees are

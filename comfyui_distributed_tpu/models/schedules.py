@@ -203,7 +203,9 @@ def sd_turbo_sigmas(ds: DiscreteSchedule, steps: int,
     ``steps`` of 1000//denoise-spaced timesteps (the reference node's
     arange/flip indexing), trailing 0."""
     steps = max(int(steps), 1)
-    start = max(int(10 - 10 * float(denoise)), 0)
+    # reference: 10 - int(10*denoise), NOT int(10 - 10*denoise) — the
+    # forms differ for fractional denoise (0.25 -> start 8 vs 7)
+    start = max(10 - int(10 * float(denoise)), 0)
     ts = np.flip(np.arange(1, 11) * 100 - 1)[start:start + steps]
     sig = ds.sigmas[ts.astype(int)]
     return np.concatenate([sig, [0.0]]).astype(np.float32)

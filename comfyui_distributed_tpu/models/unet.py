@@ -158,7 +158,8 @@ class UNet(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, timesteps: jax.Array,
                  context: jax.Array, y: Optional[jax.Array] = None,
-                 control=None) -> jax.Array:
+                 control=None,
+                 context_v: Optional[jax.Array] = None) -> jax.Array:
         """x: [B,H,W,C_in] latent; timesteps: [B]; context: [B,M,Cc] text
         tokens; y: [B, adm_in] optional vector conditioning (SDXL);
         control: optional ControlNet residuals ``(skip_list, middle)`` —
@@ -215,7 +216,8 @@ class UNet(nn.Module):
                         heads(out_ch), depth=cfg.transformer_depth[level],
                         dtype=cfg.dtype, attn_impl=cfg.attn_impl,
                         hypertile_tile=ht_tile(level),
-                        name=f"down_{level}_attn_{i}")(h, context)
+                        name=f"down_{level}_attn_{i}")(
+                            h, context, context_v=context_v)
                 skips.append(h)
             if level != cfg.num_levels - 1:
                 h = Downsample(dtype=cfg.dtype, name=f"down_{level}_ds")(h)
@@ -235,7 +237,7 @@ class UNet(nn.Module):
             dtype=cfg.dtype, attn_impl=cfg.attn_impl,
             hypertile_tile=ht_tile(cfg.num_levels - 1),
             sow_probs=cfg.sag_capture,
-            name="mid_attn")(h, context)
+            name="mid_attn")(h, context, context_v=context_v)
         h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_1")(h, emb)
         if control is not None:
             h = h + ctrl_mid
@@ -262,7 +264,8 @@ class UNet(nn.Module):
                         heads(out_ch), depth=cfg.transformer_depth[level],
                         dtype=cfg.dtype, attn_impl=cfg.attn_impl,
                         hypertile_tile=ht_tile(level),
-                        name=f"up_{level}_attn_{i}")(h, context)
+                        name=f"up_{level}_attn_{i}")(
+                            h, context, context_v=context_v)
             if level != 0:
                 h = Upsample(dtype=cfg.dtype, name=f"up_{level}_us")(h)
 

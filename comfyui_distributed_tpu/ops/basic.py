@@ -140,6 +140,33 @@ class ModelSamplingDiscrete(Op):
 
 
 @register_op
+class HypernetworkLoader(Op):
+    """A1111-format hypernetwork: residual MLPs on the cross-attention
+    k/v context streams at ``strength`` (models/hypernetwork.py).
+    Derived pipeline; rides further derivations; virtual-initializes
+    when no file exists (same policy as checkpoints)."""
+    TYPE = "HypernetworkLoader"
+    WIDGETS = ["hypernetwork_name", "strength"]
+    DEFAULTS = {"strength": 1.0}
+
+    def execute(self, ctx: OpContext, model, hypernetwork_name: str,
+                strength: float = 1.0):
+        from comfyui_distributed_tpu.models.hypernetwork import \
+            load_hypernetwork
+        s = float(strength)
+        if s == 0.0:
+            return (model,)
+        hn = load_hypernetwork(str(hypernetwork_name),
+                               models_dir=ctx.models_dir)
+        # chained loaders COMPOSE (reference: attn patches stack)
+        chain = tuple(getattr(model, "hypernets", ())) + ((hn, s),)
+        tag = "hypernet:" + ":".join(
+            f"{id(h):x}:{st}" for h, st in chain)
+        return (registry.derive_pipeline(
+            model, tag, extra_attrs={"hypernets": chain}),)
+
+
+@register_op
 class HyperTile(Op):
     """HyperTile: tile self-attention spatially (tiles ride the batch
     axis) so its cost drops from O(N^2) to O(tiles*(N/tiles)^2) — the
@@ -609,7 +636,7 @@ class SplitSigmasDenoise(Op):
     def execute(self, ctx: OpContext, sigmas, denoise: float = 1.0):
         s = np.asarray(sigmas, np.float32)
         steps = s.shape[0] - 1
-        keep = int(steps * float(denoise))
+        keep = round(steps * float(denoise))   # reference rounds
         i = max(steps - keep, 0)
         return (s[:i + 1], s[i:])
 

@@ -2113,3 +2113,100 @@ class TestRound4ReviewFixes:
                                             "normal", pos, neg, lat, 1.0)
         assert np.isfinite(np.asarray(out["samples"])).all()
         registry.clear_pipeline_cache()
+
+
+class TestHypernetwork:
+    def _make_pt(self, path, dim, seed=0):
+        """A real A1111-layout .pt: torch Sequential exports + metadata."""
+        import torch
+        g = torch.Generator().manual_seed(seed)
+
+        def stream():
+            return {
+                "linear.0.weight": torch.randn((dim * 2, dim),
+                                               generator=g) * 0.2,
+                "linear.0.bias": torch.zeros(dim * 2),
+                "linear.2.weight": torch.randn((dim, dim * 2),
+                                               generator=g) * 0.2,
+                "linear.2.bias": torch.zeros(dim),
+            }
+        torch.save({"layer_structure": [1, 2, 1],
+                    "activation_func": "relu",
+                    "is_layer_norm": False,
+                    "activate_output": False,
+                    dim: [stream(), stream()]}, path)
+
+    def test_parse_and_apply_real_pt(self, tmp_path):
+        import os
+
+        from comfyui_distributed_tpu.models import hypernetwork as hn_mod
+        d = os.path.join(str(tmp_path), "hypernetworks")
+        os.makedirs(d)
+        self._make_pt(os.path.join(d, "style.pt"), 16, seed=3)
+        hn = hn_mod.load_hypernetwork("style", models_dir=str(tmp_path))
+        assert 16 in hn
+        ctx = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (1, 7, 16)), jnp.float32)
+        ck, cv = hn_mod.apply_hypernetwork(hn, 1.0, ctx)
+        assert ck.shape == ctx.shape and cv.shape == ctx.shape
+        assert not np.allclose(np.asarray(ck), np.asarray(ctx))
+        assert not np.allclose(np.asarray(ck), np.asarray(cv))
+        # strength 0: exact passthrough
+        ck0, cv0 = hn_mod.apply_hypernetwork(hn, 0.0, ctx)
+        np.testing.assert_array_equal(np.asarray(ck0), np.asarray(ctx))
+        # unknown width: passthrough untouched
+        other = jnp.zeros((1, 7, 24), jnp.float32)
+        ok, ov = hn_mod.apply_hypernetwork(hn, 1.0, other)
+        assert ok is other and ov is other
+        # torch-reference parity for the k stream: x + relu-MLP(x)
+        import torch
+        sd = torch.load(os.path.join(d, "style.pt"),
+                        weights_only=True)
+        k_sd = sd[16][0]
+        xt = torch.from_numpy(np.asarray(ctx))
+        ref = xt + (torch.relu(xt @ k_sd["linear.0.weight"].T
+                               + k_sd["linear.0.bias"])
+                    @ k_sd["linear.2.weight"].T + k_sd["linear.2.bias"])
+        np.testing.assert_allclose(np.asarray(ck), ref.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+        hn_mod.clear_hypernetwork_cache()
+
+    def test_loader_node_steers_sampling(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("hn-base.ckpt")
+        octx = OpContext()
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (plain,) = get_op("KSampler").execute(octx, p, 3, 2, 4.0,
+                                              "euler", "normal", pos,
+                                              pos, lat, 1.0)
+        (ph,) = get_op("HypernetworkLoader").execute(octx, p,
+                                                     "vstyle.pt", 0.8)
+        assert ph is not p and ph.hypernets[0][1] == 0.8
+        (out,) = get_op("KSampler").execute(octx, ph, 3, 2, 4.0,
+                                            "euler", "normal", pos, pos,
+                                            lat, 1.0)
+        s = np.asarray(out["samples"])
+        assert np.isfinite(s).all()
+        assert not np.allclose(s, np.asarray(plain["samples"]))
+        # strength 0 is a passthrough (no derivation)
+        (p0,) = get_op("HypernetworkLoader").execute(octx, p,
+                                                     "vstyle.pt", 0.0)
+        assert p0 is p
+        # rides a LoRA chain
+        (pl, _) = get_op("LoraLoader").execute(octx, ph, ph,
+                                               "s.safetensors", 0.5, 0.5)
+        assert getattr(pl, "hypernets", None) is not None
+        # chained loaders COMPOSE (reference: attn patches stack)
+        (p2,) = get_op("HypernetworkLoader").execute(octx, ph,
+                                                     "other.pt", 0.3)
+        assert len(p2.hypernets) == 2
+        assert p2.hypernets[0][1] == 0.8 and p2.hypernets[1][1] == 0.3
+        (out2,) = get_op("KSampler").execute(octx, p2, 3, 2, 4.0,
+                                             "euler", "normal", pos,
+                                             pos, lat, 1.0)
+        assert np.isfinite(np.asarray(out2["samples"])).all()
+        assert not np.allclose(np.asarray(out2["samples"]), s)
+        registry.clear_pipeline_cache()
