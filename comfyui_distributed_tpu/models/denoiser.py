@@ -102,12 +102,11 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
         ctx_in, kw = context, {}
         if hypernet is not None and context is not None:
             from comfyui_distributed_tpu.models.hypernetwork import \
-                apply_hypernetwork
+                apply_hypernetwork_pair
             ctx_in = ctx_v = context
             for hn, s in hypernet:
-                k2, v2 = apply_hypernetwork(hn, float(s), ctx_in)
-                _, v3 = apply_hypernetwork(hn, float(s), ctx_v)
-                ctx_in, ctx_v = k2, v3
+                ctx_in, ctx_v = apply_hypernetwork_pair(
+                    hn, float(s), ctx_in, ctx_v)
             kw = {"context_v": ctx_v}
         out = apply_fn(params, xin, ts, ctx_in, y, ctrl, **kw)
         eps_or_v, probs = out if capture else (out, None)

@@ -123,13 +123,20 @@ def apply_hypernetwork(hn: Hypernet, strength: float,
                        ) -> Tuple[jax.Array, jax.Array]:
     """context -> (context_k, context_v): ``x + MLP(x) * strength`` per
     stream when the context width has an entry, else passthrough."""
-    dim = int(context.shape[-1])
+    return apply_hypernetwork_pair(hn, strength, context, context)
+
+
+def apply_hypernetwork_pair(hn: Hypernet, strength: float,
+                            ctx_k: jax.Array, ctx_v: jax.Array
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """Chained form: the k stack runs on the (already-transformed) k
+    stream, the v stack on the v stream — one evaluation each."""
+    dim = int(ctx_k.shape[-1])
     if dim not in hn:
-        return context, context
+        return ctx_k, ctx_v
     k_layers, v_layers = hn[dim]
-    ctx_k = context + _run_stack(k_layers, context) * strength
-    ctx_v = context + _run_stack(v_layers, context) * strength
-    return ctx_k, ctx_v
+    return (ctx_k + _run_stack(k_layers, ctx_k) * strength,
+            ctx_v + _run_stack(v_layers, ctx_v) * strength)
 
 
 def _virtual_hypernet(name: str, dims: Tuple[int, ...],

@@ -819,6 +819,15 @@ _DERIVED_CACHE_CAP = 8
 _cn_family_cache: Dict[str, str] = {}
 
 
+def derived_cached(base: DiffusionPipeline,
+                   tag: str) -> Optional[DiffusionPipeline]:
+    """Cache probe for derive_pipeline — ops that pay a real cost to
+    BUILD their derivation inputs (weight-space merges) check this
+    first instead of recomputing a tree the cache would discard."""
+    with _pipeline_lock:
+        return _derived_cache.get((base.cache_token, tag))
+
+
 def copy_sampler_patches(src: DiffusionPipeline,
                          dst: DiffusionPipeline) -> None:
     """Sampler-visible patches that must ride EVERY derivation chain
@@ -841,8 +850,9 @@ def derive_pipeline(base: DiffusionPipeline, tag: str,
                     cfg_rescale: Optional[float] = None,
                     prediction_type: Optional[str] = None,
                     schedule: Any = None,
-                    extra_attrs: Optional[Dict[str, Any]] = None
-                    ) -> DiffusionPipeline:
+                    extra_attrs: Optional[Dict[str, Any]] = None,
+                    unet_params: Any = None,
+                    clip_params: Any = None) -> DiffusionPipeline:
     """Cached clone of ``base`` with a replacement family (e.g. clip-skip
     configs), VAE params, and/or sampling patches; everything else shared
     by reference."""
@@ -853,7 +863,8 @@ def derive_pipeline(base: DiffusionPipeline, tag: str,
             return _derived_cache[key]
     clone = DiffusionPipeline(
         f"{base.name}|{tag}", family or base.family,
-        base.unet_params, base.clip_params,
+        unet_params if unet_params is not None else base.unet_params,
+        clip_params if clip_params is not None else base.clip_params,
         vae_params if vae_params is not None else base.vae_params,
         prediction_type=prediction_type or base.prediction_type,
         assets_dir=base.assets_dir)

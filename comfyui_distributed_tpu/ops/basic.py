@@ -106,6 +106,168 @@ class RescaleCFG(Op):
                                          cfg_rescale=m),)
 
 
+def _merge_trees(t1, t2, ratio_of_key):
+    """Per-leaf lerp of two structurally-equal param trees:
+    ``out = a * r + b * (1 - r)`` with r from the leaf's tree path."""
+    import jax
+
+    def leaf(path, a, b):
+        key = jax.tree_util.keystr(path)
+        r = float(ratio_of_key(key))
+        return (jnp.asarray(a, jnp.float32) * r
+                + jnp.asarray(b, jnp.float32) * (1.0 - r)) \
+            .astype(jnp.asarray(a).dtype)
+
+    return jax.tree_util.tree_map_with_path(leaf, t1, t2)
+
+
+@register_op
+class ModelMergeSimple(Op):
+    """Weight-space lerp of two same-family UNets:
+    ``model1 * ratio + model2 * (1 - ratio)`` (the reference ecosystem's
+    merge node)."""
+    TYPE = "ModelMergeSimple"
+    WIDGETS = ["ratio"]
+    DEFAULTS = {"ratio": 1.0}
+
+    def execute(self, ctx: OpContext, model1, model2,
+                ratio: float = 1.0):
+        if model1.family.unet != model2.family.unet:
+            raise ValueError("ModelMergeSimple: UNet configs differ "
+                             f"({model1.family.name} vs "
+                             f"{model2.family.name})")
+        tag = f"merge:{model2.cache_token}:{float(ratio)}"
+        cached = registry.derived_cached(model1, tag)
+        if cached is not None:      # don't redo a gigabyte-scale lerp
+            return (cached,)
+        merged = _merge_trees(model1.unet_params, model2.unet_params,
+                              lambda _k: float(ratio))
+        return (registry.derive_pipeline(model1, tag,
+                                         unet_params=merged),)
+
+
+@register_op
+class ModelMergeBlocks(Op):
+    """Per-section merge ratios (the reference's input/middle/out block
+    split): encoder + time/label embeds use ``input``, the mid block
+    ``middle``, decoder + output head ``out``."""
+    TYPE = "ModelMergeBlocks"
+    WIDGETS = ["input", "middle", "out"]
+    DEFAULTS = {"input": 1.0, "middle": 1.0, "out": 1.0}
+
+    def execute(self, ctx: OpContext, model1, model2, input: float = 1.0,
+                middle: float = 1.0, out: float = 1.0):
+        if model1.family.unet != model2.family.unet:
+            raise ValueError("ModelMergeBlocks: UNet configs differ")
+
+        def ratio_of(key: str) -> float:
+            # anchor on the TOP-LEVEL tree key: ResBlocks contain an
+            # inner 'out_norm' GroupNorm, so substring matching would
+            # misroute encoder norms into the 'out' section
+            if key.startswith("['mid_"):
+                return float(middle)
+            if (key.startswith("['up_") or key.startswith("['out_norm'")
+                    or key.startswith("['conv_out'")):
+                return float(out)
+            return float(input)     # down_/conv_in/time_/label_
+
+        tag = f"mergeb:{model2.cache_token}:{input}:{middle}:{out}"
+        cached = registry.derived_cached(model1, tag)
+        if cached is not None:
+            return (cached,)
+        merged = _merge_trees(model1.unet_params, model2.unet_params,
+                              ratio_of)
+        return (registry.derive_pipeline(model1, tag,
+                                         unet_params=merged),)
+
+
+@register_op
+class CLIPMergeSimple(Op):
+    TYPE = "CLIPMergeSimple"
+    WIDGETS = ["ratio"]
+    DEFAULTS = {"ratio": 1.0}
+
+    def execute(self, ctx: OpContext, clip1, clip2, ratio: float = 1.0):
+        if len(clip1.clip_params) != len(clip2.clip_params):
+            raise ValueError("CLIPMergeSimple: tower counts differ")
+        tag = f"clipmerge:{clip2.cache_token}:{float(ratio)}"
+        cached = registry.derived_cached(clip1, tag)
+        if cached is not None:
+            return (cached,)
+        merged = [_merge_trees(a, b, lambda _k: float(ratio))
+                  for a, b in zip(clip1.clip_params, clip2.clip_params)]
+        return (registry.derive_pipeline(clip1, tag,
+                                         clip_params=merged),)
+
+
+@register_op
+class LoraLoaderModelOnly(Op):
+    """LoraLoader that patches the UNet only (the CLIP stays wired to
+    the base)."""
+    TYPE = "LoraLoaderModelOnly"
+    WIDGETS = ["lora_name", "strength_model"]
+    DEFAULTS = {"strength_model": 1.0}
+
+    def execute(self, ctx: OpContext, model, lora_name: str,
+                strength_model: float = 1.0):
+        from comfyui_distributed_tpu.models.lora import \
+            apply_lora_to_pipeline
+        sm = float(strength_model)
+        if sm == 0.0:
+            return (model,)
+        return (apply_lora_to_pipeline(model, str(lora_name), sm, 0.0,
+                                       models_dir=ctx.models_dir),)
+
+
+@register_op
+class VAESave(Op):
+    """Export a VAE as a standalone bare-key safetensors (loads back via
+    VAELoader and in the reference ecosystem)."""
+    TYPE = "VAESave"
+    OUTPUT_NODE = True
+    WIDGETS = ["filename_prefix"]
+    DEFAULTS = {"filename_prefix": "vae/save"}
+
+    def execute(self, ctx: OpContext, vae,
+                filename_prefix: str = "vae/save"):
+        from comfyui_distributed_tpu.models.checkpoints import (
+            _ExportMapper, _run_vae, save_state_dict)
+        path = _safe_output_path(ctx.output_dir or os.getcwd(),
+                                 f"{filename_prefix}.safetensors")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        sd = _run_vae(_ExportMapper(vae.vae_params, ""), vae.family.vae)
+        save_state_dict(sd, path)
+        debug_log(f"VAESave: wrote {path}")
+        return ()
+
+
+@register_op
+class CLIPSave(Op):
+    """Export the text encoder tower(s) with their in-checkpoint
+    prefixes (round-trips through this framework's converter)."""
+    TYPE = "CLIPSave"
+    OUTPUT_NODE = True
+    WIDGETS = ["filename_prefix"]
+    DEFAULTS = {"filename_prefix": "clip/save"}
+
+    def execute(self, ctx: OpContext, clip,
+                filename_prefix: str = "clip/save"):
+        from comfyui_distributed_tpu.models.checkpoints import (
+            _ExportMapper, _clip_prefixes, _clip_runner, save_state_dict)
+        path = _safe_output_path(ctx.output_dir or os.getcwd(),
+                                 f"{filename_prefix}.safetensors")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        sd = {}
+        for ccfg, tree, prefix in zip(clip.family.clips,
+                                      clip.clip_params,
+                                      _clip_prefixes(clip.family)):
+            sd.update(_clip_runner(ccfg)(_ExportMapper(tree, prefix),
+                                         ccfg))
+        save_state_dict(sd, path)
+        debug_log(f"CLIPSave: wrote {path}")
+        return ()
+
+
 @register_op
 class ModelSamplingDiscrete(Op):
     """ComfyUI's ModelSamplingDiscrete: re-declare how the model's
@@ -158,12 +320,16 @@ class HypernetworkLoader(Op):
             return (model,)
         hn = load_hypernetwork(str(hypernetwork_name),
                                models_dir=ctx.models_dir)
-        # chained loaders COMPOSE (reference: attn patches stack)
+        # chained loaders COMPOSE (reference: attn patches stack);
+        # the tag is CONTENT-stable (name@dir, not id()) so a recycled
+        # object id after a cache clear can't alias a stale clone
         chain = tuple(getattr(model, "hypernets", ())) + ((hn, s),)
-        tag = "hypernet:" + ":".join(
-            f"{id(h):x}:{st}" for h, st in chain)
+        chain_tag = (getattr(model, "hypernet_tag", "")
+                     + f"|{hypernetwork_name}@{ctx.models_dir or ''}x{s}")
         return (registry.derive_pipeline(
-            model, tag, extra_attrs={"hypernets": chain}),)
+            model, "hypernet:" + chain_tag,
+            extra_attrs={"hypernets": chain,
+                         "hypernet_tag": chain_tag}),)
 
 
 @register_op

@@ -2210,3 +2210,132 @@ class TestHypernetwork:
         assert np.isfinite(np.asarray(out2["samples"])).all()
         assert not np.allclose(np.asarray(out2["samples"]), s)
         registry.clear_pipeline_cache()
+
+
+class TestModelMergingAndSaves:
+    def test_model_merge_simple_exact(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        import jax as _jax
+        registry.clear_pipeline_cache()
+        a = registry.load_pipeline("merge-a.ckpt")
+        b = registry.load_pipeline("merge-b.ckpt")
+        octx = OpContext()
+        (m1,) = get_op("ModelMergeSimple").execute(octx, a, b, 1.0)
+        _jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-6),
+            m1.unet_params, a.unet_params)
+        (mh,) = get_op("ModelMergeSimple").execute(octx, a, b, 0.25)
+        la = _jax.tree_util.tree_leaves(a.unet_params)[0]
+        lb = _jax.tree_util.tree_leaves(b.unet_params)[0]
+        lm = _jax.tree_util.tree_leaves(mh.unet_params)[0]
+        np.testing.assert_allclose(
+            np.asarray(lm),
+            np.asarray(la) * 0.25 + np.asarray(lb) * 0.75, rtol=1e-5)
+        # CLIP/VAE stay model1's (ComfyUI merges the UNet only here)
+        assert mh.clip_params is a.clip_params
+        registry.clear_pipeline_cache()
+
+    def test_model_merge_blocks_sections(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        a = registry.load_pipeline("mergeb-a.ckpt")
+        b = registry.load_pipeline("mergeb-b.ckpt")
+        octx = OpContext()
+        (m,) = get_op("ModelMergeBlocks").execute(octx, a, b, 1.0, 0.0,
+                                                  1.0)
+        # middle ratio 0 -> mid blocks are exactly model2's
+        np.testing.assert_allclose(
+            np.asarray(m.unet_params["mid_res_0"]["in_conv"]["kernel"]),
+            np.asarray(b.unet_params["mid_res_0"]["in_conv"]["kernel"]),
+            rtol=1e-6)
+        # encoder ratio 1 -> down blocks are exactly model1's
+        np.testing.assert_allclose(
+            np.asarray(m.unet_params["conv_in"]["kernel"]),
+            np.asarray(a.unet_params["conv_in"]["kernel"]), rtol=1e-6)
+        registry.clear_pipeline_cache()
+
+    def test_clip_merge_and_lora_model_only(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        import jax as _jax
+        registry.clear_pipeline_cache()
+        a = registry.load_pipeline("cm-a.ckpt")
+        b = registry.load_pipeline("cm-b.ckpt")
+        octx = OpContext()
+        (c,) = get_op("CLIPMergeSimple").execute(octx, a, b, 0.5)
+        la = _jax.tree_util.tree_leaves(a.clip_params[0])[0]
+        lb = _jax.tree_util.tree_leaves(b.clip_params[0])[0]
+        lc = _jax.tree_util.tree_leaves(c.clip_params[0])[0]
+        np.testing.assert_allclose(
+            np.asarray(lc), (np.asarray(la) + np.asarray(lb)) / 2,
+            rtol=1e-5)
+        (lm,) = get_op("LoraLoaderModelOnly").execute(
+            octx, a, "style.safetensors", 0.7)
+        assert lm is not a and lm.clip_params is a.clip_params
+        assert lm.unet_params is not a.unet_params
+        registry.clear_pipeline_cache()
+
+    def test_vae_and_clip_save_round_trip(self, tmp_path, monkeypatch):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        import jax as _jax
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("saver.ckpt")
+        octx = OpContext()
+        octx.output_dir = str(tmp_path)
+        get_op("VAESave").execute(octx, p, "vae/exported")
+        import os
+        vp = os.path.join(str(tmp_path), "vae", "exported.safetensors")
+        assert os.path.exists(vp)
+        # bare-key standalone file loads back through VAELoader
+        reloaded = registry.load_vae(
+            os.path.relpath(vp, str(tmp_path)), models_dir=str(tmp_path),
+            family_name="tiny")
+        _jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6),
+            reloaded.vae_params, p.vae_params)
+        get_op("CLIPSave").execute(octx, p, "clip/exported")
+        assert os.path.exists(os.path.join(str(tmp_path), "clip",
+                                           "exported.safetensors"))
+        registry.clear_pipeline_cache()
+
+
+class TestMergeBlocksSectionAnchoring:
+    def test_encoder_inner_out_norm_uses_input_ratio(self):
+        """ResBlocks contain an inner 'out_norm'; a substring match
+        would misroute encoder norms into the 'out' section."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        a = registry.load_pipeline("anchor-a.ckpt")
+        b = registry.load_pipeline("anchor-b.ckpt")
+        octx = OpContext()
+        (m,) = get_op("ModelMergeBlocks").execute(octx, a, b, 1.0, 1.0,
+                                                  0.0)
+        # encoder ResBlock's INNER out_norm follows the input ratio (1.0
+        # -> model1), not the out ratio
+        np.testing.assert_allclose(
+            np.asarray(m.unet_params["down_0_res_0"]["out_norm"]
+                       ["GroupNorm_0"]["scale"])
+            if "GroupNorm_0" in m.unet_params["down_0_res_0"]["out_norm"]
+            else np.asarray(m.unet_params["down_0_res_0"]["out_norm"]
+                            [next(iter(m.unet_params["down_0_res_0"]
+                                       ["out_norm"]))]["scale"]),
+            np.asarray(a.unet_params["down_0_res_0"]["out_norm"]
+                       ["GroupNorm_0"]["scale"])
+            if "GroupNorm_0" in a.unet_params["down_0_res_0"]["out_norm"]
+            else np.asarray(a.unet_params["down_0_res_0"]["out_norm"]
+                            [next(iter(a.unet_params["down_0_res_0"]
+                                       ["out_norm"]))]["scale"]),
+            rtol=1e-6)
+        # the top-level out_norm follows the OUT ratio (0.0 -> model2)
+        top = m.unet_params["out_norm"]
+        key = next(iter(top))
+        np.testing.assert_allclose(
+            np.asarray(top[key]["scale"]),
+            np.asarray(b.unet_params["out_norm"][key]["scale"]),
+            rtol=1e-6)
+        # cache probe: re-execution returns the same object
+        (m2,) = get_op("ModelMergeBlocks").execute(octx, a, b, 1.0, 1.0,
+                                                   0.0)
+        assert m2 is m
+        registry.clear_pipeline_cache()
