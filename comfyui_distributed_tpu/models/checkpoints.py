@@ -510,6 +510,31 @@ def _run_clip_hf(m, cfg: CLIPConfig):
     return m.finish("CLIP")
 
 
+def _run_clip_vision(m, cfg):
+    """HF CLIPVisionModel layout (the ``clip_vision/*.safetensors``
+    exports the reference ecosystem's CLIPVisionLoader consumes).
+    Note HF's actual key spelling ``pre_layrnorm``."""
+    m.raw("vision_model.embeddings.class_embedding", "class_embedding")
+    m.raw("vision_model.embeddings.position_embedding.weight",
+          "position_embedding")
+    m.conv("vision_model.embeddings.patch_embedding", "patch_embed")
+    m.norm("vision_model.pre_layrnorm", "pre_ln")
+    for i in range(cfg.layers):
+        t = f"vision_model.encoder.layers.{i}"
+        f = f"layers_{i}"
+        m.norm(f"{t}.layer_norm1", f"{f}/ln1")
+        m.linear(f"{t}.self_attn.q_proj", f"{f}/q")
+        m.linear(f"{t}.self_attn.k_proj", f"{f}/k")
+        m.linear(f"{t}.self_attn.v_proj", f"{f}/v")
+        m.linear(f"{t}.self_attn.out_proj", f"{f}/proj")
+        m.norm(f"{t}.layer_norm2", f"{f}/ln2")
+        m.linear(f"{t}.mlp.fc1", f"{f}/fc1")
+        m.linear(f"{t}.mlp.fc2", f"{f}/fc2")
+    m.norm("vision_model.post_layernorm", "post_ln")
+    m.linear("visual_projection", "visual_projection", bias=False)
+    return m.finish("CLIPVision")
+
+
 def _run_openclip(m, cfg: CLIPConfig):
     """OpenCLIP text-tower layout (SDXL's bigG embedder)."""
     m.raw("token_embedding.weight", "token_embedding/embedding")

@@ -1,0 +1,140 @@
+"""CLIP vision transformer (ViT) — the image tower behind the
+reference ecosystem's CLIPVisionLoader / CLIPVisionEncode /
+unCLIPConditioning surface.
+
+Standard CLIP ViT: patchify conv -> [class token; patches] + position
+embeddings -> pre-LN -> non-causal transformer (the text tower's
+CLIPLayer with a zero mask) -> post-LN class token -> visual
+projection.  The projected class embedding is what unCLIP models
+consume as their ADM image conditioning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from comfyui_distributed_tpu.models.clip import CLIPConfig, CLIPLayer
+
+# CLIP preprocessing constants (OpenAI CLIP normalize)
+CLIP_MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
+CLIP_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPVisionConfig:
+    width: int = 1280
+    layers: int = 32
+    heads: int = 16
+    patch: int = 14
+    image_size: int = 224
+    projection_dim: int = 1024
+    act: str = "gelu"
+    dtype: Any = jnp.float32
+
+
+# ViT-H/14 (the SD2.1-unclip-h image tower: 1024-d projected embeds)
+VIT_H_CONFIG = CLIPVisionConfig()
+# ViT-L/14 (the IP-Adapter/SD-unclip-l line: 768-d)
+VIT_L_CONFIG = CLIPVisionConfig(width=1024, layers=24, heads=16,
+                                projection_dim=768, act="quick_gelu")
+TINY_VISION_CONFIG = CLIPVisionConfig(width=64, layers=2, heads=4,
+                                      patch=16, image_size=64,
+                                      projection_dim=32)
+
+
+class CLIPVisionModel(nn.Module):
+    cfg: CLIPVisionConfig
+
+    @nn.compact
+    def __call__(self, pixels: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+        """pixels: [B, image_size, image_size, 3], CLIP-normalized.
+        Returns (last_hidden [B, 1+P, width], image_embeds [B, proj])."""
+        cfg = self.cfg
+        B = pixels.shape[0]
+        h = nn.Conv(cfg.width, (cfg.patch, cfg.patch),
+                    strides=(cfg.patch, cfg.patch), use_bias=False,
+                    dtype=cfg.dtype, name="patch_embed")(pixels)
+        h = h.reshape(B, -1, cfg.width)
+        cls = self.param("class_embedding",
+                         nn.initializers.normal(0.02), (cfg.width,))
+        h = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, cfg.width)).astype(h.dtype),
+             h], axis=1)
+        n_pos = (cfg.image_size // cfg.patch) ** 2 + 1
+        pos = self.param("position_embedding",
+                         nn.initializers.normal(0.02),
+                         (n_pos, cfg.width))
+        h = h + pos[None, : h.shape[1], :].astype(h.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                         name="pre_ln")(h)
+        lcfg = CLIPConfig(width=cfg.width, layers=cfg.layers,
+                          heads=cfg.heads, act=cfg.act, dtype=cfg.dtype)
+        mask = jnp.zeros((1, 1, h.shape[1], h.shape[1]), jnp.float32)
+        for i in range(cfg.layers):
+            h = CLIPLayer(lcfg, name=f"layers_{i}")(h, mask)
+        pooled = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                              name="post_ln")(h[:, 0])
+        embeds = nn.Dense(cfg.projection_dim, use_bias=False,
+                          dtype=jnp.float32,
+                          name="visual_projection")(pooled)
+        return h.astype(jnp.float32), embeds.astype(jnp.float32)
+
+
+def preprocess(images: np.ndarray, size: int,
+               crop: str = "center") -> np.ndarray:
+    """[B,H,W,3] float [0,1] -> CLIP-normalized [B,size,size,3]:
+    resize-short-side + center crop (crop="center", the reference
+    default) or plain squash (crop="none")."""
+    from comfyui_distributed_tpu.utils.image import resize_image
+
+    imgs = np.asarray(images, np.float32)
+    B, H, W, _ = imgs.shape
+    if crop != "none" and H != W:
+        if H < W:
+            nw = max(int(round(W * size / H)), size)
+            imgs = resize_image(imgs, nw, size, "bicubic")
+            x0 = (nw - size) // 2
+            imgs = imgs[:, :, x0:x0 + size]
+        else:
+            nh = max(int(round(H * size / W)), size)
+            imgs = resize_image(imgs, size, nh, "bicubic")
+            y0 = (nh - size) // 2
+            imgs = imgs[:, y0:y0 + size]
+    else:
+        imgs = resize_image(imgs, size, size, "bicubic")
+    return (np.clip(imgs, 0.0, 1.0) - CLIP_MEAN) / CLIP_STD
+
+
+@dataclasses.dataclass
+class CLIPVisionTower:
+    """CLIP_VISION wire object: module + params + jit cache."""
+    name: str
+    cfg: CLIPVisionConfig
+    params: Any
+    _jitted: Any = None
+
+    def encode(self, images: np.ndarray, crop: str = "center"):
+        """-> CLIPVisionOutput(image_embeds [B, proj],
+        last_hidden [B, 1+P, width])."""
+        module = CLIPVisionModel(self.cfg)
+        if self._jitted is None:
+            self._jitted = jax.jit(
+                lambda p, x: module.apply({"params": p}, x))
+        px = jnp.asarray(preprocess(images, self.cfg.image_size, crop))
+        hidden, embeds = self._jitted(self.params, px)
+        return CLIPVisionOutput(image_embeds=embeds,
+                                last_hidden=hidden)
+
+
+@dataclasses.dataclass
+class CLIPVisionOutput:
+    """CLIP_VISION_OUTPUT wire object."""
+    image_embeds: Any
+    last_hidden: Any = None

@@ -46,6 +46,10 @@ class ModelFamily:
     vae: vae_mod.VAEConfig
     clips: Tuple[clip_mod.CLIPConfig, ...]
     latent_channels: int = 4
+    # how the UNet's ADM vector is built: "sdxl" (pooled text + size
+    # embeds) or "unclip" (noise-augmented CLIP-vision embed + noise
+    # level embedding — ops/basic.py _sdxl_vector_cond)
+    adm_kind: str = "sdxl"
 
 
 FAMILIES: Dict[str, ModelFamily] = {
@@ -96,11 +100,30 @@ FAMILIES: Dict[str, ModelFamily] = {
         clips=(clip_mod.CLIP_L_SDXL_CONFIG,
                clip_mod.OPEN_CLIP_BIGG_CONFIG),
     ),
+    # SD2.1-unclip (stable-diffusion-2-1-unclip, "h" line): the SD21
+    # v-pred UNet grown an ADM head consuming the noise-augmented ViT-H
+    # image embedding (1024) + the noise-level timestep embedding (1024)
+    "sd21_unclip": ModelFamily(
+        name="sd21_unclip",
+        unet=dataclasses.replace(unet_mod.SD21_CONFIG,
+                                 adm_in_channels=2048),
+        vae=vae_mod.SD_VAE_CONFIG,
+        clips=(clip_mod.OPEN_CLIP_H_CONFIG,),
+        adm_kind="unclip",
+    ),
     "tiny": ModelFamily(
         name="tiny",
         unet=unet_mod.TINY_CONFIG,
         vae=vae_mod.TINY_VAE_CONFIG,
         clips=(clip_mod.TINY_CLIP_CONFIG,),
+    ),
+    "tiny_unclip": ModelFamily(
+        name="tiny_unclip",
+        unet=dataclasses.replace(unet_mod.TINY_CONFIG,
+                                 adm_in_channels=64),
+        vae=vae_mod.TINY_VAE_CONFIG,
+        clips=(clip_mod.TINY_CLIP_CONFIG,),
+        adm_kind="unclip",
     ),
     "tiny_inpaint": ModelFamily(
         name="tiny_inpaint",
@@ -133,7 +156,11 @@ def detect_family(ckpt_name: str) -> str:
     lowered = ckpt_name.lower()
     inpaint = "inpaint" in lowered
     if "tiny" in lowered or "test" in lowered:
+        if "unclip" in lowered:
+            return "tiny_unclip"
         return "tiny_inpaint" if inpaint else "tiny"
+    if "unclip" in lowered:
+        return "sd21_unclip"
     if "xl" in lowered:
         return "sdxl_inpaint" if inpaint else "sdxl"
     # Stability SD2 naming only — a bare "v2" would misroute SD1.5
@@ -801,6 +828,7 @@ def clear_pipeline_cache() -> None:
         _derived_cache.clear()
         _cn_family_cache.clear()
         _embedding_cache.clear()
+        _clip_vision_cache.clear()
     from comfyui_distributed_tpu.models import hypernetwork as hn_mod
     from comfyui_distributed_tpu.models import lora as lora_mod
     lora_mod.clear_lora_cache()
@@ -1014,6 +1042,60 @@ def load_controlnet(cn_name: str, models_dir: Optional[str] = None,
     with _pipeline_lock:
         _pipeline_cache[key] = entry
     return entry
+
+
+_clip_vision_cache: Dict[str, Any] = {}
+
+
+def load_clip_vision(clip_name: str, models_dir: Optional[str] = None,
+                     config_name: Optional[str] = None):
+    """CLIPVisionLoader equivalent: ``<models_dir>/clip_vision/<name>``
+    in the HF CLIPVisionModel safetensors layout; virtual-initializes
+    when no file exists.  The config is inferred from the file's hidden
+    width (ViT-H vs ViT-L), or forced by ``config_name``
+    ('vit_h' | 'vit_l' | 'tiny')."""
+    from comfyui_distributed_tpu.models import clip_vision as cv
+    key = f"{clip_name}:{config_name or ''}:{models_dir or ''}"
+    if key in _clip_vision_cache:
+        return _clip_vision_cache[key]
+    cfgs = {"vit_h": cv.VIT_H_CONFIG, "vit_l": cv.VIT_L_CONFIG,
+            "tiny": cv.TINY_VISION_CONFIG}
+    path = None
+    if models_dir:
+        for cand in (clip_name,
+                     os.path.join("clip_vision", clip_name)):
+            p = os.path.join(models_dir, cand.replace("\\", "/"))
+            if os.path.isfile(p):
+                path = p
+                break
+    if path is not None:
+        from comfyui_distributed_tpu.models.checkpoints import (
+            _LoadMapper, _run_clip_vision, load_state_dict)
+        sd = load_state_dict(path)
+        if config_name:
+            cfg = cfgs[config_name]
+        else:
+            w = sd.get("vision_model.embeddings.class_embedding")
+            width = int(w.shape[-1]) if w is not None else 1280
+            cfg = cv.VIT_H_CONFIG if width >= 1280 else cv.VIT_L_CONFIG
+        params = _run_clip_vision(_LoadMapper(sd, ""), cfg)
+        log(f"loaded CLIP vision {clip_name} (width {cfg.width}) "
+            f"from {path}")
+    else:
+        lowered = clip_name.lower()
+        cfg = cfgs.get(config_name or "", None)
+        if cfg is None:
+            cfg = cv.TINY_VISION_CONFIG if ("tiny" in lowered
+                                            or "test" in lowered) \
+                else cv.VIT_H_CONFIG
+        seed = _name_seed(clip_name)
+        px = jnp.zeros((1, cfg.image_size, cfg.image_size, 3))
+        params = _virtual_params(cv.CLIPVisionModel(cfg), seed, px)
+        log(f"virtual CLIP vision {clip_name!r} (width {cfg.width}): "
+            f"no file on disk, deterministic init (seed {seed})")
+    tower = cv.CLIPVisionTower(name=clip_name, cfg=cfg, params=params)
+    _clip_vision_cache[key] = tower
+    return tower
 
 
 def load_vae(vae_name: str, models_dir: Optional[str] = None,

@@ -45,6 +45,9 @@ class Conditioning:
     # 1 + C] latent-resolution array of [mask, masked-image latent],
     # concatenated to the UNet input every call (9-channel families)
     concat_latent: Any = None
+    # unCLIP image conditioning: tuple of (image_embed [1, D], strength,
+    # noise_augmentation) entries consumed by unclip-ADM families
+    unclip: Any = None
     # SDXL size conditioning (CLIPTextEncodeSDXL / ...Refiner): tuple of
     # scalars each embedded at 256 sinusoidal dims and appended to the
     # pooled text emb in the ADM vector — base order (height, width,

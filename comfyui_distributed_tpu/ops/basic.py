@@ -571,6 +571,88 @@ class CLIPTextEncode(Op):
 
 
 @register_op
+class CLIPVisionLoader(Op):
+    """-> CLIP_VISION (models/clip_vision.py tower); HF safetensors
+    layout from <models>/clip_vision/, virtual init otherwise."""
+    TYPE = "CLIPVisionLoader"
+    WIDGETS = ["clip_name"]
+
+    def execute(self, ctx: OpContext, clip_name: str):
+        return (registry.load_clip_vision(str(clip_name),
+                                          models_dir=ctx.models_dir),)
+
+
+@register_op
+class CLIPVisionEncode(Op):
+    """IMAGE -> CLIP_VISION_OUTPUT (projected class embedding +
+    penultimate hiddens); crop: center (reference default) / none."""
+    TYPE = "CLIPVisionEncode"
+    WIDGETS = ["crop"]
+    DEFAULTS = {"crop": "center"}
+
+    def execute(self, ctx: OpContext, clip_vision, image,
+                crop: str = "center"):
+        with Timer("clip_vision_encode"):
+            out = clip_vision.encode(as_image_array(image),
+                                     crop=str(crop))
+        return (out,)
+
+
+@register_op
+class unCLIPConditioning(Op):
+    """Attach a CLIP-vision embedding to a conditioning for unclip-ADM
+    models (image variations): entries accumulate like the reference's
+    unclip_conditioning list and apply to every regional sibling."""
+    TYPE = "unCLIPConditioning"
+    WIDGETS = ["strength", "noise_augmentation"]
+    DEFAULTS = {"strength": 1.0, "noise_augmentation": 0.0}
+
+    def execute(self, ctx: OpContext, conditioning: Conditioning,
+                clip_vision_output, strength: float = 1.0,
+                noise_augmentation: float = 0.0):
+        entry = (np.asarray(clip_vision_output.image_embeds, np.float32),
+                 float(strength), float(noise_augmentation))
+
+        def _attach(e: Conditioning) -> Conditioning:
+            return dataclasses.replace(
+                e, unclip=tuple(getattr(e, "unclip", None) or ())
+                + (entry,))
+
+        out = _attach(conditioning)
+        return (dataclasses.replace(
+            out, siblings=tuple(_attach(s)
+                                for s in getattr(conditioning,
+                                                 "siblings", ()) or ())),)
+
+
+@register_op
+class unCLIPCheckpointLoader(Op):
+    """-> (MODEL, CLIP, VAE, CLIP_VISION) for unclip checkpoints.  The
+    diffusion towers load like CheckpointLoaderSimple (family detected
+    as sd21_unclip); extracting the vision tower embedded in real
+    unclip checkpoint files (OpenCLIP visual layout) is not implemented
+    — the vision tower virtual-initializes with a LOUD log, or load one
+    explicitly with CLIPVisionLoader."""
+    TYPE = "unCLIPCheckpointLoader"
+    WIDGETS = ["ckpt_name"]
+
+    def execute(self, ctx: OpContext, ckpt_name: str):
+        pipe = registry.load_pipeline(ckpt_name,
+                                      models_dir=ctx.models_dir)
+        name = str(ckpt_name)
+        if ctx.models_dir and os.path.exists(
+                os.path.join(ctx.models_dir, name)):
+            log(f"unCLIPCheckpointLoader: extracting the embedded vision "
+                f"tower from {name!r} is not supported; using a "
+                "virtual tower (load one with CLIPVisionLoader instead)")
+        vision = registry.load_clip_vision(
+            f"{name}.vision",
+            config_name="tiny" if pipe.family.name.startswith("tiny")
+            else "vit_h")
+        return (pipe, pipe, pipe, vision)
+
+
+@register_op
 class CLIPTextEncodeSDXL(Op):
     """ComfyUI's SDXL dual-prompt encode: text_l feeds the CLIP-L tower,
     text_g the OpenCLIP tower (whose pooled output becomes the ADM
@@ -1297,8 +1379,15 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                 # each entry carries its OWN pooled ADM vector (regional
                 # SDXL: region B must not ride region A's pooled); an
                 # entry without one falls back to the primary positive's
+                if getattr(model.family, "adm_kind", "sdxl") == "unclip":
+                    # each entry builds from its OWN unclip list: a
+                    # negative without one gets ZERO ADM (the reference
+                    # zero-fills), never the positive's image embedding
+                    src = e
+                else:
+                    src = e if e.pooled is not None else positive
                 ye = _sdxl_vector_cond(
-                    model, e if e.pooled is not None else positive,
+                    model, src,
                     total, lat.shape[1] * 8, lat.shape[2] * 8)
                 if fanout > 1 and mesh is not None:
                     ye = coll.shard_batch(ye, mesh)
@@ -1424,13 +1513,62 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                          cfg2=cfg2, c_concat=c_concat)
 
 
+def _unclip_vector_cond(pipe, cond: Conditioning, batch: int):
+    """unCLIP ADM vector (documented approximation of the reference's
+    CLIPEmbeddingNoiseAugmentation): each entry's CLIP-vision embed is
+    q_sample-noised to ``round(999 * noise_augmentation)`` on the
+    model's own schedule (deterministic noise keyed by the embed's
+    content), concatenated with that level's timestep embedding, scaled
+    by strength, and entries SUM (the reference's weighted merge).  The
+    dataset mean/std rescale of the trained augmentor ships with real
+    weights and is not modeled — noted limitation."""
+    import zlib
+
+    from comfyui_distributed_tpu.models.layers import timestep_embedding
+    want = int(pipe.family.unet.adm_in_channels)
+    half = want // 2
+    entries = getattr(cond, "unclip", None) or ()
+    if not entries:
+        return jnp.zeros((batch, want))
+    acc = np.zeros((1, want), np.float32)
+    abar = np.asarray(pipe.schedule.alphas_cumprod, np.float32)
+    for embed, strength, noise_aug in entries:
+        e = np.asarray(embed, np.float32)
+        if e.ndim == 1:
+            e = e[None]
+        if e.shape[0] > 1:
+            log("unCLIP: batched vision embeds — using row 0 (encode "
+                "images separately for multi-image conditioning)")
+        e = e[:1]
+        if e.shape[1] < half:
+            e = np.pad(e, ((0, 0), (0, half - e.shape[1])))
+        e = e[:, :half]
+        # widget range is [0, 1]; clamp so a stray negative can't
+        # negative-index into max noise and >1 can't IndexError
+        level = min(max(int(round((abar.shape[0] - 1)
+                                  * float(noise_aug))), 0),
+                    abar.shape[0] - 1)
+        rng = np.random.default_rng(zlib.crc32(e.tobytes()) + level)
+        noised = (np.sqrt(abar[level]) * e
+                  + np.sqrt(max(1.0 - abar[level], 0.0))
+                  * rng.standard_normal(e.shape).astype(np.float32))
+        lvl = np.asarray(timestep_embedding(
+            jnp.asarray([level], jnp.float32), half), np.float32)
+        acc = acc + np.concatenate([noised, lvl], axis=-1) \
+            * float(strength)
+    return jnp.repeat(jnp.asarray(acc), batch, axis=0)
+
+
 def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,
                       height: int, width: int):
     """SDXL ADM vector: pooled text emb + size conditioning embeddings.
     A Conditioning carrying ``size_cond`` (CLIPTextEncodeSDXL /
     ...Refiner) supplies its own scalar tuple; otherwise the actual
-    latent dims stand in as (H, W, 0, 0, H, W)."""
+    latent dims stand in as (H, W, 0, 0, H, W).  unclip-ADM families
+    route to _unclip_vector_cond instead."""
     from comfyui_distributed_tpu.models.layers import timestep_embedding
+    if getattr(pipe.family, "adm_kind", "sdxl") == "unclip":
+        return _unclip_vector_cond(pipe, cond, batch)
     pooled = cond.pooled
     if pooled is None:
         pooled = jnp.zeros((1, 1280))

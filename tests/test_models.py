@@ -2339,3 +2339,154 @@ class TestMergeBlocksSectionAnchoring:
                                                    0.0)
         assert m2 is m
         registry.clear_pipeline_cache()
+
+
+class TestUnCLIP:
+    def test_vision_tower_encode_shapes(self):
+        registry.clear_pipeline_cache()
+        tower = registry.load_clip_vision("tiny-vision")
+        rng = np.random.default_rng(2)
+        img = rng.uniform(0, 1, (2, 48, 96, 3)).astype(np.float32)
+        out = tower.encode(img, crop="center")
+        assert out.image_embeds.shape == (2, 32)
+        n_tok = (64 // 16) ** 2 + 1
+        assert out.last_hidden.shape == (2, n_tok, 64)
+        assert np.isfinite(np.asarray(out.image_embeds)).all()
+        # center crop differs from squash on a non-square source
+        out2 = tower.encode(img, crop="none")
+        assert not np.allclose(np.asarray(out.image_embeds),
+                               np.asarray(out2.image_embeds))
+        registry.clear_pipeline_cache()
+
+    def test_vision_checkpoint_round_trip(self, tmp_path):
+        """A real HF-layout vision safetensors loads through the
+        converter and matches the exporting params."""
+        import os
+
+        import jax as _jax
+        from comfyui_distributed_tpu.models import clip_vision as cv
+        from comfyui_distributed_tpu.models.checkpoints import \
+            save_state_dict
+        registry.clear_pipeline_cache()
+        tower = registry.load_clip_vision("tiny-vision-rt")
+        p = tower.params
+        sd = {}
+        sd["vision_model.embeddings.class_embedding"] = \
+            np.asarray(p["class_embedding"], np.float32)
+        sd["vision_model.embeddings.position_embedding.weight"] = \
+            np.asarray(p["position_embedding"], np.float32)
+        k = np.asarray(p["patch_embed"]["kernel"], np.float32)
+        sd["vision_model.embeddings.patch_embedding.weight"] = \
+            k.transpose(3, 2, 0, 1)
+        for tk, fk in (("pre_layrnorm", "pre_ln"),
+                       ("post_layernorm", "post_ln")):
+            sd[f"vision_model.{tk}.weight"] = \
+                np.asarray(p[fk]["scale"], np.float32)
+            sd[f"vision_model.{tk}.bias"] = \
+                np.asarray(p[fk]["bias"], np.float32)
+        for i in range(tower.cfg.layers):
+            lp = p[f"layers_{i}"]
+            t = f"vision_model.encoder.layers.{i}"
+            for tn, fn in (("layer_norm1", "ln1"), ("layer_norm2",
+                                                    "ln2")):
+                sd[f"{t}.{tn}.weight"] = np.asarray(lp[fn]["scale"])
+                sd[f"{t}.{tn}.bias"] = np.asarray(lp[fn]["bias"])
+            for tn, fn in (("self_attn.q_proj", "q"),
+                           ("self_attn.k_proj", "k"),
+                           ("self_attn.v_proj", "v"),
+                           ("self_attn.out_proj", "proj"),
+                           ("mlp.fc1", "fc1"), ("mlp.fc2", "fc2")):
+                sd[f"{t}.{tn}.weight"] = \
+                    np.asarray(lp[fn]["kernel"]).T
+                sd[f"{t}.{tn}.bias"] = np.asarray(lp[fn]["bias"])
+        sd["visual_projection.weight"] = \
+            np.asarray(p["visual_projection"]["kernel"]).T
+        d = os.path.join(str(tmp_path), "clip_vision")
+        os.makedirs(d)
+        # save_state_dict, NOT raw safetensors save_file: transposed
+        # views silently round-trip WRONG through save_file (it ignores
+        # strides) — the production saver makes arrays contiguous
+        save_state_dict(sd, os.path.join(d, "tiny_vit.safetensors"))
+        loaded = registry.load_clip_vision("tiny_vit.safetensors",
+                                           models_dir=str(tmp_path),
+                                           config_name="tiny")
+        _jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6),
+            loaded.params, tower.params)
+        registry.clear_pipeline_cache()
+
+    def test_unclip_conditioning_and_sampling(self, monkeypatch):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        monkeypatch.delenv(registry.FAMILY_ENV, raising=False)
+        assert registry.detect_family("sd21-unclip-h.ckpt") \
+            == "sd21_unclip"
+        registry.clear_pipeline_cache()
+        octx = OpContext()
+        model, clip, vae, vision = get_op("unCLIPCheckpointLoader") \
+            .execute(octx, "tiny-unclip-a.ckpt")
+        assert model.family.adm_kind == "unclip"
+        rng = np.random.default_rng(5)
+        img = rng.uniform(0, 1, (1, 64, 64, 3)).astype(np.float32)
+        (vout,) = get_op("CLIPVisionEncode").execute(octx, vision, img,
+                                                     "center")
+        pos = Conditioning(context=model.encode_prompt(["a fox"])[0])
+        neg = Conditioning(context=model.encode_prompt([""])[0])
+        (posu,) = get_op("unCLIPConditioning").execute(octx, pos, vout,
+                                                       1.0, 0.1)
+        assert len(posu.unclip) == 1
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (out,) = get_op("KSampler").execute(octx, model, 3, 2, 5.0,
+                                            "euler", "normal", posu, neg,
+                                            lat, 1.0)
+        s = np.asarray(out["samples"])
+        assert np.isfinite(s).all()
+        # the image conditioning steers: dropping it changes the result
+        (plain,) = get_op("KSampler").execute(octx, model, 3, 2, 5.0,
+                                              "euler", "normal", pos,
+                                              neg, lat, 1.0)
+        assert not np.allclose(s, np.asarray(plain["samples"]))
+        # higher noise augmentation changes the ADM
+        (posn,) = get_op("unCLIPConditioning").execute(octx, pos, vout,
+                                                       1.0, 0.9)
+        (outn,) = get_op("KSampler").execute(octx, model, 3, 2, 5.0,
+                                             "euler", "normal", posn,
+                                             neg, lat, 1.0)
+        assert not np.allclose(s, np.asarray(outn["samples"]))
+        registry.clear_pipeline_cache()
+
+
+class TestUnCLIPReviewFixes:
+    def test_uncond_adm_is_zero_and_clamping(self):
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        from comfyui_distributed_tpu.ops.basic import _unclip_vector_cond
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("tiny-unclip-fix.ckpt",
+                                   family_name="tiny_unclip")
+        # no entries -> zeros (the reference's zero-fill for uncond)
+        z = _unclip_vector_cond(
+            p, Conditioning(context=None), 2)
+        np.testing.assert_array_equal(np.asarray(z),
+                                      np.zeros((2, 64), np.float32))
+        emb = np.ones((1, 32), np.float32)
+        # negative augmentation clamps to level 0, >1 clamps to max
+        lo = _unclip_vector_cond(
+            p, Conditioning(context=None, unclip=((emb, 1.0, -0.5),)), 1)
+        lo0 = _unclip_vector_cond(
+            p, Conditioning(context=None, unclip=((emb, 1.0, 0.0),)), 1)
+        np.testing.assert_array_equal(np.asarray(lo), np.asarray(lo0))
+        hi = _unclip_vector_cond(
+            p, Conditioning(context=None, unclip=((emb, 1.0, 2.0),)), 1)
+        assert np.isfinite(np.asarray(hi)).all()
+        # batched embeds: row 0 wins, with identical result to passing
+        # row 0 directly
+        b2 = np.stack([np.ones(32, np.float32),
+                       np.full(32, 9.0, np.float32)])
+        vb = _unclip_vector_cond(
+            p, Conditioning(context=None, unclip=((b2, 1.0, 0.1),)), 1)
+        v0 = _unclip_vector_cond(
+            p, Conditioning(context=None, unclip=((b2[:1], 1.0, 0.1),)),
+            1)
+        np.testing.assert_array_equal(np.asarray(vb), np.asarray(v0))
+        registry.clear_pipeline_cache()
