@@ -1056,8 +1056,9 @@ def load_clip_vision(clip_name: str, models_dir: Optional[str] = None,
     ('vit_h' | 'vit_l' | 'tiny')."""
     from comfyui_distributed_tpu.models import clip_vision as cv
     key = f"{clip_name}:{config_name or ''}:{models_dir or ''}"
-    if key in _clip_vision_cache:
-        return _clip_vision_cache[key]
+    with _pipeline_lock:
+        if key in _clip_vision_cache:
+            return _clip_vision_cache[key]
     cfgs = {"vit_h": cv.VIT_H_CONFIG, "vit_l": cv.VIT_L_CONFIG,
             "tiny": cv.TINY_VISION_CONFIG}
     path = None
@@ -1094,7 +1095,8 @@ def load_clip_vision(clip_name: str, models_dir: Optional[str] = None,
         log(f"virtual CLIP vision {clip_name!r} (width {cfg.width}): "
             f"no file on disk, deterministic init (seed {seed})")
     tower = cv.CLIPVisionTower(name=clip_name, cfg=cfg, params=params)
-    _clip_vision_cache[key] = tower
+    with _pipeline_lock:
+        _clip_vision_cache[key] = tower
     return tower
 
 

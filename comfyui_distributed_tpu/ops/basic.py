@@ -585,7 +585,9 @@ class CLIPVisionLoader(Op):
 @register_op
 class CLIPVisionEncode(Op):
     """IMAGE -> CLIP_VISION_OUTPUT (projected class embedding +
-    penultimate hiddens); crop: center (reference default) / none."""
+    FINAL-layer hiddens; consumers needing the reference's
+    penultimate-hidden contract would need a tower-side tap); crop:
+    center (reference default) / none."""
     TYPE = "CLIPVisionEncode"
     WIDGETS = ["crop"]
     DEFAULTS = {"crop": "center"}
@@ -1383,11 +1385,11 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                     # each entry builds from its OWN unclip list: a
                     # negative without one gets ZERO ADM (the reference
                     # zero-fills), never the positive's image embedding
-                    src = e
+                    adm_src = e
                 else:
-                    src = e if e.pooled is not None else positive
+                    adm_src = e if e.pooled is not None else positive
                 ye = _sdxl_vector_cond(
-                    model, src,
+                    model, adm_src,
                     total, lat.shape[1] * 8, lat.shape[2] * 8)
                 if fanout > 1 and mesh is not None:
                     ye = coll.shard_batch(ye, mesh)
@@ -1409,6 +1411,8 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                 ") requires plain single-entry positive/negative "
                 "conditionings")
         mid_ctx = mid_built[0][0]
+    unclip_adm = adm and getattr(model.family, "adm_kind",
+                                 "sdxl") == "unclip"
     if multi:
         ctx_arr = cond_entries
         unc_arr = unc_entries
@@ -1418,13 +1422,23 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
         unc_arr = unc_entries[0][0]
         # one ADM vector per [cond, middle, uncond] block; middle rides
         # its OWN pooled (fallback to the positive's inside
-        # _build_entries), the negative rides the positive's like the
-        # plain single-entry path
-        y = [y_conds[0], y_mids[0], y_conds[0]] if adm else None
+        # _build_entries).  SDXL-kind: the negative rides the positive's
+        # like the plain path; unclip-kind: the negative keeps its OWN
+        # (zero-filled) vector so CFG amplifies the image guidance
+        if adm:
+            y = [y_conds[0], y_mids[0],
+                 y_unconds[0] if unclip_adm else y_conds[0]]
+        else:
+            y = None
     else:   # the unchanged single-entry path: plain arrays
         ctx_arr = cond_entries[0][0]
         unc_arr = unc_entries[0][0]
-        y = y_conds[0] if adm else None
+        if adm and unclip_adm:
+            # per-block list: the uncond block gets the negative's
+            # zero-filled ADM, not a replicated positive embedding
+            y = [y_conds[0], y_unconds[0]]
+        else:
+            y = y_conds[0] if adm else None
 
     # control may hang on ANY conditioning entry (ComfyUI honors all).
     # One net/hint runs per step; its strength becomes a per-ENTRY tuple

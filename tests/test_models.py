@@ -2490,3 +2490,29 @@ class TestUnCLIPReviewFixes:
             1)
         np.testing.assert_array_equal(np.asarray(vb), np.asarray(v0))
         registry.clear_pipeline_cache()
+
+
+class TestUnCLIPUncondZeroFill:
+    def test_uncond_block_gets_zero_adm(self, monkeypatch):
+        """The CFG uncond row must ride the negative's ZERO-filled ADM,
+        not a replicated positive image embedding — otherwise
+        cfg*(cond-uncond) cancels the image guidance."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext)
+        from comfyui_distributed_tpu.ops.basic import \
+            _prepare_sample_inputs
+        monkeypatch.setenv(registry.FAMILY_ENV, "tiny_unclip")
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("zero-unc.ckpt")
+        emb = np.ones((1, 32), np.float32)
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0],
+                           unclip=((emb, 1.0, 0.0),))
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        prep = _prepare_sample_inputs(OpContext(), p, 0, lat, pos, neg)
+        assert isinstance(prep.y, list) and len(prep.y) == 2
+        assert not np.allclose(np.asarray(prep.y[0]), 0.0)
+        np.testing.assert_array_equal(np.asarray(prep.y[1]),
+                                      np.zeros_like(
+                                          np.asarray(prep.y[1])))
+        registry.clear_pipeline_cache()
