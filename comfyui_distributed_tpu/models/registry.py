@@ -125,6 +125,16 @@ FAMILIES: Dict[str, ModelFamily] = {
         clips=(clip_mod.TINY_CLIP_CONFIG,),
         adm_kind="unclip",
     ),
+    # SDXL-shaped tiny family: an ADM head wide enough (128 > the tiny
+    # pooled width 64) that CLIPTextEncodeSDXL's size embeddings
+    # actually reach the UNet — the sdxl fixture's CPU test target
+    "tiny_sdxl": ModelFamily(
+        name="tiny_sdxl",
+        unet=dataclasses.replace(unet_mod.TINY_CONFIG,
+                                 adm_in_channels=128),
+        vae=vae_mod.TINY_VAE_CONFIG,
+        clips=(clip_mod.TINY_CLIP_CONFIG,),
+    ),
     "tiny_inpaint": ModelFamily(
         name="tiny_inpaint",
         unet=dataclasses.replace(unet_mod.TINY_CONFIG, in_channels=9),

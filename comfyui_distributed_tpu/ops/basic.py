@@ -1931,6 +1931,145 @@ class EmptyImage(Op):
             rgb, (int(batch_size), int(height), int(width), 3)).copy(),)
 
 
+def _canny_edges(gray: np.ndarray, low: float, high: float) -> np.ndarray:
+    """Canny on one [H, W] grayscale frame: gaussian 5x5 -> sobel ->
+    gradient NMS (4-way quantized) -> double threshold + hysteresis
+    (the reference ecosystem's kornia-backed Canny node's pipeline)."""
+    g = _gaussian_blur(gray[None, ..., None], 2, 1.4)[0, ..., 0]
+    kx = np.asarray([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]], np.float32)
+    ky = kx.T
+    pad = np.pad(g, 1, mode="edge")
+    gx = sum(kx[i, j] * pad[i:i + g.shape[0], j:j + g.shape[1]]
+             for i in range(3) for j in range(3))
+    gy = sum(ky[i, j] * pad[i:i + g.shape[0], j:j + g.shape[1]]
+             for i in range(3) for j in range(3))
+    mag = np.hypot(gx, gy)
+    ang = (np.rad2deg(np.arctan2(gy, gx)) + 180.0) % 180.0
+    # non-maximum suppression along the quantized gradient direction
+    mp = np.pad(mag, 1)
+    offs = np.where(ang < 22.5, 0, np.where(ang < 67.5, 1,
+                    np.where(ang < 112.5, 2, np.where(ang < 157.5, 3,
+                                                      0))))
+    d = {0: ((0, 1), (0, -1)), 1: ((-1, 1), (1, -1)),
+         2: ((-1, 0), (1, 0)), 3: ((-1, -1), (1, 1))}
+    keep = np.zeros_like(mag, bool)
+    for o, ((dy1, dx1), (dy2, dx2)) in d.items():
+        sel = offs == o
+        n1 = mp[1 + dy1:1 + dy1 + mag.shape[0],
+                1 + dx1:1 + dx1 + mag.shape[1]]
+        n2 = mp[1 + dy2:1 + dy2 + mag.shape[0],
+                1 + dx2:1 + dx2 + mag.shape[1]]
+        keep |= sel & (mag >= n1) & (mag >= n2)
+    nms = np.where(keep, mag, 0.0)
+    strong = nms >= high
+    weak = (nms >= low) & ~strong
+    # hysteresis, EXACT: an 8-connected component of candidate pixels
+    # survives iff it contains a strong pixel (one labeling pass —
+    # iterative flooding would truncate chains longer than the image
+    # diameter)
+    from scipy import ndimage
+    labels, _ = ndimage.label(strong | weak, structure=np.ones((3, 3)))
+    keep_ids = np.unique(labels[strong])
+    keep_ids = keep_ids[keep_ids != 0]
+    return np.isin(labels, keep_ids).astype(np.float32)
+
+
+@register_op
+class Canny(Op):
+    """IMAGE -> edge IMAGE (ControlNet hint preprocessor)."""
+    TYPE = "Canny"
+    WIDGETS = ["low_threshold", "high_threshold"]
+    DEFAULTS = {"low_threshold": 0.4, "high_threshold": 0.8}
+
+    def execute(self, ctx: OpContext, image, low_threshold: float = 0.4,
+                high_threshold: float = 0.8):
+        img = as_image_array(image)
+        gray = img @ np.asarray([0.299, 0.587, 0.114], np.float32)
+        with Timer("canny"):
+            edges = np.stack([_canny_edges(f, float(low_threshold),
+                                           float(high_threshold))
+                              for f in gray])
+        return (np.repeat(edges[..., None], 3, axis=-1),)
+
+
+@register_op
+class ImageFromBatch(Op):
+    TYPE = "ImageFromBatch"
+    WIDGETS = ["batch_index", "length"]
+    DEFAULTS = {"batch_index": 0, "length": 1}
+
+    def execute(self, ctx: OpContext, image, batch_index: int = 0,
+                length: int = 1):
+        img = as_image_array(image)
+        i = min(max(int(batch_index), 0), img.shape[0] - 1)
+        return (img[i:i + max(int(length), 1)],)
+
+
+@register_op
+class RebatchImages(Op):
+    """IMAGE -> IMAGE (batch_size ignored headless: this framework's
+    executor carries whole arrays, so rebatching is an identity — the
+    reference node exists to bound per-call VRAM in its executor)."""
+    TYPE = "RebatchImages"
+    WIDGETS = ["batch_size"]
+    DEFAULTS = {"batch_size": 1}
+
+    def execute(self, ctx: OpContext, images, batch_size: int = 1):
+        return (as_image_array(images),)
+
+
+@register_op
+class RebatchLatents(Op):
+    """LATENT -> LATENT (same identity rationale as RebatchImages)."""
+    TYPE = "RebatchLatents"
+    WIDGETS = ["batch_size"]
+    DEFAULTS = {"batch_size": 1}
+
+    def execute(self, ctx: OpContext, latents, batch_size: int = 1):
+        return ({**_latent_meta(latents),
+                 "samples": np.asarray(latents["samples"],
+                                       np.float32)},)
+
+
+def _morpho(m: np.ndarray, op: str, size: int) -> np.ndarray:
+    """Grayscale morphology with a square structuring element (the
+    reference's Morphology node set)."""
+    from scipy import ndimage
+    k = max(int(size), 1)
+    fns = {"erode": ndimage.grey_erosion,
+           "dilate": ndimage.grey_dilation,
+           "open": ndimage.grey_opening,
+           "close": ndimage.grey_closing}
+    if op in fns:
+        return np.stack([fns[op](f, size=(k, k)) for f in m])
+    if op == "gradient":
+        return np.stack([ndimage.grey_dilation(f, size=(k, k))
+                         - ndimage.grey_erosion(f, size=(k, k))
+                         for f in m])
+    if op == "top_hat":
+        return np.stack([f - ndimage.grey_opening(f, size=(k, k))
+                         for f in m])
+    if op == "bottom_hat":
+        return np.stack([ndimage.grey_closing(f, size=(k, k)) - f
+                         for f in m])
+    raise ValueError(f"unknown morphology operation {op!r}")
+
+
+@register_op
+class Morphology(Op):
+    TYPE = "Morphology"
+    WIDGETS = ["operation", "kernel_size"]
+    DEFAULTS = {"operation": "dilate", "kernel_size": 3}
+
+    def execute(self, ctx: OpContext, image, operation: str = "dilate",
+                kernel_size: int = 3):
+        img = as_image_array(image)
+        out = np.stack([_morpho(img[..., c], str(operation),
+                                int(kernel_size))
+                        for c in range(img.shape[-1])], axis=-1)
+        return (np.clip(out, 0.0, 1.0).astype(np.float32),)
+
+
 @register_op
 class ImageCompositeMasked(Op):
     """Paste ``source`` over ``destination`` at pixel (x, y), optionally

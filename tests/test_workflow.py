@@ -782,12 +782,29 @@ class TestRound4Fixtures:
         g = parse_workflow("/root/repo/workflows/distributed-sdxl.json")
         g.nodes["2"].inputs.update(width=64, height=64, batch_size=1)
         g.nodes["6"].inputs.update(steps=2)
-        res = WorkflowExecutor(
-            self._ctx(tmp_path, monkeypatch)).execute(g)
+        # tiny_sdxl: an ADM-bearing family so the dual-prompt size
+        # conds actually reach the UNet (plain 'tiny' would skip the
+        # whole y path)
+        ctx = self._ctx(tmp_path, monkeypatch, family="tiny_sdxl")
+        res = WorkflowExecutor(ctx).execute(g)
         assert len(res.images) == 2
         imgs = np.stack(res.images)
         assert np.isfinite(imgs).all()
         assert not np.allclose(imgs[0], imgs[1])
+        # the explicit size conds steer: a different target size
+        # changes the output (regression net for size_cond handling)
+        g2 = parse_workflow(
+            "/root/repo/workflows/distributed-sdxl.json")
+        g2.nodes["2"].inputs.update(width=64, height=64, batch_size=1)
+        g2.nodes["6"].inputs.update(steps=2)
+        # vary the FIRST ADM scalar (declared height): the tiny family's
+        # 128-dim ADM head truncates past the height embedding
+        g2.nodes["3"].inputs.update(width=256, height=256)
+        registry.clear_pipeline_cache()
+        res2 = WorkflowExecutor(
+            self._ctx(tmp_path, monkeypatch,
+                      family="tiny_sdxl")).execute(g2)
+        assert not np.allclose(imgs[0], np.stack(res2.images)[0])
 
     def test_inpaint_model_fixture(self, tmp_path, monkeypatch):
         from comfyui_distributed_tpu.workflow import (WorkflowExecutor,
@@ -823,3 +840,59 @@ class TestRound4Fixtures:
         imgs = np.stack(res.images)
         assert np.isfinite(imgs).all()
         assert not np.allclose(imgs[0], imgs[1])
+
+
+class TestCannyBatchMorphoNodes:
+    def _op(self, name):
+        from comfyui_distributed_tpu.ops.base import get_op
+        return get_op(name)
+
+    def _ctx(self):
+        return OpContext()
+
+    def test_canny_finds_a_box_edge(self):
+        octx = self._ctx()
+        img = np.zeros((1, 32, 32, 3), np.float32)
+        img[:, 8:24, 8:24] = 1.0
+        (edges,) = self._op("Canny").execute(octx, img, 0.1, 0.3)
+        assert edges.shape == (1, 32, 32, 3)
+        assert set(np.unique(edges)) <= {0.0, 1.0}
+        # edges ring the box, interior and background stay empty
+        assert edges[0, 8, 16, 0] == 1.0 or edges[0, 7, 16, 0] == 1.0
+        assert edges[0, 16, 16, 0] == 0.0
+        assert edges[0, 2, 2, 0] == 0.0
+        # a flat image has no edges
+        (none,) = self._op("Canny").execute(
+            octx, np.full((1, 16, 16, 3), 0.5, np.float32), 0.1, 0.3)
+        assert none.sum() == 0.0
+
+    def test_image_from_batch_and_rebatch(self):
+        octx = self._ctx()
+        img = np.arange(3 * 4 * 4 * 3, dtype=np.float32) \
+            .reshape(3, 4, 4, 3)
+        (one,) = self._op("ImageFromBatch").execute(octx, img, 1, 1)
+        np.testing.assert_array_equal(one, img[1:2])
+        (two,) = self._op("ImageFromBatch").execute(octx, img, 1, 2)
+        assert two.shape[0] == 2
+        (rb,) = self._op("RebatchImages").execute(octx, img, 2)
+        np.testing.assert_array_equal(rb, img)
+        lat = {"samples": np.ones((2, 4, 4, 4), np.float32),
+               "fanout": 2}
+        (rl,) = self._op("RebatchLatents").execute(octx, lat, 1)
+        assert rl["fanout"] == 2
+
+    def test_morphology_ops(self):
+        octx = self._ctx()
+        img = np.zeros((1, 9, 9, 3), np.float32)
+        img[:, 4, 4] = 1.0
+        (d,) = self._op("Morphology").execute(octx, img, "dilate", 3)
+        assert d[0, 3, 3, 0] == 1.0 and d[0, 1, 1, 0] == 0.0
+        (e,) = self._op("Morphology").execute(octx, d, "erode", 3)
+        np.testing.assert_array_equal(e, img)
+        (g,) = self._op("Morphology").execute(octx, img, "gradient", 3)
+        # gradient of a point: dilation minus erosion is 1 across the
+        # whole dilated neighborhood (erosion of a point is empty)
+        assert g[0, 4, 4, 0] == 1.0 and g[0, 3, 4, 0] == 1.0
+        assert g[0, 1, 1, 0] == 0.0
+        with pytest.raises(ValueError):
+            self._op("Morphology").execute(octx, img, "nope", 3)
