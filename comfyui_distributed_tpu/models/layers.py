@@ -228,14 +228,34 @@ class TransformerBlock(nn.Module):
     dtype: Dtype = jnp.bfloat16
     attn_impl: str = "xla"
     sow_probs: bool = False        # SAG: capture attn1's softmax weights
+    # ToMe: merge this fraction of attn1's QUERY tokens into their most
+    # similar destinations (models/tome.py); needs the token grid dims
+    tome_ratio: float = 0.0
+    hw: Optional[tuple] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array],
                  context_v: Optional[jax.Array] = None) -> jax.Array:
-        x = x + Attention(self.num_heads, dtype=self.dtype,
+        xn = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                          name="norm1")(x)
+        attn1 = Attention(self.num_heads, dtype=self.dtype,
                           attn_impl=self.attn_impl,
-                          sow_probs=self.sow_probs, name="attn1")(
-            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(x))
+                          sow_probs=self.sow_probs, name="attn1")
+        if (self.tome_ratio > 0.0 and self.hw is not None
+                and not self.sow_probs):
+            from comfyui_distributed_tpu.models.tome import build_merge
+            th, tw = self.hw
+            merge, unmerge, r = build_merge(
+                xn.astype(jnp.float32), th, tw, self.tome_ratio)
+            if r > 0:
+                # merged queries attend the FULL token set (k/v
+                # unmerged, the reference's attn1 patch): kept tokens'
+                # outputs are exact, merged ones adopt their dst's
+                x = x + unmerge(attn1(merge(xn), context=xn))
+            else:
+                x = x + attn1(xn)
+        else:
+            x = x + attn1(xn)
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           attn_impl=self.attn_impl, name="attn2")(
             nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x), context=context,
@@ -272,6 +292,7 @@ class SpatialTransformer(nn.Module):
     attn_impl: str = "xla"
     hypertile_tile: int = 0
     sow_probs: bool = False        # SAG: first block's attn1 sows
+    tome_ratio: float = 0.0        # ToMe query merging (models/tome.py)
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array],
@@ -298,10 +319,13 @@ class SpatialTransformer(nn.Module):
                 ctx_v = jnp.repeat(context_v, nh * nw, axis=0)
         else:
             h = h.reshape(B, H * W, C)
+        th, tw = (H // nh, W // nw) if nh * nw > 1 else (H, W)
         for i in range(self.depth):
             h = TransformerBlock(self.num_heads, dtype=self.dtype,
                                  attn_impl=self.attn_impl,
                                  sow_probs=self.sow_probs and i == 0,
+                                 tome_ratio=self.tome_ratio,
+                                 hw=(th, tw),
                                  name=f"blocks_{i}")(h, ctx,
                                                      context_v=ctx_v)
         if nh * nw > 1:

@@ -1,0 +1,105 @@
+"""Token merging (ToMe / tomesd) for stable-diffusion self-attention.
+
+The reference ecosystem's TomePatchModel merges the ``r`` most-similar
+query tokens into their nearest "destination" token before attn1 and
+unmerges after: attention cost drops from O(N^2) to O((N-r)*N) with
+minimal quality loss at moderate ratios.
+
+TPU shape: everything here is static — the destination grid is the
+deterministic top-left token of every 2x2 cell (tomesd's ``no_rand``
+mode; the randomized grid is jit-hostile), ``r`` is a trace-time
+constant from the ratio widget, and merge/unmerge are gathers plus one
+segment-mean.  Following the reference's attn1 patch, only the QUERY
+side merges — keys/values stay full, so the attention output for kept
+tokens is mathematically unchanged and merged tokens adopt their
+destination's output on unmerge.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dst_grid_indices(h: int, w: int, sy: int = 2,
+                     sx: int = 2) -> Tuple[np.ndarray, np.ndarray]:
+    """Token indices of (dst, src) for an h x w grid: dst = the
+    top-left token of each sy x sx cell, src = everything else."""
+    idx = np.arange(h * w).reshape(h, w)
+    dst = idx[::sy, ::sx].reshape(-1)
+    mask = np.zeros(h * w, bool)
+    mask[dst] = True
+    src = np.nonzero(~mask)[0]
+    return dst, src
+
+
+def build_merge(metric: jax.Array, h: int, w: int, ratio: float,
+                sy: int = 2, sx: int = 2
+                ) -> Tuple[Callable, Callable, int]:
+    """-> (merge, unmerge, r).
+
+    ``metric`` [B, N, C]: the similarity features (the block's normed
+    hidden states).  ``merge(x)`` -> [B, N - r, C'] with rows laid out
+    [kept_src; dst] (merged src tokens mean-pool into their most
+    similar dst).  ``unmerge(y)`` -> [B, N, C']: kept rows scatter
+    back, merged src rows copy their dst's row.  r = 0 returns
+    identities."""
+    B, N, _ = metric.shape
+    assert N == h * w, (N, h, w)
+    dst_idx, src_idx = dst_grid_indices(h, w, sy, sx)
+    n_src = src_idx.shape[0]
+    r = min(int(N * float(ratio)), n_src)
+    if r <= 0:
+        return (lambda x: x), (lambda y: y), 0
+
+    m = metric / jnp.maximum(
+        jnp.linalg.norm(metric, axis=-1, keepdims=True), 1e-6)
+    a = m[:, src_idx]                       # [B, n_src, C]
+    b = m[:, dst_idx]                       # [B, n_dst, C]
+    scores = jnp.einsum("bsc,bdc->bsd", a, b)
+    node_max = scores.max(axis=-1)          # [B, n_src]
+    node_idx = scores.argmax(axis=-1)       # [B, n_src] -> dst slot
+    order = jnp.argsort(-node_max, axis=-1)
+    merged_sel = order[:, :r]               # positions INTO src_idx
+    kept_sel = order[:, r:]
+    n_dst = dst_idx.shape[0]
+    batch = jnp.arange(B)[:, None]
+
+    def merge(x: jax.Array) -> jax.Array:
+        src = x[:, src_idx]
+        dst = x[:, dst_idx]
+        kept = src[batch, kept_sel]                      # [B, n_src-r, C]
+        merged = src[batch, merged_sel]                  # [B, r, C]
+        tgt = node_idx[batch, merged_sel]                # [B, r]
+        # mean-pool each merged token into its dst slot
+        ones = jnp.ones((B, r), x.dtype)
+        add = jax.vmap(
+            lambda d, t, v: d.at[t].add(v))(dst, tgt, merged)
+        cnt = jax.vmap(
+            lambda t, o: jnp.ones((n_dst,),
+                                  x.dtype).at[t].add(o))(tgt, ones)
+        dst_pooled = add / cnt[..., None]
+        return jnp.concatenate([kept, dst_pooled], axis=1)
+
+    def unmerge(y: jax.Array) -> jax.Array:
+        kept = y[:, : n_src - r]
+        dst = y[:, n_src - r:]
+        out = jnp.zeros((B, N) + y.shape[2:], y.dtype)
+        # dst tokens back to their grid positions
+        out = out.at[:, dst_idx].set(dst)
+        # kept src tokens back to theirs
+        kept_pos = jnp.asarray(src_idx)[kept_sel]        # [B, n_src-r]
+        out = jax.vmap(
+            lambda o, p, v: o.at[p].set(v))(out, kept_pos, kept)
+        # merged src tokens adopt their destination's row
+        merged_pos = jnp.asarray(src_idx)[merged_sel]
+        tgt = node_idx[batch, merged_sel]
+        out = jax.vmap(
+            lambda o, p, d, t: o.at[p].set(d[t]))(out, merged_pos, dst,
+                                                  tgt)
+        return out
+
+    return merge, unmerge, r

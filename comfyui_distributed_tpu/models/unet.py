@@ -68,6 +68,11 @@ class UNetConfig:
     # branch lives OUTSIDE the module (registry builds a lax.cond over a
     # shrunk-config and a plain-config apply sharing one param tree)
     deep_shrink: Optional[Tuple[int, float]] = None
+    # ToMe (TomePatchModel): merge this fraction of attn1 query tokens
+    # at the HIGHEST-resolution attention level only (the reference's
+    # max_downsample=1 — deep levels would degrade quality for no
+    # savings); 0 = off.  Static config like freeu
+    tome_ratio: float = 0.0
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     prediction_type: str = "eps"  # "eps" | "v"
@@ -216,6 +221,8 @@ class UNet(nn.Module):
                         heads(out_ch), depth=cfg.transformer_depth[level],
                         dtype=cfg.dtype, attn_impl=cfg.attn_impl,
                         hypertile_tile=ht_tile(level),
+                        tome_ratio=cfg.tome_ratio if level == 0
+                        else 0.0,
                         name=f"down_{level}_attn_{i}")(
                             h, context, context_v=context_v)
                 skips.append(h)
@@ -264,6 +271,8 @@ class UNet(nn.Module):
                         heads(out_ch), depth=cfg.transformer_depth[level],
                         dtype=cfg.dtype, attn_impl=cfg.attn_impl,
                         hypertile_tile=ht_tile(level),
+                        tome_ratio=cfg.tome_ratio if level == 0
+                        else 0.0,
                         name=f"up_{level}_attn_{i}")(
                             h, context, context_v=context_v)
             if level != 0:

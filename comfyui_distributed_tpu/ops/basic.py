@@ -302,6 +302,29 @@ class ModelSamplingDiscrete(Op):
 
 
 @register_op
+class TomePatchModel(Op):
+    """ToMe token merging: every self-attention merges ``ratio`` of its
+    query tokens into their most similar 2x2-cell destinations and
+    unmerges after (models/tome.py) — attention cost drops toward
+    O((1-ratio) N^2) with minimal quality loss at moderate ratios.
+    Deterministic destination grid (the reference's randomized grid is
+    jit-hostile).  Derived pipeline, static config like FreeU."""
+    TYPE = "TomePatchModel"
+    WIDGETS = ["ratio"]
+    DEFAULTS = {"ratio": 0.3}
+
+    def execute(self, ctx: OpContext, model, ratio: float = 0.3):
+        r = min(max(float(ratio), 0.0), 0.9)
+        if r == 0.0:
+            return (model,)
+        fam = model.family
+        fam2 = dataclasses.replace(fam, unet=dataclasses.replace(
+            fam.unet, tome_ratio=r))
+        return (registry.derive_pipeline(model, f"tome:{r}",
+                                         family=fam2),)
+
+
+@register_op
 class HypernetworkLoader(Op):
     """A1111-format hypernetwork: residual MLPs on the cross-attention
     k/v context streams at ``strength`` (models/hypernetwork.py).

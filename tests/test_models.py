@@ -2516,3 +2516,87 @@ class TestUnCLIPUncondZeroFill:
                                       np.zeros_like(
                                           np.asarray(prep.y[1])))
         registry.clear_pipeline_cache()
+
+
+class TestTokenMerging:
+    def test_merge_unmerge_contract(self):
+        """Kept tokens round-trip EXACTLY; merged tokens adopt their
+        destination's row; r=0 is the identity."""
+        from comfyui_distributed_tpu.models import tome
+        rng = np.random.default_rng(3)
+        h = w = 4
+        x = jnp.asarray(rng.standard_normal((2, h * w, 8)), jnp.float32)
+        m0, u0, r0 = tome.build_merge(x, h, w, 0.0)
+        assert r0 == 0 and m0(x) is x and u0(x) is x
+        merge, unmerge, r = tome.build_merge(x, h, w, 0.25)
+        assert r == 4
+        y = merge(x)
+        assert y.shape == (2, h * w - r, 8)
+        out = unmerge(y)
+        assert out.shape == x.shape
+        dst_idx, src_idx = tome.dst_grid_indices(h, w)
+        # dst rows in the unmerge output must equal the pooled dst rows
+        np.testing.assert_allclose(np.asarray(out[:, dst_idx]),
+                                   np.asarray(y[:, -dst_idx.shape[0]:]),
+                                   rtol=1e-6)
+        # EXACT oracle: replicate the matching in numpy and assert the
+        # full unmerge(merge(x)) output positionally
+        xs = np.asarray(x)
+        for b in range(2):
+            mm = xs[b] / np.maximum(
+                np.linalg.norm(xs[b], axis=-1, keepdims=True), 1e-6)
+            scores = mm[src_idx] @ mm[dst_idx].T
+            node_max = scores.max(-1)
+            node_tgt = scores.argmax(-1)
+            order = np.argsort(-node_max, kind="stable")
+            merged_sel, kept_sel = order[:r], order[r:]
+            pooled = xs[b][dst_idx].copy()
+            cnt = np.ones(len(dst_idx), np.float32)
+            for srow in merged_sel:
+                pooled[node_tgt[srow]] += xs[b][src_idx[srow]]
+                cnt[node_tgt[srow]] += 1.0
+            pooled /= cnt[:, None]
+            expect = np.empty_like(xs[b])
+            expect[dst_idx] = pooled
+            expect[src_idx[kept_sel]] = xs[b][src_idx[kept_sel]]
+            expect[src_idx[merged_sel]] = pooled[node_tgt[merged_sel]]
+            np.testing.assert_allclose(np.asarray(out[b]), expect,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_merge_pools_identical_tokens_losslessly(self):
+        """If every token in a cell is identical, merging then
+        unmerging an identity transform reconstructs the input
+        EXACTLY (mean of identical rows = the row)."""
+        from comfyui_distributed_tpu.models import tome
+        h = w = 4
+        base = np.random.default_rng(5).standard_normal((1, 4, 8))
+        cells = np.repeat(np.repeat(
+            base.reshape(1, 2, 2, 8), 2, axis=1), 2, axis=2) \
+            .reshape(1, h * w, 8).astype(np.float32)
+        x = jnp.asarray(cells)
+        merge, unmerge, r = tome.build_merge(x, h, w, 0.5)
+        assert r == 8
+        np.testing.assert_allclose(np.asarray(unmerge(merge(x))),
+                                   np.asarray(x), rtol=1e-5, atol=1e-5)
+
+    def test_node_patches_and_steers(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("tome.ckpt")
+        octx = OpContext()
+        (pt,) = get_op("TomePatchModel").execute(octx, p, 0.3)
+        assert pt.family.unet.tome_ratio == 0.3
+        assert pt.unet_params is p.unet_params
+        (p0,) = get_op("TomePatchModel").execute(octx, p, 0.0)
+        assert p0 is p
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0])
+        lat = {"samples": np.zeros((1, 16, 16, 4), np.float32)}
+        (a,) = get_op("KSampler").execute(octx, pt, 3, 2, 4.0, "euler",
+                                          "normal", pos, pos, lat, 1.0)
+        s = np.asarray(a["samples"])
+        assert np.isfinite(s).all()
+        (b,) = get_op("KSampler").execute(octx, p, 3, 2, 4.0, "euler",
+                                          "normal", pos, pos, lat, 1.0)
+        assert not np.allclose(s, np.asarray(b["samples"]))
+        registry.clear_pipeline_cache()
