@@ -303,12 +303,15 @@ class ModelSamplingDiscrete(Op):
 
 @register_op
 class TomePatchModel(Op):
-    """ToMe token merging: every self-attention merges ``ratio`` of its
-    query tokens into their most similar 2x2-cell destinations and
-    unmerges after (models/tome.py) — attention cost drops toward
-    O((1-ratio) N^2) with minimal quality loss at moderate ratios.
-    Deterministic destination grid (the reference's randomized grid is
-    jit-hostile).  Derived pipeline, static config like FreeU."""
+    """ToMe token merging at the HIGHEST-resolution attention level
+    (the reference's max_downsample=1): level-0 self-attentions merge
+    ``ratio`` of their query tokens into their most similar 2x2-cell
+    destinations and unmerge after (models/tome.py) — that level is
+    where the quadratic cost lives.  Deterministic destination grid
+    (the reference's randomized grid is jit-hostile).  Families without
+    level-0 attention (SDXL) get a loud no-op, matching the reference's
+    behavior at its default max_downsample.  Derived pipeline, static
+    config like FreeU."""
     TYPE = "TomePatchModel"
     WIDGETS = ["ratio"]
     DEFAULTS = {"ratio": 0.3}
@@ -318,6 +321,11 @@ class TomePatchModel(Op):
         if r == 0.0:
             return (model,)
         fam = model.family
+        if fam.unet.transformer_depth[0] == 0:
+            log(f"TomePatchModel: {fam.name} has no level-0 attention "
+                "(SDXL layout) — the patch is a no-op, as with the "
+                "reference's default max_downsample=1")
+            return (model,)
         fam2 = dataclasses.replace(fam, unet=dataclasses.replace(
             fam.unet, tome_ratio=r))
         return (registry.derive_pipeline(model, f"tome:{r}",
