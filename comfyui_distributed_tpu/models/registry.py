@@ -843,6 +843,8 @@ def clear_pipeline_cache() -> None:
     from comfyui_distributed_tpu.models import lora as lora_mod
     lora_mod.clear_lora_cache()
     hn_mod.clear_hypernetwork_cache()
+    from comfyui_distributed_tpu.models import style_model as sm_mod
+    sm_mod.clear_style_model_cache()
 
 
 # derived pipelines (clip-skip variants, external VAEs): param trees are

@@ -1,0 +1,120 @@
+"""Style adapter (the reference ecosystem's StyleModelLoader /
+StyleModelApply surface — T2I "coadapter-style"): a small transformer
+turns CLIP-vision hidden states into a handful of style tokens that
+APPEND to the text context, steering sampling toward the reference
+image's style through ordinary cross-attention.
+
+Mechanism implemented faithfully (learned style queries + transformer
+over [vision tokens; queries] -> projected trailing tokens); converting
+the reference's trained .pth weights is NOT implemented — loading a
+real file logs loudly and virtual-initializes, the same policy as the
+unCLIP checkpoint's embedded vision tower."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from comfyui_distributed_tpu.models.clip import CLIPConfig, CLIPLayer
+from comfyui_distributed_tpu.utils.logging import log
+
+
+@dataclasses.dataclass(frozen=True)
+class StyleAdapterConfig:
+    width: int = 1024
+    layers: int = 3
+    heads: int = 8
+    num_tokens: int = 8
+    context_dim: int = 768      # output token width (the text context's)
+    dtype: Any = jnp.float32
+
+
+STYLE_CONFIG = StyleAdapterConfig()
+TINY_STYLE_CONFIG = StyleAdapterConfig(width=64, layers=1, heads=4,
+                                       num_tokens=2, context_dim=64)
+
+
+class StyleAdapter(nn.Module):
+    cfg: StyleAdapterConfig
+
+    @nn.compact
+    def __call__(self, vision_hidden: jax.Array) -> jax.Array:
+        """[B, P, D_vision] -> [B, num_tokens, context_dim]."""
+        cfg = self.cfg
+        B = vision_hidden.shape[0]
+        h = nn.Dense(cfg.width, dtype=cfg.dtype,
+                     name="proj_in")(vision_hidden)
+        queries = self.param("style_embedding",
+                             nn.initializers.normal(0.02),
+                             (cfg.num_tokens, cfg.width))
+        h = jnp.concatenate(
+            [h, jnp.broadcast_to(queries,
+                                 (B,) + queries.shape).astype(h.dtype)],
+            axis=1)
+        lcfg = CLIPConfig(width=cfg.width, layers=cfg.layers,
+                          heads=cfg.heads, act="gelu", dtype=cfg.dtype)
+        mask = jnp.zeros((1, 1, h.shape[1], h.shape[1]), jnp.float32)
+        for i in range(cfg.layers):
+            h = CLIPLayer(lcfg, name=f"layers_{i}")(h, mask)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                         name="ln_post")(h[:, -cfg.num_tokens:])
+        return nn.Dense(cfg.context_dim, dtype=jnp.float32,
+                        name="proj_out")(h)
+
+
+@dataclasses.dataclass
+class StyleModelTower:
+    """STYLE_MODEL wire object."""
+    name: str
+    cfg: StyleAdapterConfig
+    params: Any
+    _jitted: Any = None
+
+    def get_cond(self, vision_output) -> jax.Array:
+        if self._jitted is None:
+            module = StyleAdapter(self.cfg)
+            self._jitted = jax.jit(
+                lambda p, x: module.apply({"params": p}, x))
+        return self._jitted(self.params,
+                            jnp.asarray(vision_output.last_hidden))
+
+
+_cache: Dict[str, StyleModelTower] = {}
+
+
+def load_style_model(name: str, models_dir=None,
+                     context_dim: int = 768) -> StyleModelTower:
+    import os
+    key = f"{name}:{context_dim}:{models_dir or ''}"
+    if key in _cache:
+        return _cache[key]
+    lowered = name.lower()
+    cfg = TINY_STYLE_CONFIG if ("tiny" in lowered or "test" in lowered) \
+        else dataclasses.replace(STYLE_CONFIG, context_dim=context_dim)
+    if models_dir:
+        for cand in (name, os.path.join("style_models", name)):
+            p = os.path.join(models_dir, cand.replace("\\", "/"))
+            if os.path.isfile(p):
+                log(f"style model {name}: converting trained adapter "
+                    "weights is not implemented — using a deterministic "
+                    "virtual adapter (known limitation)")
+                break
+    from comfyui_distributed_tpu.models.registry import (_name_seed,
+                                                         _virtual_params)
+    seed = _name_seed(name)
+    vis = jnp.zeros((1, 10, cfg.width))
+    params = _virtual_params(StyleAdapter(cfg), seed, vis)
+    log(f"virtual style model {name!r} (tokens {cfg.num_tokens} -> "
+        f"{cfg.context_dim}d), deterministic init (seed {seed})")
+    tower = StyleModelTower(name=name, cfg=cfg, params=params)
+    _cache[key] = tower
+    return tower
+
+
+def clear_style_model_cache() -> None:
+    _cache.clear()

@@ -686,6 +686,44 @@ class unCLIPCheckpointLoader(Op):
 
 
 @register_op
+class StyleModelLoader(Op):
+    """-> STYLE_MODEL (models/style_model.py)."""
+    TYPE = "StyleModelLoader"
+    WIDGETS = ["style_model_name"]
+
+    def execute(self, ctx: OpContext, style_model_name: str):
+        from comfyui_distributed_tpu.models.style_model import \
+            load_style_model
+        return (load_style_model(str(style_model_name),
+                                 models_dir=ctx.models_dir),)
+
+
+@register_op
+class StyleModelApply(Op):
+    """Append the style tokens derived from a CLIP-vision output to the
+    conditioning's TOKEN axis (every sibling too) — style steering via
+    ordinary cross-attention."""
+    TYPE = "StyleModelApply"
+
+    def execute(self, ctx: OpContext, conditioning: Conditioning,
+                style_model, clip_vision_output):
+        with Timer("style_model_apply"):
+            tokens = style_model.get_cond(clip_vision_output)
+
+        def _cat(e: Conditioning) -> Conditioning:
+            return dataclasses.replace(
+                e, context=jnp.concatenate(
+                    [jnp.asarray(e.context),
+                     jnp.asarray(tokens, jnp.float32)], axis=1))
+
+        out = _cat(conditioning)
+        return (dataclasses.replace(
+            out, siblings=tuple(_cat(s)
+                                for s in getattr(conditioning,
+                                                 "siblings", ()) or ())),)
+
+
+@register_op
 class CLIPTextEncodeSDXL(Op):
     """ComfyUI's SDXL dual-prompt encode: text_l feeds the CLIP-L tower,
     text_g the OpenCLIP tower (whose pooled output becomes the ADM
@@ -1875,6 +1913,107 @@ class MaskComposite(Op):
             raise ValueError(f"unknown mask operation {op!r}")
         out[:, y0:y1, x0:x1] = np.clip(reg, 0.0, 1.0)
         return (out,)
+
+
+@register_op
+class MaskToImage(Op):
+    TYPE = "MaskToImage"
+
+    def execute(self, ctx: OpContext, mask):
+        m = np.asarray(mask, np.float32)
+        if m.ndim == 2:
+            m = m[None]
+        return (np.repeat(m[..., None], 3, axis=-1),)
+
+
+@register_op
+class ImageToMask(Op):
+    TYPE = "ImageToMask"
+    WIDGETS = ["channel"]
+    DEFAULTS = {"channel": "red"}
+
+    def execute(self, ctx: OpContext, image, channel: str = "red"):
+        img = as_image_array(image)
+        idx = {"red": 0, "green": 1, "blue": 2,
+               "alpha": 3}.get(str(channel), 0)
+        if idx >= img.shape[-1]:
+            raise ValueError(
+                f"ImageToMask: image has no {channel!r} channel "
+                f"({img.shape[-1]} channels)")
+        return (np.asarray(img[..., idx], np.float32),)
+
+
+@register_op
+class ImageColorToMask(Op):
+    """Pixels matching the 24-bit ``color`` exactly (after 8-bit
+    quantization) become 1."""
+    TYPE = "ImageColorToMask"
+    WIDGETS = ["color"]
+    DEFAULTS = {"color": 0}
+
+    def execute(self, ctx: OpContext, image, color: int = 0):
+        img = as_image_array(image)
+        q = np.clip(np.asarray(img[..., :3]) * 255.0, 0,
+                    255).round().astype(np.int64)
+        packed = (q[..., 0] << 16) | (q[..., 1] << 8) | q[..., 2]
+        return ((packed == int(color)).astype(np.float32),)
+
+
+@register_op
+class CropMask(Op):
+    TYPE = "CropMask"
+    WIDGETS = ["x", "y", "width", "height"]
+
+    def execute(self, ctx: OpContext, mask, x: int = 0, y: int = 0,
+                width: int = 64, height: int = 64):
+        m = np.asarray(mask, np.float32)
+        if m.ndim == 2:
+            m = m[None]
+        H, W = m.shape[1], m.shape[2]
+        x0 = min(max(int(x), 0), max(W - 1, 0))
+        y0 = min(max(int(y), 0), max(H - 1, 0))
+        return (m[:, y0:y0 + max(int(height), 1),
+                  x0:x0 + max(int(width), 1)].copy(),)
+
+
+@register_op
+class FeatherMask(Op):
+    """Linear ramps toward 0 over the given margin on each side —
+    reference rate (t+1)/margin, so the innermost feathered row
+    reaches 1.0 (a margin of 1 is a no-op, like ComfyUI)."""
+    TYPE = "FeatherMask"
+    WIDGETS = ["left", "top", "right", "bottom"]
+    DEFAULTS = {"left": 0, "top": 0, "right": 0, "bottom": 0}
+
+    def execute(self, ctx: OpContext, mask, left: int = 0, top: int = 0,
+                right: int = 0, bottom: int = 0):
+        m = np.asarray(mask, np.float32)
+        if m.ndim == 2:
+            m = m[None]
+        out = m.copy()
+        H, W = out.shape[1], out.shape[2]
+        for t in range(min(max(int(top), 0), H)):
+            out[:, t, :] *= (t + 1) / int(top)
+        for t in range(min(max(int(bottom), 0), H)):
+            out[:, H - 1 - t, :] *= (t + 1) / int(bottom)
+        for t in range(min(max(int(left), 0), W)):
+            out[:, :, t] *= (t + 1) / int(left)
+        for t in range(min(max(int(right), 0), W)):
+            out[:, :, W - 1 - t] *= (t + 1) / int(right)
+        return (out,)
+
+
+@register_op
+class ThresholdMask(Op):
+    TYPE = "ThresholdMask"
+    WIDGETS = ["value"]
+    DEFAULTS = {"value": 0.5}
+
+    def execute(self, ctx: OpContext, mask, value: float = 0.5):
+        m = np.asarray(mask, np.float32)
+        if m.ndim == 2:
+            m = m[None]
+        return ((m > float(value)).astype(np.float32),)
 
 
 @register_op

@@ -904,3 +904,67 @@ class TestCannyBatchMorphoNodes:
         assert g[0, 1, 1, 0] == 0.0
         with pytest.raises(ValueError):
             self._op("Morphology").execute(octx, img, "nope", 3)
+
+
+class TestMaskToolchainCompletion:
+    def _op(self, name):
+        from comfyui_distributed_tpu.ops.base import get_op
+        return get_op(name)
+
+    def test_mask_image_conversions(self):
+        octx = OpContext()
+        m = np.zeros((1, 4, 4), np.float32)
+        m[0, 1, 2] = 0.8
+        (img,) = self._op("MaskToImage").execute(octx, m)
+        assert img.shape == (1, 4, 4, 3)
+        np.testing.assert_array_equal(img[..., 0], m)
+        (back,) = self._op("ImageToMask").execute(octx, img, "red")
+        np.testing.assert_array_equal(back, m)
+        rgb = np.zeros((1, 2, 2, 3), np.float32)
+        rgb[0, 0, 1] = [1.0, 0.0, 0.0]
+        (cm,) = self._op("ImageColorToMask").execute(octx, rgb,
+                                                     0xFF0000)
+        assert cm[0, 0, 1] == 1.0 and cm.sum() == 1.0
+
+    def test_crop_feather_threshold(self):
+        octx = OpContext()
+        m = np.ones((1, 8, 8), np.float32)
+        (cr,) = self._op("CropMask").execute(octx, m, 2, 2, 4, 4)
+        assert cr.shape == (1, 4, 4)
+        (fe,) = self._op("FeatherMask").execute(octx, m, 2, 2, 0, 0)
+        # reference rate (t+1)/margin: edge 1/2, inner row reaches 1.0
+        assert fe[0, 0, 4] == 0.5 and fe[0, 1, 4] == 1.0
+        assert fe[0, 4, 7] == 1.0                 # right untouched
+        assert fe[0, 0, 0] == fe[0, 0, 4] * fe[0, 4, 0]  # corners mult
+        # margin 1 is a no-op (the reference's semantics)
+        (noop,) = self._op("FeatherMask").execute(octx, m, 1, 1, 1, 1)
+        np.testing.assert_array_equal(noop, m)
+        soft = np.linspace(0, 1, 16, dtype=np.float32).reshape(1, 4, 4)
+        (th,) = self._op("ThresholdMask").execute(octx, soft, 0.5)
+        assert set(np.unique(th)) <= {0.0, 1.0}
+        assert th.sum() == (soft > 0.5).sum()
+
+    def test_style_model_apply(self):
+        octx = OpContext()
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        registry.clear_pipeline_cache()
+        (sm,) = self._op("StyleModelLoader").execute(octx,
+                                                     "tiny-style.pth")
+        vision = registry.load_clip_vision("tiny-style-vision")
+        rng = np.random.default_rng(3)
+        img = rng.uniform(0, 1, (1, 64, 64, 3)).astype(np.float32)
+        (vout,) = self._op("CLIPVisionEncode").execute(octx, vision,
+                                                       img, "center")
+        c = Conditioning(context=np.zeros((1, 7, 64), np.float32))
+        (out,) = self._op("StyleModelApply").execute(octx, c, sm, vout)
+        assert out.context.shape == (1, 7 + sm.cfg.num_tokens, 64)
+        assert np.isfinite(np.asarray(out.context)).all()
+        # style tokens depend on the image
+        img2 = rng.uniform(0, 1, (1, 64, 64, 3)).astype(np.float32)
+        (vout2,) = self._op("CLIPVisionEncode").execute(octx, vision,
+                                                        img2, "center")
+        (out2,) = self._op("StyleModelApply").execute(octx, c, sm,
+                                                      vout2)
+        assert not np.allclose(np.asarray(out.context[:, 7:]),
+                               np.asarray(out2.context[:, 7:]))
+        registry.clear_pipeline_cache()
