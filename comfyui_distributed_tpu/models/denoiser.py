@@ -66,6 +66,7 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
     def denoiser(x: jax.Array, sigma: jax.Array,
                  context: Optional[jax.Array] = None,
                  y: Optional[jax.Array] = None,
+                 objs: Optional[jax.Array] = None,
                  **_: Any) -> jax.Array:
         sigma = jnp.asarray(sigma, jnp.float32)
         c_in = 1.0 / jnp.sqrt(sigma ** 2 + 1.0)
@@ -108,6 +109,8 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
                 ctx_in, ctx_v = apply_hypernetwork_pair(
                     hn, float(s), ctx_in, ctx_v)
             kw = {"context_v": ctx_v}
+        if objs is not None:
+            kw["objs"] = objs
         out = apply_fn(params, xin, ts, ctx_in, y, ctrl, **kw)
         eps_or_v, probs = out if capture else (out, None)
         if prediction_type == "v":

@@ -1,0 +1,138 @@
+"""GLIGEN grounded generation (the reference ecosystem's GLIGENLoader /
+GLIGENTextBoxApply): phrase embeddings + normalized boxes become
+grounding tokens (PositionNet) that every transformer block's gated
+self-attention fuser attends alongside the visual tokens
+(models/layers.GatedSelfAttention — zero-init gates, so the patch
+starts as a near-no-op).
+
+The fuser weights live INSIDE the UNet param tree (``.../fuser``): the
+loader virtual-initializes a gligen-enabled tree and grafts the base
+checkpoint's weights over every shared key, so trained base weights are
+preserved exactly and only the grounding-specific parameters are
+synthesized.  Converting trained GLIGEN release weights is not
+implemented — loading a real file logs loudly (the virtual fusers keep
+the surface runnable), the same policy as other adapter files."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from comfyui_distributed_tpu.utils.logging import log
+
+FOURIER_FREQS = 8
+POS_DIM = FOURIER_FREQS * 2 * 4       # sin/cos x 4 box coords
+
+
+def fourier_box_embed(boxes: jax.Array) -> jax.Array:
+    """[..., 4] normalized xyxy -> [..., POS_DIM] (GLIGEN's fourier
+    position encoding: freqs 2^0..2^(F-1))."""
+    freqs = 2.0 ** jnp.arange(FOURIER_FREQS, dtype=jnp.float32)
+    ang = boxes[..., None] * freqs * np.pi          # [..., 4, F]
+    emb = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    return emb.reshape(boxes.shape[:-1] + (POS_DIM,))
+
+
+@dataclasses.dataclass(frozen=True)
+class GligenConfig:
+    text_dim: int = 768
+    out_dim: int = 768
+    hidden: int = 512
+
+
+class PositionNet(nn.Module):
+    """(text_embs [B,N,text_dim], boxes [B,N,4], masks [B,N]) ->
+    grounding tokens [B, N, out_dim]; masked-out entries use the
+    learned null features (GLIGEN's layout)."""
+    cfg: GligenConfig
+
+    @nn.compact
+    def __call__(self, text_embs, boxes, masks):
+        cfg = self.cfg
+        pos = fourier_box_embed(boxes)
+        null_pos = self.param("null_position_feature",
+                              nn.initializers.zeros, (POS_DIM,))
+        null_text = self.param("null_text_feature",
+                               nn.initializers.zeros, (cfg.text_dim,))
+        m = masks[..., None].astype(jnp.float32)
+        pos = pos * m + null_pos * (1.0 - m)
+        txt = text_embs * m + null_text * (1.0 - m)
+        h = jnp.concatenate([txt, pos], axis=-1)
+        h = nn.Dense(cfg.hidden, name="fc1")(h)
+        h = nn.silu(h)
+        h = nn.Dense(cfg.hidden, name="fc2")(h)
+        h = nn.silu(h)
+        return nn.Dense(cfg.out_dim, name="fc3")(h)
+
+
+@dataclasses.dataclass
+class GligenModel:
+    """GLIGEN wire object: the position net + its params."""
+    name: str
+    cfg: GligenConfig
+    params: Any
+    _jitted: Any = None
+
+    def grounding_tokens(self, text_embs, boxes, masks) -> jax.Array:
+        if self._jitted is None:
+            module = PositionNet(self.cfg)
+            self._jitted = jax.jit(
+                lambda p, t, b, m: module.apply({"params": p}, t, b, m))
+        return self._jitted(self.params, jnp.asarray(text_embs),
+                            jnp.asarray(boxes, jnp.float32),
+                            jnp.asarray(masks, jnp.float32))
+
+
+def graft_params(base: Dict, full: Dict) -> Dict:
+    """Overlay: every key present in ``base`` keeps the base value;
+    keys only in ``full`` (the fusers) come from ``full``."""
+    out = {}
+    for k, v in full.items():
+        if k in base and isinstance(v, dict):
+            out[k] = graft_params(base[k], v)
+        elif k in base:
+            out[k] = base[k]
+        else:
+            out[k] = v
+    return out
+
+
+_cache: Dict[str, GligenModel] = {}
+
+
+def load_gligen(name: str, models_dir=None,
+                text_dim: int = 768) -> GligenModel:
+    import os
+    key = f"{name}:{text_dim}:{models_dir or ''}"
+    if key in _cache:
+        return _cache[key]
+    if models_dir:
+        for cand in (name, os.path.join("gligen", name)):
+            p = os.path.join(models_dir, cand.replace("\\", "/"))
+            if os.path.isfile(p):
+                log(f"gligen {name}: converting trained release weights "
+                    "is not implemented — using deterministic virtual "
+                    "fusers/position net (known limitation)")
+                break
+    from comfyui_distributed_tpu.models.registry import (_name_seed,
+                                                         _virtual_params)
+    cfg = GligenConfig(text_dim=text_dim, out_dim=text_dim)
+    seed = _name_seed(name)
+    t = jnp.zeros((1, 1, cfg.text_dim))
+    b = jnp.zeros((1, 1, 4))
+    m = jnp.zeros((1, 1))
+    params = _virtual_params(PositionNet(cfg), seed, t, b, m)
+    log(f"virtual gligen {name!r} (text_dim {text_dim}), deterministic "
+        f"init (seed {seed})")
+    model = GligenModel(name=name, cfg=cfg, params=params)
+    _cache[key] = model
+    return model
+
+
+def clear_gligen_cache() -> None:
+    _cache.clear()

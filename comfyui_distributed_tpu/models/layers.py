@@ -221,6 +221,35 @@ class FeedForward(nn.Module):
         return nn.Dense(c, dtype=self.dtype, name="out")(h)
 
 
+class GatedSelfAttention(nn.Module):
+    """GLIGEN fuser (GatedSelfAttentionDense): self-attention over
+    [visual tokens; grounding tokens] and a FF, each gated by a learned
+    tanh(alpha) scalar so an untrained fuser starts as a near-no-op.
+    Grounding tokens project from their 768-d space to the block width
+    first (the reference layout's ``linear``)."""
+    num_heads: int
+    dtype: Dtype = jnp.bfloat16
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, objs: jax.Array) -> jax.Array:
+        n = x.shape[1]
+        o = nn.Dense(x.shape[-1], dtype=self.dtype, name="linear")(objs)
+        alpha_attn = self.param("alpha_attn", nn.initializers.zeros, ())
+        alpha_dense = self.param("alpha_dense", nn.initializers.zeros,
+                                 ())
+        h = jnp.concatenate([x, o.astype(x.dtype)], axis=1)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(h)
+        att = Attention(self.num_heads, dtype=self.dtype,
+                        attn_impl=self.attn_impl,
+                        name="attn")(h)[:, :n]
+        x = x + jnp.tanh(alpha_attn).astype(x.dtype) * att
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x)
+        x = x + jnp.tanh(alpha_dense).astype(x.dtype) \
+            * FeedForward(dtype=self.dtype, name="ff")(h)
+        return x
+
+
 class TransformerBlock(nn.Module):
     """Self-attn -> cross-attn -> FF, pre-LN residuals (SD spatial
     transformer block layout)."""
@@ -232,10 +261,12 @@ class TransformerBlock(nn.Module):
     # similar destinations (models/tome.py); needs the token grid dims
     tome_ratio: float = 0.0
     hw: Optional[tuple] = None
+    gligen: int = 0      # >0: create the GLIGEN fuser (grounding dim)
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array],
-                 context_v: Optional[jax.Array] = None) -> jax.Array:
+                 context_v: Optional[jax.Array] = None,
+                 objs: Optional[jax.Array] = None) -> jax.Array:
         xn = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
                           name="norm1")(x)
         attn1 = Attention(self.num_heads, dtype=self.dtype,
@@ -256,6 +287,16 @@ class TransformerBlock(nn.Module):
                 x = x + attn1(xn)
         else:
             x = x + attn1(xn)
+        if self.gligen:
+            # GLIGEN fuser between attn1 and attn2 (the reference's
+            # insertion point); zero grounding tokens + zero-init gates
+            # make the untrained/unused case a near-no-op
+            o = objs if objs is not None \
+                else jnp.zeros((x.shape[0], 1, int(self.gligen)),
+                               x.dtype)
+            x = GatedSelfAttention(self.num_heads, dtype=self.dtype,
+                                   attn_impl=self.attn_impl,
+                                   name="fuser")(x, o)
         x = x + Attention(self.num_heads, dtype=self.dtype,
                           attn_impl=self.attn_impl, name="attn2")(
             nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x), context=context,
@@ -293,10 +334,12 @@ class SpatialTransformer(nn.Module):
     hypertile_tile: int = 0
     sow_probs: bool = False        # SAG: first block's attn1 sows
     tome_ratio: float = 0.0        # ToMe query merging (models/tome.py)
+    gligen: int = 0                # GLIGEN fusers (grounding dim)
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array],
-                 context_v: Optional[jax.Array] = None) -> jax.Array:
+                 context_v: Optional[jax.Array] = None,
+                 objs: Optional[jax.Array] = None) -> jax.Array:
         B, H, W, C = x.shape
         # CompVis attention.py Normalize: GroupNorm eps=1e-6 (the UNet's
         # ResBlock GroupNorm32 uses torch's 1e-5 default instead)
@@ -317,6 +360,8 @@ class SpatialTransformer(nn.Module):
                 ctx = jnp.repeat(context, nh * nw, axis=0)
             if context_v is not None:
                 ctx_v = jnp.repeat(context_v, nh * nw, axis=0)
+            if objs is not None:
+                objs = jnp.repeat(objs, nh * nw, axis=0)
         else:
             h = h.reshape(B, H * W, C)
         th, tw = (H // nh, W // nw) if nh * nw > 1 else (H, W)
@@ -325,9 +370,10 @@ class SpatialTransformer(nn.Module):
                                  attn_impl=self.attn_impl,
                                  sow_probs=self.sow_probs and i == 0,
                                  tome_ratio=self.tome_ratio,
-                                 hw=(th, tw),
+                                 hw=(th, tw), gligen=self.gligen,
                                  name=f"blocks_{i}")(h, ctx,
-                                                     context_v=ctx_v)
+                                                     context_v=ctx_v,
+                                                     objs=objs)
         if nh * nw > 1:
             th, tw = H // nh, W // nw
             h = h.reshape(B, nh, nw, th, tw, C) \

@@ -342,18 +342,19 @@ class DiffusionPipeline:
     # --- denoising ----------------------------------------------------------
 
     def raw_unet_apply(self, params, x, t, context, y=None, control=None,
-                       context_v=None):
+                       context_v=None, objs=None):
         return self.unet.apply({"params": params}, x, t, context, y=y,
-                               control=control, context_v=context_v)
+                               control=control, context_v=context_v,
+                               objs=objs)
 
     def raw_unet_apply_capture(self, params, x, t, context, y=None,
-                               control=None, context_v=None):
+                               control=None, context_v=None, objs=None):
         """Like raw_unet_apply but returns (prediction, attn_probs): the
         sag_capture family flag makes the mid-block attn1 sow its
         softmax weights (SAG's blur mask source)."""
         out, inters = self.unet.apply(
             {"params": params}, x, t, context, y=y, control=control,
-            context_v=context_v, mutable=["intermediates"])
+            context_v=context_v, objs=objs, mutable=["intermediates"])
         leaves = jax.tree_util.tree_leaves(inters)
         if len(leaves) != 1:
             raise RuntimeError(
@@ -379,7 +380,8 @@ class DiffusionPipeline:
                middle_context=None,
                cfg2: float = 1.0,
                guidance: str = "dual",
-               c_concat=None) -> jnp.ndarray:
+               c_concat=None,
+               gligen_objs=None) -> jnp.ndarray:
         """Full ksampler: schedule -> noise -> scan-sampler -> latents.
 
         ``seeds``: per-sample host seed array [B] (64-bit ok; replica offsets
@@ -517,6 +519,9 @@ class DiffusionPipeline:
                       c_concat is not None,
                       tuple(c_concat.shape) if c_concat is not None
                       else (),
+                      (tuple(gligen_objs[0].shape),
+                       tuple(gligen_objs[2]))
+                      if gligen_objs is not None else (),
                       bool(force_full_denoise), noise_mask is not None,
                       control is not None,
                       _strength_key(control[3]) if control is not None
@@ -543,7 +548,7 @@ class DiffusionPipeline:
 
             def core(unet_params, latents, ctx_list, area_list,
                      keys, sigmas, y_in, mask_in, cn_params, hint_in,
-                     concat_in):
+                     concat_in, objs_in):
                 ctrl_spec = None
                 if has_control:
                     sk = _strength_key(cn_strength)
@@ -567,19 +572,20 @@ class DiffusionPipeline:
                         deep_shrink=(int(lvl), float(fac))))
 
                     def _shrunk(p, x, t, c, y=None, control=None,
-                                context_v=None):
+                                context_v=None, objs=None):
                         return shrunk_mod.apply({"params": p}, x, t, c,
                                                 y=y, control=control,
-                                                context_v=context_v)
+                                                context_v=context_v,
+                                                objs=objs)
 
                     def use_apply(p, x, t, c, y=None, control=None,
-                                  context_v=None):
+                                  context_v=None, objs=None):
                         pred = jnp.logical_and(t[0] > t_lo, t[0] <= t_hi)
                         return jax.lax.cond(
                             pred,
                             lambda a: _shrunk(*a),
                             lambda a: self.raw_unet_apply(*a),
-                            (p, x, t, c, y, control, context_v))
+                            (p, x, t, c, y, control, context_v, objs))
 
                 den = make_denoiser(
                     use_apply, unet_params, self.schedule,
@@ -617,6 +623,21 @@ class DiffusionPipeline:
                                                    cfg_rescale=cfg_rescale)
                     reps = n_conds + (n_unconds if cfg_scale != 1.0
                                       else 0)
+                if gligen_objs is not None:
+                    # per-block grounding tokens: ONLY the blocks whose
+                    # conditioning entry carries the gligen spec get the
+                    # real tokens (the reference applies gligen on the
+                    # carrying conditioning only); the rest get nulls.
+                    # Flag order matches the ctx_list block layout
+                    # (conds first, then unconds) — ops/basic.py
+                    og, on = objs_in
+                    flags = tuple(gligen_objs[2])[:max(reps, 1)]
+                    parts = [og if f else on for f in flags]
+                    parts += [on] * (max(reps, 1) - len(parts))
+                    extra_objs = jnp.concatenate(parts, axis=0) \
+                        if reps > 1 else parts[0]
+                else:
+                    extra_objs = None
                 if not has_y:
                     y2 = y_in
                 elif y_is_list:
@@ -636,6 +657,8 @@ class DiffusionPipeline:
                 # txt2img passes zeros, so pure-noise starts fall out
                 x = latents + noise * sigmas[0] if add_noise else latents
                 extra = {"y": y2} if has_y else {}
+                if extra_objs is not None:
+                    extra["objs"] = extra_objs
                 if has_mask:
                     # inpainting (KSamplerX0Inpaint): every model call sees
                     # the source re-noised to the CURRENT sigma outside the
@@ -679,9 +702,11 @@ class DiffusionPipeline:
                      for _, m, _, _ in conds + unconds]
         concat_arg = c_concat if c_concat is not None \
             else jnp.zeros((1, 1, 1, 1))
+        objs_arg = gligen_objs[:2] if gligen_objs is not None \
+            else (jnp.zeros((1, 1, 1)), jnp.zeros((1, 1, 1)))
         return core(self.unet_params, latents, ctx_list, area_list,
                     keys, sigmas, y_arg, mask_arg,
-                    cn_params_arg, hint_arg, concat_arg)
+                    cn_params_arg, hint_arg, concat_arg, objs_arg)
 
     # --- internals ----------------------------------------------------------
 
@@ -843,8 +868,10 @@ def clear_pipeline_cache() -> None:
     from comfyui_distributed_tpu.models import lora as lora_mod
     lora_mod.clear_lora_cache()
     hn_mod.clear_hypernetwork_cache()
+    from comfyui_distributed_tpu.models import gligen as gg_mod
     from comfyui_distributed_tpu.models import style_model as sm_mod
     sm_mod.clear_style_model_cache()
+    gg_mod.clear_gligen_cache()
 
 
 # derived pipelines (clip-skip variants, external VAEs): param trees are

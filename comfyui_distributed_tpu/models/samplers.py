@@ -1283,8 +1283,9 @@ def cfg_denoiser_sag(model_capture: Model, model_plain: Model,
         # one more UNCOND denoise on it
         degraded_noised = degraded + x - den_unc
         extra_1 = dict(extra)
-        if extra_1.get("y") is not None:
-            extra_1["y"] = extra_1["y"][B:2 * B]
+        for k2 in ("y", "objs"):    # per-block extras: take the uncond
+            if extra_1.get(k2) is not None:     # block's rows
+                extra_1[k2] = extra_1[k2][B:2 * B]
         den_sag = model_plain(degraded_noised, sigma, context=uncond,
                               **extra_1)
         if cfg_rescale:

@@ -73,6 +73,10 @@ class UNetConfig:
     # max_downsample=1 — deep levels would degrade quality for no
     # savings); 0 = off.  Static config like freeu
     tome_ratio: float = 0.0
+    # GLIGEN: >0 creates GatedSelfAttention fusers in every transformer
+    # block at this grounding-token width (params live in the unet tree
+    # under .../fuser); grounding tokens arrive per call via ``objs``
+    gligen: int = 0
     dtype: Any = jnp.bfloat16
     attn_impl: str = "xla"
     prediction_type: str = "eps"  # "eps" | "v"
@@ -164,7 +168,8 @@ class UNet(nn.Module):
     def __call__(self, x: jax.Array, timesteps: jax.Array,
                  context: jax.Array, y: Optional[jax.Array] = None,
                  control=None,
-                 context_v: Optional[jax.Array] = None) -> jax.Array:
+                 context_v: Optional[jax.Array] = None,
+                 objs: Optional[jax.Array] = None) -> jax.Array:
         """x: [B,H,W,C_in] latent; timesteps: [B]; context: [B,M,Cc] text
         tokens; y: [B, adm_in] optional vector conditioning (SDXL);
         control: optional ControlNet residuals ``(skip_list, middle)`` —
@@ -223,8 +228,10 @@ class UNet(nn.Module):
                         hypertile_tile=ht_tile(level),
                         tome_ratio=cfg.tome_ratio if level == 0
                         else 0.0,
+                        gligen=cfg.gligen,
                         name=f"down_{level}_attn_{i}")(
-                            h, context, context_v=context_v)
+                            h, context, context_v=context_v,
+                            objs=objs)
                 skips.append(h)
             if level != cfg.num_levels - 1:
                 h = Downsample(dtype=cfg.dtype, name=f"down_{level}_ds")(h)
@@ -243,8 +250,9 @@ class UNet(nn.Module):
             heads(mid_ch), depth=max(cfg.transformer_depth[-1], 1),
             dtype=cfg.dtype, attn_impl=cfg.attn_impl,
             hypertile_tile=ht_tile(cfg.num_levels - 1),
-            sow_probs=cfg.sag_capture,
-            name="mid_attn")(h, context, context_v=context_v)
+            sow_probs=cfg.sag_capture, gligen=cfg.gligen,
+            name="mid_attn")(h, context, context_v=context_v,
+                             objs=objs)
         h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_1")(h, emb)
         if control is not None:
             h = h + ctrl_mid
@@ -273,8 +281,10 @@ class UNet(nn.Module):
                         hypertile_tile=ht_tile(level),
                         tome_ratio=cfg.tome_ratio if level == 0
                         else 0.0,
+                        gligen=cfg.gligen,
                         name=f"up_{level}_attn_{i}")(
-                            h, context, context_v=context_v)
+                            h, context, context_v=context_v,
+                            objs=objs)
             if level != 0:
                 h = Upsample(dtype=cfg.dtype, name=f"up_{level}_us")(h)
 

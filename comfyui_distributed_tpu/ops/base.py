@@ -48,6 +48,10 @@ class Conditioning:
     # unCLIP image conditioning: tuple of (image_embed [1, D], strength,
     # noise_augmentation) entries consumed by unclip-ADM families
     unclip: Any = None
+    # GLIGEN grounding: (gligen_model, ((phrase_emb [1, D], box_xywh
+    # latent-units), ...)) — GLIGENTextBoxApply appends; sampling turns
+    # the entries into grounding tokens for the fusers
+    gligen: Any = None
     # SDXL size conditioning (CLIPTextEncodeSDXL / ...Refiner): tuple of
     # scalars each embedded at 256 sinusoidal dims and appended to the
     # pooled text emb in the ADM vector — base order (height, width,

@@ -302,6 +302,75 @@ class ModelSamplingDiscrete(Op):
 
 
 @register_op
+class GLIGENLoader(Op):
+    """-> GLIGEN (models/gligen.py position net).  Applying it to a
+    model happens implicitly at GLIGENTextBoxApply time via
+    gligen_attach (the fuser weights graft into the UNet tree)."""
+    TYPE = "GLIGENLoader"
+    WIDGETS = ["gligen_name"]
+
+    def execute(self, ctx: OpContext, gligen_name: str):
+        from comfyui_distributed_tpu.models.gligen import load_gligen
+        return (load_gligen(str(gligen_name),
+                            models_dir=ctx.models_dir),)
+
+
+def gligen_attach(model, gligen) -> object:
+    """Derived pipeline with GLIGEN fusers: the gligen-enabled UNet's
+    missing parameters (the fusers) virtual-initialize and the base
+    checkpoint's weights graft over every shared key — trained weights
+    stay bit-exact, only grounding-specific params are synthesized."""
+    from comfyui_distributed_tpu.models import unet as unet_mod
+    from comfyui_distributed_tpu.models.gligen import graft_params
+    tag = f"gligen:{gligen.name}"
+    cached = registry.derived_cached(model, tag)
+    if cached is not None:
+        return cached
+    fam = model.family
+    fam2 = dataclasses.replace(fam, unet=dataclasses.replace(
+        fam.unet, gligen=int(gligen.cfg.out_dim)))
+    ds = fam.vae.downscale
+    h = w = 8 * ds
+    full = registry._virtual_params(
+        unet_mod.UNet(fam2.unet), registry._name_seed(tag),
+        jnp.zeros((1, h // ds, w // ds, fam.unet.in_channels)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, 77, fam.unet.context_dim)))
+    merged = graft_params(model.unet_params, full)
+    return registry.derive_pipeline(model, tag, family=fam2,
+                                    unet_params=merged)
+
+
+@register_op
+class GLIGENTextBoxApply(Op):
+    """Ground a phrase to a pixel box: the phrase's encoding + the
+    normalized box become a grounding token every fuser attends.
+    Entries accumulate on the conditioning (the reference's schema);
+    the sampler grafts the fusers into the UNet automatically when a
+    grounded conditioning arrives (_maybe_gligen_model)."""
+    TYPE = "GLIGENTextBoxApply"
+    WIDGETS = ["text", "width", "height", "x", "y"]
+
+    def execute(self, ctx: OpContext, conditioning_to: Conditioning,
+                clip, gligen_textbox_model, text: str, width: int,
+                height: int, x: int, y: int):
+        g = gligen_textbox_model
+        ctx_arr, pooled = clip.encode_prompt([str(text)])
+        emb = np.asarray(pooled if pooled is not None
+                         else ctx_arr.mean(axis=1), np.float32)
+        if emb.shape[-1] < g.cfg.text_dim:
+            emb = np.pad(emb, ((0, 0),
+                               (0, g.cfg.text_dim - emb.shape[-1])))
+        emb = emb[:, : g.cfg.text_dim]
+        box = (int(x) // 8, int(y) // 8,
+               max(int(width) // 8, 1), max(int(height) // 8, 1))
+        prev = getattr(conditioning_to, "gligen", None)
+        entries = (prev[1] if prev is not None else ()) + ((emb, box),)
+        return (dataclasses.replace(conditioning_to,
+                                    gligen=(g, entries)),)
+
+
+@register_op
 class TomePatchModel(Op):
     """ToMe token merging at the HIGHEST-resolution attention level
     (the reference's max_downsample=1): level-0 self-attentions merge
@@ -1005,6 +1074,7 @@ class SamplerCustom(Op):
                 positive: Conditioning, negative: Conditioning,
                 latent_image, sampler, sigmas):
         ctx.check_interrupt()
+        model = _maybe_gligen_model(model, positive, negative)
         prep = _prepare_sample_inputs(ctx, model, noise_seed, latent_image,
                                       positive, negative)
         name = sampler.name if isinstance(sampler, SamplerObject) \
@@ -1020,7 +1090,8 @@ class SamplerCustom(Op):
                 noise_mask=prep.noise_mask, control=prep.control,
                 sigmas_override=np.asarray(sigmas, np.float32),
                 middle_context=prep.mid_context, cfg2=prep.cfg2,
-                guidance=prep.guidance, c_concat=prep.c_concat)
+                guidance=prep.guidance, c_concat=prep.c_concat,
+                gligen_objs=prep.gligen_objs)
         out_d = {"samples": out, **_latent_meta(latent_image),
                  "local_batch": prep.local_batch, "fanout": prep.fanout}
         return (out_d, dict(out_d))
@@ -1152,6 +1223,9 @@ class SamplerCustomAdvanced(Op):
         ctx.check_interrupt()
         g = guider
         neg = g.negative if g.negative is not None else g.positive
+        g = dataclasses.replace(
+            g, model=_maybe_gligen_model(g.model, g.positive, neg,
+                                         g.middle))
         three_row = g.mode in ("dual", "perp")
         if three_row and not all(
                 self._plain(e) for e in (g.positive, g.middle, neg)):
@@ -1178,7 +1252,8 @@ class SamplerCustomAdvanced(Op):
                 control=prep.control,
                 sigmas_override=np.asarray(sigmas, np.float32),
                 middle_context=prep.mid_context, cfg2=cfg2,
-                guidance=guidance, c_concat=prep.c_concat)
+                guidance=guidance, c_concat=prep.c_concat,
+                gligen_objs=prep.gligen_objs)
         out_d = {"samples": out, **_latent_meta(latent_image),
                  "local_batch": prep.local_batch, "fanout": prep.fanout}
         return (out_d, dict(out_d))
@@ -1198,6 +1273,7 @@ class KSampler(Op):
                 scheduler, positive: Conditioning, negative: Conditioning,
                 latent_image, denoise: float = 1.0):
         ctx.check_interrupt()
+        model = _maybe_gligen_model(model, positive, negative)
         prep = _prepare_sample_inputs(ctx, model, seed, latent_image,
                                       positive, negative)
         with Timer(f"ksampler[{sampler_name}x{steps}]"):
@@ -1209,7 +1285,8 @@ class KSampler(Op):
                 sample_idx=prep.sample_idx,
                 noise_mask=prep.noise_mask, control=prep.control,
                 middle_context=prep.mid_context, cfg2=prep.cfg2,
-                guidance=prep.guidance, c_concat=prep.c_concat)
+                guidance=prep.guidance, c_concat=prep.c_concat,
+                gligen_objs=prep.gligen_objs)
         out_d = {"samples": out, "local_batch": prep.local_batch,
                  "fanout": prep.fanout}
         if "noise_mask" in latent_image:   # ComfyUI keeps the mask on the
@@ -1236,6 +1313,7 @@ class KSamplerAdvanced(Op):
                 start_at_step: int = 0, end_at_step: int = 10000,
                 return_with_leftover_noise: str = "disable"):
         ctx.check_interrupt()
+        model = _maybe_gligen_model(model, positive, negative)
         prep = _prepare_sample_inputs(ctx, model, noise_seed, latent_image,
                                       positive, negative)
         with Timer(f"ksampler_adv[{sampler_name}x{steps}"
@@ -1252,7 +1330,8 @@ class KSamplerAdvanced(Op):
                 force_full_denoise=(
                     str(return_with_leftover_noise) == "disable"),
                 middle_context=prep.mid_context, cfg2=prep.cfg2,
-                guidance=prep.guidance, c_concat=prep.c_concat)
+                guidance=prep.guidance, c_concat=prep.c_concat,
+                gligen_objs=prep.gligen_objs)
         out_d = {"samples": out, "local_batch": prep.local_batch,
                  "fanout": prep.fanout}
         if "noise_mask" in latent_image:
@@ -1349,6 +1428,25 @@ class _SampleInputs:
     cfg2: float = 1.0
     # inpaint-model channels (Conditioning.concat_latent), batch-matched
     c_concat: object = None
+    # GLIGEN grounding token pair (cond, null), batch-matched
+    gligen_objs: object = None
+
+
+def _maybe_gligen_model(model, *conds):
+    """A conditioning carrying GLIGEN grounding pulls the fuser-grafted
+    pipeline in transparently (the reference patches the model inside
+    its sampling machinery; the graph schema carries only the
+    conditioning)."""
+    for c in conds:
+        if c is None:
+            continue
+        for e in (c,) + tuple(getattr(c, "siblings", ()) or ()):
+            spec = getattr(e, "gligen", None)
+            if spec is not None:
+                if model.family.unet.gligen:
+                    return model
+                return gligen_attach(model, spec[0])
+    return model
 
 
 def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
@@ -1572,6 +1670,43 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
             m = coll.shard_batch(m, mesh)
         mask = jnp.asarray(m)
 
+    # GLIGEN grounding tokens: the (cond, null) pair — cond blocks get
+    # the real tokens, uncond blocks the null tokens (registry.sample)
+    gligen_objs = None
+    gspec = next((getattr(e, "gligen", None) for e in all_entries
+                  if getattr(e, "gligen", None) is not None), None)
+    if gspec is not None:
+        gmodel, entries_g = gspec
+        n_obj = len(entries_g)
+        embs = np.concatenate(
+            [np.asarray(t, np.float32).reshape(1, -1)
+             for t, _ in entries_g])[None]              # [1, N, D]
+        # xywh latent units -> normalized xyxy against THIS latent
+        bx = np.asarray([[b[0], b[1], b[0] + b[2], b[1] + b[3]]
+                         for _, b in entries_g], np.float32)
+        bx = bx / np.asarray([lat.shape[2], lat.shape[1],
+                              lat.shape[2], lat.shape[1]], np.float32)
+        boxes = np.clip(bx, 0.0, 1.0)[None]             # [1, N, 4]
+        og = gmodel.grounding_tokens(embs, boxes,
+                                     np.ones((1, n_obj), np.float32))
+        on = gmodel.grounding_tokens(np.zeros_like(embs),
+                                     np.zeros_like(boxes),
+                                     np.zeros((1, n_obj), np.float32))
+        og = jnp.repeat(jnp.asarray(og), total, axis=0)
+        on = jnp.repeat(jnp.asarray(on), total, axis=0)
+        if fanout > 1 and mesh is not None:
+            og = coll.shard_batch(np.asarray(og), mesh)
+            on = coll.shard_batch(np.asarray(on), mesh)
+        # per-block carry flags in the registry's block layout (conds
+        # first — incl. the dual middle — then unconds)
+        carries = tuple(getattr(e, "gligen", None) is not None
+                        for e in pos_entries)
+        if middle is not None:
+            carries += (getattr(middle, "gligen", None) is not None,)
+        carries += tuple(getattr(e, "gligen", None) is not None
+                         for e in neg_entries)
+        gligen_objs = (og, on, carries)
+
     # inpaint-MODEL channels: any conditioning entry may carry them
     # (ComfyUI sets them on positive AND negative); one array rides every
     # model call, cycled to the fanned batch like the control hint
@@ -1593,7 +1728,8 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                          y=y, local_batch=local_b, fanout=fanout,
                          noise_mask=mask, control=control,
                          mid_context=mid_ctx, guidance=guidance,
-                         cfg2=cfg2, c_concat=c_concat)
+                         cfg2=cfg2, c_concat=c_concat,
+                         gligen_objs=gligen_objs)
 
 
 def _unclip_vector_cond(pipe, cond: Conditioning, batch: int):

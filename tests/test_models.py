@@ -2600,3 +2600,107 @@ class TestTokenMerging:
                                           "normal", pos, pos, lat, 1.0)
         assert not np.allclose(s, np.asarray(b["samples"]))
         registry.clear_pipeline_cache()
+
+
+class TestGligen:
+    def test_position_net_and_fuser_shapes(self):
+        import jax as _jax
+
+        from comfyui_distributed_tpu.models import gligen as gg
+        from comfyui_distributed_tpu.models.layers import \
+            GatedSelfAttention
+        registry.clear_pipeline_cache()
+        gm = gg.load_gligen("tiny-gligen.pth", text_dim=64)
+        embs = np.ones((1, 3, 64), np.float32)
+        boxes = np.asarray([[[0, 0, .5, .5], [.5, 0, 1, .5],
+                             [0, .5, 1, 1]]], np.float32)
+        toks = gm.grounding_tokens(embs, boxes, np.ones((1, 3)))
+        assert toks.shape == (1, 3, 64)
+        nulls = gm.grounding_tokens(np.zeros_like(embs),
+                                    np.zeros_like(boxes),
+                                    np.zeros((1, 3)))
+        assert not np.allclose(np.asarray(toks), np.asarray(nulls))
+        # zero-init gates: a FRESH fuser is an exact no-op
+        fus = GatedSelfAttention(num_heads=2, dtype=jnp.float32)
+        x = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (1, 16, 32)), jnp.float32)
+        params = fus.init(_jax.random.PRNGKey(0), x, toks)
+        np.testing.assert_array_equal(np.asarray(fus.apply(params, x,
+                                                           toks)),
+                                      np.asarray(x))
+        registry.clear_pipeline_cache()
+
+    def test_textbox_apply_and_sampling(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("gligen-base.ckpt")
+        octx = OpContext()
+        (gm,) = get_op("GLIGENLoader").execute(octx, "tiny-gligen.pth")
+        pos = Conditioning(context=p.encode_prompt(["a meadow"])[0])
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        (posg,) = get_op("GLIGENTextBoxApply").execute(
+            octx, pos, p, gm, "a red fox", 32, 32, 0, 0)
+        (posg2,) = get_op("GLIGENTextBoxApply").execute(
+            octx, posg, p, gm, "a blue bird", 32, 32, 32, 32)
+        assert len(posg2.gligen[1]) == 2
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (out,) = get_op("KSampler").execute(octx, p, 3, 2, 5.0, "euler",
+                                            "normal", posg2, neg, lat,
+                                            1.0)
+        s = np.asarray(out["samples"])
+        assert np.isfinite(s).all()
+        (plain,) = get_op("KSampler").execute(octx, p, 3, 2, 5.0,
+                                              "euler", "normal", pos,
+                                              neg, lat, 1.0)
+        # virtual fusers zero-init their gates: grounded == plain
+        # EXACTLY (the graft preserves the base weights bit-exact)
+        np.testing.assert_allclose(s, np.asarray(plain["samples"]),
+                                   rtol=2e-3, atol=2e-3)
+        # boost the gates -> grounding steers
+        from comfyui_distributed_tpu.ops.basic import gligen_attach
+        pg = gligen_attach(p, gm)
+        import jax as _jax
+
+        def boost(path, a):
+            kp = _jax.tree_util.keystr(path)
+            if "alpha_attn" in kp or "alpha_dense" in kp:
+                return jnp.full_like(a, 0.5)
+            return a
+        pg.unet_params = _jax.tree_util.tree_map_with_path(
+            boost, pg.unet_params)
+        pg._jit_cache.clear()
+        (steered,) = get_op("KSampler").execute(octx, pg, 3, 2, 5.0,
+                                                "euler", "normal",
+                                                posg2, neg, lat, 1.0)
+        assert np.isfinite(np.asarray(steered["samples"])).all()
+        assert not np.allclose(np.asarray(steered["samples"]), s,
+                               atol=1e-3)
+        registry.clear_pipeline_cache()
+
+
+class TestGligenCarryFlags:
+    def test_flags_follow_the_carrying_entry(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        from comfyui_distributed_tpu.ops.basic import \
+            _prepare_sample_inputs
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("gligen-flags.ckpt")
+        octx = OpContext()
+        (gm,) = get_op("GLIGENLoader").execute(octx, "tiny-gg2.pth")
+        pos = Conditioning(context=p.encode_prompt(["a"])[0])
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        (negg,) = get_op("GLIGENTextBoxApply").execute(
+            octx, neg, p, gm, "x", 16, 16, 0, 0)
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        # gligen on the NEGATIVE only: flags (pos=False, neg=True)
+        prep = _prepare_sample_inputs(octx, p, 0, lat, pos, negg)
+        assert prep.gligen_objs is not None
+        assert prep.gligen_objs[2] == (False, True)
+        # and on the positive: (True, False)
+        (posg,) = get_op("GLIGENTextBoxApply").execute(
+            octx, pos, p, gm, "x", 16, 16, 0, 0)
+        prep2 = _prepare_sample_inputs(octx, p, 0, lat, posg, neg)
+        assert prep2.gligen_objs[2] == (True, False)
+        registry.clear_pipeline_cache()
