@@ -741,6 +741,14 @@ def _virtual_params(module, seed: int, *shaped_args) -> Any:
     import zlib
 
     shapes = jax.eval_shape(module.init, jax.random.PRNGKey(0), *shaped_args)
+    leaf = _virtual_leaf(seed)
+    return jax.tree_util.tree_map_with_path(leaf, shapes)["params"]
+
+
+def _virtual_leaf(seed: int):
+    """The ONE copy of the virtual-init fill rules (shared with partial
+    initializers like gligen_attach's missing-leaf graft)."""
+    import zlib
 
     def leaf(path, sd):
         name = jax.tree_util.keystr(path)
@@ -759,7 +767,7 @@ def _virtual_params(module, seed: int, *shaped_args) -> Any:
                 / np.sqrt(fan_in)
         return jnp.asarray(arr, dtype=dtype)
 
-    return jax.tree_util.tree_map_with_path(leaf, shapes)["params"]
+    return leaf
 
 
 # pipelines under plain names, (module, params) tuples under "cn:" keys,

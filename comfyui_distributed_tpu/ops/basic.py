@@ -331,12 +331,30 @@ def gligen_attach(model, gligen) -> object:
         fam.unet, gligen=int(gligen.cfg.out_dim)))
     ds = fam.vae.downscale
     h = w = 8 * ds
-    full = registry._virtual_params(
-        unet_mod.UNet(fam2.unet), registry._name_seed(tag),
+    import jax as _jax
+    mod2 = unet_mod.UNet(fam2.unet)
+    # synthesize ONLY the leaves missing from the base tree (the
+    # fusers): eval_shape traces without compiling, and present leaves
+    # reuse the base checkpoint's arrays by reference — no
+    # gigabyte-scale throwaway init for real model sizes
+    shapes = _jax.eval_shape(
+        mod2.init, _jax.random.PRNGKey(0),
         jnp.zeros((1, h // ds, w // ds, fam.unet.in_channels)),
         jnp.zeros((1,)),
-        jnp.zeros((1, 77, fam.unet.context_dim)))
-    merged = graft_params(model.unet_params, full)
+        jnp.zeros((1, 77, fam.unet.context_dim)))["params"]
+    fill = registry._virtual_leaf(registry._name_seed(tag))
+
+    def build(path, sd):
+        node = model.unet_params
+        for part in path:
+            k2 = getattr(part, "key", str(part))
+            if isinstance(node, dict) and k2 in node:
+                node = node[k2]
+            else:
+                return fill(("params",) + tuple(path), sd)
+        return node
+
+    merged = _jax.tree_util.tree_map_with_path(build, shapes)
     return registry.derive_pipeline(model, tag, family=fam2,
                                     unet_params=merged)
 
@@ -1482,6 +1500,10 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
         seeds = np.full((total,), np.uint64(base), np.uint64)
     local_idx = np.tile(np.arange(local_b, dtype=np.uint32),
                         max(fanout, 1))[:total]
+    if latent_image.get("seed_fixed_batch"):
+        # LatentBatchSeedBehavior 'fixed': one noise stream for the
+        # whole local batch (replica offsets still apply via seeds)
+        local_idx = np.zeros_like(local_idx)
 
     # multi-entry cond lists (regional prompting), SYMMETRIC on both CFG
     # sides: the primary plus any siblings bundled by ConditioningCombine;
@@ -1676,6 +1698,12 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
     gspec = next((getattr(e, "gligen", None) for e in all_entries
                   if getattr(e, "gligen", None) is not None), None)
     if gspec is not None:
+        if any(getattr(e, "gligen", None) is not None
+               and getattr(e, "gligen") is not gspec
+               for e in all_entries):
+            debug_log("GLIGEN: conditioning entries carry different "
+                      "grounding specs; applying the first only (one "
+                      "token set runs per stacked call)")
         gmodel, entries_g = gspec
         n_obj = len(entries_g)
         embs = np.concatenate(
@@ -1699,11 +1727,11 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
             on = coll.shard_batch(np.asarray(on), mesh)
         # per-block carry flags in the registry's block layout (conds
         # first — incl. the dual middle — then unconds)
-        carries = tuple(getattr(e, "gligen", None) is not None
+        carries = tuple(getattr(e, "gligen", None) is gspec
                         for e in pos_entries)
         if middle is not None:
-            carries += (getattr(middle, "gligen", None) is not None,)
-        carries += tuple(getattr(e, "gligen", None) is not None
+            carries += (getattr(middle, "gligen", None) is gspec,)
+        carries += tuple(getattr(e, "gligen", None) is gspec
                          for e in neg_entries)
         gligen_objs = (og, on, carries)
 
@@ -2374,6 +2402,136 @@ class Morphology(Op):
                                 int(kernel_size))
                         for c in range(img.shape[-1])], axis=-1)
         return (np.clip(out, 0.0, 1.0).astype(np.float32),)
+
+
+_PORTER_DUFF = {
+    # mode: (Fa, Fb) source/destination fractions of the PD algebra
+    # out = Fa * a_s * C_s + Fb * a_d * C_d (premultiplied form)
+    "ADD": None,        # special: saturating add
+    "CLEAR": (lambda a_s, a_d: 0.0, lambda a_s, a_d: 0.0),
+    "DARKEN": None,     # special below
+    "DST": (lambda a_s, a_d: 0.0, lambda a_s, a_d: 1.0),
+    "DST_ATOP": (lambda a_s, a_d: 1.0 - a_d, lambda a_s, a_d: a_s),
+    "DST_IN": (lambda a_s, a_d: 0.0, lambda a_s, a_d: a_s),
+    "DST_OUT": (lambda a_s, a_d: 0.0, lambda a_s, a_d: 1.0 - a_s),
+    "DST_OVER": (lambda a_s, a_d: 1.0 - a_d, lambda a_s, a_d: 1.0),
+    "LIGHTEN": None,    # special below
+    "MULTIPLY": None,   # special below
+    "SRC": (lambda a_s, a_d: 1.0, lambda a_s, a_d: 0.0),
+    "SRC_ATOP": (lambda a_s, a_d: a_d, lambda a_s, a_d: 1.0 - a_s),
+    "SRC_IN": (lambda a_s, a_d: a_d, lambda a_s, a_d: 0.0),
+    "SRC_OUT": (lambda a_s, a_d: 1.0 - a_d, lambda a_s, a_d: 0.0),
+    "SRC_OVER": (lambda a_s, a_d: 1.0, lambda a_s, a_d: 1.0 - a_s),
+    "XOR": (lambda a_s, a_d: 1.0 - a_d, lambda a_s, a_d: 1.0 - a_s),
+}
+
+
+@register_op
+class PorterDuffImageComposite(Op):
+    """Porter-Duff compositing of (source, source_alpha) over
+    (destination, destination_alpha) — the reference's compositing node
+    set, premultiplied algebra; ADD/DARKEN/LIGHTEN/MULTIPLY use their
+    blend formulas."""
+    TYPE = "PorterDuffImageComposite"
+    WIDGETS = ["mode"]
+    DEFAULTS = {"mode": "DST"}
+
+    def execute(self, ctx: OpContext, source, source_alpha, destination,
+                destination_alpha, mode: str = "DST"):
+        cs = as_image_array(source)
+        cd = as_image_array(destination)
+        if cd.shape[1:3] != cs.shape[1:3]:
+            cd = resize_image(cd, cs.shape[2], cs.shape[1], "bilinear")
+        cd = _cycle_batch(np.asarray(cd, np.float32), cs.shape[0])
+
+        def _align_alpha(a):
+            a = np.asarray(a, np.float32)
+            if a.ndim == 2:
+                a = a[None]
+            if a.shape[1:3] != cs.shape[1:3]:
+                a = resize_image(a[..., None], cs.shape[2],
+                                 cs.shape[1], "bilinear")[..., 0]
+            return _cycle_batch(a, cs.shape[0])
+
+        a_s = _align_alpha(source_alpha)
+        a_d = _align_alpha(destination_alpha)
+        asr = a_s[..., None]
+        adr = a_d[..., None]
+        m = str(mode).upper()
+        if m == "ADD":
+            out_c = np.clip(cs + cd, 0.0, 1.0)
+            out_a = np.clip(a_s + a_d, 0.0, 1.0)
+        elif m in ("DARKEN", "LIGHTEN"):
+            pick = np.minimum if m == "DARKEN" else np.maximum
+            out_a = a_s + a_d - a_s * a_d
+            out_c = ((1 - adr) * asr * cs + (1 - asr) * adr * cd
+                     + asr * adr * pick(cs, cd))
+            out_c = np.divide(out_c, np.maximum(out_a[..., None], 1e-6))
+        elif m == "MULTIPLY":
+            out_a = a_s * a_d
+            out_c = cs * cd
+        elif m in _PORTER_DUFF and _PORTER_DUFF[m] is not None:
+            fa, fb = _PORTER_DUFF[m]
+            out_a = fa(a_s, a_d) * a_s + fb(a_s, a_d) * a_d
+            prem = (fa(asr, adr) * asr * cs + fb(asr, adr) * adr * cd)
+            out_c = np.divide(prem, np.maximum(out_a[..., None], 1e-6))
+        else:
+            raise ValueError(f"unknown Porter-Duff mode {mode!r}")
+        return (np.clip(out_c, 0.0, 1.0).astype(np.float32),
+                np.clip(out_a, 0.0, 1.0).astype(np.float32))
+
+
+@register_op
+class SplitImageWithAlpha(Op):
+    TYPE = "SplitImageWithAlpha"
+
+    def execute(self, ctx: OpContext, image):
+        img = np.asarray(image, np.float32)
+        if img.ndim == 3:
+            img = img[None]
+        rgb = img[..., :3]
+        alpha = img[..., 3] if img.shape[-1] == 4 \
+            else np.ones(img.shape[:3], np.float32)
+        # the reference returns the INVERTED alpha as the mask
+        return (rgb, 1.0 - alpha)
+
+
+@register_op
+class JoinImageWithAlpha(Op):
+    TYPE = "JoinImageWithAlpha"
+
+    def execute(self, ctx: OpContext, image, alpha):
+        img = as_image_array(image)[..., :3]
+        a = np.asarray(alpha, np.float32)
+        if a.ndim == 2:
+            a = a[None]
+        if a.shape[1:3] != img.shape[1:3]:
+            a = resize_image(a[..., None], img.shape[2], img.shape[1],
+                             "bilinear")[..., 0]
+        a = _cycle_batch(a, img.shape[0])
+        # inverse of SplitImageWithAlpha's inverted-mask convention
+        return (np.concatenate([img, (1.0 - a)[..., None]], axis=-1)
+                .astype(np.float32),)
+
+
+@register_op
+class LatentBatchSeedBehavior(Op):
+    """'fixed': every latent in the batch gets the SAME noise stream
+    (the per-sample fold-in index zeroes); 'random' (default) keeps
+    per-sample streams."""
+    TYPE = "LatentBatchSeedBehavior"
+    WIDGETS = ["seed_behavior"]
+    DEFAULTS = {"seed_behavior": "random"}
+
+    def execute(self, ctx: OpContext, samples,
+                seed_behavior: str = "random"):
+        out = {**_latent_meta(samples),
+               "samples": np.asarray(samples["samples"], np.float32)}
+        if str(seed_behavior) == "fixed":
+            out["seed_fixed_batch"] = True
+        else:
+            out.pop("seed_fixed_batch", None)
+        return (out,)
 
 
 @register_op
@@ -3174,7 +3332,7 @@ def _latent_meta(samples) -> dict:
     future meta key can't be forwarded by one op and dropped by another
     (which would make a downstream VAEEncode re-tile a fanned batch)."""
     return {k: samples[k] for k in ("local_batch", "fanout",
-                                    "noise_mask")
+                                    "noise_mask", "seed_fixed_batch")
             if k in samples}
 
 

@@ -968,3 +968,77 @@ class TestMaskToolchainCompletion:
         assert not np.allclose(np.asarray(out.context[:, 7:]),
                                np.asarray(out2.context[:, 7:]))
         registry.clear_pipeline_cache()
+
+
+class TestCompositingAndSeedBehavior:
+    def _op(self, name):
+        from comfyui_distributed_tpu.ops.base import get_op
+        return get_op(name)
+
+    def test_porter_duff_modes(self):
+        octx = OpContext()
+        cs = np.full((1, 2, 2, 3), 0.8, np.float32)
+        cd = np.full((1, 2, 2, 3), 0.2, np.float32)
+        a1 = np.ones((1, 2, 2), np.float32)
+        a0 = np.zeros((1, 2, 2), np.float32)
+        op = self._op("PorterDuffImageComposite")
+        # SRC_OVER with opaque source = source
+        c, a = op.execute(octx, cs, a1, cd, a1, "SRC_OVER")
+        np.testing.assert_allclose(c, 0.8, atol=1e-6)
+        np.testing.assert_allclose(a, 1.0)
+        # SRC_OVER with transparent source = destination
+        c, a = op.execute(octx, cs, a0, cd, a1, "SRC_OVER")
+        np.testing.assert_allclose(c, 0.2, atol=1e-5)
+        # DST ignores the source entirely
+        c, a = op.execute(octx, cs, a1, cd, a1, "DST")
+        np.testing.assert_allclose(c, 0.2, atol=1e-6)
+        # MULTIPLY / ADD / DARKEN / LIGHTEN formulas
+        c, _ = op.execute(octx, cs, a1, cd, a1, "MULTIPLY")
+        np.testing.assert_allclose(c, 0.16, atol=1e-5)
+        c, _ = op.execute(octx, cs, a1, cd, a1, "ADD")
+        np.testing.assert_allclose(c, 1.0)
+        c, _ = op.execute(octx, cs, a1, cd, a1, "DARKEN")
+        np.testing.assert_allclose(c, 0.2, atol=1e-5)
+        c, _ = op.execute(octx, cs, a1, cd, a1, "LIGHTEN")
+        np.testing.assert_allclose(c, 0.8, atol=1e-5)
+        # CLEAR zeroes everything
+        c, a = op.execute(octx, cs, a1, cd, a1, "CLEAR")
+        assert c.sum() == 0.0 and a.sum() == 0.0
+        with pytest.raises(ValueError):
+            op.execute(octx, cs, a1, cd, a1, "NOPE")
+
+    def test_alpha_split_join_round_trip(self):
+        octx = OpContext()
+        rng = np.random.default_rng(4)
+        rgba = rng.uniform(0, 1, (1, 4, 4, 4)).astype(np.float32)
+        rgb, mask = self._op("SplitImageWithAlpha").execute(octx, rgba)
+        np.testing.assert_array_equal(rgb, rgba[..., :3])
+        np.testing.assert_allclose(mask, 1.0 - rgba[..., 3])
+        (joined,) = self._op("JoinImageWithAlpha").execute(octx, rgb,
+                                                           mask)
+        np.testing.assert_allclose(joined, rgba, atol=1e-6)
+
+    def test_seed_behavior_fixed_gives_identical_batch(self, ctx):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("seedfix.ckpt")
+        octx = OpContext()
+        pos = Conditioning(context=p.encode_prompt(["a fox"])[0])
+        lat = {"samples": np.zeros((3, 8, 8, 4), np.float32)}
+        (fixed,) = get_op("LatentBatchSeedBehavior").execute(
+            octx, lat, "fixed")
+        (out,) = get_op("KSampler").execute(octx, p, 5, 2, 4.0, "euler",
+                                            "normal", pos, pos, fixed,
+                                            1.0)
+        s = np.asarray(out["samples"])
+        np.testing.assert_allclose(s[0], s[1], atol=1e-5)
+        np.testing.assert_allclose(s[0], s[2], atol=1e-5)
+        (rand,) = get_op("LatentBatchSeedBehavior").execute(
+            octx, lat, "random")
+        (out2,) = get_op("KSampler").execute(octx, p, 5, 2, 4.0,
+                                             "euler", "normal", pos,
+                                             pos, rand, 1.0)
+        s2 = np.asarray(out2["samples"])
+        assert not np.allclose(s2[0], s2[1])
+        registry.clear_pipeline_cache()
