@@ -88,20 +88,6 @@ class GligenModel:
                             jnp.asarray(masks, jnp.float32))
 
 
-def graft_params(base: Dict, full: Dict) -> Dict:
-    """Overlay: every key present in ``base`` keeps the base value;
-    keys only in ``full`` (the fusers) come from ``full``."""
-    out = {}
-    for k, v in full.items():
-        if k in base and isinstance(v, dict):
-            out[k] = graft_params(base[k], v)
-        elif k in base:
-            out[k] = base[k]
-        else:
-            out[k] = v
-    return out
-
-
 _cache: Dict[str, GligenModel] = {}
 
 

@@ -321,7 +321,6 @@ def gligen_attach(model, gligen) -> object:
     checkpoint's weights graft over every shared key — trained weights
     stay bit-exact, only grounding-specific params are synthesized."""
     from comfyui_distributed_tpu.models import unet as unet_mod
-    from comfyui_distributed_tpu.models.gligen import graft_params
     tag = f"gligen:{gligen.name}"
     cached = registry.derived_cached(model, tag)
     if cached is not None:
@@ -2404,41 +2403,69 @@ class Morphology(Op):
         return (np.clip(out, 0.0, 1.0).astype(np.float32),)
 
 
-_PORTER_DUFF = {
-    # mode: (Fa, Fb) source/destination fractions of the PD algebra
-    # out = Fa * a_s * C_s + Fb * a_d * C_d (premultiplied form)
-    "ADD": None,        # special: saturating add
-    "CLEAR": (lambda a_s, a_d: 0.0, lambda a_s, a_d: 0.0),
-    "DARKEN": None,     # special below
-    "DST": (lambda a_s, a_d: 0.0, lambda a_s, a_d: 1.0),
-    "DST_ATOP": (lambda a_s, a_d: 1.0 - a_d, lambda a_s, a_d: a_s),
-    "DST_IN": (lambda a_s, a_d: 0.0, lambda a_s, a_d: a_s),
-    "DST_OUT": (lambda a_s, a_d: 0.0, lambda a_s, a_d: 1.0 - a_s),
-    "DST_OVER": (lambda a_s, a_d: 1.0 - a_d, lambda a_s, a_d: 1.0),
-    "LIGHTEN": None,    # special below
-    "MULTIPLY": None,   # special below
-    "SRC": (lambda a_s, a_d: 1.0, lambda a_s, a_d: 0.0),
-    "SRC_ATOP": (lambda a_s, a_d: a_d, lambda a_s, a_d: 1.0 - a_s),
-    "SRC_IN": (lambda a_s, a_d: a_d, lambda a_s, a_d: 0.0),
-    "SRC_OUT": (lambda a_s, a_d: 1.0 - a_d, lambda a_s, a_d: 0.0),
-    "SRC_OVER": (lambda a_s, a_d: 1.0, lambda a_s, a_d: 1.0 - a_s),
-    "XOR": (lambda a_s, a_d: 1.0 - a_d, lambda a_s, a_d: 1.0 - a_s),
-}
+def _porter_duff(mode, cs, cd, a_s, a_d):
+    """The reference node's straight-alpha formula table (the Android
+    PorterDuff documentation set it mirrors), applied verbatim to
+    unpremultiplied image values — matching the reference's tensors
+    exactly, including its known quirks at partial alpha."""
+    asr, adr = a_s[..., None], a_d[..., None]
+    if mode == "ADD":
+        return np.clip(cs + cd, 0, 1), np.clip(a_s + a_d, 0, 1)
+    if mode == "CLEAR":
+        return np.zeros_like(cs), np.zeros_like(a_s)
+    if mode == "DARKEN":
+        return ((1 - adr) * cs + (1 - asr) * cd
+                + np.minimum(cs, cd)), a_s + (1 - a_s) * a_d
+    if mode == "DST":
+        return cd, a_d
+    if mode == "DST_ATOP":
+        return asr * cd + (1 - adr) * cs, a_s
+    if mode == "DST_IN":
+        return cd * asr, a_s * a_d
+    if mode == "DST_OUT":
+        return (1 - asr) * cd, (1 - a_s) * a_d
+    if mode == "DST_OVER":
+        return cd + (1 - adr) * cs, a_d + (1 - a_d) * a_s
+    if mode == "LIGHTEN":
+        return ((1 - adr) * cs + (1 - asr) * cd
+                + np.maximum(cs, cd)), a_s + (1 - a_s) * a_d
+    if mode == "MULTIPLY":
+        return cs * cd, a_s * a_d
+    if mode == "OVERLAY":
+        out_a = a_s + (1 - a_s) * a_d
+        lo = 2 * cs * cd + cs * (1 - adr) + cd * (1 - asr)
+        hi = cs * (1 + adr) + cd * (1 + asr) - 2 * cd * cs - adr * asr
+        return np.where(2 * cd <= adr, lo, hi), out_a
+    if mode == "SCREEN":
+        return cs + cd - cs * cd, a_s + (1 - a_s) * a_d
+    if mode == "SRC":
+        return cs, a_s
+    if mode == "SRC_ATOP":
+        return adr * cs + (1 - asr) * cd, a_d
+    if mode == "SRC_IN":
+        return cs * adr, a_s * a_d
+    if mode == "SRC_OUT":
+        return (1 - adr) * cs, (1 - a_d) * a_s
+    if mode == "SRC_OVER":
+        return cs + (1 - asr) * cd, a_s + (1 - a_s) * a_d
+    if mode == "XOR":
+        return ((1 - adr) * cs + (1 - asr) * cd,
+                (1 - a_d) * a_s + (1 - a_s) * a_d)
+    raise ValueError(f"unknown Porter-Duff mode {mode!r}")
 
 
 @register_op
 class PorterDuffImageComposite(Op):
     """Porter-Duff compositing of (source, source_alpha) over
-    (destination, destination_alpha) — the reference's compositing node
-    set, premultiplied algebra; ADD/DARKEN/LIGHTEN/MULTIPLY use their
-    blend formulas."""
+    (destination, destination_alpha) — the reference's straight-alpha
+    formula table (_porter_duff)."""
     TYPE = "PorterDuffImageComposite"
     WIDGETS = ["mode"]
     DEFAULTS = {"mode": "DST"}
 
     def execute(self, ctx: OpContext, source, source_alpha, destination,
                 destination_alpha, mode: str = "DST"):
-        cs = as_image_array(source)
+        cs = np.asarray(as_image_array(source), np.float32)
         cd = as_image_array(destination)
         if cd.shape[1:3] != cs.shape[1:3]:
             cd = resize_image(cd, cs.shape[2], cs.shape[1], "bilinear")
@@ -2455,28 +2482,7 @@ class PorterDuffImageComposite(Op):
 
         a_s = _align_alpha(source_alpha)
         a_d = _align_alpha(destination_alpha)
-        asr = a_s[..., None]
-        adr = a_d[..., None]
-        m = str(mode).upper()
-        if m == "ADD":
-            out_c = np.clip(cs + cd, 0.0, 1.0)
-            out_a = np.clip(a_s + a_d, 0.0, 1.0)
-        elif m in ("DARKEN", "LIGHTEN"):
-            pick = np.minimum if m == "DARKEN" else np.maximum
-            out_a = a_s + a_d - a_s * a_d
-            out_c = ((1 - adr) * asr * cs + (1 - asr) * adr * cd
-                     + asr * adr * pick(cs, cd))
-            out_c = np.divide(out_c, np.maximum(out_a[..., None], 1e-6))
-        elif m == "MULTIPLY":
-            out_a = a_s * a_d
-            out_c = cs * cd
-        elif m in _PORTER_DUFF and _PORTER_DUFF[m] is not None:
-            fa, fb = _PORTER_DUFF[m]
-            out_a = fa(a_s, a_d) * a_s + fb(a_s, a_d) * a_d
-            prem = (fa(asr, adr) * asr * cs + fb(asr, adr) * adr * cd)
-            out_c = np.divide(prem, np.maximum(out_a[..., None], 1e-6))
-        else:
-            raise ValueError(f"unknown Porter-Duff mode {mode!r}")
+        out_c, out_a = _porter_duff(str(mode).upper(), cs, cd, a_s, a_d)
         return (np.clip(out_c, 0.0, 1.0).astype(np.float32),
                 np.clip(out_a, 0.0, 1.0).astype(np.float32))
 
@@ -2606,6 +2612,115 @@ class LatentComposite(Op):
                     mask[:, :, w - 1 - t] *= rate
         out = _paste(dest, src, xl, yl, mask)
         return ({**_latent_meta(samples_to), "samples": out},)
+
+
+def _counted_output_path(ctx: OpContext, filename_prefix: str,
+                         ext: str) -> str:
+    """Counter-suffixed save path (never-overwrite semantics shared
+    with SaveImage: a second queue of the same workflow must not
+    clobber earlier outputs)."""
+    probe = _safe_output_path(ctx.output_dir or os.getcwd(),
+                              f"{filename_prefix}_00000.{ext}")
+    d, fname = os.path.split(probe)
+    base = fname[: -len(f"_00000.{ext}")]
+    os.makedirs(d, exist_ok=True)
+    n = _next_image_counter(d, base, ext)
+    return os.path.join(d, f"{base}_{n:05d}.{ext}")
+
+
+@register_op
+class SaveLatent(Op):
+    """Write the latent batch as a ``.latent`` safetensors (the
+    reference's format: key ``latent_tensor`` in NCHW + a
+    ``latent_format_version_0`` marker)."""
+    TYPE = "SaveLatent"
+    OUTPUT_NODE = True
+    WIDGETS = ["filename_prefix"]
+    DEFAULTS = {"filename_prefix": "latents/save"}
+
+    def execute(self, ctx: OpContext, samples,
+                filename_prefix: str = "latents/save"):
+        # save_state_dict, not raw safetensors save_file: the NCHW
+        # transpose is a strided view and save_file ignores strides
+        from comfyui_distributed_tpu.models.checkpoints import \
+            save_state_dict
+        path = _counted_output_path(ctx, filename_prefix, "latent")
+        lat = np.asarray(samples["samples"], np.float32)
+        save_state_dict({"latent_tensor": lat.transpose(0, 3, 1, 2),
+                         "latent_format_version_0": np.asarray([0])},
+                        path)
+        debug_log(f"SaveLatent: wrote {path}")
+        return ()
+
+
+@register_op
+class LoadLatent(Op):
+    TYPE = "LoadLatent"
+    WIDGETS = ["latent"]
+
+    def execute(self, ctx: OpContext, latent: str):
+        from safetensors import safe_open
+        path = latent
+        if ctx.input_dir and not os.path.isabs(path):
+            path = os.path.join(ctx.input_dir, latent)
+        with safe_open(path, framework="numpy") as f:
+            keys = set(f.keys())
+            lat = np.asarray(f.get_tensor("latent_tensor"), np.float32)
+        # reference parity: files WITHOUT the version marker predate
+        # latent standardization and stored SCALED latents
+        if "latent_format_version_0" not in keys:
+            lat = lat * (1.0 / 0.18215)
+        # reference files are NCHW; this framework is NHWC
+        return ({"samples": lat.transpose(0, 2, 3, 1)},)
+
+
+@register_op
+class SaveAnimatedWEBP(Op):
+    """Write the image batch as one animated WEBP."""
+    TYPE = "SaveAnimatedWEBP"
+    OUTPUT_NODE = True
+    WIDGETS = ["filename_prefix", "fps", "lossless", "quality"]
+    DEFAULTS = {"filename_prefix": "anim/save", "fps": 6.0,
+                "lossless": True, "quality": 80}
+
+    def execute(self, ctx: OpContext, images,
+                filename_prefix: str = "anim/save", fps: float = 6.0,
+                lossless=True, quality: int = 80, method: str = "default"):
+        frames = [tensor_to_pil(f) for f in as_image_array(images)]
+        path = _counted_output_path(ctx, filename_prefix, "webp")
+        methods = {"default": 4, "fastest": 0, "slowest": 6}
+        frames[0].save(
+            path, save_all=True, append_images=frames[1:],
+            duration=int(1000.0 / max(float(fps), 0.01)), loop=0,
+            lossless=str(lossless).lower() not in ("false", "0", ""),
+            quality=int(quality),
+            method=methods.get(str(method), 4))
+        debug_log(f"SaveAnimatedWEBP: wrote {path} "
+                  f"({len(frames)} frames)")
+        return ()
+
+
+@register_op
+class SaveAnimatedPNG(Op):
+    """Write the image batch as one APNG."""
+    TYPE = "SaveAnimatedPNG"
+    OUTPUT_NODE = True
+    WIDGETS = ["filename_prefix", "fps", "compress_level"]
+    DEFAULTS = {"filename_prefix": "anim/save", "fps": 6.0,
+                "compress_level": 4}
+
+    def execute(self, ctx: OpContext, images,
+                filename_prefix: str = "anim/save", fps: float = 6.0,
+                compress_level: int = 4):
+        frames = [tensor_to_pil(f) for f in as_image_array(images)]
+        path = _counted_output_path(ctx, filename_prefix, "png")
+        frames[0].save(
+            path, save_all=True, append_images=frames[1:],
+            duration=int(1000.0 / max(float(fps), 0.01)), loop=0,
+            compress_level=int(compress_level))
+        debug_log(f"SaveAnimatedPNG: wrote {path} "
+                  f"({len(frames)} frames)")
+        return ()
 
 
 @register_op
@@ -3527,10 +3642,13 @@ class SaveImage(Op):
         return ()
 
 
-def _next_image_counter(dirpath: str, base: str) -> int:
-    """First unused counter for ``base_#####.png`` files in ``dirpath``."""
+def _next_image_counter(dirpath: str, base: str,
+                        ext: str = "png") -> int:
+    """First unused counter for ``base_#####.<ext>`` files in
+    ``dirpath``."""
     import re
-    pat = re.compile(re.escape(base) + r"_(\d+)\.png$")  # \d+: the save
+    pat = re.compile(re.escape(base)
+                     + r"_(\d+)\." + re.escape(ext) + r"$")  # \d+: the save
     # format widens past 99999, and a 5-digit match would overwrite there
     mx = -1
     try:

@@ -986,9 +986,18 @@ class TestCompositingAndSeedBehavior:
         c, a = op.execute(octx, cs, a1, cd, a1, "SRC_OVER")
         np.testing.assert_allclose(c, 0.8, atol=1e-6)
         np.testing.assert_allclose(a, 1.0)
-        # SRC_OVER with transparent source = destination
+        # SRC_OVER with transparent source: the reference feeds
+        # STRAIGHT values into the premultiplied formula (its known
+        # quirk) -> cs + cd, clipped
         c, a = op.execute(octx, cs, a0, cd, a1, "SRC_OVER")
+        np.testing.assert_allclose(c, 1.0, atol=1e-5)
+        np.testing.assert_allclose(a, 1.0)
+        # DST_IN with opaque source keeps the destination exactly
+        c, a = op.execute(octx, cs, a1, cd, a1, "DST_IN")
         np.testing.assert_allclose(c, 0.2, atol=1e-5)
+        # SCREEN formula
+        c, _ = op.execute(octx, cs, a1, cd, a1, "SCREEN")
+        np.testing.assert_allclose(c, 0.8 + 0.2 - 0.16, atol=1e-5)
         # DST ignores the source entirely
         c, a = op.execute(octx, cs, a1, cd, a1, "DST")
         np.testing.assert_allclose(c, 0.2, atol=1e-6)
@@ -1042,3 +1051,62 @@ class TestCompositingAndSeedBehavior:
         s2 = np.asarray(out2["samples"])
         assert not np.allclose(s2[0], s2[1])
         registry.clear_pipeline_cache()
+
+
+class TestLatentAndAnimatedIO:
+    def test_save_load_latent_round_trip(self, tmp_path):
+        from comfyui_distributed_tpu.ops.base import get_op
+        octx = OpContext()
+        octx.output_dir = str(tmp_path)
+        octx.input_dir = str(tmp_path)
+        rng = np.random.default_rng(3)
+        lat = {"samples": rng.standard_normal((2, 8, 8, 4))
+               .astype(np.float32)}
+        get_op("SaveLatent").execute(octx, lat, "latents/rt")
+        import os
+        p = os.path.join(str(tmp_path), "latents", "rt_00000.latent")
+        assert os.path.exists(p)
+        # never-overwrite: a second save gets the next counter
+        get_op("SaveLatent").execute(octx, lat, "latents/rt")
+        assert os.path.exists(os.path.join(str(tmp_path), "latents",
+                                           "rt_00001.latent"))
+        # NCHW on disk (reference format)
+        from safetensors import safe_open
+        with safe_open(p, framework="numpy") as f:
+            assert f.get_tensor("latent_tensor").shape == (2, 4, 8, 8)
+        (loaded,) = get_op("LoadLatent").execute(
+            octx, "latents/rt_00000.latent")
+        np.testing.assert_allclose(loaded["samples"], lat["samples"],
+                                   rtol=1e-6)
+        # the reference's pre-versioning files (no marker) load with
+        # the 1/0.18215 legacy multiplier
+        from comfyui_distributed_tpu.models.checkpoints import \
+            save_state_dict
+        legacy = os.path.join(str(tmp_path), "latents", "old.latent")
+        save_state_dict(
+            {"latent_tensor":
+             np.ascontiguousarray(lat["samples"].transpose(0, 3, 1, 2))},
+            legacy)
+        (old,) = get_op("LoadLatent").execute(octx, "latents/old.latent")
+        np.testing.assert_allclose(old["samples"],
+                                   lat["samples"] / 0.18215, rtol=1e-5)
+
+    def test_animated_savers(self, tmp_path):
+        from PIL import Image
+
+        from comfyui_distributed_tpu.ops.base import get_op
+        octx = OpContext()
+        octx.output_dir = str(tmp_path)
+        frames = np.stack([np.full((16, 16, 3), v, np.float32)
+                           for v in (0.1, 0.5, 0.9)])
+        get_op("SaveAnimatedWEBP").execute(octx, frames, "anim/w", 8.0,
+                                           True, 80, "slowest")
+        get_op("SaveAnimatedPNG").execute(octx, frames, "anim/p", 8.0, 4)
+        import os
+        wp = os.path.join(str(tmp_path), "anim", "w_00000.webp")
+        pp = os.path.join(str(tmp_path), "anim", "p_00000.png")
+        assert os.path.exists(wp) and os.path.exists(pp)
+        im = Image.open(wp)
+        assert getattr(im, "n_frames", 1) == 3
+        im2 = Image.open(pp)
+        assert getattr(im2, "n_frames", 1) == 3
