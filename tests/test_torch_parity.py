@@ -325,3 +325,38 @@ def test_rrdb_upscaler_matches_torch_reference(tmp_path):
     # reference the same way for comparison
     np.testing.assert_allclose(np.asarray(out), np.clip(ref, 0.0, 1.0),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_clip_vision_matches_transformers():
+    """flax CLIPVisionModel forward == transformers CLIPVisionModelWithProjection
+    through the real HF key mapping (_run_clip_vision), tiny ViT
+    geometry — the image tower behind CLIPVisionEncode/unCLIP."""
+    from comfyui_distributed_tpu.models import clip_vision as cv
+
+    vcfg = dataclasses.replace(cv.TINY_VISION_CONFIG, act="quick_gelu")
+    hf_cfg = transformers.CLIPVisionConfig(
+        hidden_size=vcfg.width, num_hidden_layers=vcfg.layers,
+        num_attention_heads=vcfg.heads, patch_size=vcfg.patch,
+        image_size=vcfg.image_size, intermediate_size=vcfg.width * 4,
+        projection_dim=vcfg.projection_dim, hidden_act="quick_gelu",
+        layer_norm_eps=1e-5)
+    torch.manual_seed(3)
+    tm = transformers.CLIPVisionModelWithProjection(hf_cfg).eval()
+
+    sd = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    params = ckpt._run_clip_vision(ckpt._LoadMapper(sd, ""), vcfg)
+
+    rng = np.random.default_rng(1)
+    px = rng.standard_normal(
+        (2, vcfg.image_size, vcfg.image_size, 3)).astype(np.float32)
+    with torch.no_grad():
+        out = tm(pixel_values=torch.from_numpy(
+            px.transpose(0, 3, 1, 2)))
+    ref_embeds = out.image_embeds.numpy()
+    ref_hidden = out.last_hidden_state.numpy()
+
+    fm = cv.CLIPVisionModel(vcfg)
+    hidden, embeds = fm.apply({"params": params}, jnp.asarray(px))
+    tol = dict(rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(np.asarray(embeds), ref_embeds, **tol)
+    np.testing.assert_allclose(np.asarray(hidden), ref_hidden, **tol)
