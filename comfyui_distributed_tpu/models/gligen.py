@@ -16,7 +16,7 @@ the surface runnable), the same policy as other adapter files."""
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp

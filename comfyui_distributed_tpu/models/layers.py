@@ -11,7 +11,7 @@ TPU-first conventions used across the model zoo:
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,6 @@ from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from flax import linen as nn
 
 from comfyui_distributed_tpu.models.clip import CLIPConfig, CLIPLayer

@@ -14,7 +14,7 @@ k-diffusion ``denoised = f(x, sigma)`` form.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

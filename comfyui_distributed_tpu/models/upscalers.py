@@ -9,7 +9,7 @@ RRDB architecture covers the common ``4x*.pth`` ESRGAN-style checkpoints.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Tuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp

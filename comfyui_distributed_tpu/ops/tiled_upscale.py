@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import json
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -45,7 +45,7 @@ from comfyui_distributed_tpu.ops.base import (
 )
 from comfyui_distributed_tpu.parallel import collectives as coll
 from comfyui_distributed_tpu.utils import constants as C
-from comfyui_distributed_tpu.utils.image import decode_png, encode_png, resize_image
+from comfyui_distributed_tpu.utils.image import encode_png, resize_image
 from comfyui_distributed_tpu.utils.logging import Timer, debug_log, log
 from comfyui_distributed_tpu.utils.net import post_form_with_retry, run_async_in_loop
 

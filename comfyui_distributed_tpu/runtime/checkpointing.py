@@ -21,8 +21,6 @@ import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
-import jax
-import numpy as np
 
 from comfyui_distributed_tpu.utils.logging import log
 

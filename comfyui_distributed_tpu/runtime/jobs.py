@@ -12,7 +12,7 @@ owned by the server app directly.
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 
 class JobStore:

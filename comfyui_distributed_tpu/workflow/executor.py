@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from comfyui_distributed_tpu.ops.base import Op, OpContext, get_op
+from comfyui_distributed_tpu.ops.base import OpContext, get_op
 from comfyui_distributed_tpu.utils.constants import \
     DISTRIBUTED_NODE_TYPES as DISTRIBUTED_TYPES
 from comfyui_distributed_tpu.workflow.graph import (
