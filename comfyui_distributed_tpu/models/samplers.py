@@ -162,6 +162,56 @@ def sample_euler(model: Model, x: jax.Array, sigmas: jax.Array,
 sample_ddim = sample_euler  # deterministic DDIM == euler in sigma space
 
 
+def _last_uncond(model: Model, denoised: jax.Array) -> jax.Array:
+    """CFG++ side-channel: the cfg denoiser stashes its uncond denoised
+    on itself each call (a traced value read back within the same trace
+    step); a bare model (no CFG wrapper) falls back to the denoised."""
+    return getattr(model, "last_uncond", denoised)
+
+
+def sample_euler_cfg_pp(model: Model, x: jax.Array, sigmas: jax.Array,
+                        extra_args: Optional[Dict[str, Any]] = None,
+                        keys: Optional[jax.Array] = None) -> jax.Array:
+    """Euler CFG++ (the reference's euler_cfg_pp): the step direction
+    comes from the UNCOND denoised while the anchor is the CFG result —
+    ``x' = denoised + sigma_next * (x - uncond_denoised) / sigma``."""
+    extra = extra_args or {}
+
+    def step(carry, step_i, s, s_next):
+        x, _ = carry
+        denoised = model(x, s, **extra)
+        d = _to_d(x, s, _last_uncond(model, denoised))
+        x = denoised + d * s_next
+        return (x, None), None
+
+    return _scan_sampler(step, x, sigmas)
+
+
+def sample_euler_ancestral_cfg_pp(
+        model: Model, x: jax.Array, sigmas: jax.Array,
+        extra_args: Optional[Dict[str, Any]] = None,
+        keys: Optional[jax.Array] = None,
+        eta: float = 1.0) -> jax.Array:
+    """Ancestral Euler CFG++ (euler_ancestral_cfg_pp)."""
+    extra = extra_args or {}
+    if keys is None:
+        raise ValueError("euler_ancestral_cfg_pp requires per-sample "
+                         "keys")
+    noise_fn = make_noise_fn(keys)
+    sample_shape = x.shape[1:]
+
+    def step(carry, step_i, s, s_next):
+        x, _ = carry
+        denoised = model(x, s, **extra)
+        sd, su = _ancestral_sigmas(s, s_next, eta)
+        d = _to_d(x, s, _last_uncond(model, denoised))
+        x = denoised + d * sd
+        x = x + noise_fn(step_i, sample_shape) * su
+        return (x, None), None
+
+    return _scan_sampler(step, x, sigmas)
+
+
 def sample_euler_ancestral(model: Model, x: jax.Array, sigmas: jax.Array,
                            extra_args: Optional[Dict[str, Any]] = None,
                            keys: Optional[jax.Array] = None,
@@ -1090,7 +1140,9 @@ def sample_lcm(model: Model, x: jax.Array, sigmas: jax.Array,
 SAMPLERS: Dict[str, Callable] = {
     "euler": sample_euler,
     "ddim": sample_ddim,
+    "euler_cfg_pp": sample_euler_cfg_pp,
     "euler_ancestral": sample_euler_ancestral,
+    "euler_ancestral_cfg_pp": sample_euler_ancestral_cfg_pp,
     "heun": sample_heun,
     "dpm_2": sample_dpm_2,
     "dpm_2_ancestral": sample_dpm_2_ancestral,
@@ -1183,7 +1235,9 @@ def cfg_denoiser_multi(model: Model, conds, uncond: Any,
         use_uncond = cfg_scale != 1.0
         reps = n + (nu if use_uncond else 0)
         if reps == 1 and conds[0][1] is None and conds[0][3] is None:
-            return model(x, sigma, context=conds[0][0], **extra)
+            den = model(x, sigma, context=conds[0][0], **extra)
+            wrapped.last_uncond = den      # cfg==1: no separate uncond
+            return den
         x_rep = jnp.concatenate([x] * reps, axis=0)
         ctx = jnp.concatenate(
             [c for c, _, _, _ in conds]
@@ -1193,8 +1247,12 @@ def cfg_denoiser_multi(model: Model, conds, uncond: Any,
         parts = jnp.split(out, reps, axis=0)
         den_cond = _mask_blend(conds, parts[:n], sigma)
         if not use_uncond:
+            wrapped.last_uncond = den_cond
             return den_cond
         d_uncond = _mask_blend(unconds, parts[n:], sigma)
+        # side-channel for CFG++ samplers: the UNCOND denoised of THIS
+        # call (a traced value read back within the same trace step)
+        wrapped.last_uncond = d_uncond
         if cfg_rescale:
             return _rescale_cfg(x, sigma, den_cond, d_uncond, cfg_scale,
                                 cfg_rescale)
@@ -1221,6 +1279,7 @@ def cfg_denoiser_dual(model: Model, cond: jax.Array, middle: jax.Array,
         ctx = jnp.concatenate([cond, middle, uncond], axis=0)
         out = model(x_rep, sigma, context=ctx, **extra)
         pos, mid, neg = jnp.split(out, 3, axis=0)
+        wrapped.last_uncond = neg       # CFG++ side-channel
         if cfg_rescale:
             base = _rescale_cfg(x, sigma, mid, neg, cfg2, cfg_rescale)
         else:
@@ -1270,6 +1329,7 @@ def cfg_denoiser_sag(model_capture: Model, model_plain: Model,
         ctx = jnp.concatenate([cond, uncond], axis=0)
         out, probs = model_capture(x_rep, sigma, context=ctx, **extra)
         den_cond, den_unc = jnp.split(out, 2, axis=0)
+        wrapped.last_uncond = den_unc   # CFG++ side-channel
         # probs [2B, heads, N, N]: uncond rows second; mean over heads,
         # sum over the QUERY axis -> per-key attention mass
         a = probs[B:].mean(axis=1).sum(axis=1)          # [B, N]
@@ -1323,6 +1383,7 @@ def cfg_denoiser_perp_neg(model: Model, cond: jax.Array,
         ctx = jnp.concatenate([cond, empty, uncond], axis=0)
         out = model(x_rep, sigma, context=ctx, **extra)
         den_cond, den_empty, den_unc = jnp.split(out, 3, axis=0)
+        wrapped.last_uncond = den_unc   # CFG++ side-channel
         pos = den_cond - den_empty
         neg = den_unc - den_empty
         axes = tuple(range(1, x.ndim))

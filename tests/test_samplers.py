@@ -877,3 +877,81 @@ class TestLatentArithmeticNodes:
         # parallel directions: magnitudes lerp
         (ih,) = self._op("LatentInterpolate").execute(octx, a, b, 0.5)
         np.testing.assert_allclose(ih["samples"], 1.25, rtol=1e-5)
+
+
+class TestCFGPlusPlus:
+    def test_reduces_to_euler_without_cfg_wrapper(self, ds):
+        """A bare model has no uncond side-channel: CFG++ falls back to
+        the denoised anchor and the update equals plain euler exactly
+        (x' = den + s_next*(x-den)/s == x + d*(s_next - s))."""
+        x0 = jnp.full((1, 4, 4, 2), 0.3, jnp.float32)
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "karras", 6))
+        x = jnp.ones_like(x0) * sigmas[0]
+        a = smp.sample_euler_cfg_pp(ideal_model(x0), x, sigmas)
+        b = smp.sample_euler(ideal_model(x0), x, sigmas)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_uses_the_uncond_direction_under_cfg(self, ds):
+        """With a CFG wrapper whose cond and uncond denoise to different
+        targets, the step direction must come from the UNCOND (the
+        reference's post-cfg uncond_denoised), not the CFG result."""
+        cond_t = jnp.full((1, 4, 4, 2), 0.5, jnp.float32)
+        unc_t = jnp.full((1, 4, 4, 2), -0.5, jnp.float32)
+
+        def raw(x, sigma, context=None, **kw):
+            # rows: [cond; uncond] — pretend contexts select targets
+            B = x.shape[0] // 2
+            return jnp.concatenate(
+                [jnp.broadcast_to(cond_t, (B,) + cond_t.shape[1:]),
+                 jnp.broadcast_to(unc_t, (B,) + unc_t.shape[1:])])
+
+        cfg = smp.cfg_denoiser(raw, jnp.zeros((1, 7, 8)),
+                               jnp.zeros((1, 7, 8)), 3.0)
+        sigmas = jnp.asarray([4.0, 2.0], jnp.float32)
+        x = jnp.zeros((1, 4, 4, 2), jnp.float32) + 4.0
+        out = smp.sample_euler_cfg_pp(cfg, x, sigmas)
+        den = np.asarray(unc_t + (cond_t - unc_t) * 3.0)  # CFG result
+        expect = den + (np.asarray(x) - np.asarray(unc_t)) / 4.0 * 2.0
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+
+    def test_ancestral_variant_stochastic_contract(self, ds):
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "normal", 4))
+        x = jnp.zeros((1, 2, 2, 1))
+        with pytest.raises(ValueError):
+            smp.sample_euler_ancestral_cfg_pp(ideal_model(x), x, sigmas)
+
+
+class TestCFGPlusPlusGuiderCoverage:
+    def test_ancestral_eta0_equals_euler_cfg_pp(self, ds):
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "karras", 6))
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(1,
+                                                       dtype=jnp.uint32))
+        x0 = jnp.full((1, 4, 4, 2), 0.4, jnp.float32)
+        x = jnp.ones_like(x0) * sigmas[0]
+        a = smp.sample_euler_ancestral_cfg_pp(ideal_model(x0), x,
+                                              sigmas, keys=keys,
+                                              eta=0.0)
+        b = smp.sample_euler_cfg_pp(ideal_model(x0), x, sigmas)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_dual_and_perp_wrappers_expose_uncond(self):
+        cond_t = jnp.full((1, 4, 4, 2), 0.5, jnp.float32)
+        unc_t = jnp.full((1, 4, 4, 2), -0.5, jnp.float32)
+
+        def raw3(x, sigma, context=None, **kw):
+            B = x.shape[0] // 3
+            t = lambda v: jnp.broadcast_to(v, (B,) + v.shape[1:])  # noqa
+            return jnp.concatenate([t(cond_t), t(jnp.zeros_like(cond_t)),
+                                    t(unc_t)])
+
+        c = jnp.zeros((1, 7, 8))
+        dual = smp.cfg_denoiser_dual(raw3, c, c, c, 2.0, 1.5)
+        dual(jnp.zeros((1, 4, 4, 2)), jnp.asarray(3.0))
+        np.testing.assert_allclose(np.asarray(dual.last_uncond),
+                                   np.asarray(unc_t))
+        perp = smp.cfg_denoiser_perp_neg(raw3, c, c, c, 2.0, 1.0)
+        perp(jnp.zeros((1, 4, 4, 2)), jnp.asarray(3.0))
+        np.testing.assert_allclose(np.asarray(perp.last_uncond),
+                                   np.asarray(unc_t))
