@@ -401,12 +401,27 @@ def init_backend(args):
 
     # The probe succeeding doesn't guarantee the in-process init can't wedge
     # (the flake is intermittent) — guard it with a hard-exit watchdog.
+    # Suite mode: the timeout respects the capped ladder budget, and the
+    # watchdog takes the same artifact-replay exit as a failed ladder
+    # (it cannot raise into a main thread wedged inside the C client, so
+    # the fallback runs HERE) — a wedged in-process init must not zero a
+    # round that has a green recovery-loop artifact.
     done = threading.Event()
-    inproc_timeout = args.init_timeout or 600
+    if args.init_timeout:
+        inproc_timeout = args.init_timeout
+    elif getattr(args, "suite", False):
+        inproc_timeout = ladder_budget(args)[0]
+    else:
+        inproc_timeout = 600
 
     def watchdog():
         if not done.wait(inproc_timeout):
             log(f"in-process backend init hung >{inproc_timeout}s")
+            if getattr(args, "suite", False):
+                rec = _artifact_replay(args)
+                if rec is not None:
+                    emit(args, rec)
+                    os._exit(0)
             emit(args, failure_payload(
                 args, "backend_init_inprocess",
                 f"in-process jax.devices() wedged "

@@ -46,6 +46,15 @@ stop_requested() {  # fresh flag only — a SIGKILLed suite can't clean up,
   return 0
 }
 
+pause_while_stopped() {  # PAUSE, don't exit: nothing restarts the loop
+  # mid-round, so a driver-window suite must only suspend it — the suite
+  # removes the flag on its way out (or the 1h expiry clears it)
+  while stop_requested; do
+    log "stop flag set (driver window active); pausing"
+    sleep 60
+  done
+}
+
 probe() {  # $1 = window seconds
   timeout "$1" python - <<'EOF'
 import jax, sys
@@ -95,7 +104,7 @@ GATE_RC=97   # sentinel for "backend gone": must not collide with real
 
 run_gated() {  # $1 = timeout, rest = command
   local to=$1; shift
-  stop_requested && { log "stop flag set; exiting"; exit 0; }
+  pause_while_stopped
   if ! probe "$QUICK_PROBE"; then
     log "backend gone mid-cycle; aborting the rest of this cycle"
     return $GATE_RC
@@ -107,7 +116,7 @@ run_gated() {  # $1 = timeout, rest = command
 }
 
 while true; do
-  stop_requested && { log "stop flag set; exiting"; exit 0; }
+  pause_while_stopped
   log "probing backend (window ${PROBE_WINDOW}s)..."
   if probe "$PROBE_WINDOW"; then
     log "chip is UP — running the TPU bench set (cheapest first)"
@@ -156,6 +165,6 @@ while true; do
   else
     log "chip still unavailable"
   fi
-  stop_requested && { log "stop flag set; exiting"; exit 0; }
+  pause_while_stopped
   sleep "$SLEEP_BETWEEN"
 done

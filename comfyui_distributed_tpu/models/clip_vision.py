@@ -53,9 +53,12 @@ class CLIPVisionModel(nn.Module):
 
     @nn.compact
     def __call__(self, pixels: jax.Array
-                 ) -> Tuple[jax.Array, jax.Array]:
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """pixels: [B, image_size, image_size, 3], CLIP-normalized.
-        Returns (last_hidden [B, 1+P, width], image_embeds [B, proj])."""
+        Returns (last_hidden [B, 1+P, width],
+        penultimate_hidden [B, 1+P, width] — the tap before the final
+        CLIPLayer, the layer the reference's style-model path consumes —
+        and image_embeds [B, proj])."""
         cfg = self.cfg
         B = pixels.shape[0]
         h = nn.Conv(cfg.width, (cfg.patch, cfg.patch),
@@ -77,14 +80,18 @@ class CLIPVisionModel(nn.Module):
         lcfg = CLIPConfig(width=cfg.width, layers=cfg.layers,
                           heads=cfg.heads, act=cfg.act, dtype=cfg.dtype)
         mask = jnp.zeros((1, 1, h.shape[1], h.shape[1]), jnp.float32)
+        penultimate = h
         for i in range(cfg.layers):
+            if i == cfg.layers - 1:
+                penultimate = h          # tap BEFORE the final layer
             h = CLIPLayer(lcfg, name=f"layers_{i}")(h, mask)
         pooled = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
                               name="post_ln")(h[:, 0])
         embeds = nn.Dense(cfg.projection_dim, use_bias=False,
                           dtype=jnp.float32,
                           name="visual_projection")(pooled)
-        return h.astype(jnp.float32), embeds.astype(jnp.float32)
+        return (h.astype(jnp.float32), penultimate.astype(jnp.float32),
+                embeds.astype(jnp.float32))
 
 
 def preprocess(images: np.ndarray, size: int,
@@ -122,15 +129,17 @@ class CLIPVisionTower:
 
     def encode(self, images: np.ndarray, crop: str = "center"):
         """-> CLIPVisionOutput(image_embeds [B, proj],
-        last_hidden [B, 1+P, width])."""
+        last_hidden [B, 1+P, width], penultimate_hidden — the
+        reference's style-model contract layer)."""
         module = CLIPVisionModel(self.cfg)
         if self._jitted is None:
             self._jitted = jax.jit(
                 lambda p, x: module.apply({"params": p}, x))
         px = jnp.asarray(preprocess(images, self.cfg.image_size, crop))
-        hidden, embeds = self._jitted(self.params, px)
+        hidden, penultimate, embeds = self._jitted(self.params, px)
         return CLIPVisionOutput(image_embeds=embeds,
-                                last_hidden=hidden)
+                                last_hidden=hidden,
+                                penultimate_hidden=penultimate)
 
 
 @dataclasses.dataclass
@@ -138,3 +147,6 @@ class CLIPVisionOutput:
     """CLIP_VISION_OUTPUT wire object."""
     image_embeds: Any
     last_hidden: Any = None
+    # hidden states BEFORE the final transformer layer: what the
+    # reference's style-model (ReduxImageEncoder et al.) consumes
+    penultimate_hidden: Any = None

@@ -624,15 +624,16 @@ class DiffusionPipeline:
                     reps = n_conds + (n_unconds if cfg_scale != 1.0
                                       else 0)
                 if gligen_objs is not None:
-                    # per-block grounding tokens: ONLY the blocks whose
-                    # conditioning entry carries the gligen spec get the
-                    # real tokens (the reference applies gligen on the
-                    # carrying conditioning only); the rest get nulls.
-                    # Flag order matches the ctx_list block layout
-                    # (conds first, then unconds) — ops/basic.py
+                    # per-block grounding tokens: each block whose
+                    # conditioning entry carries a gligen spec gets THAT
+                    # spec's token set (the reference applies gligen
+                    # per-cond); the rest get the null set.  Index order
+                    # matches the ctx_list block layout (conds first,
+                    # then unconds) — ops/basic.py.  og: [S, B, N, D]
+                    # stacked per-spec sets; index -1 = null set
                     og, on = objs_in
-                    flags = tuple(gligen_objs[2])[:max(reps, 1)]
-                    parts = [og if f else on for f in flags]
+                    idxs = tuple(gligen_objs[2])[:max(reps, 1)]
+                    parts = [og[i] if i >= 0 else on for i in idxs]
                     parts += [on] * (max(reps, 1) - len(parts))
                     extra_objs = jnp.concatenate(parts, axis=0) \
                         if reps > 1 else parts[0]
@@ -675,6 +676,16 @@ class DiffusionPipeline:
                         xi = xi * mask_in + (latents + mnoise * s) \
                             * (1.0 - mask_in)
                         out = inner(xi, sigma, **kw)
+                        # CFG++ side-channel must survive the wrapper:
+                        # samplers read ``model.last_uncond`` off the
+                        # OUTER callable, so re-expose the inner CFG
+                        # denoiser's uncond, re-anchored through the
+                        # same blend as the cond output (without this,
+                        # masked euler_cfg_pp silently degraded to
+                        # plain euler semantics)
+                        lu = getattr(inner, "last_uncond", out)
+                        model.last_uncond = lu * mask_in \
+                            + latents * (1.0 - mask_in)
                         return out * mask_in + latents * (1.0 - mask_in)
 
                 out = sampler(model, x, sigmas, extra_args=extra, keys=keys)

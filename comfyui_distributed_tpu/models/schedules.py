@@ -101,12 +101,13 @@ def rescale_zero_terminal_snr(ds: DiscreteSchedule) -> DiscreteSchedule:
     Schedules and Sample Steps are Flawed") — ModelSamplingDiscrete's
     ``zsnr`` toggle: shift+scale sqrt(abar) so the final step carries
     zero signal.  The exact rescale sends the terminal sigma to
-    infinity; the terminal abar clamps at 1e-8 (sigma ~ 1e4) to keep
-    the schedule finite for the samplers."""
+    infinity; the terminal abar clamps at 4.8973451890853435e-08
+    (sigma ~ 4519) — the reference ecosystem's pinned constant, so
+    zsnr-patched models start sampling from the same sigma_max."""
     abar_sqrt = np.sqrt(ds.alphas_cumprod)
     a0, aT = abar_sqrt[0], abar_sqrt[-1]
     abar_sqrt = (abar_sqrt - aT) * (a0 / (a0 - aT))
-    abar = np.clip(abar_sqrt ** 2, 1e-8, 1.0)
+    abar = np.clip(abar_sqrt ** 2, 4.8973451890853435e-08, 1.0)
     sigmas = np.sqrt((1.0 - abar) / abar)
     return DiscreteSchedule(sigmas=sigmas.astype(np.float32),
                             alphas_cumprod=abar.astype(np.float32))

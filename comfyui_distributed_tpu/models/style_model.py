@@ -79,8 +79,12 @@ class StyleModelTower:
             module = StyleAdapter(self.cfg)
             self._jitted = jax.jit(
                 lambda p, x: module.apply({"params": p}, x))
-        return self._jitted(self.params,
-                            jnp.asarray(vision_output.last_hidden))
+        # the reference's style-model path consumes the PENULTIMATE
+        # vision hiddens (hidden_states[-2]), not the final layer
+        hidden = getattr(vision_output, "penultimate_hidden", None)
+        if hidden is None:
+            hidden = vision_output.last_hidden
+        return self._jitted(self.params, jnp.asarray(hidden))
 
 
 _cache: Dict[str, StyleModelTower] = {}

@@ -381,10 +381,21 @@ class GLIGENTextBoxApply(Op):
         emb = emb[:, : g.cfg.text_dim]
         box = (int(x) // 8, int(y) // 8,
                max(int(width) // 8, 1), max(int(height) // 8, 1))
-        prev = getattr(conditioning_to, "gligen", None)
-        entries = (prev[1] if prev is not None else ()) + ((emb, box),)
-        return (dataclasses.replace(conditioning_to,
-                                    gligen=(g, entries)),)
+
+        # the reference appends the phrase to EVERY entry of the
+        # conditioning list — siblings bundled by ConditioningCombine
+        # (regional prompting) each keep their OWN prior grounding
+        # entries and gain this one (the sampler runs per-block token
+        # sets, so a sibling's earlier boxes are preserved)
+        def _ground(e: Conditioning) -> Conditioning:
+            prev = getattr(e, "gligen", None)
+            entries = (prev[1] if prev is not None else ()) + ((emb, box),)
+            return dataclasses.replace(e, gligen=(g, entries))
+
+        return (dataclasses.replace(
+            _ground(conditioning_to),
+            siblings=tuple(_ground(s)
+                           for s in conditioning_to.siblings)),)
 
 
 @register_op
@@ -701,10 +712,10 @@ class CLIPVisionLoader(Op):
 
 @register_op
 class CLIPVisionEncode(Op):
-    """IMAGE -> CLIP_VISION_OUTPUT (projected class embedding +
-    FINAL-layer hiddens; consumers needing the reference's
-    penultimate-hidden contract would need a tower-side tap); crop:
-    center (reference default) / none."""
+    """IMAGE -> CLIP_VISION_OUTPUT: projected class embedding,
+    FINAL-layer hiddens, and the PENULTIMATE hiddens (the layer the
+    reference's style-model path consumes); crop: center (reference
+    default) / none."""
     TYPE = "CLIPVisionEncode"
     WIDGETS = ["crop"]
     DEFAULTS = {"crop": "center"}
@@ -1691,48 +1702,63 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
             m = coll.shard_batch(m, mesh)
         mask = jnp.asarray(m)
 
-    # GLIGEN grounding tokens: the (cond, null) pair — cond blocks get
-    # the real tokens, uncond blocks the null tokens (registry.sample)
+    # GLIGEN grounding tokens, PER BLOCK: each conditioning entry keeps
+    # its OWN grounding spec (the reference applies gligen per-cond), so
+    # distinct specs become distinct token sets padded to a common
+    # object count (null tokens are the natural pad); blocks without a
+    # spec get the all-null set (registry.sample indexes per block)
     gligen_objs = None
-    gspec = next((getattr(e, "gligen", None) for e in all_entries
-                  if getattr(e, "gligen", None) is not None), None)
-    if gspec is not None:
-        if any(getattr(e, "gligen", None) is not None
-               and getattr(e, "gligen") is not gspec
-               for e in all_entries):
-            debug_log("GLIGEN: conditioning entries carry different "
-                      "grounding specs; applying the first only (one "
-                      "token set runs per stacked call)")
-        gmodel, entries_g = gspec
-        n_obj = len(entries_g)
-        embs = np.concatenate(
-            [np.asarray(t, np.float32).reshape(1, -1)
-             for t, _ in entries_g])[None]              # [1, N, D]
-        # xywh latent units -> normalized xyxy against THIS latent
-        bx = np.asarray([[b[0], b[1], b[0] + b[2], b[1] + b[3]]
-                         for _, b in entries_g], np.float32)
-        bx = bx / np.asarray([lat.shape[2], lat.shape[1],
-                              lat.shape[2], lat.shape[1]], np.float32)
-        boxes = np.clip(bx, 0.0, 1.0)[None]             # [1, N, 4]
-        og = gmodel.grounding_tokens(embs, boxes,
-                                     np.ones((1, n_obj), np.float32))
-        on = gmodel.grounding_tokens(np.zeros_like(embs),
-                                     np.zeros_like(boxes),
-                                     np.zeros((1, n_obj), np.float32))
-        og = jnp.repeat(jnp.asarray(og), total, axis=0)
-        on = jnp.repeat(jnp.asarray(on), total, axis=0)
-        if fanout > 1 and mesh is not None:
-            og = coll.shard_batch(np.asarray(og), mesh)
-            on = coll.shard_batch(np.asarray(on), mesh)
-        # per-block carry flags in the registry's block layout (conds
-        # first — incl. the dual middle — then unconds)
-        carries = tuple(getattr(e, "gligen", None) is gspec
-                        for e in pos_entries)
+    specs = []           # unique specs, first-appearance order (identity)
+    for e in all_entries:
+        sp = getattr(e, "gligen", None)
+        if sp is not None and all(sp is not s for s in specs):
+            specs.append(sp)
+    if specs:
+        gmodel = specs[0][0]
+        if any(sp[0] is not gmodel for sp in specs):
+            log("GLIGEN: conditioning entries carry DIFFERENT gligen "
+                "models; grounding tokens all run through the first "
+                "model's fusers")
+        n_max = max(len(sp[1]) for sp in specs)
+        d_text = gmodel.cfg.text_dim
+
+        def spec_tokens(entries_g):
+            embs = np.zeros((1, n_max, d_text), np.float32)
+            boxes = np.zeros((1, n_max, 4), np.float32)
+            alive = np.zeros((1, n_max), np.float32)
+            for i, (t, b) in enumerate(entries_g):
+                embs[0, i] = np.asarray(t, np.float32).reshape(-1)
+                # xywh latent units -> normalized xyxy vs THIS latent
+                bx = np.asarray([b[0], b[1], b[0] + b[2], b[1] + b[3]],
+                                np.float32)
+                bx = bx / np.asarray([lat.shape[2], lat.shape[1],
+                                      lat.shape[2], lat.shape[1]],
+                                     np.float32)
+                boxes[0, i] = np.clip(bx, 0.0, 1.0)
+                alive[0, i] = 1.0
+            return gmodel.grounding_tokens(embs, boxes, alive)
+
+        def batch_tokens(t):
+            t = jnp.repeat(jnp.asarray(t), total, axis=0)
+            if fanout > 1 and mesh is not None:
+                t = coll.shard_batch(np.asarray(t), mesh)
+            return t
+
+        og = jnp.stack([batch_tokens(spec_tokens(sp[1]))
+                        for sp in specs])          # [S, total, N, D]
+        on = batch_tokens(spec_tokens(()))         # all-null set
+
+        def spec_index(e):
+            sp = getattr(e, "gligen", None)
+            return next((i for i, s in enumerate(specs) if s is sp), -1)
+
+        # per-block spec indices in the registry's block layout (conds
+        # first — incl. the dual middle — then unconds); -1 = null set
+        idxs = tuple(spec_index(e) for e in pos_entries)
         if middle is not None:
-            carries += (getattr(middle, "gligen", None) is gspec,)
-        carries += tuple(getattr(e, "gligen", None) is gspec
-                         for e in neg_entries)
-        gligen_objs = (og, on, carries)
+            idxs += (spec_index(middle),)
+        idxs += tuple(spec_index(e) for e in neg_entries)
+        gligen_objs = (og, on, idxs)
 
     # inpaint-MODEL channels: any conditioning entry may carry them
     # (ComfyUI sets them on positive AND negative); one array rides every

@@ -576,6 +576,42 @@ class TestInpainting:
         assert not np.allclose(o[:, :, 4:], src[:, :, 4:])         # redone
         assert out["noise_mask"] is mask  # mask stays on the latent
 
+    def test_mask_wrapper_propagates_cfg_pp_side_channel(self, monkeypatch):
+        """ADVICE r4 (medium): the inpaint mask wrapper must re-expose
+        the CFG denoiser's ``last_uncond`` side-channel — otherwise CFG++
+        samplers under a noise_mask fall back to the CFG result and
+        silently degrade to plain-euler semantics.  A probe sampler
+        reads the side-channel exactly like the CFG++ samplers do
+        (getattr off the callable it was handed) and returns
+        ``last_uncond - denoised``: zero everywhere pre-fix (fallback),
+        nonzero INSIDE the mask post-fix (cfg!=1, cond!=uncond), and
+        source-anchored outside either way."""
+        from comfyui_distributed_tpu.models import samplers as smp_mod
+        pipe = self._pipe()
+
+        def probe_sampler(model, x, sigmas, extra_args=None, keys=None):
+            den = model(x, sigmas[0], **(extra_args or {}))
+            lu = getattr(model, "last_uncond", den)
+            return lu - den
+
+        monkeypatch.setitem(smp_mod.SAMPLERS, "_lu_probe", probe_sampler)
+        rng = np.random.default_rng(7)
+        src = rng.standard_normal((1, 8, 8, 4)).astype(np.float32)
+        mask = np.zeros((1, 8, 8, 1), np.float32)
+        mask[:, :, 4:] = 1.0                  # latent-res mask
+        ctx_c, _ = pipe.encode_prompt(["a cat"])
+        ctx_u, _ = pipe.encode_prompt([""])
+        out = np.asarray(pipe.sample(
+            jnp.asarray(src), ctx_c, ctx_u,
+            np.asarray([11], np.uint64), steps=3, cfg=7.5,
+            sampler_name="_lu_probe", scheduler="normal",
+            noise_mask=jnp.asarray(mask)))
+        # outside the mask the final re-anchor returns the source
+        np.testing.assert_array_equal(out[:, :, :4], src[:, :, :4])
+        # inside: uncond != cfg result -> the probe saw a REAL uncond
+        assert np.abs(out[:, :, 4:]).max() > 1e-4, \
+            "last_uncond side-channel lost by the mask wrapper"
+
     def test_no_mask_output_differs_everywhere(self):
         from comfyui_distributed_tpu.ops.base import (Conditioning,
                                                       OpContext, get_op)
@@ -1694,6 +1730,12 @@ class TestModelPatchesRound4:
         (pz,) = get_op("ModelSamplingDiscrete").execute(octx, p, "eps",
                                                         True)
         assert pz.schedule.sigma_max > p.schedule.sigma_max * 10
+        # the reference ecosystem's pinned terminal abar (ADVICE r4):
+        # sigma_max = sqrt((1-abar)/abar) at abar=4.8973451890853435e-08
+        ref_abar = 4.8973451890853435e-08
+        np.testing.assert_allclose(
+            float(pz.schedule.sigma_max),
+            float(np.sqrt((1.0 - ref_abar) / ref_abar)), rtol=1e-4)
         assert np.isclose(pz.schedule.sigmas[0], p.schedule.sigmas[0],
                           rtol=0.15)       # clean end barely moves
         # patch rides a LoRA derivation
@@ -2630,6 +2672,44 @@ class TestGligen:
                                       np.asarray(x))
         registry.clear_pipeline_cache()
 
+    def test_textbox_apply_reaches_combined_siblings(self):
+        """ADVICE r4: the reference applies the grounding spec to EVERY
+        entry of the conditioning list — siblings bundled earlier by
+        ConditioningCombine must carry it too, or their stacked blocks
+        sample with null grounding tokens."""
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("gligen-base.ckpt")
+        octx = OpContext()
+        (gm,) = get_op("GLIGENLoader").execute(octx, "tiny-gligen.pth")
+        a = Conditioning(context=p.encode_prompt(["a meadow"])[0])
+        b = Conditioning(context=p.encode_prompt(["a lake"])[0])
+        (a1,) = get_op("GLIGENTextBoxApply").execute(
+            octx, a, p, gm, "a red fox", 32, 32, 0, 0)
+        (b1,) = get_op("GLIGENTextBoxApply").execute(
+            octx, b, p, gm, "a blue bird", 32, 32, 32, 32)
+        (combined,) = get_op("ConditioningCombine").execute(octx, a1, b1)
+        assert combined.siblings
+        (grounded,) = get_op("GLIGENTextBoxApply").execute(
+            octx, combined, p, gm, "a green tree", 16, 16, 16, 0)
+        # head: its own prior box + the new one
+        assert len(grounded.gligen[1]) == 2
+        # sibling: ITS prior box (the bird) survives + the new one
+        sib = grounded.siblings[0]
+        assert len(sib.gligen[1]) == 2
+        assert sib.gligen is not grounded.gligen
+        np.testing.assert_array_equal(sib.gligen[1][0][0],
+                                      b1.gligen[1][0][0])
+        # distinct per-block specs sample end-to-end (stacked token
+        # sets padded to a common object count)
+        neg = Conditioning(context=p.encode_prompt([""])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (out,) = get_op("KSampler").execute(octx, p, 3, 2, 5.0, "euler",
+                                            "normal", grounded, neg,
+                                            lat, 1.0)
+        assert np.isfinite(np.asarray(out["samples"])).all()
+
     def test_textbox_apply_and_sampling(self):
         from comfyui_distributed_tpu.ops.base import (Conditioning,
                                                       OpContext, get_op)
@@ -2694,13 +2774,17 @@ class TestGligenCarryFlags:
         (negg,) = get_op("GLIGENTextBoxApply").execute(
             octx, neg, p, gm, "x", 16, 16, 0, 0)
         lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
-        # gligen on the NEGATIVE only: flags (pos=False, neg=True)
+        # gligen on the NEGATIVE only: spec indices (pos=-1, neg=0)
         prep = _prepare_sample_inputs(octx, p, 0, lat, pos, negg)
         assert prep.gligen_objs is not None
-        assert prep.gligen_objs[2] == (False, True)
-        # and on the positive: (True, False)
+        assert prep.gligen_objs[2] == (-1, 0)
+        # and on the positive: (0, -1)
         (posg,) = get_op("GLIGENTextBoxApply").execute(
             octx, pos, p, gm, "x", 16, 16, 0, 0)
         prep2 = _prepare_sample_inputs(octx, p, 0, lat, posg, neg)
-        assert prep2.gligen_objs[2] == (True, False)
+        assert prep2.gligen_objs[2] == (0, -1)
+        # distinct specs on BOTH sides: each block keeps its own set
+        prep3 = _prepare_sample_inputs(octx, p, 0, lat, posg, negg)
+        assert prep3.gligen_objs[2] == (0, 1)
+        assert prep3.gligen_objs[0].shape[0] == 2   # stacked [S, ...]
         registry.clear_pipeline_cache()

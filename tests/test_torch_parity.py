@@ -351,12 +351,16 @@ def test_clip_vision_matches_transformers():
         (2, vcfg.image_size, vcfg.image_size, 3)).astype(np.float32)
     with torch.no_grad():
         out = tm(pixel_values=torch.from_numpy(
-            px.transpose(0, 3, 1, 2)))
+            px.transpose(0, 3, 1, 2)), output_hidden_states=True)
     ref_embeds = out.image_embeds.numpy()
     ref_hidden = out.last_hidden_state.numpy()
+    # hidden_states[-2]: the penultimate tap the style-model path
+    # consumes (ADVICE r4)
+    ref_penult = out.hidden_states[-2].numpy()
 
     fm = cv.CLIPVisionModel(vcfg)
-    hidden, embeds = fm.apply({"params": params}, jnp.asarray(px))
+    hidden, penult, embeds = fm.apply({"params": params}, jnp.asarray(px))
     tol = dict(rtol=3e-4, atol=3e-4)
     np.testing.assert_allclose(np.asarray(embeds), ref_embeds, **tol)
     np.testing.assert_allclose(np.asarray(hidden), ref_hidden, **tol)
+    np.testing.assert_allclose(np.asarray(penult), ref_penult, **tol)
