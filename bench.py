@@ -377,6 +377,7 @@ def init_backend(args):
     'tpu') then init in-process under a watchdog.  No CPU fallback here:
     a silent CPU number on the TPU metric would be worse than a
     structured failure.  Returns the list of devices."""
+    t_start = time.monotonic()
     if args.platform == "cpu":
         from comfyui_distributed_tpu.parallel.mesh import force_cpu_platform
         force_cpu_platform(max(args.cpu_devices, 1))
@@ -410,7 +411,10 @@ def init_backend(args):
     if args.init_timeout:
         inproc_timeout = args.init_timeout
     elif getattr(args, "suite", False):
-        inproc_timeout = ladder_budget(args)[0]
+        # budget from time REMAINING in the capped window, not a fresh
+        # allowance — the ladder may already have spent most of it
+        spent = time.monotonic() - t_start
+        inproc_timeout = max(30.0, ladder_budget(args)[0] - spent)
     else:
         inproc_timeout = 600
 

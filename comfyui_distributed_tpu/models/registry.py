@@ -233,6 +233,41 @@ class DiffusionPipeline:
             collections.OrderedDict()
         self._jit_cache_cap = int(os.environ.get("DTPU_JIT_CACHE_CAP", "16"))
         self._lock = threading.Lock()
+        self._tp_mesh = None   # mesh the params are currently tp-laid-out for
+
+    # --- tensor parallelism -------------------------------------------------
+
+    def _ensure_tp_sharded(self) -> None:
+        """Lay the UNet params out for tensor parallelism when the live
+        mesh has a ``tensor`` axis (megatron-style column splits via
+        ``parallel/sharding.params_shardings``; GSPMD inserts the
+        matching collectives inside the jitted sample core).  No-op on
+        tensor==1 meshes and when already laid out for this mesh, so the
+        single-chip serving path pays nothing.  This is the serving-side
+        counterpart of ``parallel/train.shard_train_step`` — without it
+        tp was train-only and inference weights stayed replicated.
+        Floor override for tiny test models: ``DTPU_TP_MIN_SHARD_ELEMENTS``."""
+        from comfyui_distributed_tpu.parallel.mesh import get_live_runtime
+        from comfyui_distributed_tpu.utils.constants import TENSOR_AXIS
+        rt = get_live_runtime()
+        if rt is None or rt.mesh is None:
+            return
+        mesh = rt.mesh
+        if int(mesh.shape.get(TENSOR_AXIS, 1)) <= 1 \
+                or self._tp_mesh is mesh:
+            return
+        from comfyui_distributed_tpu.parallel import sharding as shd
+        min_el = int(os.environ.get("DTPU_TP_MIN_SHARD_ELEMENTS",
+                                    shd.MIN_SHARD_ELEMENTS))
+        with self._lock:
+            if self._tp_mesh is mesh:
+                return
+            sh = shd.params_shardings(self.unet_params, mesh,
+                                      min_elements=min_el)
+            self.unet_params = shd.apply_shardings(self.unet_params, sh)
+            self._tp_mesh = mesh
+            log(f"tp: UNet params laid out over tensor="
+                f"{int(mesh.shape[TENSOR_AXIS])} for serving")
 
     # --- text ---------------------------------------------------------------
 
@@ -404,6 +439,10 @@ class DiffusionPipeline:
         per-sample ADM array (replicated over every block) or a list
         with one array per entry, conds first then unconds.
         The denoise loop is jit-compiled and cached per static config."""
+        # serving-side tensor parallelism: lay the UNet params out over
+        # the mesh's tensor axis before they enter the jitted core
+        self._ensure_tp_sharded()
+
         def _norm(entries):
             if not isinstance(entries, (list, tuple)):
                 return [(entries, None, 1.0, None)]

@@ -1727,7 +1727,12 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
             boxes = np.zeros((1, n_max, 4), np.float32)
             alive = np.zeros((1, n_max), np.float32)
             for i, (t, b) in enumerate(entries_g):
-                embs[0, i] = np.asarray(t, np.float32).reshape(-1)
+                # clip to the first model's text width: entries applied
+                # through a DIFFERENT gligen model may carry another
+                # dim — degrade (warned above), don't crash
+                v = np.asarray(t, np.float32).reshape(-1)
+                w = min(v.shape[0], d_text)
+                embs[0, i, :w] = v[:w]
                 # xywh latent units -> normalized xyxy vs THIS latent
                 bx = np.asarray([b[0], b[1], b[0] + b[2], b[1] + b[3]],
                                 np.float32)

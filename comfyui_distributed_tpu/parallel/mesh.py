@@ -418,6 +418,14 @@ def get_runtime(axes: Optional[Dict[str, int]] = None,
         return _runtime
 
 
+def get_live_runtime() -> Optional[MeshRuntime]:
+    """The runtime singleton IF one was set/built — never builds one.
+    Hot serving paths use this to ask "is a mesh live?" without paying
+    for (or side-effecting) a default mesh construction."""
+    with _runtime_lock:
+        return _runtime
+
+
 def set_runtime(rt: Optional[MeshRuntime]) -> None:
     global _runtime
     with _runtime_lock:

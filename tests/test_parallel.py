@@ -267,3 +267,79 @@ class TestBackendEscapeLadder:
         rep = self._run(monkeypatch, probe, patience_s=100000)
         assert rep["ok"] and rep["config"] == "env"
         assert n["env"] == 3
+
+
+class TestServingTensorParallel:
+    """VERDICT r4 §2.3: tp must reach SERVING, not just the train step —
+    a tensor-axis mesh lays the UNet params out via params_shardings and
+    the sampled result must match the replicated-weights oracle."""
+
+    def test_tp_sharded_sample_matches_replicated_oracle(self, monkeypatch):
+        monkeypatch.setenv("DTPU_TP_MIN_SHARD_ELEMENTS", "2")
+        from comfyui_distributed_tpu.models import registry
+        registry.clear_pipeline_cache()
+        mesh_mod.set_runtime(None)
+        try:
+            pipe = registry.load_pipeline("tp-serve.ckpt",
+                                          family_name="tiny")
+            ctx_c, _ = pipe.encode_prompt(["a lighthouse"])
+            ctx_u, _ = pipe.encode_prompt([""])
+            lat = jnp.zeros((2, 8, 8, 4), jnp.float32)
+            seeds = np.asarray([3, 4], np.uint64)
+
+            def run():
+                return np.asarray(pipe.sample(
+                    lat, jnp.concatenate([ctx_c] * 2),
+                    jnp.concatenate([ctx_u] * 2), seeds, steps=3,
+                    cfg=5.0, sampler_name="euler", scheduler="normal"))
+
+            oracle = run()                       # replicated weights
+            assert pipe._tp_mesh is None
+            mesh = mesh_mod.build_mesh(
+                {DATA_AXIS: 2, TENSOR_AXIS: 2, SEQ_AXIS: 1},
+                devices=jax.devices()[:4])
+            mesh_mod.set_runtime(mesh_mod.MeshRuntime(mesh=mesh))
+            tp = run()                           # tp-laid-out weights
+            assert pipe._tp_mesh is mesh
+            # some leaves actually sharded over tensor
+            sharded = [
+                x for x in jax.tree_util.tree_leaves(pipe.unet_params)
+                if hasattr(x, "sharding")
+                and x.sharding.spec != P()
+                and TENSOR_AXIS in str(x.sharding.spec)]
+            assert sharded, "no parameter leaf was tensor-sharded"
+            np.testing.assert_allclose(tp, oracle, rtol=2e-4, atol=2e-4)
+        finally:
+            mesh_mod.set_runtime(None)
+            registry.clear_pipeline_cache()
+
+
+class TestDryrunMultichip:
+    """The driver's multi-chip artifact runs the PRODUCT paths: sharded
+    train step + executor fan-out inference (VERDICT r4 #3), and the
+    16-device factorization exercises tensor=4 x seq=4 — axis extents
+    > 2 — plus a ragged padded batch (VERDICT r4 #8).  Subprocess: the
+    dryrun re-pins the backend device count, which must not disturb
+    this process's 8-device mesh."""
+
+    @pytest.mark.parametrize("n", [8, 16])
+    def test_dryrun_green(self, n):
+        import os
+        import subprocess
+        import sys
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS",)}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = "/root/repo" + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        out = subprocess.run(
+            [sys.executable, "-c",
+             f"from __graft_entry__ import dryrun_multichip; "
+             f"dryrun_multichip({n})"],
+            cwd="/root/repo", env=env, capture_output=True, text=True,
+            timeout=540)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert f"n={n}" in out.stdout and "inference" in out.stdout
+        if n == 16:
+            assert "'tensor': 4" in out.stdout and "'seq': 4" in out.stdout
+        assert "tp_engaged=True" in out.stdout
