@@ -83,7 +83,9 @@ ORPHAN_START=$(date +%s)
 while :; do
   holders=$(device_holders 2>/dev/null || echo 0)
   bench_alive=0
-  pgrep -f "bench\.py" >/dev/null 2>&1 && bench_alive=1
+  # match an actual interpreter invocation, NOT any process whose argv
+  # merely mentions the filename (the driver's own prompt contains it)
+  pgrep -f "python[0-9.]* bench\.py" >/dev/null 2>&1 && bench_alive=1
   if [ "${holders:-0}" = 0 ] && [ "$bench_alive" = 0 ]; then
     break
   fi

@@ -98,6 +98,13 @@ class OpContext:
     saved_images: List[np.ndarray] = dataclasses.field(default_factory=list)
     node_timings: Dict[str, float] = dataclasses.field(default_factory=dict)
     interrupt_event: Any = None
+    # PNG metadata (ComfyUI contract): the executing graph in API format
+    # and the client's extra_pnginfo (typically {"workflow": <UI doc>}) —
+    # SaveImage embeds both as tEXt chunks so saved images reload into
+    # the same graph (reference ships extra_pnginfo with every dispatch,
+    # gpupanel.js:1344-1358)
+    prompt_json: Any = None
+    extra_pnginfo: Any = None
 
     def check_interrupt(self):
         if self.interrupt_event is not None and self.interrupt_event.is_set():

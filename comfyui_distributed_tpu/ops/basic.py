@@ -7,6 +7,7 @@ Schemas mirror ComfyUI node surfaces used by the reference workflows
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
 import os
 from typing import Optional
@@ -2748,7 +2749,8 @@ class SaveAnimatedPNG(Op):
         frames[0].save(
             path, save_all=True, append_images=frames[1:],
             duration=int(1000.0 / max(float(fps), 0.01)), loop=0,
-            compress_level=int(compress_level))
+            compress_level=int(compress_level),
+            pnginfo=_png_metadata(ctx))
         debug_log(f"SaveAnimatedPNG: wrote {path} "
                   f"({len(frames)} frames)")
         return ()
@@ -3667,10 +3669,33 @@ class SaveImage(Op):
             # workflow must never overwrite earlier outputs (ComfyUI's
             # incrementing-counter save semantics)
             start = _next_image_counter(d, base)
+            meta = _png_metadata(ctx)
             for i in range(arr.shape[0]):
                 tensor_to_pil(arr, i).save(
-                    os.path.join(d, f"{base}_{start + i:05d}.png"))
+                    os.path.join(d, f"{base}_{start + i:05d}.png"),
+                    pnginfo=meta)
         return ()
+
+
+def _png_metadata(ctx: OpContext):
+    """PIL ``PngInfo`` carrying the executing prompt + extra_pnginfo as
+    tEXt chunks (ComfyUI's save contract: ``prompt`` = API-format graph,
+    plus one chunk per extra_pnginfo key — typically ``workflow``, the
+    UI-format doc the reference ships with every dispatch,
+    ``gpupanel.js:1344-1358``).  None when there is nothing to embed."""
+    meta = None
+    if getattr(ctx, "prompt_json", None) is not None:
+        from PIL.PngImagePlugin import PngInfo
+        meta = PngInfo()
+        meta.add_text("prompt", json.dumps(ctx.prompt_json))
+    extra = getattr(ctx, "extra_pnginfo", None)
+    if extra:
+        if meta is None:
+            from PIL.PngImagePlugin import PngInfo
+            meta = PngInfo()
+        for k, v in dict(extra).items():
+            meta.add_text(str(k), json.dumps(v))
+    return meta
 
 
 def _next_image_counter(dirpath: str, base: str,

@@ -99,29 +99,153 @@ class UltimateSDUpscaleDistributed(Op):
 
     # --- shared refinement core --------------------------------------------
 
+    def _canvas_area_mask(self, entry, img_w: int, img_h: int):
+        """An entry's area spec -> a full-canvas image-resolution weight
+        mask [1, H, W, 1], or None.  Rect specs resolve against the
+        CURRENT canvas (the upscaled image) — "px" via ComfyUI's //8
+        latent-unit convention on this canvas's latent, "pct" as
+        fractions; array masks resize like the sample-time path."""
+        from comfyui_distributed_tpu.ops.basic import _materialize_area_mask
+        if getattr(entry, "area_mask", None) is None:
+            return None
+        cm = _materialize_area_mask(entry, max(img_h // 8, 1),
+                                    max(img_w // 8, 1), 1)
+        cm = np.asarray(cm, np.float32)
+        if cm.shape[0] != 1:
+            log("tiled upscale: regional mask has a batch dimension; the "
+                "tile refine uses row 0 for every tile")
+            cm = cm[:1]
+        return np.clip(resize_image(cm, img_w, img_h, "bilinear"), 0.0, 1.0)
+
+    def _regional_entries(self, pipe, src_entries, n: int,
+                          positions: Sequence[Tuple[int, int]],
+                          p: Dict[str, Any], img_size: Tuple[int, int],
+                          lat_hw: Tuple[int, int], t_align: int,
+                          positive: Conditioning, tiles_hw: Tuple[int, int],
+                          mesh=None):
+        """[Conditioning, ...] (one CFG side) -> registry.sample entry
+        list with each entry's canvas mask CROPPED through the tile
+        windows: materialize at canvas resolution, extract the same
+        padded windows the pixels went through (tiling.extract_tiles, so
+        edge clamping and resize agree exactly), then downsample to the
+        tile latent (VERDICT r4 #4; reference passes canvas-global conds
+        into every tile, distributed_upscale.py:516-541 — cropping is
+        strictly more correct).  Returns (entries, y_list)."""
+        from comfyui_distributed_tpu.ops.basic import (
+            _image_mask_to_latent, _sdxl_vector_cond)
+        img_w, img_h = img_size
+        lh, lw = lat_hw
+        th, tw = tiles_hw
+        adm = pipe.family.unet.adm_in_channels is not None
+        entries, ys = [], []
+        for e in src_entries:
+            c = e.context
+            t = int(c.shape[1])
+            if t != t_align:
+                c = jnp.tile(c, (1, t_align // t, 1)) if t_align % t == 0 \
+                    else jnp.pad(c, ((0, 0), (0, t_align - t), (0, 0)))
+            ce = jnp.repeat(c, n, axis=0)
+            am = None
+            cm = self._canvas_area_mask(e, img_w, img_h)
+            if cm is not None:
+                wins = tiling.extract_tiles(cm, positions, tw, th,
+                                            p["padding"],
+                                            resize_method="bilinear")
+                am = jnp.asarray(_image_mask_to_latent(
+                    wins[..., 0], lh, lw, n))
+            tr = getattr(e, "timestep_range", None)
+            srange = None
+            if tr is not None:
+                srange = (pipe.schedule.percent_to_sigma(float(tr[0])),
+                          pipe.schedule.percent_to_sigma(float(tr[1])))
+            if mesh is not None:
+                ce = coll.shard_batch(np.asarray(ce), mesh)
+                if am is not None and am.shape[0] == n:
+                    am = coll.shard_batch(np.asarray(am), mesh)
+            entries.append((ce, am,
+                            float(getattr(e, "area_strength", 1.0)),
+                            srange))
+            if adm:
+                # unclip families build from the entry's OWN unclip list
+                # (a negative without one gets zero ADM, never the
+                # positive's image embedding — ops/basic.py:1583-1590)
+                if getattr(pipe.family, "adm_kind", "sdxl") == "unclip":
+                    adm_src = e
+                else:
+                    adm_src = e if e.pooled is not None else positive
+                ye = _sdxl_vector_cond(pipe, adm_src, n, th, tw)
+                if mesh is not None:
+                    ye = coll.shard_batch(np.asarray(ye), mesh)
+                ys.append(ye)
+        return entries, ys
+
     def _refine_batch(self, ctx: OpContext, pipe, tiles: np.ndarray,
                       tile_indices: Sequence[int], positive: Conditioning,
                       negative: Conditioning, p: Dict[str, Any],
+                      positions: Sequence[Tuple[int, int]] = None,
+                      img_size: Tuple[int, int] = None,
                       shard: bool = False) -> np.ndarray:
         """VAE-encode -> sample(denoise) -> decode a [N, th, tw, C] tile
         batch.  Per-tile seed = seed + tile_idx with a fixed fold index so
-        results are layout-independent."""
+        results are layout-independent.  Regional conditionings (siblings
+        / area masks) refine with their masks cropped per tile window
+        (``_regional_entries``)."""
+        import math as _math
+
         from comfyui_distributed_tpu.ops.basic import _sdxl_vector_cond
-        from comfyui_distributed_tpu.utils.logging import debug_log
-        if any(getattr(c, "siblings", ())
-               or getattr(c, "area_mask", None) is not None
-               for c in (positive, negative)):
-            # regional conds would need per-tile mask crops through the
-            # scatter — refine with the primary prompt only, loudly,
-            # rather than silently mis-applying a canvas-global mask to
-            # tile-local coordinates
-            debug_log("tiled upscale: regional conditioning entries are "
-                      "not supported in the tile refine; using the "
-                      "primary prompt only")
         n = tiles.shape[0]
         seeds = np.asarray([p["seed"] + int(t) for t in tile_indices],
                            np.uint64)
         idx = np.zeros((n,), np.uint32)  # each tile is its own batch-of-1
+        regional = any(getattr(c, "siblings", ())
+                       or getattr(c, "area_mask", None) is not None
+                       or getattr(c, "timestep_range", None) is not None
+                       for c in (positive, negative))
+        if regional and (getattr(pipe, "perp_neg_cond", None) is not None
+                         or positions is None or img_size is None):
+            # 3-row guidance can't compose with multi-entry conds in one
+            # stacked call (registry contract), and a caller that didn't
+            # thread tile positions can't crop masks — degrade LOUDLY to
+            # the primary prompt, never silently mis-apply canvas-global
+            # masks to tile-local coordinates
+            log("tiled upscale: regional conditioning cannot be mapped "
+                "into this tile refine "
+                + ("(PerpNeg-patched model)" if positions is not None
+                   else "(no tile positions)")
+                + "; using the primary prompt only")
+            regional = False
+        mesh = ctx.runtime.mesh if (shard and ctx.runtime is not None) \
+            else None
+        if regional:
+            pos_entries = [positive] + list(getattr(positive, "siblings",
+                                                    ()) or ())
+            neg_entries = [negative] + list(getattr(negative, "siblings",
+                                                    ()) or ())
+            lengths = {int(e.context.shape[1])
+                       for e in pos_entries + neg_entries}
+            t_align = _math.lcm(*lengths)
+            if t_align > 8 * max(lengths):
+                t_align = max(lengths)
+            ds = pipe.family.vae.downscale
+            lat_hw = (tiles.shape[1] // ds, tiles.shape[2] // ds)
+            tiles_hw = (tiles.shape[1], tiles.shape[2])
+            ctx_arr, y_conds = self._regional_entries(
+                pipe, pos_entries, n, positions, p, img_size, lat_hw,
+                t_align, positive, tiles_hw, mesh)
+            unc_arr, y_unconds = self._regional_entries(
+                pipe, neg_entries, n, positions, p, img_size, lat_hw,
+                t_align, positive, tiles_hw, mesh)
+            y = (y_conds + y_unconds) if y_conds or y_unconds else None
+            tiles_dev = jnp.asarray(tiles)
+            if mesh is not None:
+                tiles_dev = coll.shard_batch(tiles, mesh)
+            lat = pipe.vae_encode(tiles_dev)
+            out_lat = pipe.sample(
+                lat, ctx_arr, unc_arr, seeds,
+                steps=p["steps"], cfg=p["cfg"],
+                sampler_name=p["sampler_name"], scheduler=p["scheduler"],
+                denoise=p["denoise"], add_noise=True, sample_idx=idx, y=y)
+            return np.clip(np.asarray(pipe.vae_decode(out_lat)), 0.0, 1.0)
         ctx_arr = jnp.repeat(positive.context, n, axis=0)
         unc_arr = jnp.repeat(negative.context, n, axis=0)
         y = None
@@ -233,6 +357,8 @@ class UltimateSDUpscaleDistributed(Op):
         with Timer("tile_refine"):
             refined = self._refine_batch(ctx, pipe, tiles, indices,
                                          positive, negative, p,
+                                         positions=positions,
+                                         img_size=(w, h),
                                          shard=(d > 1))
         with Timer("tile_blend"):
             out = self._blend_all(
@@ -262,7 +388,9 @@ class UltimateSDUpscaleDistributed(Op):
         tiles = tiling.extract_tiles(image, [all_tiles[i] for i in mine],
                                      p["tile_w"], p["tile_h"], p["padding"])
         refined = self._refine_batch(ctx, pipe, tiles, mine,
-                                     positive, negative, p)
+                                     positive, negative, p,
+                                     positions=[all_tiles[i] for i in mine],
+                                     img_size=(w, h))
         self._send_tiles(ctx, refined, mine, all_tiles, p, multi_job_id,
                          master_url, worker_id, (w, h))
         return (image,)
@@ -344,8 +472,9 @@ class UltimateSDUpscaleDistributed(Op):
                                          [all_tiles[i] for i in mine],
                                          p["tile_w"], p["tile_h"],
                                          p["padding"])
-            out = self._refine_batch(ctx, pipe, tiles, mine,
-                                     positive, negative, p)
+            out = self._refine_batch(
+                ctx, pipe, tiles, mine, positive, negative, p,
+                positions=[all_tiles[i] for i in mine], img_size=(w, h))
             refined.update({i: out[k] for k, i in enumerate(mine)})
 
         if active_workers and ctx.job_store is not None:

@@ -133,11 +133,13 @@ class ServerState:
         with self._queue_lock:
             return len(self._queue) + (1 if self._running else 0)
 
-    def enqueue_prompt(self, prompt: Dict[str, Any], client_id: str) -> str:
+    def enqueue_prompt(self, prompt: Dict[str, Any], client_id: str,
+                       extra_data: Optional[Dict[str, Any]] = None) -> str:
         pid = f"p_{int(time.time() * 1000)}_{next(self._id_counter)}"
         with self._queue_lock:
             self._queue.append({"id": pid, "prompt": prompt,
-                                "client_id": client_id})
+                                "client_id": client_id,
+                                "extra_data": extra_data or {}})
         self._queue_event.set()
         return pid
 
@@ -164,7 +166,10 @@ class ServerState:
                     server_loop=self.loop,
                     interrupt_event=self.interrupt_event,
                 )
-                res = WorkflowExecutor(ctx).execute(item["prompt"])
+                res = WorkflowExecutor(ctx).execute(
+                    item["prompt"],
+                    extra_pnginfo=item.get("extra_data", {}).get(
+                        "extra_pnginfo"))
                 self._history[item["id"]] = {
                     "status": "success",
                     "images": len(res.images),
@@ -518,6 +523,10 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             if mj and not h.get("is_worker"):
                 await state.jobs.prepare_tile_job(str(mj))
         client_id = data.get("client_id", "unknown")
+        # ComfyUI contract: extra_data.extra_pnginfo.workflow rides every
+        # dispatch so saved PNGs embed the source workflow (reference
+        # gpupanel.js:1344-1358)
+        extra_data = data.get("extra_data") or {}
         try:
             cfg = await _orchestration_config(prompt)
             if cfg is not None:
@@ -528,7 +537,8 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                     run_distributed)
 
                 async def enqueue_graph(g):
-                    return state.enqueue_prompt(g.to_api_format(), client_id)
+                    return state.enqueue_prompt(g.to_api_format(),
+                                                client_id, extra_data)
 
                 host = cfg.get("master", {}).get("host") or "127.0.0.1"
                 master_url = f"http://{host}:{state.port or 8288}"
@@ -536,14 +546,14 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                     prompt, master_url,
                     workers=cfg_mod.enabled_workers(cfg),
                     master_dispatch=enqueue_graph, job_store=state.jobs,
-                    client_id=client_id)
+                    client_id=client_id, extra_data=extra_data)
                 return web.json_response({
                     "prompt_id": out["result"],
                     "number": state.queue_remaining(),
                     "workers": out["workers"],
                     "failed_workers": out.get("failed", []),
                 })
-            pid = state.enqueue_prompt(prompt, client_id)
+            pid = state.enqueue_prompt(prompt, client_id, extra_data)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=400)
         return web.json_response({"prompt_id": pid,

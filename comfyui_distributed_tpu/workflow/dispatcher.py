@@ -176,11 +176,17 @@ async def preflight_check(workers: List[Dict[str, Any]],
 
 
 async def dispatch_to_worker(worker: Dict[str, Any], graph: Graph,
-                             client_id: str = "dtpu-master") -> Dict[str, Any]:
+                             client_id: str = "dtpu-master",
+                             extra_data: Optional[Dict[str, Any]] = None
+                             ) -> Dict[str, Any]:
     """POST the prepared prompt to a worker's /prompt
-    (``_dispatchToWorker``, ``gpupanel.js:1313-1362``)."""
+    (``_dispatchToWorker``, ``gpupanel.js:1313-1362``; ``extra_data``
+    carries extra_pnginfo like the reference's dispatch payload,
+    ``:1344-1358``)."""
     session = await get_client_session()
     payload = {"prompt": graph.to_api_format(), "client_id": client_id}
+    if extra_data:
+        payload["extra_data"] = extra_data
     async with session.post(
             worker_url(worker) + "/prompt", json=payload,
             timeout=aiohttp.ClientTimeout(total=30)) as r:

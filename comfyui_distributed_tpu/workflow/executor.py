@@ -55,16 +55,22 @@ class WorkflowExecutor:
         return max(self.ctx.runtime.num_participants, 1)
 
     def execute(self, workflow: Any,
-                hidden: Optional[Dict[str, Dict[str, Any]]] = None
+                hidden: Optional[Dict[str, Dict[str, Any]]] = None,
+                extra_pnginfo: Optional[Dict[str, Any]] = None
                 ) -> ExecutionResult:
         """Run a workflow (path/JSON/dict/Graph).  ``hidden`` optionally maps
-        node id -> hidden-input overrides (the dispatcher's injections)."""
+        node id -> hidden-input overrides (the dispatcher's injections).
+        ``extra_pnginfo`` (ComfyUI contract, typically
+        ``{"workflow": <UI-format doc>}``) is embedded by SaveImage into
+        every saved PNG alongside the API-format prompt."""
         graph = workflow if isinstance(workflow, Graph) \
             else parse_workflow(workflow)
         hidden = hidden or {}
         # fresh per-run collection state (assign, don't clear — prior
         # ExecutionResults keep their own lists)
         self.ctx.saved_images = []
+        self.ctx.prompt_json = graph.to_api_format()
+        self.ctx.extra_pnginfo = extra_pnginfo
         fanout = self._decide_fanout(graph)
         fan_nodes = None
         if fanout > 1:

@@ -59,9 +59,63 @@ def _clean_image_name(ref: str) -> str:
     return re.sub(r"\s*\[\w+\]$", "", ref)
 
 
+# Staged-image cache: one master read per image, N worker pushes — the
+# reference caches images pulled from the master for 30 s across workers
+# (``gpupanel.js:1364-1416``).  Entries hold an asyncio future so the
+# PARALLEL per-worker staging tasks of one dispatch share a single
+# in-flight fetch instead of racing N identical reads.
+STAGE_CACHE_TTL_S = 30.0
+_stage_cache: Dict[Any, Any] = {}
+
+
+async def _load_master_image(master_url: str, name: str) -> Optional[bytes]:
+    """Fetch one input image's bytes from the master, through the 30 s
+    cache.  Returns None (cached too) when the master doesn't have it."""
+    loop = asyncio.get_running_loop()
+    key = (master_url, name)
+    now = loop.time()
+    ent = _stage_cache.get(key)
+    if ent is not None and now - ent[0] < STAGE_CACHE_TTL_S \
+            and not (ent[1].done() and ent[1].exception() is not None):
+        return await ent[1]
+    fut = loop.create_future()
+    _stage_cache[key] = (now, fut)
+    # prune expired entries so long-lived masters don't accumulate
+    for k in [k for k, (t, f) in _stage_cache.items()
+              if now - t >= STAGE_CACHE_TTL_S and f.done()]:
+        _stage_cache.pop(k, None)
+    try:
+        session = await get_client_session()
+        async with session.post(
+                f"{master_url}/distributed/load_image",
+                json={"image_name": name},
+                timeout=aiohttp.ClientTimeout(total=30)) as r:
+            if r.status != 200:
+                log(f"stage: master missing input {name!r} ({r.status}); "
+                    f"skipping")
+                # resolve for CONCURRENT awaiters of this dispatch, but
+                # drop the entry: a miss must not be negatively cached —
+                # the image may be uploaded seconds later
+                fut.set_result(None)
+                _stage_cache.pop(key, None)
+                return None
+            data = await r.json()
+        fut.set_result(base64.b64decode(data["image_data"]))
+    except BaseException as e:  # incl. CancelledError: a cancelled fetch
+        # must not leave a forever-pending future for later stagers
+        _stage_cache.pop(key, None)
+        if not fut.done():
+            fut.set_exception(e)
+            # mark retrieved: nobody may ever await an abandoned future
+            fut.exception()
+        raise
+    return fut.result()
+
+
 async def stage_images_on_worker(master_url: str, worker: Dict[str, Any],
                                  refs: List[str]) -> None:
-    """Pull input images from the master and push them to one remote worker
+    """Pull input images from the master (cached across the dispatch's
+    workers, ``_load_master_image``) and push them to one remote worker
     (reference ``loadImagesForWorker``/``uploadImagesToWorker``,
     ``gpupanel.js:1364-1468``)."""
     if not refs:
@@ -70,18 +124,11 @@ async def stage_images_on_worker(master_url: str, worker: Dict[str, Any],
     wurl = dsp.worker_url(worker)
     for ref in refs:
         name = _clean_image_name(ref)
-        async with session.post(
-                f"{master_url}/distributed/load_image",
-                json={"image_name": name},
-                timeout=aiohttp.ClientTimeout(total=30)) as r:
-            if r.status != 200:
-                log(f"stage: master missing input {name!r} ({r.status}); "
-                    f"skipping")
-                continue
-            data = await r.json()
+        blob = await _load_master_image(master_url, name)
+        if blob is None:
+            continue
         form = aiohttp.FormData()
-        form.add_field("image", base64.b64decode(data["image_data"]),
-                       filename=os.path.basename(name),
+        form.add_field("image", blob, filename=os.path.basename(name),
                        content_type="image/png")
         async with session.post(
                 f"{wurl}/upload/image", data=form,
@@ -96,10 +143,13 @@ def _is_remote(worker: Dict[str, Any]) -> bool:
     return worker.get("host") not in (None, "", "localhost", "127.0.0.1")
 
 
-async def _post_prompt(url: str, graph: Graph, client_id: str) -> Any:
+async def _post_prompt(url: str, graph: Graph, client_id: str,
+                       extra_data: Optional[Dict[str, Any]] = None) -> Any:
     """Queue a graph on a server's ComfyUI-compatible /prompt."""
     session = await get_client_session()
     payload = {"prompt": graph.to_api_format(), "client_id": client_id}
+    if extra_data:
+        payload["extra_data"] = extra_data
     async with session.post(f"{url}/prompt", json=payload,
                             timeout=aiohttp.ClientTimeout(total=30)) as r:
         if r.status != 200:
@@ -116,7 +166,9 @@ async def run_distributed(graph_or_doc: Any,
                           master_dispatch=None,
                           job_store=None,
                           client_id: str = "dtpu-orchestrator",
-                          job_prefix: Optional[str] = None) -> Dict[str, Any]:
+                          job_prefix: Optional[str] = None,
+                          extra_data: Optional[Dict[str, Any]] = None
+                          ) -> Dict[str, Any]:
     """Fan a workflow out to master + enabled workers.
 
     The master's share runs through exactly one of:
@@ -145,7 +197,8 @@ async def run_distributed(graph_or_doc: Any,
                 return await loop.run_in_executor(None, lambda: _ex(g))
         else:
             async def master_dispatch(g):
-                return await _post_prompt(master_url, g, client_id)
+                return await _post_prompt(master_url, g, client_id,
+                                          extra_data)
 
     # 1. preflight (drop dead workers; reference gpupanel.js:842-848)
     alive = await dsp.preflight_check(workers) if workers else []
@@ -191,8 +244,11 @@ async def run_distributed(graph_or_doc: Any,
         wgraph = dsp.prepare_for_participant(
             graph, "worker", job_id_map, enabled_ids,
             master_url=master_url, worker_index=index)
+        # extra_pnginfo rides every worker dispatch (reference
+        # gpupanel.js:1344-1358) so worker-saved PNGs carry the workflow
         return await dsp.dispatch_to_worker(worker, wgraph,
-                                            client_id=client_id)
+                                            client_id=client_id,
+                                            extra_data=extra_data)
 
     t0 = time.perf_counter()
     dispatches = asyncio.gather(

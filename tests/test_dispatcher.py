@@ -1,5 +1,6 @@
 """Dispatcher graph-rewrite parity (gpupanel.js semantics)."""
 
+import asyncio
 import json
 
 import pytest
@@ -136,3 +137,53 @@ def test_prune_without_distributed_nodes_returns_copy():
     assert w0.nodes["1"].hidden["worker_id"] == "worker_0"
     assert w1.nodes["1"].hidden["worker_id"] == "worker_1"
     assert "worker_id" not in g.nodes["1"].hidden
+
+
+class TestStagedImageCache:
+    """VERDICT r4 #6: images pulled from the master are cached (30 s,
+    reference gpupanel.js:1364-1416) so a multi-worker dispatch does ONE
+    master read per image and N worker pushes."""
+
+    def test_one_master_read_for_two_workers(self):
+        import base64
+
+        from aiohttp import web
+        from comfyui_distributed_tpu.workflow import orchestrate as orch
+
+        counts = {"load": 0, "upload": 0}
+
+        async def load_image(request):
+            counts["load"] += 1
+            return web.json_response(
+                {"image_data": base64.b64encode(b"pngbytes").decode()})
+
+        async def upload(request):
+            counts["upload"] += 1
+            await request.post()
+            return web.json_response({"name": "in.png"})
+
+        async def go():
+            app = web.Application()
+            app.router.add_post("/distributed/load_image", load_image)
+            app.router.add_post("/upload/image", upload)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            url = f"http://127.0.0.1:{port}"
+            orch._stage_cache.clear()
+            try:
+                workers = [{"id": f"worker_{i}", "host": "127.0.0.1",
+                            "port": port} for i in range(2)]
+                # parallel staging, exactly like run_distributed's gather
+                await asyncio.gather(*(
+                    orch.stage_images_on_worker(url, w, ["in.png"])
+                    for w in workers))
+            finally:
+                await runner.cleanup()
+            return counts
+
+        out = asyncio.run(go())
+        assert out["upload"] == 2        # every worker got the image
+        assert out["load"] == 1, "master was read once per worker"

@@ -496,6 +496,61 @@ class TestUpscaleE2E:
                                    atol=2e-3)
 
 
+class TestRegionalTiledUpscale:
+    """VERDICT r4 #4: regional conditioning entries refine with their
+    masks cropped through the tile windows (instead of the loud
+    primary-prompt fallback)."""
+
+    def _regional_conds(self, pipe):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        octx = OpContext()
+        a = Conditioning(context=pipe.encode_prompt(["blue sky"])[0])
+        b = Conditioning(context=pipe.encode_prompt(["green forest"])[0])
+        left = np.zeros((64, 64), np.float32)
+        left[:, :32] = 1.0
+        (am,) = get_op("ConditioningSetMask").execute(octx, a, left, 1.0)
+        (bm,) = get_op("ConditioningSetMask").execute(octx, b,
+                                                      1.0 - left, 1.0)
+        (combined,) = get_op("ConditioningCombine").execute(octx, am, bm)
+        neg = Conditioning(context=pipe.encode_prompt([""])[0])
+        return combined, neg
+
+    def _upscale(self, ctx, pipe, positive, negative):
+        from comfyui_distributed_tpu.ops.base import get_op
+        rng = np.random.default_rng(3)
+        img = rng.random((1, 64, 64, 3)).astype(np.float32)
+        (out,) = get_op("UltimateSDUpscaleDistributed").execute(
+            ctx, img, pipe, positive, negative, pipe, 5, 1, 4.0,
+            "euler", "normal", 0.4, 32, 32, 8, 2, True)
+        return np.asarray(out)
+
+    def test_regional_spmd_matches_single_device_oracle(self, ctx):
+        pipe = registry.load_pipeline("regup.ckpt")
+        pos, neg = self._regional_conds(pipe)
+        out_d = self._upscale(ctx, pipe, pos, neg)
+        ctx_s = OpContext(runtime=ctx.runtime)
+        ctx_s.runtime.enabled = False
+        try:
+            out_s = self._upscale(ctx_s, pipe, pos, neg)
+        finally:
+            ctx.runtime.enabled = True
+        assert np.isfinite(out_d).all()
+        np.testing.assert_allclose(out_d, out_s, atol=2e-3)
+
+    def test_regional_masks_engage(self, ctx):
+        """The cropped masks must actually reach the sampler: the
+        regional result differs from refining with the primary prompt
+        alone (the old fallback behavior)."""
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        pipe = registry.load_pipeline("regup.ckpt")
+        pos, neg = self._regional_conds(pipe)
+        out_r = self._upscale(ctx, pipe, pos, neg)
+        primary = Conditioning(context=pipe.encode_prompt(["blue sky"])[0])
+        out_p = self._upscale(ctx, pipe, primary, neg)
+        assert not np.allclose(out_r, out_p, atol=1e-4)
+
+
 class TestRepoFixtures:
     """The repo's own workflow fixtures (same node-type surface as the
     reference's two workflows) parse and execute end-to-end on the virtual
@@ -1110,3 +1165,50 @@ class TestLatentAndAnimatedIO:
         assert getattr(im, "n_frames", 1) == 3
         im2 = Image.open(pp)
         assert getattr(im2, "n_frames", 1) == 3
+
+
+class TestPngWorkflowMetadata:
+    """VERDICT r4 #5: saved PNGs embed the executing prompt and the
+    client's extra_pnginfo (reference ships extra_pnginfo.workflow with
+    every dispatch, gpupanel.js:1344-1358) and round-trip into the same
+    graph."""
+
+    def test_save_image_embeds_and_round_trips(self, ctx, tmp_path):
+        import os
+
+        from PIL import Image
+        g = parse_workflow("/root/repo/workflows/distributed-txt2img.json")
+        g.nodes["5"].inputs.update(width=64, height=64, batch_size=1)
+        g.nodes["3"].inputs.update(steps=1)
+        g.nodes["9"].class_type = "SaveImage"
+        g.nodes["9"].inputs["filename_prefix"] = "meta_rt"
+        ui_doc = json.load(
+            open("/root/repo/workflows/distributed-txt2img.json"))
+        ctx.output_dir = str(tmp_path / "out")
+        res = WorkflowExecutor(ctx).execute(
+            g, extra_pnginfo={"workflow": ui_doc})
+        assert res.images
+        outs = sorted(os.listdir(ctx.output_dir))
+        assert outs, "SaveImage wrote nothing"
+        im = Image.open(os.path.join(ctx.output_dir, outs[0]))
+        assert "prompt" in im.info and "workflow" in im.info
+        # the prompt chunk reloads into the SAME executable graph
+        g2 = parse_workflow(json.loads(im.info["prompt"]))
+        assert g2.to_api_format() == g.to_api_format()
+        # the workflow chunk reloads into the same node set
+        g3 = parse_workflow(json.loads(im.info["workflow"]))
+        assert set(g3.nodes) == set(g.nodes)
+
+    def test_no_metadata_when_none_given(self, tmp_path):
+        """A bare op-level SaveImage (no executor run) writes clean PNGs."""
+        import os
+
+        from PIL import Image
+
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        octx = OpContext(output_dir=str(tmp_path / "out"))
+        img = np.zeros((1, 8, 8, 3), np.float32)
+        get_op("SaveImage").execute(octx, img, "plain")
+        outs = sorted(os.listdir(octx.output_dir))
+        im = Image.open(os.path.join(octx.output_dir, outs[0]))
+        assert "prompt" not in im.info and "workflow" not in im.info
