@@ -1368,6 +1368,55 @@ class KSamplerAdvanced(Op):
         return (out_d,)
 
 
+def cond_token_align(entries) -> int:
+    """Common token length for a set of conditioning entries: ComfyUI
+    repeats each cond to the lcm of the lengths (77-chunk multiples in
+    practice) — semantically lossless, unlike zero-pad (zero keys still
+    soak up softmax mass); falls back to zero-padding at max length only
+    if a pathological mix would explode the lcm.  ONE copy of the rule —
+    the sampler prep and the tiled-upscale regional refine both use it."""
+    lengths = {int(e.context.shape[1]) for e in entries}
+    t_max = max(lengths)
+    t_align = math.lcm(*lengths)
+    if t_align > 8 * t_max:
+        debug_log(f"conditioning token lengths {sorted(lengths)} have no "
+                  f"small common multiple; zero-padding to {t_max}")
+        t_align = t_max
+    return t_align
+
+
+def align_cond_tokens(c, t_align: int):
+    """Repeat (lossless) or zero-pad one context to ``t_align`` tokens."""
+    t = int(c.shape[1])
+    if t == t_align:
+        return c
+    if t_align % t == 0:
+        return jnp.tile(c, (1, t_align // t, 1))
+    return jnp.pad(c, ((0, 0), (0, t_align - t), (0, 0)))
+
+
+def adm_cond_source(family, e: Conditioning, positive: Conditioning):
+    """Which conditioning supplies an entry's ADM vector: unclip
+    families build from the entry's OWN unclip list (a negative without
+    one gets ZERO ADM — the reference zero-fills — never the positive's
+    image embedding); sdxl entries without a pooled fall back to the
+    primary positive's."""
+    if getattr(family, "adm_kind", "sdxl") == "unclip":
+        return e
+    return e if e.pooled is not None else positive
+
+
+def entry_sigma_range(schedule, e: Conditioning):
+    """timestep_range percents -> (sigma_start, sigma_end) bounds
+    against THIS model's schedule (active while s_end <= sigma <=
+    s_start), or None."""
+    tr = getattr(e, "timestep_range", None)
+    if tr is None:
+        return None
+    return (schedule.percent_to_sigma(float(tr[0])),
+            schedule.percent_to_sigma(float(tr[1])))
+
+
 def _materialize_area_mask(cond: Conditioning, h: int, w: int, total: int):
     """A Conditioning's area spec -> latent-resolution weight mask
     [1_or_B, h, w, 1], or None.  Rect specs resolve against the ACTUAL
@@ -1527,25 +1576,10 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                                     or ())
     mid_entries = [middle] if middle is not None else []
     all_entries = pos_entries + neg_entries + mid_entries
-    lengths = {int(e.context.shape[1]) for e in all_entries}
-    t_max = max(lengths)
-    # ComfyUI repeats each cond to the lcm of the lengths (77-chunk
-    # multiples in practice) — semantically lossless, unlike zero-pad
-    # (zero keys still soak up softmax mass); fall back to zero-pad only
-    # if a pathological mix would explode the lcm
-    t_align = math.lcm(*lengths)
-    if t_align > 8 * t_max:
-        debug_log(f"conditioning token lengths {sorted(lengths)} have no "
-                  f"small common multiple; zero-padding to {t_max}")
-        t_align = t_max
+    t_align = cond_token_align(all_entries)
 
     def _align_tokens(c):
-        t = int(c.shape[1])
-        if t == t_align:
-            return c
-        if t_align % t == 0:
-            return jnp.tile(c, (1, t_align // t, 1))
-        return jnp.pad(c, ((0, 0), (0, t_align - t), (0, 0)))
+        return align_cond_tokens(c, t_align)
 
     lat_dev = lat
     mesh = ctx.runtime.mesh if ctx.runtime is not None else None
@@ -1568,28 +1602,15 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                 # per-sample masks ride the data axis like the noise
                 # mask; single-row masks stay replicated
                 am = coll.shard_batch(np.asarray(am), mesh)
-            tr = getattr(e, "timestep_range", None)
-            srange = None
-            if tr is not None:
-                # percents -> sigma bounds against THIS model's schedule
-                # (active while s_end <= sigma <= s_start)
-                srange = (model.schedule.percent_to_sigma(float(tr[0])),
-                          model.schedule.percent_to_sigma(float(tr[1])))
+            srange = entry_sigma_range(model.schedule, e)
             out.append((ce, am,
                         float(getattr(e, "area_strength", 1.0)), srange))
             if adm:
                 # each entry carries its OWN pooled ADM vector (regional
-                # SDXL: region B must not ride region A's pooled); an
-                # entry without one falls back to the primary positive's
-                if getattr(model.family, "adm_kind", "sdxl") == "unclip":
-                    # each entry builds from its OWN unclip list: a
-                    # negative without one gets ZERO ADM (the reference
-                    # zero-fills), never the positive's image embedding
-                    adm_src = e
-                else:
-                    adm_src = e if e.pooled is not None else positive
+                # SDXL: region B must not ride region A's pooled) —
+                # source selection shared with the tile refine
                 ye = _sdxl_vector_cond(
-                    model, adm_src,
+                    model, adm_cond_source(model.family, e, positive),
                     total, lat.shape[1] * 8, lat.shape[2] * 8)
                 if fanout > 1 and mesh is not None:
                     ye = coll.shard_batch(ye, mesh)

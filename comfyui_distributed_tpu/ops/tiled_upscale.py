@@ -132,19 +132,16 @@ class UltimateSDUpscaleDistributed(Op):
         into every tile, distributed_upscale.py:516-541 — cropping is
         strictly more correct).  Returns (entries, y_list)."""
         from comfyui_distributed_tpu.ops.basic import (
-            _image_mask_to_latent, _sdxl_vector_cond)
+            _image_mask_to_latent, _sdxl_vector_cond, adm_cond_source,
+            align_cond_tokens, entry_sigma_range)
         img_w, img_h = img_size
         lh, lw = lat_hw
         th, tw = tiles_hw
         adm = pipe.family.unet.adm_in_channels is not None
         entries, ys = [], []
         for e in src_entries:
-            c = e.context
-            t = int(c.shape[1])
-            if t != t_align:
-                c = jnp.tile(c, (1, t_align // t, 1)) if t_align % t == 0 \
-                    else jnp.pad(c, ((0, 0), (0, t_align - t), (0, 0)))
-            ce = jnp.repeat(c, n, axis=0)
+            ce = jnp.repeat(align_cond_tokens(e.context, t_align), n,
+                            axis=0)
             am = None
             cm = self._canvas_area_mask(e, img_w, img_h)
             if cm is not None:
@@ -153,11 +150,7 @@ class UltimateSDUpscaleDistributed(Op):
                                             resize_method="bilinear")
                 am = jnp.asarray(_image_mask_to_latent(
                     wins[..., 0], lh, lw, n))
-            tr = getattr(e, "timestep_range", None)
-            srange = None
-            if tr is not None:
-                srange = (pipe.schedule.percent_to_sigma(float(tr[0])),
-                          pipe.schedule.percent_to_sigma(float(tr[1])))
+            srange = entry_sigma_range(pipe.schedule, e)
             if mesh is not None:
                 ce = coll.shard_batch(np.asarray(ce), mesh)
                 if am is not None and am.shape[0] == n:
@@ -166,14 +159,9 @@ class UltimateSDUpscaleDistributed(Op):
                             float(getattr(e, "area_strength", 1.0)),
                             srange))
             if adm:
-                # unclip families build from the entry's OWN unclip list
-                # (a negative without one gets zero ADM, never the
-                # positive's image embedding — ops/basic.py:1583-1590)
-                if getattr(pipe.family, "adm_kind", "sdxl") == "unclip":
-                    adm_src = e
-                else:
-                    adm_src = e if e.pooled is not None else positive
-                ye = _sdxl_vector_cond(pipe, adm_src, n, th, tw)
+                ye = _sdxl_vector_cond(
+                    pipe, adm_cond_source(pipe.family, e, positive),
+                    n, th, tw)
                 if mesh is not None:
                     ye = coll.shard_batch(np.asarray(ye), mesh)
                 ys.append(ye)
@@ -190,8 +178,6 @@ class UltimateSDUpscaleDistributed(Op):
         results are layout-independent.  Regional conditionings (siblings
         / area masks) refine with their masks cropped per tile window
         (``_regional_entries``)."""
-        import math as _math
-
         from comfyui_distributed_tpu.ops.basic import _sdxl_vector_cond
         n = tiles.shape[0]
         seeds = np.asarray([p["seed"] + int(t) for t in tile_indices],
@@ -217,15 +203,12 @@ class UltimateSDUpscaleDistributed(Op):
         mesh = ctx.runtime.mesh if (shard and ctx.runtime is not None) \
             else None
         if regional:
+            from comfyui_distributed_tpu.ops.basic import cond_token_align
             pos_entries = [positive] + list(getattr(positive, "siblings",
                                                     ()) or ())
             neg_entries = [negative] + list(getattr(negative, "siblings",
                                                     ()) or ())
-            lengths = {int(e.context.shape[1])
-                       for e in pos_entries + neg_entries}
-            t_align = _math.lcm(*lengths)
-            if t_align > 8 * max(lengths):
-                t_align = max(lengths)
+            t_align = cond_token_align(pos_entries + neg_entries)
             ds = pipe.family.vae.downscale
             lat_hw = (tiles.shape[1] // ds, tiles.shape[2] // ds)
             tiles_hw = (tiles.shape[1], tiles.shape[2])

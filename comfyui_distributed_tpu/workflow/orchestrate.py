@@ -192,8 +192,22 @@ async def run_distributed(graph_or_doc: Any,
 
     if master_dispatch is None:
         if executor is not None:
+            # thread extra_pnginfo through when the executor accepts it
+            # (WorkflowExecutor.execute does) so the MASTER's saved PNGs
+            # carry the workflow chunk like the workers' do
+            import inspect
+            try:
+                takes_meta = "extra_pnginfo" in inspect.signature(
+                    executor).parameters
+            except (TypeError, ValueError):
+                takes_meta = False
+            meta = (extra_data or {}).get("extra_pnginfo")
+
             async def master_dispatch(g, _ex=executor):
                 loop = asyncio.get_running_loop()
+                if takes_meta and meta is not None:
+                    return await loop.run_in_executor(
+                        None, lambda: _ex(g, extra_pnginfo=meta))
                 return await loop.run_in_executor(None, lambda: _ex(g))
         else:
             async def master_dispatch(g):

@@ -76,7 +76,8 @@ class TestSamplers:
 
     @pytest.mark.parametrize("name", ["euler_ancestral", "dpmpp_2m_sde",
                                       "lcm", "dpmpp_sde", "dpmpp_3m_sde",
-                                      "ddpm"])
+                                      "ddpm", "er_sde", "seeds_2",
+                                      "seeds_3"])
     def test_stochastic_requires_keys(self, ds, name):
         sigmas = jnp.asarray(sch.compute_sigmas(ds, "normal", 4))
         x = jnp.zeros((1, 2, 2, 1))
@@ -955,3 +956,121 @@ class TestCFGPlusPlusGuiderCoverage:
         perp(jnp.zeros((1, 4, 4, 2)), jnp.asarray(3.0))
         np.testing.assert_allclose(np.asarray(perp.last_uncond),
                                    np.asarray(unc_t))
+
+
+class TestRound5SamplerLongTail:
+    """res_multistep / gradient_estimation / er_sde / sa_solver /
+    seeds_2 / seeds_3 (VERDICT r4 #7) — behavioral contracts beyond the
+    all-sampler parametrized suites."""
+
+    def _setup(self, ds, steps=8, b=2):
+        x0 = jnp.asarray(np.random.default_rng(9).standard_normal(
+            (b, 4, 4, 2)).astype(np.float32)) * 0.4
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "karras", steps))
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(b, dtype=jnp.uint32))
+        x = jax.random.normal(jax.random.PRNGKey(2), x0.shape) * sigmas[0]
+        return x0, sigmas, keys, x
+
+    def test_gradient_estimation_equals_euler_for_ideal_model(self, ds):
+        """For a constant-x0 denoiser the step directions coincide, so
+        the gamma-extrapolation is exact and the trajectory IS euler."""
+        x0, sigmas, keys, x = self._setup(ds)
+        a = smp.sample_gradient_estimation(ideal_model(x0), x, sigmas)
+        b = smp.sample_euler(ideal_model(x0), x, sigmas)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_res_multistep_second_order_beats_euler(self, ds):
+        """On a sigma-curved denoiser (denoised bends with sigma) the
+        2nd-order multistep lands closer to the true limit than euler at
+        the same step count."""
+        x0 = jnp.full((1, 4, 4, 2), 0.5, jnp.float32)
+
+        def curved(x, sigma, **kw):
+            s = jnp.reshape(sigma, (-1,) + (1,) * (x.ndim - 1))
+            return x0 * (1.0 + 0.3 * jnp.tanh(s))
+
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "karras", 6))
+        x = jnp.ones_like(x0) * sigmas[0]
+        # the true sigma->0 limit of the curved target is x0
+        err_res = np.abs(np.asarray(
+            smp.sample_res_multistep(curved, x, sigmas)) - 0.5).max()
+        err_euler = np.abs(np.asarray(
+            smp.sample_euler(curved, x, sigmas)) - 0.5).max()
+        assert err_res <= err_euler + 1e-6, (err_res, err_euler)
+
+    def test_sa_solver_corrector_beats_predictor_only(self, ds):
+        """The PECE corrector evaluation must tighten the same curved
+        trajectory vs the predictor-only res_multistep path."""
+        x0 = jnp.full((1, 4, 4, 2), 0.5, jnp.float32)
+
+        def curved(x, sigma, **kw):
+            s = jnp.reshape(sigma, (-1,) + (1,) * (x.ndim - 1))
+            return x0 * (1.0 + 0.3 * jnp.tanh(s))
+
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "karras", 6))
+        x = jnp.ones_like(x0) * sigmas[0]
+        err_sa = np.abs(np.asarray(
+            smp.sample_sa_solver(curved, x, sigmas)) - 0.5).max()
+        err_res = np.abs(np.asarray(
+            smp.sample_res_multistep(curved, x, sigmas)) - 0.5).max()
+        assert err_sa <= err_res + 1e-6, (err_sa, err_res)
+
+    @pytest.mark.parametrize("name", ["seeds_2", "seeds_3", "er_sde"])
+    def test_stochastic_deterministic_given_keys(self, ds, name):
+        x0, sigmas, keys, x = self._setup(ds)
+        fn = smp.get_sampler(name)
+        a = fn(ideal_model(x0), x, sigmas, keys=keys)
+        b = fn(ideal_model(x0), x, sigmas, keys=keys)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("name", ["seeds_2", "seeds_3"])
+    def test_seeds_eta_zero_is_deterministic_no_keys(self, ds, name):
+        """eta=0 degenerates to the deterministic exponential RK — no
+        keys needed, and repeated runs are bit-identical."""
+        x0, sigmas, _, x = self._setup(ds)
+        fn = smp.get_sampler(name)
+        a = fn(ideal_model(x0), x, sigmas, eta=0.0)
+        b = fn(ideal_model(x0), x, sigmas, eta=0.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(x0),
+                                   atol=1e-3)
+
+    @pytest.mark.parametrize("name", ["seeds_2", "seeds_3", "er_sde"])
+    def test_distinct_keys_distinct_trajectories(self, ds, name):
+        """Per-sample noise streams: different keys diverge mid-run
+        (stopped before sigma 0 so the noise isn't annihilated)."""
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "normal", 8))[:5]
+        keys_a = jax.vmap(jax.random.PRNGKey)(jnp.asarray([1, 2],
+                                                          jnp.uint32))
+        keys_b = jax.vmap(jax.random.PRNGKey)(jnp.asarray([3, 4],
+                                                          jnp.uint32))
+        x = jnp.zeros((2, 4, 4, 1)) + sigmas[0]
+        x0 = jnp.zeros((2, 4, 4, 1))
+        fn = smp.get_sampler(name)
+        a = fn(ideal_model(x0), x, sigmas, keys=keys_a)
+        b = fn(ideal_model(x0), x, sigmas, keys=keys_b)
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+    def test_ksampler_runs_the_long_tail_end_to_end(self, ds):
+        """The registry path (static-key jit cache, CFG wrapper, noise
+        plumbing) accepts every new sampler name."""
+        from comfyui_distributed_tpu.models import registry
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        import os
+        os.environ["DTPU_DEFAULT_FAMILY"] = "tiny"
+        try:
+            pipe = registry.load_pipeline("longtail.ckpt")
+            pos = Conditioning(context=pipe.encode_prompt(["x"])[0])
+            lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+            for name in ("res_multistep", "gradient_estimation", "er_sde",
+                         "sa_solver", "seeds_2", "seeds_3"):
+                (out,) = get_op("KSampler").execute(
+                    OpContext(), pipe, 3, 2, 3.0, name, "normal", pos,
+                    pos, lat, 1.0)
+                assert np.isfinite(np.asarray(out["samples"])).all(), name
+        finally:
+            os.environ.pop("DTPU_DEFAULT_FAMILY", None)
+            registry.clear_pipeline_cache()
