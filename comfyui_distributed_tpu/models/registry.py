@@ -86,6 +86,14 @@ FAMILIES: Dict[str, ModelFamily] = {
         vae=vae_mod.SD_VAE_CONFIG,
         clips=(clip_mod.CLIP_L_CONFIG,),
     ),
+    # InstructPix2Pix: [latent(4), source-image latent(4)] = 8 input
+    # channels, no mask (timbrooks/instruct-pix2pix layout)
+    "sd15_ip2p": ModelFamily(
+        name="sd15_ip2p",
+        unet=dataclasses.replace(unet_mod.SD15_CONFIG, in_channels=8),
+        vae=vae_mod.SD_VAE_CONFIG,
+        clips=(clip_mod.CLIP_L_CONFIG,),
+    ),
     "sd21_inpaint": ModelFamily(       # 512-inpainting-ema (eps line)
         name="sd21_inpaint",
         unet=dataclasses.replace(unet_mod.SD21_BASE_CONFIG,
@@ -141,6 +149,12 @@ FAMILIES: Dict[str, ModelFamily] = {
         vae=vae_mod.TINY_VAE_CONFIG,
         clips=(clip_mod.TINY_CLIP_CONFIG,),
     ),
+    "tiny_ip2p": ModelFamily(
+        name="tiny_ip2p",
+        unet=dataclasses.replace(unet_mod.TINY_CONFIG, in_channels=8),
+        vae=vae_mod.TINY_VAE_CONFIG,
+        clips=(clip_mod.TINY_CLIP_CONFIG,),
+    ),
 }
 
 FAMILY_ENV = "DTPU_DEFAULT_FAMILY"
@@ -168,7 +182,12 @@ def detect_family(ckpt_name: str) -> str:
     if "tiny" in lowered or "test" in lowered:
         if "unclip" in lowered:
             return "tiny_unclip"
+        if "ip2p" in lowered or "pix2pix" in lowered:
+            return "tiny_ip2p"
         return "tiny_inpaint" if inpaint else "tiny"
+    # timbrooks/instruct-pix2pix style finetunes (8-channel UNet)
+    if "ip2p" in lowered or "pix2pix" in lowered:
+        return "sd15_ip2p"
     if "unclip" in lowered:
         return "sd21_unclip"
     if "xl" in lowered:
@@ -1232,6 +1251,133 @@ def load_vae(vae_name: str, models_dir: Optional[str] = None,
             f"deterministic init (seed {seed})")
 
     pipe = DiffusionPipeline(f"vae:{vae_name}", fam, {}, [{}], vae_p)
+    with _pipeline_lock:
+        _pipeline_cache[key] = pipe
+    return pipe
+
+
+# ComfyUI CLIPLoader/DualCLIPLoader "type" widget -> model family whose
+# text-tower geometry the file(s) must match
+CLIP_TYPE_FAMILIES = {
+    "stable_diffusion": "sd15",
+    "sd1": "sd15",
+    "sd2": "sd21",
+    "sdxl": "sdxl",
+}
+
+
+def load_clip(clip_names: List[str], models_dir: Optional[str] = None,
+              family_name: Optional[str] = None) -> DiffusionPipeline:
+    """CLIPLoader/DualCLIPLoader equivalent: standalone text tower(s)
+    usable wherever a checkpoint's CLIP output is (CLIPTextEncode and
+    friends).  Accepts each tower's in-checkpoint prefix (as CLIPSave
+    writes), an HF-standalone ``text_model.`` prefix, or bare keys; one
+    file per tower (DualCLIPLoader: [clip_l, clip_g] for sdxl); virtual
+    init per missing file."""
+    fam = FAMILIES[family_name or os.environ.get(FAMILY_ENV) or "sd15"]
+    if len(clip_names) != len(fam.clips):
+        raise ValueError(
+            f"family {fam.name} has {len(fam.clips)} text tower(s), got "
+            f"{len(clip_names)} file name(s) — use "
+            f"{'DualCLIPLoader' if len(fam.clips) == 2 else 'CLIPLoader'}")
+    key = f"clip:{':'.join(clip_names)}:{fam.name}:{models_dir or ''}"
+    with _pipeline_lock:
+        if key in _pipeline_cache:
+            return _pipeline_cache[key]
+
+    from comfyui_distributed_tpu.models.checkpoints import (
+        _clip_prefixes, _clip_runner, _LoadMapper, load_state_dict)
+    clip_ps = []
+    for i, (name, ccfg) in enumerate(zip(clip_names, fam.clips)):
+        path = None
+        if models_dir:
+            for sub in (name, os.path.join("clip", name),
+                        os.path.join("text_encoders", name)):
+                cand = os.path.join(models_dir, sub.replace("\\", "/"))
+                if os.path.exists(cand):
+                    path = cand
+                    break
+        if path is not None:
+            sd = load_state_dict(path)
+            in_ckpt = _clip_prefixes(fam)[i]
+            prefix = next((p for p in (in_ckpt, "text_model.")
+                           if any(k.startswith(p) for k in sd)), "")
+            clip_ps.append(_clip_runner(ccfg)(_LoadMapper(sd, prefix),
+                                              ccfg))
+            log(f"loaded CLIP tower {i} from {path} (prefix {prefix!r})")
+        else:
+            seed = _name_seed(name) + i
+            tok = jnp.zeros((1, ccfg.max_length), jnp.int32)
+            clip_ps.append(_virtual_params(
+                clip_mod.CLIPTextModel(ccfg), seed, tok))
+            log(f"virtual CLIP tower {name!r} ({fam.name}[{i}]): no file "
+                f"on disk, deterministic init (seed {seed})")
+
+    if _bf16_weights_enabled(fam):
+        # same storage policy as load_pipeline: CLIP towers loaded here
+        # must not diverge (dtype or HBM traffic) from the identical
+        # towers arriving via CheckpointLoaderSimple
+        clip_ps = [_cast_bf16(p) for p in clip_ps]
+    pipe = DiffusionPipeline(f"clip:{':'.join(clip_names)}", fam, {},
+                             clip_ps, {}, assets_dir=models_dir)
+    with _pipeline_lock:
+        _pipeline_cache[key] = pipe
+    return pipe
+
+
+def load_unet(unet_name: str, models_dir: Optional[str] = None,
+              family_name: Optional[str] = None) -> DiffusionPipeline:
+    """UNETLoader equivalent: a standalone diffusion model (family
+    detected from the filename unless given).  Accepts full-checkpoint
+    ``model.diffusion_model.`` keys or bare UNet keys; text/VAE towers
+    virtually initialize so the result is a complete MODEL wire (swap
+    them via CLIPLoader/VAELoader outputs downstream)."""
+    fam_name = family_name or detect_family(unet_name)
+    key = f"unet:{unet_name}:{fam_name}:{models_dir or ''}"
+    with _pipeline_lock:
+        if key in _pipeline_cache:
+            return _pipeline_cache[key]
+    fam = FAMILIES[fam_name]
+
+    seed = _name_seed(unet_name)
+    path = None
+    if models_dir:
+        for sub in (unet_name, os.path.join("unet", unet_name),
+                    os.path.join("diffusion_models", unet_name)):
+            cand = os.path.join(models_dir, sub.replace("\\", "/"))
+            if os.path.exists(cand):
+                path = cand
+                break
+    if path is not None:
+        from comfyui_distributed_tpu.models.checkpoints import (
+            UNET_PREFIX, _LoadMapper, _run_unet, load_state_dict)
+        sd = load_state_dict(path)
+        prefix = UNET_PREFIX if any(k.startswith(UNET_PREFIX)
+                                    for k in sd) else ""
+        unet_p = _run_unet(_LoadMapper(sd, prefix), fam.unet)
+        log(f"loaded UNet {unet_name} ({fam.name}) from {path}")
+    else:
+        x = jnp.zeros((1, 8, 8, fam.unet.in_channels))
+        unet_p = _virtual_params(
+            unet_mod.UNet(fam.unet), seed, x, jnp.zeros((1,)),
+            jnp.zeros((1, 77, fam.unet.context_dim)))
+        log(f"virtual UNet {unet_name!r} ({fam.name}): no file on disk, "
+            f"deterministic init (seed {seed})")
+
+    clip_ps = []
+    for i, ccfg in enumerate(fam.clips):
+        tok = jnp.zeros((1, ccfg.max_length), jnp.int32)
+        clip_ps.append(_virtual_params(
+            clip_mod.CLIPTextModel(ccfg), seed + 1 + i, tok))
+    img = jnp.zeros((1, 8 * fam.vae.downscale, 8 * fam.vae.downscale, 3))
+    vae_p = _virtual_params(vae_mod.VAE(fam.vae), seed + 100, img)
+    if _bf16_weights_enabled(fam):
+        unet_p = _cast_bf16(unet_p)
+        clip_ps = [_cast_bf16(p) for p in clip_ps]
+    pipe = DiffusionPipeline(f"unet:{unet_name}", fam, unet_p, clip_ps,
+                             vae_p, prediction_type=fam.unet.prediction_type,
+                             assets_dir=models_dir)
+    pipe.cache_token = key
     with _pipeline_lock:
         _pipeline_cache[key] = pipe
     return pipe

@@ -147,6 +147,66 @@ class ModelMergeSimple(Op):
                                          unet_params=merged),)
 
 
+def _arith_trees(t1, t2, fn):
+    """Per-leaf arithmetic of two structurally-equal param trees in
+    fp32, cast back to the first tree's dtype."""
+    import jax
+
+    def leaf(a, b):
+        return fn(jnp.asarray(a, jnp.float32),
+                  jnp.asarray(b, jnp.float32)) \
+            .astype(jnp.asarray(a).dtype)
+
+    return jax.tree_util.tree_map(leaf, t1, t2)
+
+
+@register_op
+class ModelMergeAdd(Op):
+    """Weight-space sum ``model1 + model2`` — the "add difference"
+    workflow's second half (apply a ModelMergeSubtract delta onto a
+    base)."""
+    TYPE = "ModelMergeAdd"
+
+    def execute(self, ctx: OpContext, model1, model2):
+        if model1.family.unet != model2.family.unet:
+            raise ValueError("ModelMergeAdd: UNet configs differ "
+                             f"({model1.family.name} vs "
+                             f"{model2.family.name})")
+        tag = f"merge_add:{model2.cache_token}"
+        cached = registry.derived_cached(model1, tag)
+        if cached is not None:
+            return (cached,)
+        merged = _arith_trees(model1.unet_params, model2.unet_params,
+                              lambda a, b: a + b)
+        return (registry.derive_pipeline(model1, tag,
+                                         unet_params=merged),)
+
+
+@register_op
+class ModelMergeSubtract(Op):
+    """Weight-space difference ``model1 - multiplier * model2`` — the
+    "add difference" workflow's delta extraction."""
+    TYPE = "ModelMergeSubtract"
+    WIDGETS = ["multiplier"]
+    DEFAULTS = {"multiplier": 1.0}
+
+    def execute(self, ctx: OpContext, model1, model2,
+                multiplier: float = 1.0):
+        if model1.family.unet != model2.family.unet:
+            raise ValueError("ModelMergeSubtract: UNet configs differ "
+                             f"({model1.family.name} vs "
+                             f"{model2.family.name})")
+        m = float(multiplier)
+        tag = f"merge_sub:{model2.cache_token}:{m}"
+        cached = registry.derived_cached(model1, tag)
+        if cached is not None:
+            return (cached,)
+        merged = _arith_trees(model1.unet_params, model2.unet_params,
+                              lambda a, b: a - m * b)
+        return (registry.derive_pipeline(model1, tag,
+                                         unet_params=merged),)
+
+
 @register_op
 class ModelMergeBlocks(Op):
     """Per-section merge ratios (the reference's input/middle/out block
@@ -648,6 +708,65 @@ class VAELoader(Op):
     def execute(self, ctx: OpContext, vae_name: str):
         return (registry.load_vae(str(vae_name),
                                   models_dir=ctx.models_dir),)
+
+
+@register_op
+class CLIPLoader(Op):
+    """Standalone text encoder -> CLIP wire (usable by CLIPTextEncode
+    and friends); ``type`` picks the tower geometry
+    (registry.CLIP_TYPE_FAMILIES)."""
+    TYPE = "CLIPLoader"
+    WIDGETS = ["clip_name", "type"]
+    DEFAULTS = {"type": "stable_diffusion"}
+
+    def execute(self, ctx: OpContext, clip_name: str,
+                type: str = "stable_diffusion"):  # noqa: A002 - schema name
+        fam = registry.CLIP_TYPE_FAMILIES.get(str(type))
+        if fam is None:
+            raise ValueError(
+                f"CLIPLoader: unknown type {type!r}; available: "
+                f"{sorted(registry.CLIP_TYPE_FAMILIES)}")
+        if len(registry.FAMILIES[fam].clips) != 1:
+            raise ValueError(f"CLIPLoader: type {type!r} needs "
+                             "DualCLIPLoader (two towers)")
+        return (registry.load_clip([str(clip_name)],
+                                   models_dir=ctx.models_dir,
+                                   family_name=fam),)
+
+
+@register_op
+class DualCLIPLoader(Op):
+    """Two standalone text encoders -> one dual-tower CLIP wire
+    (sdxl: clip_name1 = CLIP-L, clip_name2 = OpenCLIP bigG)."""
+    TYPE = "DualCLIPLoader"
+    WIDGETS = ["clip_name1", "clip_name2", "type"]
+    DEFAULTS = {"type": "sdxl"}
+
+    def execute(self, ctx: OpContext, clip_name1: str, clip_name2: str,
+                type: str = "sdxl"):  # noqa: A002 - schema name
+        fam = registry.CLIP_TYPE_FAMILIES.get(str(type))
+        if fam is None or len(registry.FAMILIES[fam].clips) != 2:
+            raise ValueError(
+                f"DualCLIPLoader: type {type!r} is not a two-tower "
+                "family")
+        return (registry.load_clip([str(clip_name1), str(clip_name2)],
+                                   models_dir=ctx.models_dir,
+                                   family_name=fam),)
+
+
+@register_op
+class UNETLoader(Op):
+    """Standalone diffusion model -> MODEL wire; family detected from
+    the filename.  ``weight_dtype`` accepted for schema parity (weight
+    storage is governed by DTPU_BF16_WEIGHTS)."""
+    TYPE = "UNETLoader"
+    WIDGETS = ["unet_name", "weight_dtype"]
+    DEFAULTS = {"weight_dtype": "default"}
+
+    def execute(self, ctx: OpContext, unet_name: str,
+                weight_dtype: str = "default"):
+        return (registry.load_unet(str(unet_name),
+                                   models_dir=ctx.models_dir),)
 
 
 @register_op
@@ -2272,6 +2391,52 @@ class ImageInvert(Op):
 
 
 @register_op
+class ImageBlend(Op):
+    """Blend two image batches: ``image2`` composited onto ``image1``
+    with the named mode, then lerped by ``blend_factor`` (ComfyUI's
+    mode set; image2 resizes to image1's dims when they differ)."""
+    TYPE = "ImageBlend"
+    WIDGETS = ["blend_factor", "blend_mode"]
+    DEFAULTS = {"blend_factor": 0.5, "blend_mode": "normal"}
+
+    MODES = ("normal", "multiply", "screen", "overlay", "soft_light",
+             "difference")
+
+    def execute(self, ctx: OpContext, image1, image2,
+                blend_factor: float = 0.5, blend_mode: str = "normal"):
+        a = np.asarray(as_image_array(image1), np.float32)
+        b = np.asarray(as_image_array(image2), np.float32)
+        if b.shape[1:3] != a.shape[1:3]:
+            b = resize_image(b, a.shape[2], a.shape[1], "bilinear")
+        b = _cycle_batch(b, a.shape[0])
+        mode = str(blend_mode)
+        if mode == "normal":
+            blended = b
+        elif mode == "multiply":
+            blended = a * b
+        elif mode == "screen":
+            blended = 1.0 - (1.0 - a) * (1.0 - b)
+        elif mode == "overlay":
+            blended = np.where(a <= 0.5, 2.0 * a * b,
+                               1.0 - 2.0 * (1.0 - a) * (1.0 - b))
+        elif mode == "soft_light":
+            # W3C/Photoshop piecewise form (ComfyUI's)
+            g = np.where(a <= 0.25,
+                         ((16.0 * a - 12.0) * a + 4.0) * a,
+                         np.sqrt(np.maximum(a, 0.0)))
+            blended = np.where(b <= 0.5,
+                               a - (1.0 - 2.0 * b) * a * (1.0 - a),
+                               a + (2.0 * b - 1.0) * (g - a))
+        elif mode == "difference":
+            blended = np.abs(a - b)
+        else:
+            raise ValueError(f"ImageBlend: unknown mode {mode!r}; "
+                             f"available: {self.MODES}")
+        f = float(blend_factor)
+        return (np.clip(a * (1.0 - f) + blended * f, 0.0, 1.0),)
+
+
+@register_op
 class ImageBatchOp(Op):
     """Concatenate two image batches; the second resizes to the first's
     dims when they differ (ComfyUI bilinear).  (Class named ...Op: the
@@ -2917,6 +3082,27 @@ class InpaintModelConditioning(Op):
         (out_d,) = _expand_encoded_latent(ctx, pixels, orig_lat)
         if str(noise_mask).lower() not in ("false", "0", ""):
             out_d["noise_mask"] = m
+        return (pos2, neg2, out_d)
+
+
+@register_op
+class InstructPixToPixConditioning(Op):
+    """InstructPix2Pix prep: the source image's latent rides every model
+    call as concat channels (8-channel UNets), sampling starts from a
+    zero latent of the same spatial dims; both CFG sides carry the
+    concat (the ecosystem sets it on positive AND negative)."""
+    TYPE = "InstructPixToPixConditioning"
+
+    def execute(self, ctx: OpContext, positive: Conditioning,
+                negative: Conditioning, vae, pixels):
+        img = np.asarray(as_image_array(pixels), np.float32)
+        with Timer("ip2p_cond_encode"):
+            concat = np.asarray(vae.vae_encode(jnp.asarray(img)),
+                                np.float32)
+        pos2 = dataclasses.replace(positive, concat_latent=concat)
+        neg2 = dataclasses.replace(negative, concat_latent=concat)
+        (out_d,) = _expand_encoded_latent(ctx, pixels,
+                                          np.zeros_like(concat))
         return (pos2, neg2, out_d)
 
 

@@ -2788,3 +2788,155 @@ class TestGligenCarryFlags:
         assert prep3.gligen_objs[2] == (0, 1)
         assert prep3.gligen_objs[0].shape[0] == 2   # stacked [S, ...]
         registry.clear_pipeline_cache()
+
+
+class TestComponentLoadersRound5:
+    """CLIPLoader / DualCLIPLoader / UNETLoader: standalone towers
+    assemble into usable wires (reference-ecosystem split-checkpoint
+    workflows)."""
+
+    def test_clip_save_load_round_trip(self, tmp_path):
+        """CLIPSave's in-checkpoint-prefix export reloads through
+        load_clip into a tower that encodes IDENTICALLY."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        pipe = registry.load_pipeline("cliprt.ckpt")
+        octx = OpContext(output_dir=str(tmp_path))
+        get_op("CLIPSave").execute(octx, pipe, "tower")
+        loaded = registry.load_clip(["tower.safetensors"],
+                                    models_dir=str(tmp_path),
+                                    family_name="tiny")
+        a, _ = pipe.encode_prompt(["a red fox"])
+        b, _ = loaded.encode_prompt(["a red fox"])
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_clip_loader_op_virtual_and_type_validation(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        (clip,) = get_op("CLIPLoader").execute(OpContext(), "solo.bin",
+                                               "stable_diffusion")
+        ctx_arr, _ = clip.encode_prompt(["x"])
+        assert ctx_arr.shape[0] == 1
+        with pytest.raises(ValueError):
+            get_op("CLIPLoader").execute(OpContext(), "x.bin", "nope")
+        with pytest.raises(ValueError):   # sdxl needs the dual loader
+            get_op("CLIPLoader").execute(OpContext(), "x.bin", "sdxl")
+
+    def test_dual_clip_loader_sdxl_towers(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        (clip,) = get_op("DualCLIPLoader").execute(
+            OpContext(), "clip_l.safetensors", "clip_g.safetensors",
+            "sdxl")
+        assert len(clip.clip_params) == 2
+        ctx_arr, pooled = clip.encode_prompt(["x"])
+        # SDXL concat: CLIP-L width + bigG width
+        assert ctx_arr.shape[-1] == sum(c.width
+                                        for c in clip.family.clips)
+
+    def test_unet_loader_samples_end_to_end(self):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        (model,) = get_op("UNETLoader").execute(OpContext(),
+                                                "tiny-solo-unet.sft")
+        assert model.family.name == "tiny"
+        pos = Conditioning(context=model.encode_prompt(["x"])[0])
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        (out,) = get_op("KSampler").execute(OpContext(), model, 3, 2,
+                                            3.0, "euler", "normal", pos,
+                                            pos, lat, 1.0)
+        assert np.isfinite(np.asarray(out["samples"])).all()
+
+
+class TestModelMergeArithmetic:
+    """ModelMergeAdd / ModelMergeSubtract — the add-difference pair."""
+
+    def test_subtract_then_add_round_trips(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        a = registry.load_pipeline("ma.ckpt")
+        b = registry.load_pipeline("mb.ckpt")
+        octx = OpContext()
+        (delta,) = get_op("ModelMergeSubtract").execute(octx, a, b, 1.0)
+        (back,) = get_op("ModelMergeAdd").execute(octx, delta, b)
+        import jax
+        for la, lb in zip(jax.tree_util.tree_leaves(a.unet_params),
+                          jax.tree_util.tree_leaves(back.unet_params)):
+            np.testing.assert_allclose(np.asarray(la, np.float32),
+                                       np.asarray(lb, np.float32),
+                                       rtol=1e-3, atol=1e-3)
+
+    def test_family_mismatch_raises(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        a = registry.load_pipeline("ma.ckpt")
+        c = registry.load_pipeline("inp.ckpt",
+                                   family_name="tiny_inpaint")
+        with pytest.raises(ValueError):
+            get_op("ModelMergeAdd").execute(OpContext(), a, c)
+
+
+class TestImageBlendOp:
+    def _imgs(self):
+        a = np.full((1, 4, 4, 3), 0.5, np.float32)
+        b = np.full((1, 4, 4, 3), 0.25, np.float32)
+        return a, b
+
+    def test_modes(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        a, b = self._imgs()
+        op = get_op("ImageBlend")
+        octx = OpContext()
+        (normal,) = op.execute(octx, a, b, 1.0, "normal")
+        np.testing.assert_allclose(normal, 0.25)
+        (mult,) = op.execute(octx, a, b, 1.0, "multiply")
+        np.testing.assert_allclose(mult, 0.125)
+        (scr,) = op.execute(octx, a, b, 1.0, "screen")
+        np.testing.assert_allclose(scr, 1 - 0.5 * 0.75, rtol=1e-6)
+        (diff,) = op.execute(octx, a, b, 1.0, "difference")
+        np.testing.assert_allclose(diff, 0.25)
+        (ovl,) = op.execute(octx, a, b, 1.0, "overlay")
+        np.testing.assert_allclose(ovl, 0.25, rtol=1e-6)  # a<=0.5: 2ab
+        (half,) = op.execute(octx, a, b, 0.5, "normal")
+        np.testing.assert_allclose(half, 0.375)
+        (soft,) = op.execute(octx, a, b, 1.0, "soft_light")
+        assert np.all((soft >= 0) & (soft <= 1))
+        with pytest.raises(ValueError):
+            op.execute(octx, a, b, 1.0, "dodge")
+
+    def test_mismatched_sizes_resize(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        a = np.zeros((1, 8, 8, 3), np.float32)
+        b = np.ones((1, 4, 4, 3), np.float32)
+        (out,) = get_op("ImageBlend").execute(OpContext(), a, b, 1.0,
+                                              "normal")
+        assert out.shape == a.shape
+        np.testing.assert_allclose(out, 1.0)
+
+
+class TestInstructPixToPix:
+    def test_conditioning_and_sampling(self, monkeypatch):
+        from comfyui_distributed_tpu.ops.base import (Conditioning,
+                                                      OpContext, get_op)
+        registry.clear_pipeline_cache()
+        monkeypatch.delenv(registry.FAMILY_ENV, raising=False)
+        assert registry.detect_family("tiny-ip2p.ckpt") == "tiny_ip2p"
+        assert registry.detect_family(
+            "instruct-pix2pix-00-22000.safetensors") == "sd15_ip2p"
+        pipe = registry.load_pipeline("tiny-ip2p.ckpt")
+        assert pipe.family.unet.in_channels == 8
+        octx = OpContext()
+        img = np.random.default_rng(0).random((1, 16, 16, 3)
+                                              ).astype(np.float32)
+        pos = Conditioning(context=pipe.encode_prompt(["make it snowy"])[0])
+        neg = Conditioning(context=pipe.encode_prompt([""])[0])
+        (p2, n2, lat) = get_op("InstructPixToPixConditioning").execute(
+            octx, pos, neg, pipe, img)
+        assert p2.concat_latent is not None
+        assert n2.concat_latent is not None
+        np.testing.assert_array_equal(np.asarray(lat["samples"]), 0.0)
+        assert lat["samples"].shape[-1] == 4
+        (out,) = get_op("KSampler").execute(octx, pipe, 3, 2, 3.0,
+                                            "euler", "normal", p2, n2,
+                                            lat, 1.0)
+        assert np.isfinite(np.asarray(out["samples"])).all()
+        registry.clear_pipeline_cache()
