@@ -1,0 +1,34 @@
+#!/bin/bash
+# Refresh every CPU-runnable round artifact at the CURRENT code.
+# Run near the end of a round so the committed artifacts describe the
+# final code (the pattern r3/r4 followed).  Usage:
+#   bash benchmarks/refresh_cpu_artifacts.sh r5
+set -u
+cd "$(dirname "$0")/.."
+R=${1:-$(python -c 'import bench; print(bench.ROUND)')}
+
+run() { echo "== $*"; "$@" || echo "!! rc=$? ($*)"; }
+
+# SPMD partitioning overhead, virtual 8-device mesh (BASELINE method)
+run python bench.py --scaling-sweep --platform cpu \
+  --out benchmarks/scaling_virtual_$R.json
+# multi-process DCN-analog overhead (jax.distributed over CPU/Gloo)
+run python bench.py --multiproc-sweep --multiproc-procs 2 \
+  --out benchmarks/multiproc_cpu_$R.json
+run python bench.py --multiproc-sweep --multiproc-procs 4 \
+  --out benchmarks/multiproc4_cpu_$R.json
+# ring attention liveness on a virtual seq mesh (tiny 128px)
+run python bench.py --platform cpu --cpu-devices 4 --attn ring \
+  --family tiny --height 128 --width 128 --steps 4 --repeats 1 \
+  --out benchmarks/ring_virtual_$R.json
+# harness liveness smokes (tiny CPU)
+run python bench.py --platform cpu --family tiny --height 128 --width 128 \
+  --steps 4 --repeats 1 --out benchmarks/tiny_cpu_smoke_$R.json
+run python bench.py --platform cpu --upscale --family tiny \
+  --upscale-target 128 --tile 64 --steps 1 --repeats 1 \
+  --out benchmarks/tiny_cpu_upscale_smoke_$R.json
+run python bench.py --platform cpu --img2img --family tiny \
+  --height 64 --width 64 --steps 2 --repeats 1 \
+  --out benchmarks/tiny_cpu_img2img_smoke_$R.json
+echo "== artifacts:"
+ls -la benchmarks/*_$R.json 2>/dev/null

@@ -1223,7 +1223,10 @@ def load_vae(vae_name: str, models_dir: Optional[str] = None,
     forms real VAE files use — full-checkpoint style (``first_stage_model.
     encoder...``) and bare (``encoder...``, e.g. vae-ft-mse-840000) —
     and virtually initializes when no file exists."""
-    fam = FAMILIES[family_name or os.environ.get(FAMILY_ENV) or "sd15"]
+    # 'tiny' only — a broader 'test' substring would match real names
+    # like 'latest' and map a real VAE onto tiny geometry
+    default = "tiny" if "tiny" in vae_name.lower() else "sd15"
+    fam = FAMILIES[family_name or os.environ.get(FAMILY_ENV) or default]
     key = f"vae:{vae_name}:{fam.name}:{models_dir or ''}"
     with _pipeline_lock:
         if key in _pipeline_cache:
@@ -1263,6 +1266,8 @@ CLIP_TYPE_FAMILIES = {
     "sd1": "sd15",
     "sd2": "sd21",
     "sdxl": "sdxl",
+    "tiny": "tiny",    # test geometry (same convention as the other
+                       # standalone loaders' tiny-name detection)
 }
 
 

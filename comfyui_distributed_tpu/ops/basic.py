@@ -1525,13 +1525,16 @@ def adm_cond_source(family, e: Conditioning, positive: Conditioning):
     return e if e.pooled is not None else positive
 
 
-def entry_sigma_range(schedule, e: Conditioning):
+def entry_sigma_range(model_or_schedule, e: Conditioning):
     """timestep_range percents -> (sigma_start, sigma_end) bounds
     against THIS model's schedule (active while s_end <= sigma <=
-    s_start), or None."""
+    s_start), or None.  Accepts the model/pipeline OR a schedule and
+    resolves ``.schedule`` lazily — wrapper models without one must
+    keep working when no entry carries a timestep_range."""
     tr = getattr(e, "timestep_range", None)
     if tr is None:
         return None
+    schedule = getattr(model_or_schedule, "schedule", model_or_schedule)
     return (schedule.percent_to_sigma(float(tr[0])),
             schedule.percent_to_sigma(float(tr[1])))
 
@@ -1721,7 +1724,7 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                 # per-sample masks ride the data axis like the noise
                 # mask; single-row masks stay replicated
                 am = coll.shard_batch(np.asarray(am), mesh)
-            srange = entry_sigma_range(model.schedule, e)
+            srange = entry_sigma_range(model, e)
             out.append((ce, am,
                         float(getattr(e, "area_strength", 1.0)), srange))
             if adm:
