@@ -502,10 +502,13 @@ class TestClusterActions:
             assert r.status == 200 and await r.json() == {}
 
             # no enabled workers -> fan-out is a no-op but self still acts
+            from comfyui_distributed_tpu.runtime import interrupt as itr
             r = await client.post("/distributed/cluster/interrupt")
             assert r.status == 200
             assert (await r.json())["workers"] == {}
             assert state.interrupt_event.is_set()
+            itr.clear_interrupt()  # the process-global sampler flag
+            # (conftest's _no_leaked_interrupt also guards every test)
 
             r = await client.post("/distributed/cluster/clear_memory")
             assert r.status == 200
