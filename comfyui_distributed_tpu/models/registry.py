@@ -160,6 +160,17 @@ FAMILIES: Dict[str, ModelFamily] = {
 FAMILY_ENV = "DTPU_DEFAULT_FAMILY"
 
 
+def _window_key(w):
+    """Hashable form of a ControlNet sigma-window spec: None, one
+    (start, end) pair, or the ops-layer nested per-block structure."""
+    if w is None:
+        return None
+    if isinstance(w, (tuple, list)) and w \
+            and isinstance(w[0], (tuple, list, type(None))):
+        return tuple(_window_key(x) for x in w)
+    return (float(w[0]), float(w[1]))
+
+
 def _strength_key(strength):
     """ControlNet strength as a hashable static value: a scalar, a flat
     per-block tuple, or ops/basic.py's ``(pos_strengths, neg_strengths)``
@@ -583,7 +594,10 @@ class DiffusionPipeline:
                       bool(force_full_denoise), noise_mask is not None,
                       control is not None,
                       _strength_key(control[3]) if control is not None
-                      else 0.0)
+                      else 0.0,
+                      _window_key(control[4])
+                      if control is not None and len(control) > 4
+                      else None)
 
         def make_core():
             has_y = y is not None
@@ -596,7 +610,8 @@ class DiffusionPipeline:
             sranges = [sr for _, _, _, sr in conds + unconds]
             sampler = smp.get_sampler(sampler_name)
             if has_control:
-                cn_module, _, _, cn_strength = control
+                cn_module, cn_strength = control[0], control[3]
+                cn_window = control[4] if len(control) > 4 else None
 
                 def cn_apply(p, xi, ts, ctx, hint, y_in):
                     return cn_module.apply({"params": p}, xi, ts, ctx,
@@ -610,14 +625,24 @@ class DiffusionPipeline:
                 ctrl_spec = None
                 if has_control:
                     sk = _strength_key(cn_strength)
+                    cw = cn_window
                     if (isinstance(sk, tuple) and len(sk) == 2
                             and isinstance(sk[0], tuple)):
                         # ops-layer (pos_strengths, neg_strengths): flat
-                        # per-block tuple sized to the actual layout
+                        # per-block tuples sized to the actual layout —
+                        # windows flatten IN LOCKSTEP with strengths so
+                        # block i's gate stays block i's
                         pos_s, neg_s = sk
                         sk = tuple(pos_s) + (tuple(neg_s)
                                              if cfg_scale != 1.0 else ())
-                    ctrl_spec = (cn_apply, cn_params, hint_in, sk)
+                        if cw is not None:
+                            pos_w, neg_w = cw
+                            cw = tuple(pos_w) + (tuple(neg_w)
+                                                 if cfg_scale != 1.0
+                                                 else ())
+                    ctrl_spec = (cn_apply, cn_params, hint_in, sk) \
+                        if cw is None \
+                        else (cn_apply, cn_params, hint_in, sk, cw)
                 use_apply = self.raw_unet_apply
                 if ds_spec is not None:
                     # deep shrink: a lax.cond over two config-variant

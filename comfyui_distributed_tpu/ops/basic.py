@@ -262,6 +262,47 @@ class CLIPMergeSimple(Op):
 
 
 @register_op
+class CLIPMergeAdd(Op):
+    """Weight-space sum of two text towers (the add-difference pair's
+    second half on the CLIP side)."""
+    TYPE = "CLIPMergeAdd"
+
+    def execute(self, ctx: OpContext, clip1, clip2):
+        if len(clip1.clip_params) != len(clip2.clip_params):
+            raise ValueError("CLIPMergeAdd: tower counts differ")
+        tag = f"clipmerge_add:{clip2.cache_token}"
+        cached = registry.derived_cached(clip1, tag)
+        if cached is not None:
+            return (cached,)
+        merged = [_arith_trees(a, b, lambda x, y: x + y)
+                  for a, b in zip(clip1.clip_params, clip2.clip_params)]
+        return (registry.derive_pipeline(clip1, tag,
+                                         clip_params=merged),)
+
+
+@register_op
+class CLIPMergeSubtract(Op):
+    """Weight-space difference ``clip1 - multiplier * clip2``."""
+    TYPE = "CLIPMergeSubtract"
+    WIDGETS = ["multiplier"]
+    DEFAULTS = {"multiplier": 1.0}
+
+    def execute(self, ctx: OpContext, clip1, clip2,
+                multiplier: float = 1.0):
+        if len(clip1.clip_params) != len(clip2.clip_params):
+            raise ValueError("CLIPMergeSubtract: tower counts differ")
+        m = float(multiplier)
+        tag = f"clipmerge_sub:{clip2.cache_token}:{m}"
+        cached = registry.derived_cached(clip1, tag)
+        if cached is not None:
+            return (cached,)
+        merged = [_arith_trees(a, b, lambda x, y: x - m * y)
+                  for a, b in zip(clip1.clip_params, clip2.clip_params)]
+        return (registry.derive_pipeline(clip1, tag,
+                                         clip_params=merged),)
+
+
+@register_op
 class LoraLoaderModelOnly(Op):
     """LoraLoader that patches the UNet only (the CLIP stays wired to
     the base)."""
@@ -806,6 +847,81 @@ class ControlNetApply(Op):
             conditioning, control=spec,
             siblings=tuple(dataclasses.replace(s, control=spec)
                            for s in conditioning.siblings)),)
+
+
+@register_op
+class ControlNetApplyAdvanced(Op):
+    """ControlNetApply plus a sampling-percent window and separate
+    positive/negative outputs: the control's residuals contribute only
+    while start_percent <= progress <= end_percent (a traced sigma gate
+    in the denoiser), applied to BOTH CFG sides like the ecosystem
+    node."""
+    TYPE = "ControlNetApplyAdvanced"
+    WIDGETS = ["strength", "start_percent", "end_percent"]
+    DEFAULTS = {"strength": 1.0, "start_percent": 0.0, "end_percent": 1.0}
+
+    def execute(self, ctx: OpContext, positive: Conditioning,
+                negative: Conditioning, control_net, image,
+                strength: float = 1.0, start_percent: float = 0.0,
+                end_percent: float = 1.0):
+        if float(strength) == 0.0:
+            return (positive, negative)
+        module, params = control_net
+        hint = np.asarray(as_image_array(image), np.float32)
+        window = (float(start_percent), float(end_percent))
+        spec = (module, params, hint, float(strength), window)
+
+        def _attach(c: Conditioning) -> Conditioning:
+            return dataclasses.replace(
+                c, control=spec,
+                siblings=tuple(dataclasses.replace(s, control=spec)
+                               for s in c.siblings))
+
+        return (_attach(positive), _attach(negative))
+
+
+@register_op
+class DiffControlNetLoader(Op):
+    """'Difference' ControlNet loader: the stored weights are DELTAS
+    over the base model's encoder, so loading ADDS the given model's
+    matching parameter leaves (same tree path and shape) onto the net's
+    params — zero-convs and other net-only leaves pass through
+    untouched.  Returns a normal CONTROL_NET wire."""
+    TYPE = "DiffControlNetLoader"
+    WIDGETS = ["control_net_name"]
+
+    _cache: dict = {}
+
+    def execute(self, ctx: OpContext, model, control_net_name: str):
+        import jax
+        key = (model.cache_token, str(control_net_name),
+               ctx.models_dir or "")
+        hit = self._cache.get(key)
+        if hit is not None:   # don't redo a full-net add per prompt
+            return (hit,)
+        module, params = registry.load_controlnet(
+            str(control_net_name), models_dir=ctx.models_dir,
+            family_name=model.family.name)
+        unet_flat = {
+            jax.tree_util.keystr(path): leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                model.unet_params)[0]}
+        matched = [0]
+
+        def add_base(path, leaf):
+            base = unet_flat.get(jax.tree_util.keystr(path))
+            if base is not None and tuple(base.shape) == tuple(leaf.shape):
+                matched[0] += 1
+                return (jnp.asarray(leaf, jnp.float32)
+                        + jnp.asarray(base, jnp.float32)
+                        ).astype(jnp.asarray(leaf).dtype)
+            return leaf
+
+        summed = jax.tree_util.tree_map_with_path(add_base, params)
+        log(f"DiffControlNetLoader: added base-model weights into "
+            f"{matched[0]} shared leaves of {control_net_name}")
+        self._cache[key] = (module, summed)
+        return ((module, summed),)
 
 
 @register_op
@@ -1795,7 +1911,7 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
     control = next((c for c in map(_ctrl_of, all_entries)
                     if c is not None), None)
     if control is not None:
-        module, params, hint, _ = control
+        module, params, hint = control[0], control[1], control[2]
 
         def _same(c):
             return (c[0] is module and c[1] is params
@@ -1813,18 +1929,35 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
                 if _ctrl_of(e) is not None and _same(_ctrl_of(e)) else 0.0
                 for e in entries_)
 
-        # strengths BEFORE the hint rebinds below: _same closes over
-        # ``hint`` and must compare against the entries' ORIGINAL array
+        def _entry_window(e):
+            """Per-entry (start_pct, end_pct) — ControlNetApplyAdvanced;
+            each entry keeps its OWN window through the stacked call."""
+            c = _ctrl_of(e)
+            if c is None or not _same(c) or len(c) <= 4 or c[4] is None:
+                return None
+            return (float(c[4][0]), float(c[4][1]))
+
+        # strengths/windows BEFORE the hint rebinds below: _same closes
+        # over ``hint`` and must compare the entries' ORIGINAL array
         if middle is not None:
             # flat per-block [cond, middle, uncond] tuple — the dual
             # denoiser's 3-row layout (models/denoiser.py block rule)
             strengths = (_entry_strengths(pos_entries)[0],
                          _entry_strengths(mid_entries)[0],
                          _entry_strengths(neg_entries)[0])
+            windows = (_entry_window(pos_entries[0]),
+                       _entry_window(mid_entries[0]),
+                       _entry_window(neg_entries[0]))
+            flat_windows = windows
         else:
             pos_strengths = _entry_strengths(pos_entries)
             neg_strengths = _entry_strengths(neg_entries)
             strengths = (pos_strengths, neg_strengths)
+            windows = (tuple(map(_entry_window, pos_entries)),
+                       tuple(map(_entry_window, neg_entries)))
+            flat_windows = windows[0] + windows[1]
+        if all(w is None for w in flat_windows):
+            windows = None
         # hint image -> the resolution the hint ladder expects (8x the
         # latent dims — families with other VAE downscales still align)
         hh, ww = lat.shape[1] * 8, lat.shape[2] * 8
@@ -1836,6 +1969,23 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
             hint_dev = coll.shard_batch(np.asarray(hint, np.float32),
                                         ctx.runtime.mesh)
         control = (module, params, jnp.asarray(hint_dev), strengths)
+        if windows is not None:
+            sched = getattr(model, "schedule", None)
+            if sched is None:
+                log("ControlNetApplyAdvanced: model has no schedule; "
+                    "ignoring the start/end percent windows")
+            else:
+                def _to_sig(w):
+                    return None if w is None else (
+                        sched.percent_to_sigma(float(w[0])),
+                        sched.percent_to_sigma(float(w[1])))
+
+                if middle is not None:
+                    swins = tuple(_to_sig(w) for w in windows)
+                else:
+                    swins = (tuple(_to_sig(w) for w in windows[0]),
+                             tuple(_to_sig(w) for w in windows[1]))
+                control = control + (swins,)
 
     mask = latent_image.get("noise_mask")
     if mask is not None:
@@ -3657,6 +3807,37 @@ class CheckpointSave(Op):
         save_checkpoint(path, model.unet_params, clip.clip_params,
                         vae.vae_params, model.family)
         debug_log(f"CheckpointSave: wrote {path}")
+        return ()
+
+
+@register_op
+class ModelSave(Op):
+    """Export the diffusion model alone as a single-file safetensors
+    with ``model.diffusion_model.`` keys (loads back via UNETLoader and
+    in the reference ecosystem)."""
+    TYPE = "ModelSave"
+    OUTPUT_NODE = True
+    WIDGETS = ["filename_prefix"]
+    DEFAULTS = {"filename_prefix": "diffusion_models/save"}
+
+    def execute(self, ctx: OpContext, model,
+                filename_prefix: str = "diffusion_models/save"):
+        import jax
+        from comfyui_distributed_tpu.models.checkpoints import (
+            UNET_PREFIX, _ExportMapper, _run_unet, save_state_dict)
+        if any(getattr(a, "dtype", None) == jnp.bfloat16
+               for a in jax.tree_util.tree_leaves(model.unet_params)):
+            log("ModelSave: weights are stored bf16 (DTPU_BF16_WEIGHTS);"
+                " the exported file will be bf16 — set "
+                "DTPU_BF16_WEIGHTS=0 and reload for a full-precision "
+                "export")
+        path = _safe_output_path(ctx.output_dir or os.getcwd(),
+                                 f"{filename_prefix}.safetensors")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        sd = _run_unet(_ExportMapper(model.unet_params, UNET_PREFIX),
+                       model.family.unet)
+        save_state_dict(sd, path)
+        debug_log(f"ModelSave: wrote {path}")
         return ()
 
 

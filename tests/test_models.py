@@ -2940,3 +2940,50 @@ class TestInstructPixToPix:
                                             lat, 1.0)
         assert np.isfinite(np.asarray(out["samples"])).all()
         registry.clear_pipeline_cache()
+
+
+class TestRound5SaveMergeTail:
+    def test_clip_merge_subtract_then_add_round_trips(self):
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        a = registry.load_pipeline("cma.ckpt")
+        b = registry.load_pipeline("cmb.ckpt")
+        octx = OpContext()
+        (delta,) = get_op("CLIPMergeSubtract").execute(octx, a, b, 1.0)
+        (back,) = get_op("CLIPMergeAdd").execute(octx, delta, b)
+        import jax
+        for ta, tb in zip(a.clip_params, back.clip_params):
+            for la, lb in zip(jax.tree_util.tree_leaves(ta),
+                              jax.tree_util.tree_leaves(tb)):
+                np.testing.assert_allclose(np.asarray(la, np.float32),
+                                           np.asarray(lb, np.float32),
+                                           rtol=1e-3, atol=1e-3)
+
+    def test_model_save_unet_loader_round_trip(self, tmp_path,
+                                               monkeypatch):
+        """ModelSave's model.diffusion_model export reloads through
+        UNETLoader into a pipeline whose UNet forward matches."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        pipe = registry.load_pipeline("msave.ckpt")
+        octx = OpContext(output_dir=str(tmp_path),
+                         models_dir=str(tmp_path))
+        get_op("ModelSave").execute(octx, pipe, "unet_rt")
+        monkeypatch.delenv(registry.FAMILY_ENV, raising=False)
+        # geometry validation: the tiny-geometry file against the
+        # name-detected sd15 config must FAIL LOUDLY, not mis-load
+        with pytest.raises(KeyError):
+            get_op("UNETLoader").execute(octx, "unet_rt.safetensors")
+        registry.clear_pipeline_cache()
+        loaded = registry.load_unet("unet_rt.safetensors",
+                                    models_dir=str(tmp_path),
+                                    family_name="tiny")
+        import jax
+        x = jnp.zeros((1, 8, 8, 4))
+        ts = jnp.zeros((1,))
+        c = jnp.zeros((1, 77, pipe.family.unet.context_dim))
+        a = pipe.unet.apply({"params": pipe.unet_params}, x, ts, c)
+        b = loaded.unet.apply({"params": loaded.unet_params}, x, ts, c)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+        registry.clear_pipeline_cache()
