@@ -32,7 +32,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from comfyui_distributed_tpu.models.clip import CLIPConfig
-from comfyui_distributed_tpu.models.unet import UNetConfig
+from comfyui_distributed_tpu.models.unet import UNetConfig, mid_depth
 from comfyui_distributed_tpu.models.vae import VAEConfig
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 
@@ -336,7 +336,7 @@ def _run_unet(m, cfg: UNetConfig):
 
     _map_resblock(m, "middle_block.0", "mid_res_0")
     _map_spatial_transformer(m, "middle_block.1", "mid_attn",
-                             max(cfg.transformer_depth[-1], 1),
+                             mid_depth(cfg),
                              linear_proj=cfg.use_linear_in_transformer)
     _map_resblock(m, "middle_block.2", "mid_res_1")
 
@@ -399,7 +399,7 @@ def _run_controlnet(m, cfg: UNetConfig):
 
     _map_resblock(m, "middle_block.0", "mid_res_0")
     _map_spatial_transformer(m, "middle_block.1", "mid_attn",
-                             max(cfg.transformer_depth[-1], 1),
+                             mid_depth(cfg),
                              linear_proj=cfg.use_linear_in_transformer)
     _map_resblock(m, "middle_block.2", "mid_res_1")
     m.conv("middle_block_out.0", "mid_out")
@@ -565,6 +565,9 @@ CLIP_PREFIXES_SDXL = ("conditioner.embedders.0.transformer.text_model.",
 
 
 def _clip_prefixes(family) -> List[str]:
+    declared = getattr(family, "clip_prefixes", None)
+    if declared is not None:   # layout fact lives ON the family (e.g.
+        return list(declared)  # sdxl_refiner's SGM embedder-0 bigG)
     if len(family.clips) == 1:
         layout = getattr(family.clips[0], "layout", "hf")
         return [CLIP_PREFIX_SD2 if layout == "openclip" else CLIP_PREFIX_SD15]
@@ -603,6 +606,8 @@ EXPECTED_NONPARAM_KEYS = (
     "cond_stage_model.transformer.text_model.embeddings.position_ids",
     "conditioner.embedders.0.transformer.text_model.embeddings.position_ids",
     "conditioner.embedders.1.model.logit_scale",
+    # refiner: the bigG tower is embedder 0
+    "conditioner.embedders.0.model.logit_scale",
     "cond_stage_model.logit_scale",
     # SD2.x OpenCLIP tower buffers (FrozenOpenCLIPEmbedder keeps the
     # causal mask and logit scale in the state dict)

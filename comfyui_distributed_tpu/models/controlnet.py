@@ -30,7 +30,7 @@ from comfyui_distributed_tpu.models.layers import (
     SpatialTransformer,
     timestep_embedding,
 )
-from comfyui_distributed_tpu.models.unet import UNetConfig
+from comfyui_distributed_tpu.models.unet import mid_depth, UNetConfig
 
 # input_hint_block channel/stride ladder (torch ControlNet: 8 convs, three
 # stride-2 steps take the image-res hint down 8x to latent resolution)
@@ -113,7 +113,7 @@ class ControlNet(nn.Module):
         mid_ch = ch * cfg.channel_mult[-1]
         h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_0")(h, emb)
         h = SpatialTransformer(
-            heads(mid_ch), depth=max(cfg.transformer_depth[-1], 1),
+            heads(mid_ch), depth=mid_depth(cfg),
             dtype=cfg.dtype, attn_impl=cfg.attn_impl,
             name="mid_attn")(h, context)
         h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_1")(h, emb)

@@ -50,6 +50,10 @@ class ModelFamily:
     # embeds) or "unclip" (noise-augmented CLIP-vision embed + noise
     # level embedding — ops/basic.py _sdxl_vector_cond)
     adm_kind: str = "sdxl"
+    # in-checkpoint key prefixes for the text tower(s), when the family
+    # deviates from the standard cond_stage_model/conditioner layouts
+    # (checkpoints._clip_prefixes falls back to those when None)
+    clip_prefixes: Optional[Tuple[str, ...]] = None
 
 
 FAMILIES: Dict[str, ModelFamily] = {
@@ -64,6 +68,18 @@ FAMILIES: Dict[str, ModelFamily] = {
         unet=unet_mod.SDXL_CONFIG,
         vae=vae_mod.SDXL_VAE_CONFIG,
         clips=(clip_mod.CLIP_L_SDXL_CONFIG, clip_mod.OPEN_CLIP_BIGG_CONFIG),
+    ),
+    # SDXL refiner: bigG tower only (embedder 0 in the refiner file),
+    # 2560-channel ADM with the 5-scalar (h, w, crop_h, crop_w,
+    # aesthetic_score) embedding layout CLIPTextEncodeSDXLRefiner emits
+    "sdxl_refiner": ModelFamily(
+        name="sdxl_refiner",
+        unet=unet_mod.SDXL_REFINER_CONFIG,
+        vae=vae_mod.SDXL_VAE_CONFIG,
+        clips=(clip_mod.OPEN_CLIP_BIGG_CONFIG,),
+        # the refiner stores its (only) bigG tower as embedder 0 of the
+        # SGM conditioner, not under cond_stage_model
+        clip_prefixes=("conditioner.embedders.0.model.",),
     ),
     "sd21": ModelFamily(
         name="sd21",
@@ -202,6 +218,8 @@ def detect_family(ckpt_name: str) -> str:
     if "unclip" in lowered:
         return "sd21_unclip"
     if "xl" in lowered:
+        if "refiner" in lowered:
+            return "sdxl_refiner"
         return "sdxl_inpaint" if inpaint else "sdxl"
     # Stability SD2 naming only — a bare "v2" would misroute SD1.5
     # community finetunes like anything-v2 / counterfeit-v2.5

@@ -42,6 +42,10 @@ class UNetConfig:
     context_dim: int = 768
     num_head_channels: int = 64
     num_heads: Optional[int] = None  # fixed head count overrides head_channels
+    # middle-block transformer depth; None = max(transformer_depth[-1], 1)
+    # (SGM's transformer_depth_middle — the SDXL refiner has NO attention
+    # at its last level but a depth-4 middle)
+    transformer_depth_middle: Optional[int] = None
     # SDXL class/vector conditioning (text-emb pooled + size conds)
     adm_in_channels: Optional[int] = None
     # checkpoint-layout metadata only: torch stores spatial-transformer
@@ -96,6 +100,28 @@ SDXL_CONFIG = UNetConfig(
     adm_in_channels=2816,
     use_linear_in_transformer=True,
 )
+
+# SDXL refiner (sd_xl_refiner.yaml): 384 base channels over 4 levels,
+# depth-4 transformers at the two middle levels only, bigG-only context
+# (1280), ADM = pooled(1280) + 5 scalar embeddings (height, width,
+# crop_h, crop_w, aesthetic_score) x 256 = 2560
+SDXL_REFINER_CONFIG = UNetConfig(
+    model_channels=384,
+    channel_mult=(1, 2, 4, 4),
+    transformer_depth=(0, 4, 4, 0),
+    transformer_depth_middle=4,
+    context_dim=1280,
+    adm_in_channels=2560,
+    use_linear_in_transformer=True,
+)
+
+
+def mid_depth(cfg: "UNetConfig") -> int:
+    """Middle-block transformer depth — ONE copy of the rule, shared
+    with the checkpoint converter's key walk."""
+    if cfg.transformer_depth_middle is not None:
+        return int(cfg.transformer_depth_middle)
+    return max(cfg.transformer_depth[-1], 1)
 
 # SD2.1: SD1.x topology with per-level head_channels=64 (not fixed 8
 # heads), OpenCLIP-H context (1024), linear transformer projections;
@@ -247,7 +273,7 @@ class UNet(nn.Module):
         mid_ch = ch * cfg.channel_mult[-1]
         h = ResBlock(mid_ch, dtype=cfg.dtype, name="mid_res_0")(h, emb)
         h = SpatialTransformer(
-            heads(mid_ch), depth=max(cfg.transformer_depth[-1], 1),
+            heads(mid_ch), depth=mid_depth(cfg),
             dtype=cfg.dtype, attn_impl=cfg.attn_impl,
             hypertile_tile=ht_tile(cfg.num_levels - 1),
             sow_probs=cfg.sag_capture, gligen=cfg.gligen,

@@ -2144,9 +2144,17 @@ def _sdxl_vector_cond(pipe, cond: Conditioning, batch: int,
     if pooled is None:
         pooled = jnp.zeros((1, 1280))
     sc = getattr(cond, "size_cond", None)
-    sizes = jnp.asarray([[float(v) for v in sc]] if sc is not None
-                        else [[height, width, 0, 0, height, width]],
-                        jnp.float32)
+    if sc is None:
+        # fallback scalar layout when the encode node didn't supply one:
+        # base SDXL = (H, W, 0, 0, H, W); the REFINER's 5th slot is the
+        # aesthetic score — filling it with the image height would sit
+        # far outside the trained ~2-10 range, so emit (H, W, 0, 0, 6.0)
+        # (the ecosystem's default ascore) for refiner families
+        if getattr(pipe.family, "name", "").endswith("refiner"):
+            sc = (height, width, 0, 0, 6.0)
+        else:
+            sc = (height, width, 0, 0, height, width)
+    sizes = jnp.asarray([[float(v) for v in sc]], jnp.float32)
     emb = timestep_embedding(sizes.reshape(-1), 256).reshape(1, -1)
     vec = jnp.concatenate([pooled, emb], axis=-1)
     want = pipe.family.unet.adm_in_channels

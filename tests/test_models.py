@@ -2987,3 +2987,109 @@ class TestRound5SaveMergeTail:
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-3)
         registry.clear_pipeline_cache()
+
+
+class TestSDXLRefinerFamily:
+    def test_detection_geometry_and_prefix(self, monkeypatch):
+        monkeypatch.delenv(registry.FAMILY_ENV, raising=False)
+        assert registry.detect_family("sd_xl_refiner_1.0.safetensors") \
+            == "sdxl_refiner"
+        assert registry.detect_family("sd_xl_base_1.0.safetensors") \
+            == "sdxl"
+        fam = registry.FAMILIES["sdxl_refiner"]
+        assert fam.unet.model_channels == 384
+        assert fam.unet.transformer_depth == (0, 4, 4, 0)
+        assert fam.unet.transformer_depth_middle == 4
+        assert fam.unet.context_dim == 1280
+        assert fam.unet.adm_in_channels == 2560
+        assert len(fam.clips) == 1
+        assert fam.clips[0].layout == "openclip"
+        from comfyui_distributed_tpu.models.checkpoints import \
+            _clip_prefixes
+        assert _clip_prefixes(fam) == ["conditioner.embedders.0.model."]
+
+    def test_refiner_shaped_unet_forward_and_key_walk(self):
+        """A scaled-down refiner geometry (edge levels without attention
+        + an explicit middle depth) must forward AND round-trip through
+        the converter's key walk (missing/extra keys fail loudly)."""
+        import dataclasses as dc
+
+        import jax
+        from comfyui_distributed_tpu.models.checkpoints import (
+            _ExportMapper, _LoadMapper, _run_unet)
+        from comfyui_distributed_tpu.models.unet import (UNet, UNetConfig,
+                                                         mid_depth)
+        cfg = UNetConfig(model_channels=16, channel_mult=(1, 2, 4, 4),
+                         num_res_blocks=1,
+                         transformer_depth=(0, 1, 1, 0),
+                         transformer_depth_middle=2,
+                         context_dim=32, num_head_channels=8,
+                         adm_in_channels=48,
+                         use_linear_in_transformer=True,
+                         dtype=jnp.float32)
+        assert mid_depth(cfg) == 2
+        model = UNet(cfg)
+        x = jnp.zeros((1, 16, 16, 4))
+        ts = jnp.zeros((1,))
+        c = jnp.zeros((1, 7, 32))
+        y = jnp.zeros((1, 48))
+        params = model.init(jax.random.PRNGKey(0), x, ts, c, y=y)["params"]
+        out = model.apply({"params": params}, x, ts, c, y=y)
+        assert out.shape == x.shape
+        sd = _run_unet(_ExportMapper(params, ""), cfg)
+        # the middle transformer carries BOTH depth blocks in the export
+        assert any("middle_block.1.transformer_blocks.1." in k
+                   for k in sd)
+        back = _run_unet(_LoadMapper(sd, ""), cfg)
+        for a, b in zip(jax.tree_util.tree_leaves(params),
+                        jax.tree_util.tree_leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_refiner_ascore_reaches_full_width_adm(self):
+        """The 5th scalar (aesthetic_score) lands in the 2560-wide
+        refiner ADM vector: different scores give different vectors."""
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        from comfyui_distributed_tpu.ops.basic import _sdxl_vector_cond
+
+        class _U:
+            adm_in_channels = 2560
+
+        class _F:
+            unet = _U()
+
+        class _P:
+            family = _F()
+
+        pooled = np.full((1, 1280), 0.2, np.float32)
+        vecs = {}
+        for score in (2.0, 9.0):
+            vecs[score] = np.asarray(_sdxl_vector_cond(
+                _P(), Conditioning(context=None, pooled=pooled,
+                                   size_cond=(64, 64, 0, 0, score)),
+                1, 64, 64))
+        assert vecs[2.0].shape == (1, 2560)
+        assert not np.allclose(vecs[2.0], vecs[9.0])
+
+    def test_refiner_size_cond_steers_sampling(self):
+        """CLIPTextEncodeSDXLRefiner's scalar conditioning reaches the
+        UNet end-to-end: different size scalars give different samples
+        (tiny_sdxl stand-in — its 128-wide ADM carries the pooled + the
+        first scalar's embedding)."""
+        from comfyui_distributed_tpu.ops.base import OpContext, get_op
+        registry.clear_pipeline_cache()
+        p = registry.load_pipeline("ref-asc.ckpt",
+                                   family_name="tiny_sdxl")
+        octx = OpContext()
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        outs = {}
+        for height in (32, 640):
+            (cond,) = get_op("CLIPTextEncodeSDXLRefiner").execute(
+                octx, p, 6.0, 64, height, "crisp photo")
+            assert cond.size_cond == (height, 64, 0, 0, 6.0)
+            (out,) = get_op("KSampler").execute(
+                octx, p, 3, 2, 3.0, "euler", "normal", cond, cond, lat,
+                1.0)
+            outs[height] = np.asarray(out["samples"])
+        assert np.isfinite(outs[32]).all()
+        assert not np.allclose(outs[32], outs[640])
+        registry.clear_pipeline_cache()
