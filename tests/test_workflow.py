@@ -1248,3 +1248,43 @@ class TestIp2pFixture:
         assert np.isfinite(imgs).all()
         assert not np.allclose(imgs[0], imgs[1])   # distinct seeds
         registry.clear_pipeline_cache()
+
+
+class TestSdxlRefinerFixture:
+    """distributed-sdxl-refiner.json: the canonical two-stage SDXL flow
+    — base denoises [0, end) with leftover noise, the refiner finishes —
+    fanned out by DistributedSeed through BOTH stages."""
+
+    def test_two_stage_handoff_fans_out(self, tmp_path, monkeypatch):
+        from comfyui_distributed_tpu.workflow import (WorkflowExecutor,
+                                                      parse_workflow)
+        monkeypatch.setenv("DTPU_DEFAULT_FAMILY", "tiny_sdxl")
+        registry.clear_pipeline_cache()
+        rt = mesh_mod.MeshRuntime(mesh=mesh_mod.build_mesh(
+            {"data": 2, "tensor": 1, "seq": 1},
+            devices=jax.devices()[:2]))
+        ctx = OpContext(runtime=rt, output_dir=str(tmp_path / "out"))
+        g = parse_workflow(
+            "/root/repo/workflows/distributed-sdxl-refiner.json")
+        g.nodes["3"].inputs.update(width=64, height=64, batch_size=1)
+        g.nodes["8"].inputs.update(steps=4, end_at_step=3)
+        g.nodes["9"].inputs.update(steps=4, start_at_step=3)
+        res = WorkflowExecutor(ctx).execute(g)
+        assert len(res.images) == 2          # fan-out through BOTH stages
+        imgs = np.stack(res.images)
+        assert np.isfinite(imgs).all()
+        assert not np.allclose(imgs[0], imgs[1])   # distinct seeds
+        # the refiner stage actually changes the latent: base-only
+        # (full denoise, no second stage) differs from the handoff
+        g2 = parse_workflow(
+            "/root/repo/workflows/distributed-sdxl-refiner.json")
+        g2.nodes["3"].inputs.update(width=64, height=64, batch_size=1)
+        g2.nodes["8"].inputs.update(steps=4, end_at_step=10000)
+        g2.nodes["8"].inputs["return_with_leftover_noise"] = "disable"
+        g2.nodes["10"].inputs["samples"] = ["8", 0]
+        del g2.nodes["9"]          # orphaned refiner stage: don't pay for it
+        res2 = WorkflowExecutor(
+            OpContext(runtime=rt, output_dir=str(tmp_path / "o2"))
+        ).execute(g2)
+        assert not np.allclose(np.stack(res2.images), imgs)
+        registry.clear_pipeline_cache()
