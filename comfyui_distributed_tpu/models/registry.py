@@ -286,8 +286,8 @@ class DiffusionPipeline:
     # --- tensor parallelism -------------------------------------------------
 
     def _ensure_tp_sharded(self) -> None:
-        """Lay the UNet params out for tensor parallelism when the live
-        mesh has a ``tensor`` axis (megatron-style column splits via
+        """Lay the UNet/CLIP/VAE params out for tensor parallelism
+        when the live mesh has a ``tensor`` axis (megatron-style column splits via
         ``parallel/sharding.params_shardings``; GSPMD inserts the
         matching collectives inside the jitted sample core).  No-op on
         tensor==1 meshes and when already laid out for this mesh, so the
@@ -310,11 +310,19 @@ class DiffusionPipeline:
         with self._lock:
             if self._tp_mesh is mesh:
                 return
-            sh = shd.params_shardings(self.unet_params, mesh,
-                                      min_elements=min_el)
-            self.unet_params = shd.apply_shardings(self.unet_params, sh)
+
+            def lay_out(tree):
+                if not tree:
+                    return tree
+                sh = shd.params_shardings(tree, mesh,
+                                          min_elements=min_el)
+                return shd.apply_shardings(tree, sh)
+
+            self.unet_params = lay_out(self.unet_params)
+            self.clip_params = [lay_out(p) for p in self.clip_params]
+            self.vae_params = lay_out(self.vae_params)
             self._tp_mesh = mesh
-            log(f"tp: UNet params laid out over tensor="
+            log(f"tp: UNet/CLIP/VAE params laid out over tensor="
                 f"{int(mesh.shape[TENSOR_AXIS])} for serving")
 
     # --- text ---------------------------------------------------------------
@@ -338,6 +346,7 @@ class DiffusionPipeline:
         from comfyui_distributed_tpu.models.tokenizer import (
             encode_with_embeddings, has_embedding_refs)
 
+        self._ensure_tp_sharded()
         outs, pooled = [], None
         for i, (m, p) in enumerate(zip(self.clip_models,
                                        self.clip_params)):
@@ -371,6 +380,7 @@ class DiffusionPipeline:
     # --- latents ------------------------------------------------------------
 
     def vae_encode(self, images: jnp.ndarray) -> jnp.ndarray:
+        self._ensure_tp_sharded()
         fn = self._jitted("vae_enc", lambda p, x: self.vae.apply(
             {"params": p}, x, method=self.vae.encode))
         return fn(self.vae_params, images)
@@ -396,6 +406,7 @@ class DiffusionPipeline:
             check_interrupt=check_interrupt))
 
     def vae_decode(self, latents: jnp.ndarray) -> jnp.ndarray:
+        self._ensure_tp_sharded()
         fn = self._jitted("vae_dec", lambda p, z: self.vae.apply(
             {"params": p}, z, method=self.vae.decode))
         return fn(self.vae_params, latents)
@@ -487,8 +498,8 @@ class DiffusionPipeline:
         per-sample ADM array (replicated over every block) or a list
         with one array per entry, conds first then unconds.
         The denoise loop is jit-compiled and cached per static config."""
-        # serving-side tensor parallelism: lay the UNet params out over
-        # the mesh's tensor axis before they enter the jitted core
+        # serving-side tensor parallelism: lay the tower params out
+        # over the mesh's tensor axis before they enter the jitted core
         self._ensure_tp_sharded()
 
         def _norm(entries):

@@ -294,6 +294,8 @@ class TestServingTensorParallel:
                     cfg=5.0, sampler_name="euler", scheduler="normal"))
 
             oracle = run()                       # replicated weights
+            lat_img = jnp.ones((1, 8, 8, 4), jnp.float32) * 0.3
+            dec_oracle = np.asarray(pipe.vae_decode(lat_img))
             assert pipe._tp_mesh is None
             mesh = mesh_mod.build_mesh(
                 {DATA_AXIS: 2, TENSOR_AXIS: 2, SEQ_AXIS: 1},
@@ -301,6 +303,10 @@ class TestServingTensorParallel:
             mesh_mod.set_runtime(mesh_mod.MeshRuntime(mesh=mesh))
             tp = run()                           # tp-laid-out weights
             assert pipe._tp_mesh is mesh
+            # CLIP + VAE towers lay out too and stay on-oracle
+            dec_tp = np.asarray(pipe.vae_decode(lat_img))
+            np.testing.assert_allclose(dec_tp, dec_oracle,
+                                       rtol=2e-4, atol=2e-4)
             # some leaves actually sharded over tensor
             sharded = [
                 x for x in jax.tree_util.tree_leaves(pipe.unet_params)
