@@ -1154,28 +1154,125 @@ def sample_res_multistep(model: Model, x: jax.Array, sigmas: jax.Array,
     """RES second-order exponential multistep (Refined Exponential
     Solver, arXiv:2308.02157 — the ecosystem's ``res_multistep``),
     deterministic variant: one model call per step, the previous
-    denoised extrapolates via phi-weighted Adams-Bashforth
-    coefficients (b1 + b2 = phi_1 for consistency, b2*c2 = phi_2 for
-    second order; first step falls back to the first-order exponential
-    update)."""
+    denoised extrapolates via phi-weighted Adams-Bashforth coefficients
+    (first step falls back to the first-order exponential update).
+    One shared body serves all four variants (``_res_multistep_core``)."""
+    return _res_multistep_core(model, x, sigmas, extra_args, keys,
+                               eta=0.0, cfg_pp=False)
+
+
+def _res_multistep_core(model: Model, x: jax.Array, sigmas: jax.Array,
+                        extra_args: Optional[Dict[str, Any]],
+                        keys: Optional[jax.Array], eta: float,
+                        cfg_pp: bool) -> jax.Array:
+    """Shared RES multistep body: deterministic (eta=0) or ancestral
+    (sigma_down/up split + per-step noise), optionally CFG++ (the step's
+    exponential decay anchors on the uncond denoised — the same
+    ``last_uncond`` side-channel the euler CFG++ samplers read)."""
     extra = extra_args or {}
+    if eta > 0 and keys is None:
+        raise ValueError("res_multistep_ancestral requires per-sample "
+                         "keys")
+    noise_fn = make_noise_fn(keys) if eta > 0 else None
+    sample_shape = x.shape[1:]
     sig = sigmas
 
     def step(carry, step_i, s, s_next):
         x, old_denoised = carry
         denoised = model(x, s, **extra)
+        anchor = _last_uncond(model, denoised) if cfg_pp else denoised
+        sd, su = (_ancestral_sigmas(s, s_next, eta) if eta > 0
+                  else (s_next, jnp.asarray(0.0, x.dtype)))
         t = -jnp.log(s)
-        t_next = -jnp.log(jnp.maximum(s_next, 1e-20))
+        t_next = -jnp.log(jnp.maximum(sd, 1e-20))
         h = t_next - t
         t_old = -jnp.log(sig[jnp.maximum(step_i - 1, 0)])
-        # c2 = (t_old - t)/h < 0: the "stage" sits at the PREVIOUS point
         c2 = jnp.where(step_i > 0, (t_old - t) / h, -1.0)
-        phi1, phi2 = _phi1(-h), _phi2(-h)
-        b2 = phi2 / c2
-        b1 = phi1 - b2
-        x_ms = jnp.exp(-h) * x + h * (b1 * denoised + b2 * old_denoised)
-        x_first = jnp.exp(-h) * x + h * phi1 * denoised
-        x_new = jnp.where(step_i > 0, x_ms, x_first)
+        b2 = _phi2(-h) / c2
+        # first-order part: plain = e^-h x - expm1(-h) D; cfg_pp anchors
+        # the exponential decay on the UNCOND (D + e^-h (x - anchor) —
+        # euler_cfg_pp's update in exponential form); both reduce to the
+        # same thing for a bare model.  The 2nd-order correction
+        # h*b2*(D_old - D) is identical algebra either way:
+        # h*(b1 D + b2 D_old) == -expm1(-h) D + h b2 (D_old - D).
+        base = (denoised + jnp.exp(-h) * (x - anchor)) if cfg_pp \
+            else (jnp.exp(-h) * x - jnp.expm1(-h) * denoised)
+        x_ms = base + h * b2 * (old_denoised - denoised)
+        x_new = jnp.where(step_i > 0, x_ms, base)
+        if eta > 0:
+            x_new = x_new + noise_fn(step_i, sample_shape) * su
+        x = jnp.where(s_next > 0, x_new, denoised)
+        return (x, denoised), None
+
+    return _scan_sampler(step, x, sigmas, carry_init=jnp.zeros_like(x))
+
+
+def sample_res_multistep_cfg_pp(model: Model, x: jax.Array,
+                                sigmas: jax.Array,
+                                extra_args: Optional[Dict[str, Any]] = None,
+                                keys: Optional[jax.Array] = None
+                                ) -> jax.Array:
+    """res_multistep with the CFG++ anchor (uncond denoised drives the
+    exponential decay; reduces to res_multistep for a bare model)."""
+    return _res_multistep_core(model, x, sigmas, extra_args, keys,
+                               eta=0.0, cfg_pp=True)
+
+
+def sample_res_multistep_ancestral(model: Model, x: jax.Array,
+                                   sigmas: jax.Array,
+                                   extra_args: Optional[Dict[str, Any]] = None,
+                                   keys: Optional[jax.Array] = None,
+                                   eta: float = 1.0) -> jax.Array:
+    """Ancestral res_multistep: the multistep update targets sigma_down
+    and fresh noise tops back up to sigma_next."""
+    return _res_multistep_core(model, x, sigmas, extra_args, keys,
+                               eta=eta, cfg_pp=False)
+
+
+def sample_res_multistep_ancestral_cfg_pp(
+        model: Model, x: jax.Array, sigmas: jax.Array,
+        extra_args: Optional[Dict[str, Any]] = None,
+        keys: Optional[jax.Array] = None, eta: float = 1.0) -> jax.Array:
+    """Ancestral res_multistep with the CFG++ anchor."""
+    return _res_multistep_core(model, x, sigmas, extra_args, keys,
+                               eta=eta, cfg_pp=True)
+
+
+def sample_dpmpp_2m_cfg_pp(model: Model, x: jax.Array, sigmas: jax.Array,
+                           extra_args: Optional[Dict[str, Any]] = None,
+                           keys: Optional[jax.Array] = None) -> jax.Array:
+    """DPM-Solver++(2M) with the CFG++ anchor: the multistep
+    extrapolation uses the CFG denoised, the exponential decay anchors
+    on the uncond (``denoised + e^-h * (x - uncond)``) — reduces to
+    dpmpp_2m exactly for a bare model."""
+    extra = extra_args or {}
+    sig = sigmas
+
+    def t_of(s):
+        return -jnp.log(jnp.maximum(s, 1e-20))
+
+    def step(carry, step_i, s, s_next):
+        x, old_denoised = carry
+        denoised = model(x, s, **extra)
+        anchor = _last_uncond(model, denoised)
+        t, t_next = t_of(s), t_of(jnp.maximum(s_next, 1e-20))
+        h = t_next - t
+        s_prev = sig[jnp.maximum(step_i - 1, 0)]
+        h_last = t_of(s) - t_of(s_prev)
+
+        def ms_term(_):
+            r = h_last / h
+            return -jnp.expm1(-h) * (1.0 / (2.0 * r)) \
+                * (denoised - old_denoised)
+
+        extra_ms = jax.lax.cond(step_i > 0, ms_term,
+                                lambda _: jnp.zeros_like(denoised), None)
+        # D + e^-h (x - anchor): euler_cfg_pp's exponential-decay-on-
+        # uncond form; adding the standard 2M correction term reduces
+        # EXACTLY to dpmpp_2m for a bare model (anchor == D):
+        # D(1 - e^-h) + e^-h x - expm1(-h)(1/2r)(D - D_old)
+        #   == e^-h x - expm1(-h) D_d
+        x_new = denoised + jnp.exp(-h) * (x - anchor) + extra_ms
         x = jnp.where(s_next > 0, x_new, denoised)
         return (x, denoised), None
 
@@ -1449,6 +1546,10 @@ SAMPLERS: Dict[str, Callable] = {
     "uni_pc": sample_uni_pc,
     "uni_pc_bh2": sample_uni_pc_bh2,
     "res_multistep": sample_res_multistep,
+    "res_multistep_cfg_pp": sample_res_multistep_cfg_pp,
+    "res_multistep_ancestral": sample_res_multistep_ancestral,
+    "res_multistep_ancestral_cfg_pp": sample_res_multistep_ancestral_cfg_pp,
+    "dpmpp_2m_cfg_pp": sample_dpmpp_2m_cfg_pp,
     "gradient_estimation": sample_gradient_estimation,
     "er_sde": sample_er_sde,
     "sa_solver": sample_sa_solver,

@@ -1074,3 +1074,73 @@ class TestRound5SamplerLongTail:
         finally:
             os.environ.pop("DTPU_DEFAULT_FAMILY", None)
             registry.clear_pipeline_cache()
+
+
+class TestCfgPpLongTailVariants:
+    """res_multistep_cfg_pp / _ancestral(_cfg_pp) / dpmpp_2m_cfg_pp:
+    exact reductions + the uncond side-channel engaging."""
+
+    def _x(self, ds, steps=8):
+        x0 = jnp.full((1, 4, 4, 2), 0.4, jnp.float32)
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "karras", steps))
+        x = jnp.ones_like(x0) * sigmas[0]
+        return x0, sigmas, x
+
+    def test_cfg_pp_variants_reduce_to_plain_for_bare_model(self, ds):
+        x0, sigmas, x = self._x(ds)
+        a = smp.sample_res_multistep_cfg_pp(ideal_model(x0), x, sigmas)
+        b = smp.sample_res_multistep(ideal_model(x0), x, sigmas)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+        c = smp.sample_dpmpp_2m_cfg_pp(ideal_model(x0), x, sigmas)
+        d = smp.sample_dpmpp_2m(ideal_model(x0), x, sigmas)
+        np.testing.assert_allclose(np.asarray(c), np.asarray(d),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_ancestral_eta_zero_equals_deterministic(self, ds):
+        x0, sigmas, x = self._x(ds)
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(1, dtype=jnp.uint32))
+        a = smp.sample_res_multistep_ancestral(ideal_model(x0), x,
+                                               sigmas, keys=keys, eta=0.0)
+        b = smp.sample_res_multistep(ideal_model(x0), x, sigmas)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_cfg_pp_reads_the_uncond_side_channel(self, ds):
+        """Under a CFG wrapper with distinct cond/uncond targets the
+        CFG++ variant departs from the plain sampler."""
+        cond_t = jnp.full((1, 4, 4, 2), 0.5, jnp.float32)
+        unc_t = jnp.full((1, 4, 4, 2), -0.5, jnp.float32)
+
+        def raw(x, sigma, context=None, **kw):
+            B = x.shape[0] // 2
+            return jnp.concatenate(
+                [jnp.broadcast_to(cond_t, (B,) + cond_t.shape[1:]),
+                 jnp.broadcast_to(unc_t, (B,) + unc_t.shape[1:])])
+
+        cfg = smp.cfg_denoiser(raw, jnp.zeros((1, 7, 8)),
+                               jnp.zeros((1, 7, 8)), 3.0)
+        # STOP at a nonzero sigma: the stub denoises to a constant, so
+        # the final x=denoised step would erase the trajectory split
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "karras", 6))[:4]
+        x = jnp.zeros((1, 4, 4, 2), jnp.float32) + sigmas[0]
+        for pp, plain in ((smp.sample_res_multistep_cfg_pp,
+                           smp.sample_res_multistep),
+                          (smp.sample_dpmpp_2m_cfg_pp,
+                           smp.sample_dpmpp_2m)):
+            a = pp(cfg, x, sigmas)
+            b = plain(cfg, x, sigmas)
+            assert not np.allclose(np.asarray(a), np.asarray(b)), pp
+
+    def test_ancestral_keyed_noise_contract(self, ds):
+        sigmas = jnp.asarray(sch.compute_sigmas(ds, "normal", 8))[:5]
+        ka = jax.vmap(jax.random.PRNGKey)(jnp.asarray([1, 2], jnp.uint32))
+        kb = jax.vmap(jax.random.PRNGKey)(jnp.asarray([3, 4], jnp.uint32))
+        x = jnp.zeros((2, 4, 4, 1)) + sigmas[0]
+        x0 = jnp.zeros((2, 4, 4, 1))
+        fn = smp.sample_res_multistep_ancestral_cfg_pp
+        a = fn(ideal_model(x0), x, sigmas, keys=ka)
+        b = fn(ideal_model(x0), x, sigmas, keys=kb)
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+        with pytest.raises(ValueError):
+            fn(ideal_model(x0), x, sigmas)
