@@ -16,6 +16,77 @@ import jax.numpy as jnp
 from comfyui_distributed_tpu.models.schedules import DiscreteSchedule
 
 
+def _run_one_controlnet(spec, xin, ts, context, y, sigma):
+    """One ControlNet spec -> (scaled skip residuals, scaled mid).
+
+    ``spec`` = (cn_apply, cn_params, hint, strength[, windows]).
+    Optional (sigma_start, sigma_end) window(s) — ControlNetApplyAdvanced
+    start/end percents: a block's control contributes only while
+    s_end <= sigma <= s_start (traced select, same convention as the
+    conditioning timestep-range gate).  Window forms: None | one
+    (start, end) pair | a per-stacked-block tuple of pairs/None matching
+    the strength tuple — each entry keeps its OWN window.  When every
+    block is windowed the encoder forward is skipped entirely on
+    inactive steps (the reference skips out-of-range controls; paying a
+    full encoder forward for residuals multiplied by zero would double
+    the out-of-window step cost)."""
+    cn_apply, cn_params, hint, strength = spec[:4]
+    swindow = spec[4] if len(spec) > 4 else None
+    per_block = (isinstance(swindow, (tuple, list)) and swindow
+                 and isinstance(swindow[0], (tuple, list, type(None))))
+
+    def _gate(w):
+        if w is None:
+            return None
+        sig = jnp.max(sigma)
+        return jnp.logical_and(sig <= float(w[0]), sig >= float(w[1]))
+
+    gates = None
+    if swindow is not None:
+        gates = [_gate(w) for w in swindow] if per_block \
+            else [_gate(swindow)]
+    reps = xin.shape[0] // hint.shape[0]
+    hb = jnp.concatenate([hint] * reps, axis=0) if reps > 1 else hint
+
+    def run_cn(_):
+        return cn_apply(cn_params, xin, ts, context, hb, y)
+
+    if gates is not None and all(g is not None for g in gates):
+        any_active = gates[0]
+        for g in gates[1:]:
+            any_active = jnp.logical_or(any_active, g)
+        shapes = jax.eval_shape(run_cn, None)
+        zeros = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        outs, mid = jax.lax.cond(any_active, run_cn, lambda _: zeros,
+                                 None)
+    else:
+        outs, mid = run_cn(None)
+
+    def _gated(i, v):
+        if gates is None:
+            return v
+        g = gates[i] if per_block else gates[0]
+        return v if g is None else v * g.astype(xin.dtype)
+
+    if isinstance(strength, (tuple, list)):
+        # one strength per stacked block; the producer (registry.sample)
+        # sizes the tuple to the block layout
+        assert len(strength) == reps, (len(strength), reps)
+        if reps == 1:
+            scale = _gated(0, jnp.asarray(float(strength[0]), xin.dtype))
+        else:
+            b = hint.shape[0]
+            scale = jnp.concatenate(
+                [jnp.broadcast_to(
+                    _gated(i, jnp.asarray(float(s), xin.dtype)),
+                    (b, 1, 1, 1))
+                 for i, s in enumerate(strength)], axis=0)
+    else:
+        scale = _gated(0, strength) if gates is not None else strength
+    return ([o * scale for o in outs], mid * scale)
+
+
 def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
                   prediction_type: str = "eps",
                   control: Optional[tuple] = None,
@@ -75,77 +146,21 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
         xin = x * c_in
         ctrl = None
         if control is not None:
-            cn_apply, cn_params, hint, strength = control[:4]
-            # optional (sigma_start, sigma_end) window(s) — ComfyUI's
-            # ControlNetApplyAdvanced start/end percents: a block's
-            # control contributes only while s_end <= sigma <= s_start
-            # (traced select, same convention as the conditioning
-            # timestep-range gate).  Forms: None | one (start, end) pair
-            # | a per-stacked-block tuple of pairs/None matching the
-            # strength tuple — each entry keeps its OWN window.
-            swindow = control[4] if len(control) > 4 else None
-            per_block = (isinstance(swindow, (tuple, list)) and swindow
-                         and isinstance(swindow[0],
-                                        (tuple, list, type(None))))
-
-            def _gate(w):
-                if w is None:
-                    return None
-                sig = jnp.max(sigma)
-                return jnp.logical_and(sig <= float(w[0]),
-                                       sig >= float(w[1]))
-
-            gates = None
-            if swindow is not None:
-                gates = [_gate(w) for w in swindow] if per_block \
-                    else [_gate(swindow)]
-            reps = xin.shape[0] // hint.shape[0]
-            hb = jnp.concatenate([hint] * reps, axis=0) if reps > 1 else hint
-
-            def run_cn(_):
-                return cn_apply(cn_params, xin, ts, context, hb, y)
-
-            if gates is not None and all(g is not None for g in gates):
-                # every block is windowed: skip the ControlNet encoder
-                # forward entirely on steps where no block is active
-                # (the reference skips out-of-range controls; paying a
-                # full encoder forward for residuals multiplied by zero
-                # would double the out-of-window step cost)
-                any_active = gates[0]
-                for g in gates[1:]:
-                    any_active = jnp.logical_or(any_active, g)
-                shapes = jax.eval_shape(run_cn, None)
-                zeros = jax.tree_util.tree_map(
-                    lambda s: jnp.zeros(s.shape, s.dtype), shapes)
-                outs, mid = jax.lax.cond(any_active, run_cn,
-                                         lambda _: zeros, None)
-            else:
-                outs, mid = run_cn(None)
-
-            def _gated(i, v):
-                if gates is None:
-                    return v
-                g = gates[i] if per_block else gates[0]
-                return v if g is None else v * g.astype(xin.dtype)
-
-            if isinstance(strength, (tuple, list)):
-                # one strength per stacked block; the producer
-                # (registry.sample) sizes the tuple to the block layout
-                assert len(strength) == reps, (len(strength), reps)
-                if reps == 1:
-                    scale = _gated(0, jnp.asarray(float(strength[0]),
-                                                  xin.dtype))
+            # one spec or a CHAIN of specs (ComfyUI's previous_controlnet
+            # accumulation): every net runs on the same scaled input and
+            # their scaled residuals SUM into the UNet
+            chain = control if isinstance(control, (list,)) \
+                or (isinstance(control, tuple) and control
+                    and isinstance(control[0], tuple)) else [control]
+            acc = None
+            for spec in chain:
+                one = _run_one_controlnet(spec, xin, ts, context, y, sigma)
+                if acc is None:
+                    acc = one
                 else:
-                    b = hint.shape[0]
-                    scale = jnp.concatenate(
-                        [jnp.broadcast_to(
-                            _gated(i, jnp.asarray(float(s), xin.dtype)),
-                            (b, 1, 1, 1))
-                         for i, s in enumerate(strength)], axis=0)
-            else:
-                scale = _gated(0, strength) if gates is not None \
-                    else strength
-            ctrl = ([o * scale for o in outs], mid * scale)
+                    acc = ([a + b for a, b in zip(acc[0], one[0])],
+                           acc[1] + one[1])
+            ctrl = acc
         if concat is not None:
             # AFTER the control block: a ControlNet sees the plain
             # 4-channel scaled input, only the UNet gets the 9 channels

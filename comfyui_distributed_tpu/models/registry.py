@@ -564,6 +564,12 @@ class DiffusionPipeline:
                           tuple(float(v) for v in sr) if sr is not None
                           else None) for c, m, s, sr in entries)
 
+        # normalize control to a CHAIN of per-net wire specs (the ops
+        # layer sends a tuple of (module, params, hint, strengths[,
+        # windows]) — ComfyUI's previous_controlnet chain; a single
+        # legacy spec becomes a 1-chain for direct callers)
+        if control is not None and not isinstance(control[0], tuple):
+            control = (control,)
         cfg_rescale = float(getattr(self, "cfg_rescale", 0.0) or 0.0)
         hn_spec = getattr(self, "hypernets", None) or None
         ds_spec = getattr(self, "deep_shrink_spec", None)
@@ -621,12 +627,10 @@ class DiffusionPipeline:
                        tuple(gligen_objs[2]))
                       if gligen_objs is not None else (),
                       bool(force_full_denoise), noise_mask is not None,
-                      control is not None,
-                      _strength_key(control[3]) if control is not None
-                      else 0.0,
-                      _window_key(control[4])
-                      if control is not None and len(control) > 4
-                      else None)
+                      tuple((_strength_key(c[3]),
+                             _window_key(c[4]) if len(c) > 4 else None)
+                            for c in control)
+                      if control is not None else None)
 
         def make_core():
             has_y = y is not None
@@ -639,12 +643,18 @@ class DiffusionPipeline:
             sranges = [sr for _, _, _, sr in conds + unconds]
             sampler = smp.get_sampler(sampler_name)
             if has_control:
-                cn_module, cn_strength = control[0], control[3]
-                cn_window = control[4] if len(control) > 4 else None
+                cn_modules = [c[0] for c in control]
+                cn_strengths = [c[3] for c in control]
+                cn_windows = [c[4] if len(c) > 4 else None
+                              for c in control]
 
-                def cn_apply(p, xi, ts, ctx, hint, y_in):
-                    return cn_module.apply({"params": p}, xi, ts, ctx,
-                                           hint, y_in)
+                def _make_apply(mod):
+                    def cn_apply(p, xi, ts, ctx, hint, y_in):
+                        return mod.apply({"params": p}, xi, ts, ctx,
+                                         hint, y_in)
+                    return cn_apply
+
+                cn_applies = [_make_apply(m) for m in cn_modules]
 
             has_concat = c_concat is not None
 
@@ -653,25 +663,29 @@ class DiffusionPipeline:
                      concat_in, objs_in):
                 ctrl_spec = None
                 if has_control:
-                    sk = _strength_key(cn_strength)
-                    cw = cn_window
-                    if (isinstance(sk, tuple) and len(sk) == 2
-                            and isinstance(sk[0], tuple)):
-                        # ops-layer (pos_strengths, neg_strengths): flat
-                        # per-block tuples sized to the actual layout —
-                        # windows flatten IN LOCKSTEP with strengths so
-                        # block i's gate stays block i's
-                        pos_s, neg_s = sk
-                        sk = tuple(pos_s) + (tuple(neg_s)
-                                             if cfg_scale != 1.0 else ())
-                        if cw is not None:
-                            pos_w, neg_w = cw
-                            cw = tuple(pos_w) + (tuple(neg_w)
+                    ctrl_spec = []
+                    for k in range(len(cn_applies)):
+                        sk = _strength_key(cn_strengths[k])
+                        cw = cn_windows[k]
+                        if (isinstance(sk, tuple) and len(sk) == 2
+                                and isinstance(sk[0], tuple)):
+                            # ops-layer (pos_strengths, neg_strengths):
+                            # flat per-block tuples sized to the actual
+                            # layout — windows flatten IN LOCKSTEP with
+                            # strengths so block i's gate stays block i's
+                            pos_s, neg_s = sk
+                            sk = tuple(pos_s) + (tuple(neg_s)
                                                  if cfg_scale != 1.0
                                                  else ())
-                    ctrl_spec = (cn_apply, cn_params, hint_in, sk) \
-                        if cw is None \
-                        else (cn_apply, cn_params, hint_in, sk, cw)
+                            if cw is not None:
+                                pos_w, neg_w = cw
+                                cw = tuple(pos_w) + (tuple(neg_w)
+                                                     if cfg_scale != 1.0
+                                                     else ())
+                        spec = (cn_applies[k], cn_params[k], hint_in[k],
+                                sk)
+                        ctrl_spec.append(spec if cw is None
+                                         else spec + (cw,))
                 use_apply = self.raw_unet_apply
                 if ds_spec is not None:
                     # deep shrink: a lax.cond over two config-variant
@@ -816,9 +830,10 @@ class DiffusionPipeline:
             y_arg = y
         mask_arg = noise_mask if noise_mask is not None \
             else jnp.ones((1, 1, 1, 1))
-        cn_params_arg = control[1] if control is not None else {}
-        hint_arg = control[2] if control is not None \
-            else jnp.zeros((1, 8, 8, 3))
+        cn_params_arg = [c[1] for c in control] if control is not None \
+            else [{}]
+        hint_arg = [c[2] for c in control] if control is not None \
+            else [jnp.zeros((1, 8, 8, 3))]
         ctx_list = [jnp.asarray(c) for c, _, _, _ in conds + unconds]
         area_list = [jnp.asarray(m) if m is not None
                      else jnp.ones((1, 1, 1, 1))

@@ -10,7 +10,7 @@ import dataclasses
 import json
 import math
 import os
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -822,6 +822,18 @@ class ControlNetLoader(Op):
                                          models_dir=ctx.models_dir),)
 
 
+def _control_chain(cond) -> tuple:
+    """A conditioning's ControlNet specs as a tuple (the chain).  A
+    single legacy 4/5-tuple spec (first element is the net module, not
+    another tuple) normalizes to a 1-chain; None to empty."""
+    c = getattr(cond, "control", None)
+    if c is None:
+        return ()
+    if isinstance(c, tuple) and c and not isinstance(c[0], tuple):
+        return (c,)
+    return tuple(c)
+
+
 @register_op
 class ControlNetApply(Op):
     """Attach a ControlNet + hint image to a conditioning at the given
@@ -843,10 +855,17 @@ class ControlNetApply(Op):
         module, params = control_net
         hint = np.asarray(as_image_array(image), np.float32)
         spec = (module, params, hint, float(strength))
+
+        def _attach(c: Conditioning) -> Conditioning:
+            # CHAIN, don't replace: applying a second net accumulates
+            # (ComfyUI's previous_controlnet chain — residuals sum)
+            return dataclasses.replace(
+                c, control=_control_chain(c) + (spec,))
+
+        out = _attach(conditioning)
         return (dataclasses.replace(
-            conditioning, control=spec,
-            siblings=tuple(dataclasses.replace(s, control=spec)
-                           for s in conditioning.siblings)),)
+            out, siblings=tuple(_attach(s)
+                                for s in conditioning.siblings)),)
 
 
 @register_op
@@ -872,10 +891,12 @@ class ControlNetApplyAdvanced(Op):
         spec = (module, params, hint, float(strength), window)
 
         def _attach(c: Conditioning) -> Conditioning:
+            chained = _control_chain(c) + (spec,)
             return dataclasses.replace(
-                c, control=spec,
-                siblings=tuple(dataclasses.replace(s, control=spec)
-                               for s in c.siblings))
+                c, control=chained,
+                siblings=tuple(dataclasses.replace(
+                    s, control=_control_chain(s) + (spec,))
+                    for s in c.siblings))
 
         return (_attach(positive), _attach(negative))
 
@@ -1899,93 +1920,113 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
         else:
             y = y_conds[0] if adm else None
 
-    # control may hang on ANY conditioning entry (ComfyUI honors all).
-    # One net/hint runs per step; its strength becomes a per-ENTRY tuple
-    # so only the carrying entries' blocks are steered (a control on the
-    # right-region sibling must not steer the left region).  Entries
-    # carrying a DIFFERENT net/hint are dropped loudly — the single
-    # stacked call can't run two nets
-    def _ctrl_of(e):
-        return getattr(e, "control", None)
+    # controls may hang on ANY conditioning entry (ComfyUI honors all),
+    # and each entry may CHAIN several nets (previous_controlnet
+    # accumulation).  EVERY unique (net, params, hint) runs per step —
+    # residuals sum in the denoiser — and each net's strength/window
+    # becomes a per-ENTRY tuple so only the carrying entries' blocks are
+    # steered (a control on the right-region sibling must not steer the
+    # left region).
+    nets: List[Tuple] = []   # (module, params, hint) in first-seen order
+    net_max_ord: List[int] = []   # per net: max chain repeats per entry
+    spec_slot: Dict[int, Tuple[int, int]] = {}  # id(spec) -> (net, ord)
 
-    control = next((c for c in map(_ctrl_of, all_entries)
-                    if c is not None), None)
-    if control is not None:
-        module, params, hint = control[0], control[1], control[2]
+    def _net_key_index(spec) -> int:
+        for i, (m, p, h) in enumerate(nets):
+            if spec[0] is m and spec[1] is p \
+                    and (spec[2] is h or np.array_equal(spec[2], h)):
+                return i
+        return -1
 
-        def _same(c):
-            return (c[0] is module and c[1] is params
-                    and (c[2] is hint or np.array_equal(c[2], hint)))
+    for e in all_entries:
+        counts: Dict[int, int] = {}
+        for spec in _control_chain(e):
+            i = spec_slot[id(spec)][0] if id(spec) in spec_slot \
+                else _net_key_index(spec)
+            if i < 0:
+                nets.append((spec[0], spec[1], spec[2]))
+                net_max_ord.append(0)
+                i = len(nets) - 1
+            # the same net chained TWICE on one entry keeps both links
+            # (distinct wire slots — ComfyUI runs every link and sums;
+            # the common two-windows-one-net pattern needs this)
+            j = counts.get(i, 0)
+            counts[i] = j + 1
+            spec_slot.setdefault(id(spec), (i, j))
+            net_max_ord[i] = max(net_max_ord[i], j + 1)
 
-        if any(c is not None and not _same(c)
-               for c in map(_ctrl_of, all_entries)):
-            debug_log("ControlNet: conditioning entries carry different "
-                      "controls/hints; applying the first only (one net "
-                      "runs per stacked call)")
+    control = None
+    if nets:
+        def _entry_spec(e, slot):
+            for spec in _control_chain(e):
+                if spec_slot.get(id(spec)) == slot:
+                    return spec
+            return None
 
-        def _entry_strengths(entries_):
-            return tuple(
-                float(_ctrl_of(e)[3])
-                if _ctrl_of(e) is not None and _same(_ctrl_of(e)) else 0.0
-                for e in entries_)
+        slots = [(i, j) for i, n in enumerate(net_max_ord)
+                 for j in range(n)]
+        sched = getattr(model, "schedule", None)
+        wire = []
+        for slot in slots:
+            module, params, hint = nets[slot[0]]
 
-        def _entry_window(e):
-            """Per-entry (start_pct, end_pct) — ControlNetApplyAdvanced;
-            each entry keeps its OWN window through the stacked call."""
-            c = _ctrl_of(e)
-            if c is None or not _same(c) or len(c) <= 4 or c[4] is None:
-                return None
-            return (float(c[4][0]), float(c[4][1]))
+            def _strength(e, _s=slot):
+                sp = _entry_spec(e, _s)
+                return float(sp[3]) if sp is not None else 0.0
 
-        # strengths/windows BEFORE the hint rebinds below: _same closes
-        # over ``hint`` and must compare the entries' ORIGINAL array
-        if middle is not None:
-            # flat per-block [cond, middle, uncond] tuple — the dual
-            # denoiser's 3-row layout (models/denoiser.py block rule)
-            strengths = (_entry_strengths(pos_entries)[0],
-                         _entry_strengths(mid_entries)[0],
-                         _entry_strengths(neg_entries)[0])
-            windows = (_entry_window(pos_entries[0]),
-                       _entry_window(mid_entries[0]),
-                       _entry_window(neg_entries[0]))
-            flat_windows = windows
-        else:
-            pos_strengths = _entry_strengths(pos_entries)
-            neg_strengths = _entry_strengths(neg_entries)
-            strengths = (pos_strengths, neg_strengths)
-            windows = (tuple(map(_entry_window, pos_entries)),
-                       tuple(map(_entry_window, neg_entries)))
-            flat_windows = windows[0] + windows[1]
-        if all(w is None for w in flat_windows):
-            windows = None
-        # hint image -> the resolution the hint ladder expects (8x the
-        # latent dims — families with other VAE downscales still align)
-        hh, ww = lat.shape[1] * 8, lat.shape[2] * 8
-        if hint.shape[1] != hh or hint.shape[2] != ww:
-            hint = resize_image(hint, ww, hh, "bilinear")
-        hint = _cycle_batch(hint, total)
-        hint_dev = hint
-        if fanout > 1 and ctx.runtime is not None:
-            hint_dev = coll.shard_batch(np.asarray(hint, np.float32),
-                                        ctx.runtime.mesh)
-        control = (module, params, jnp.asarray(hint_dev), strengths)
-        if windows is not None:
-            sched = getattr(model, "schedule", None)
-            if sched is None:
-                log("ControlNetApplyAdvanced: model has no schedule; "
-                    "ignoring the start/end percent windows")
+            def _window(e, _s=slot):
+                sp = _entry_spec(e, _s)
+                if sp is None or len(sp) <= 4 or sp[4] is None:
+                    return None
+                return (float(sp[4][0]), float(sp[4][1]))
+
+            if middle is not None:
+                # flat per-block [cond, middle, uncond] tuple — the dual
+                # denoiser's 3-row layout (models/denoiser.py block rule)
+                strengths = (_strength(pos_entries[0]),
+                             _strength(mid_entries[0]),
+                             _strength(neg_entries[0]))
+                windows = (_window(pos_entries[0]),
+                           _window(mid_entries[0]),
+                           _window(neg_entries[0]))
+                flat_windows = windows
             else:
-                def _to_sig(w):
-                    return None if w is None else (
-                        sched.percent_to_sigma(float(w[0])),
-                        sched.percent_to_sigma(float(w[1])))
-
-                if middle is not None:
-                    swins = tuple(_to_sig(w) for w in windows)
+                strengths = (tuple(_strength(e) for e in pos_entries),
+                             tuple(_strength(e) for e in neg_entries))
+                windows = (tuple(_window(e) for e in pos_entries),
+                           tuple(_window(e) for e in neg_entries))
+                flat_windows = windows[0] + windows[1]
+            if all(w is None for w in flat_windows):
+                windows = None
+            # hint image -> the resolution the hint ladder expects (8x
+            # the latent dims — other VAE downscales still align)
+            hh, ww = lat.shape[1] * 8, lat.shape[2] * 8
+            if hint.shape[1] != hh or hint.shape[2] != ww:
+                hint = resize_image(hint, ww, hh, "bilinear")
+            hint = _cycle_batch(hint, total)
+            hint_dev = hint
+            if fanout > 1 and ctx.runtime is not None:
+                hint_dev = coll.shard_batch(
+                    np.asarray(hint, np.float32), ctx.runtime.mesh)
+            spec_w = (module, params, jnp.asarray(hint_dev), strengths)
+            if windows is not None:
+                if sched is None:
+                    log("ControlNetApplyAdvanced: model has no schedule;"
+                        " ignoring the start/end percent windows")
                 else:
-                    swins = (tuple(_to_sig(w) for w in windows[0]),
-                             tuple(_to_sig(w) for w in windows[1]))
-                control = control + (swins,)
+                    def _to_sig(w):
+                        return None if w is None else (
+                            sched.percent_to_sigma(float(w[0])),
+                            sched.percent_to_sigma(float(w[1])))
+
+                    if middle is not None:
+                        swins = tuple(_to_sig(w) for w in windows)
+                    else:
+                        swins = (tuple(_to_sig(w) for w in windows[0]),
+                                 tuple(_to_sig(w) for w in windows[1]))
+                    spec_w = spec_w + (swins,)
+            wire.append(spec_w)
+        control = tuple(wire)
 
     mask = latent_image.get("noise_mask")
     if mask is not None:

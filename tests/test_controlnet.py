@@ -393,3 +393,107 @@ class TestPerEntryControlWindows:
                                  "normal", both, neg, lat, 1.0)
         assert not np.allclose(np.asarray(out_mixed["samples"]),
                                np.asarray(out_both["samples"]))
+
+
+class TestControlNetChaining:
+    """ComfyUI's previous_controlnet accumulation: a second apply CHAINS
+    (residuals sum) instead of replacing the first."""
+
+    def _setup(self):
+        pipe = reg.load_pipeline("cn-chain.ckpt")
+        m1, p1 = reg.load_controlnet("chain_a.safetensors")
+        m2, p2 = reg.load_controlnet("chain_b.safetensors")
+        boosted = jax.tree_util.tree_map(lambda a: a + 0.05, p1)
+        pos = Conditioning(context=pipe.encode_prompt(["a castle"])[0])
+        neg = Conditioning(context=pipe.encode_prompt([""])[0])
+        hint = np.random.default_rng(11).uniform(
+            0, 1, (1, 64, 64, 3)).astype(np.float32)
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        return pipe, (m1, boosted), (m2, p2), pos, neg, hint, lat
+
+    def _sample(self, pipe, cond, neg, lat):
+        (out,) = get_op("KSampler").execute(
+            OpContext(), pipe, 9, 2, 3.0, "euler", "normal", cond, neg,
+            lat, 1.0)
+        return np.asarray(out["samples"])
+
+    def test_zero_net_chain_is_additive_identity(self):
+        """boosted + fresh-virtual (zero-conv) chain == boosted alone,
+        bit-exact — the second net contributes exactly zero residuals."""
+        pipe, cn_b, cn_zero, pos, neg, hint, lat = self._setup()
+        (single,) = get_op("ControlNetApply").execute(
+            OpContext(), pos, cn_b, hint, 1.0)
+        (chained,) = get_op("ControlNetApply").execute(
+            OpContext(), single, cn_zero, hint, 1.0)
+        assert len(chained.control) == 2
+        a = self._sample(pipe, single, neg, lat)
+        b = self._sample(pipe, chained, neg, lat)
+        np.testing.assert_array_equal(a, b)
+
+    def test_two_live_nets_accumulate(self):
+        """Two boosted nets chained differ from either alone."""
+        pipe, cn_b, (m2, p2), pos, neg, hint, lat = self._setup()
+        cn_b2 = (m2, jax.tree_util.tree_map(lambda a: a + 0.03, p2))
+        (one,) = get_op("ControlNetApply").execute(
+            OpContext(), pos, cn_b, hint, 1.0)
+        (other,) = get_op("ControlNetApply").execute(
+            OpContext(), pos, cn_b2, hint, 1.0)
+        (both,) = get_op("ControlNetApply").execute(
+            OpContext(), one, cn_b2, hint, 1.0)
+        ra = self._sample(pipe, one, neg, lat)
+        rb = self._sample(pipe, other, neg, lat)
+        rc = self._sample(pipe, both, neg, lat)
+        assert not np.allclose(rc, ra)
+        assert not np.allclose(rc, rb)
+
+    def test_per_entry_nets_both_steer(self):
+        """Entry A carries net 1, entry B carries net 2 (via Combine):
+        BOTH nets now run — the old first-only drop made the combined
+        run identical to A-only."""
+        pipe, cn_b, (m2, p2), pos, neg, hint, lat = self._setup()
+        cn_b2 = (m2, jax.tree_util.tree_map(lambda a: a + 0.03, p2))
+        b_cond = Conditioning(context=pipe.encode_prompt(["a moat"])[0])
+        (a1,) = get_op("ControlNetApply").execute(
+            OpContext(), pos, cn_b, hint, 1.0)
+        (b1,) = get_op("ControlNetApply").execute(
+            OpContext(), b_cond, cn_b2, hint, 1.0)
+        (combined,) = get_op("ConditioningCombine").execute(
+            OpContext(), a1, b1)
+        (b_plain,) = get_op("ConditioningCombine").execute(
+            OpContext(), a1, b_cond)
+        rc = self._sample(pipe, combined, neg, lat)
+        rp = self._sample(pipe, b_plain, neg, lat)
+        assert not np.allclose(rc, rp), \
+            "the sibling's own net was dropped"
+
+
+class TestSameNetChainedTwice:
+    def test_two_links_of_one_net_sum(self):
+        """Chaining the SAME net twice at 0.5 each == one link at 1.0
+        (ComfyUI runs every chain link; residual scaling is linear in
+        strength, so the sums match exactly)."""
+        pipe = reg.load_pipeline("cn-dup.ckpt")
+        m, p = reg.load_controlnet("dup_cn.safetensors")
+        cn = (m, jax.tree_util.tree_map(lambda a: a + 0.05, p))
+        pos = Conditioning(context=pipe.encode_prompt(["a gate"])[0])
+        neg = Conditioning(context=pipe.encode_prompt([""])[0])
+        hint = np.random.default_rng(13).uniform(
+            0, 1, (1, 64, 64, 3)).astype(np.float32)
+        lat = {"samples": np.zeros((1, 8, 8, 4), np.float32)}
+        octx = OpContext()
+        (once,) = get_op("ControlNetApply").execute(octx, pos, cn, hint,
+                                                    1.0)
+        (h1,) = get_op("ControlNetApply").execute(octx, pos, cn, hint,
+                                                  0.5)
+        (h2,) = get_op("ControlNetApply").execute(octx, h1, cn, hint,
+                                                  0.5)
+        assert len(h2.control) == 2
+
+        def run(c):
+            (out,) = get_op("KSampler").execute(
+                OpContext(), pipe, 9, 2, 3.0, "euler", "normal", c, neg,
+                lat, 1.0)
+            return np.asarray(out["samples"])
+
+        np.testing.assert_allclose(run(h2), run(once), rtol=1e-4,
+                                   atol=1e-5)

@@ -1087,8 +1087,8 @@ class TestRegionalPromptingFixups:
         (ctl,) = get_op("ControlNetApply").execute(
             octx, comb, (module, params), hint, 0.9)
         assert ctl.control is not None
-        assert all(s.control is not None and s.control[3] == 0.9
-                   for s in ctl.siblings)
+        assert all(s.control is not None and s.control[0][3] == 0.9
+                   for s in ctl.siblings)      # 1-chain spec per entry
         registry.clear_pipeline_cache()
 
 
@@ -1531,7 +1531,7 @@ class TestCustomSamplingAdvanced:
                                       np.asarray(prep.y[0]))
         assert prep.mid_context.shape == prep.context.shape
         assert prep.control is not None
-        assert prep.control[3] == (0.0, 0.7, 0.0)
+        assert prep.control[0][3] == (0.0, 0.7, 0.0)  # 1-chain wire
 
     def test_dual_cfg_honors_rescale_patch(self):
         octx, get_op, p, pos, neg, lat, sampler, sig = \
