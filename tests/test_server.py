@@ -513,3 +513,54 @@ class TestClusterActions:
             r = await client.post("/distributed/cluster/clear_memory")
             assert r.status == 200
         run_with_client(body, tmp_path, start_exec_thread=False)
+
+
+class TestPromptExtraPnginfo:
+    def test_extra_data_reaches_saved_pngs(self, tmp_path):
+        """/prompt's extra_data.extra_pnginfo rides the exec thread into
+        SaveImage: the saved PNG embeds the prompt AND the workflow
+        chunk (the reference ships extra_pnginfo with every dispatch,
+        gpupanel.js:1344-1358)."""
+        from PIL import Image
+        prompt = {
+            "7": {"class_type": "CheckpointLoaderSimple",
+                  "inputs": {"ckpt_name": "tiny.safetensors"}},
+            "5": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "cat", "clip": ["7", 1]}},
+            "9": {"class_type": "EmptyLatentImage",
+                  "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+            "8": {"class_type": "KSampler",
+                  "inputs": {"model": ["7", 0], "positive": ["5", 0],
+                             "negative": ["5", 0], "latent_image": ["9", 0],
+                             "seed": 1, "steps": 1, "cfg": 1.0,
+                             "sampler_name": "euler", "scheduler": "normal",
+                             "denoise": 1.0}},
+            "1": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["8", 0], "vae": ["7", 2]}},
+            "3": {"class_type": "SaveImage",
+                  "inputs": {"images": ["1", 0],
+                             "filename_prefix": "meta_http"}},
+        }
+        ui_doc = {"nodes": [], "links": [], "note": "source workflow"}
+
+        async def body(client, state):
+            r = await client.post("/prompt", json={
+                "prompt": prompt, "client_id": "t",
+                "extra_data": {"extra_pnginfo": {"workflow": ui_doc}}})
+            assert r.status == 200
+            pid = (await r.json())["prompt_id"]
+            for _ in range(1800):
+                hist = await (await client.get("/history")).json()
+                if pid in hist:
+                    assert hist[pid]["status"] == "success", hist[pid]
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("prompt never finished")
+            outs = sorted(os.listdir(state.output_dir))
+            assert outs, "SaveImage wrote nothing"
+            im = Image.open(os.path.join(state.output_dir, outs[0]))
+            assert json.loads(im.info["workflow"]) == ui_doc
+            embedded = json.loads(im.info["prompt"])
+            assert set(embedded) == set(prompt)
+        run_with_client(body, tmp_path, start_exec_thread=True)
