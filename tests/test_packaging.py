@@ -28,6 +28,14 @@ class TestInstall:
             [sys.executable, "-m", "pip", "install", "--no-deps",
              "--no-build-isolation", "--target", str(target), REPO, "-q"],
             capture_output=True, text=True, timeout=300)
+        # the in-tree build leaves build/ + *.egg-info behind — a full
+        # stale copy of the package that double-counts every LoC audit
+        # of the checkout; the installed --target tree is all we need
+        import glob
+        import shutil
+        shutil.rmtree(os.path.join(REPO, "build"), ignore_errors=True)
+        for p in glob.glob(os.path.join(REPO, "*.egg-info")):
+            shutil.rmtree(p, ignore_errors=True)
         assert r.returncode == 0, r.stderr[-2000:]
         return target
 
