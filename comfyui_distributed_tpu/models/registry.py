@@ -475,7 +475,8 @@ class DiffusionPipeline:
                cfg2: float = 1.0,
                guidance: str = "dual",
                c_concat=None,
-               gligen_objs=None) -> jnp.ndarray:
+               gligen_objs=None,
+               donate_latents: bool = False) -> jnp.ndarray:
         """Full ksampler: schedule -> noise -> scan-sampler -> latents.
 
         ``seeds``: per-sample host seed array [B] (64-bit ok; replica offsets
@@ -485,6 +486,12 @@ class DiffusionPipeline:
         KSamplerAdvanced): noise scales by the window's FIRST sigma, and
         stopping early returns a still-noisy latent for a later stage
         unless ``force_full_denoise`` zeroes the final sigma.
+        ``donate_latents``: the caller warrants no other reference to the
+        ``latents`` buffer exists — the jitted denoise loop DONATES it to
+        XLA (the scan carry aliases it), halving peak latent memory per
+        replica; the input ``jax.Array`` is invalidated.  With it False
+        a defensive on-device copy is donated instead (one extra latent
+        buffer, identical numerics, upstream buffer untouched).
         ``noise_mask`` [B_or_1, h, w, 1] in latent resolution inpaints: 1 =
         resample, 0 = keep source.  ComfyUI's KSamplerX0Inpaint semantics —
         every model call sees the source re-noised to the current sigma
@@ -819,7 +826,10 @@ class DiffusionPipeline:
                     out = out * mask_in + latents * (1.0 - mask_in)
                 return out
 
-            return jax.jit(core)
+            # the latent arg is donated: the scan carry (one latent-sized
+            # buffer per step) aliases the input instead of doubling it.
+            # sample() guards shared buffers by donating a copy.
+            return jax.jit(core, donate_argnums=(1,))
 
         core = self._cache_get_or_make(static_key, make_core)
         if y is None:
@@ -842,9 +852,101 @@ class DiffusionPipeline:
             else jnp.zeros((1, 1, 1, 1))
         objs_arg = gligen_objs[:2] if gligen_objs is not None \
             else (jnp.zeros((1, 1, 1)), jnp.zeros((1, 1, 1)))
-        return core(self.unet_params, latents, ctx_list, area_list,
+        lat_arg = jnp.asarray(latents)
+        if not donate_latents:
+            # core always donates its latent arg; protect a buffer the
+            # caller (or the workflow graph) still references by donating
+            # a fresh on-device copy instead
+            lat_arg = jnp.copy(lat_arg)
+        return core(self.unet_params, lat_arg, ctx_list, area_list,
                     keys, sigmas, y_arg, mask_arg,
                     cn_params_arg, hint_arg, concat_arg, objs_arg)
+
+    # --- warmup -------------------------------------------------------------
+
+    def warmup(self, height: int = 512, width: int = 512, batch: int = 1,
+               steps: int = 20, cfg: float = 7.5,
+               sampler_name: str = "euler", scheduler: str = "normal",
+               denoise: float = 1.0, with_vae: bool = True) -> Dict[str, float]:
+        """Ahead-of-time warmup for one serving shape: trace, compile and
+        execute the CLIP encode, the jitted denoise loop and the VAE
+        decode on zero inputs, exactly shaped like a txt2img request of
+        ``batch`` images at ``width`` x ``height`` (ComfyUI //8 latent
+        convention — the shapes EmptyLatentImage -> KSampler produce).
+
+        Call at server startup (``POST /distributed/warmup`` or
+        ``DTPU_WARMUP``): the first real request then hits the in-memory
+        jit cache — time-to-first-image drops to dispatch cost — and,
+        with the persistent compilation cache enabled
+        (``runtime.manager.enable_persistent_compile_cache``), even a
+        fresh process pays trace+deserialize instead of an XLA compile.
+
+        When a live mesh with a >1 data axis exists, the warmup batch is
+        fanned out and SHARDED exactly like a distributed run
+        (jit keys compilations on input shardings: an unsharded warmup
+        would leave the flagship SPMD program cold and the first real
+        fan-out request would recompile anyway).
+        Returns per-stage wall-clock seconds."""
+        import time as _time
+
+        from comfyui_distributed_tpu.ops.base import Conditioning
+        from comfyui_distributed_tpu.parallel import collectives as coll
+        from comfyui_distributed_tpu.parallel.mesh import get_live_runtime
+        from comfyui_distributed_tpu.utils.trace import install_jax_monitoring
+        install_jax_monitoring()
+        timings: Dict[str, float] = {}
+        t_all = _time.perf_counter()
+
+        t0 = _time.perf_counter()
+        ctx1, pooled = self.encode_prompt([""])
+        jax.block_until_ready(ctx1)
+        timings["clip_s"] = _time.perf_counter() - t0
+
+        rt = get_live_runtime()
+        mesh = rt.mesh if rt is not None and rt.num_participants > 1 \
+            else None
+        total = batch * (rt.num_participants if mesh is not None else 1)
+
+        lh, lw = max(int(height) // 8, 1), max(int(width) // 8, 1)
+        context = jnp.repeat(ctx1, total, axis=0)
+        uncond = jnp.repeat(ctx1, total, axis=0)
+        y = None
+        if self.family.unet.adm_in_channels is not None:
+            from comfyui_distributed_tpu.ops.basic import _sdxl_vector_cond
+            y = _sdxl_vector_cond(
+                self, Conditioning(context=ctx1, pooled=pooled),
+                total, lh * 8, lw * 8)
+        lat = jnp.zeros((total, lh, lw, self.family.latent_channels),
+                        jnp.float32)
+        if mesh is not None:
+            lat = coll.shard_batch(lat, mesh)
+            context = coll.shard_batch(context, mesh)
+            uncond = coll.shard_batch(uncond, mesh)
+            if y is not None:
+                y = coll.shard_batch(y, mesh)
+        t0 = _time.perf_counter()
+        out = self.sample(lat, context, uncond,
+                          np.zeros((total,), np.uint64),
+                          steps=int(steps), cfg=float(cfg),
+                          sampler_name=str(sampler_name),
+                          scheduler=str(scheduler), denoise=float(denoise),
+                          y=y, donate_latents=True)
+        jax.block_until_ready(out)
+        timings["sample_s"] = _time.perf_counter() - t0
+
+        if with_vae:
+            t0 = _time.perf_counter()
+            jax.block_until_ready(self.vae_decode(out))
+            timings["vae_s"] = _time.perf_counter() - t0
+        timings["total_s"] = _time.perf_counter() - t_all
+        log(f"warmup {self.name}: {total}x{width}x{height} "
+            f"{sampler_name}x{steps}"
+            + (f" sharded over data={rt.num_participants}"
+               if mesh is not None else "")
+            + f" in {timings['total_s']:.2f}s "
+            f"(clip {timings['clip_s']:.2f}s, "
+            f"sample {timings['sample_s']:.2f}s)")
+        return timings
 
     # --- internals ----------------------------------------------------------
 

@@ -110,6 +110,16 @@ def _interrupt_stop(operand) -> jax.Array:
 def _scan_sampler(step_fn, x, sigmas, carry_init=None):
     """Run ``step_fn`` over consecutive sigma pairs with lax.scan.
 
+    Memory contract (buffer donation): ``x`` rides the scan as the carry,
+    and the registry jits the enclosing denoise loop with the latent
+    argument donated (``registry.sample``: ``donate_argnums`` on the
+    core) — XLA aliases the carry onto the caller's input buffer, so the
+    loop holds ONE latent-sized buffer per carry slot instead of
+    input + carry.  Samplers must keep the latent flowing THROUGH the
+    carry (never closing over ``x`` from an outer scope) or the aliasing
+    breaks and peak memory doubles; history slots (``carry_init``) are
+    extra buffers by design (multistep samplers need them).
+
     Per-step interrupt (reference parity with ComfyUI's in-sampler
     interrupt): each iteration polls the process-global flag
     (:mod:`comfyui_distributed_tpu.runtime.interrupt`) via a host callback

@@ -14,7 +14,11 @@ import dataclasses
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
+
+from comfyui_distributed_tpu.utils.trace import record_transfer
 
 # sentinel for widget slots that are UI chrome (control_after_generate)
 CONTROL = "__control__"
@@ -152,9 +156,126 @@ def get_op(type_name: str) -> Op:
     return cls()
 
 
+class DeviceTensor:
+    """Device-resident tensor-plane value: a ``jax.Array`` plus fan-out
+    metadata, handed BETWEEN ops without leaving the device.
+
+    The wrapper exists so op boundaries stop being implicit host edges:
+    device-aware consumers unwrap via :func:`as_device_array` (or
+    ``jnp.asarray``, which takes the ``__jax_array__`` fast path — no
+    transfer), while legacy numpy consumers keep working through
+    ``__array__`` — paying, and *recording*, the device->host fetch.
+    Every transfer is attributed to the executing workflow node via
+    ``utils.trace``, which is what makes "zero host transfers between
+    KSampler and Collector" an assertable property instead of a hope."""
+
+    __slots__ = ("data", "local_batch", "fanout")
+
+    def __init__(self, data, local_batch: Optional[int] = None,
+                 fanout: int = 1):
+        self.data = data if isinstance(data, jax.Array) \
+            else put_device_array(np.asarray(data, np.float32))
+        self.local_batch = local_batch
+        self.fanout = int(fanout)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self.data.ndim
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    def __jax_array__(self):
+        # jnp.asarray()/device consumers: hand over the jax.Array directly
+        # — NO host round trip
+        return self.data
+
+    def to_host(self) -> np.ndarray:
+        """THE device->host edge: fetch, count, return float32 numpy."""
+        arr = np.asarray(jax.device_get(self.data), dtype=np.float32)
+        record_transfer("d2h", arr.nbytes)
+        return arr
+
+    def __array__(self, dtype=None, copy=None):
+        # legacy numpy consumers (np.asarray, np.clip, ...): transparent
+        # but COUNTED host fetch
+        arr = self.to_host()
+        return arr if dtype is None else arr.astype(dtype)
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}(shape={self.shape}, "
+                f"local_batch={self.local_batch}, fanout={self.fanout})")
+
+
+class DeviceImage(DeviceTensor):
+    """IMAGE wire value resident on device ([B,H,W,C] float32 in [0,1])."""
+
+
+class DeviceLatent(DeviceTensor):
+    """LATENT ``samples`` value resident on device ([B,h,w,C] float32)."""
+
+
+def put_device_array(x) -> jax.Array:
+    """Host -> device put with transfer accounting (the counted inverse of
+    ``DeviceTensor.to_host``)."""
+    arr = np.asarray(x)
+    record_transfer("h2d", arr.nbytes)
+    return jnp.asarray(arr)
+
+
+def as_device_array(x) -> jax.Array:
+    """Normalize a wire value to a ``jax.Array`` WITHOUT a host bounce when
+    it is already device-resident (DeviceTensor / jax.Array); host arrays
+    pay one counted h2d put."""
+    if isinstance(x, DeviceTensor):
+        return x.data
+    if isinstance(x, jax.Array):
+        return x
+    return put_device_array(np.asarray(x, np.float32))
+
+
+def as_device_image(x) -> jax.Array:
+    """IMAGE value -> device [B,H,W,C] float32, staying on device when
+    possible (device analog of :func:`as_image_array`)."""
+    arr = as_device_array(x)
+    if arr.ndim == 3:
+        arr = arr[None]
+    return arr
+
+
+def fanout_meta(x) -> Dict[str, Any]:
+    """Fan-out metadata riding an IMAGE value (DeviceImage or ImageBatch),
+    in the LATENT-dict key convention."""
+    meta: Dict[str, Any] = {}
+    lb = getattr(x, "local_batch", None)
+    if lb is not None:
+        meta["local_batch"] = int(lb)
+    meta["fanout"] = int(getattr(x, "fanout", 1) or 1)
+    return meta
+
+
 def as_image_array(x) -> np.ndarray:
-    """Normalize IMAGE values to numpy [B,H,W,C] float32."""
-    arr = np.asarray(x, dtype=np.float32)
+    """Normalize IMAGE values to numpy [B,H,W,C] float32.
+
+    This is a HOST edge: device-resident values (DeviceTensor/jax.Array)
+    pay a device->host fetch here, recorded against the executing node —
+    legal at true host boundaries (PNG encode, HTTP wire, host-side
+    compositing), a counted bug between device ops."""
+    if isinstance(x, DeviceTensor):
+        arr = x.to_host()
+    elif isinstance(x, jax.Array):
+        arr = np.asarray(jax.device_get(x), dtype=np.float32)
+        record_transfer("d2h", arr.nbytes)
+    else:
+        arr = np.asarray(x, dtype=np.float32)
     if arr.ndim == 3:
         arr = arr[None]
     return arr

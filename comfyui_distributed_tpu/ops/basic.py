@@ -19,9 +19,14 @@ from comfyui_distributed_tpu.models import registry
 from comfyui_distributed_tpu.ops.base import (
     CONTROL,
     Conditioning,
+    DeviceImage,
+    DeviceLatent,
+    DeviceTensor,
     Op,
     OpContext,
     SeedValue,
+    as_device_array,
+    as_device_image,
     as_image_array,
     register_op,
 )
@@ -1376,8 +1381,9 @@ class SamplerCustom(Op):
                 sigmas_override=np.asarray(sigmas, np.float32),
                 middle_context=prep.mid_context, cfg2=prep.cfg2,
                 guidance=prep.guidance, c_concat=prep.c_concat,
-                gligen_objs=prep.gligen_objs)
-        out_d = {"samples": out, **_latent_meta(latent_image),
+                gligen_objs=prep.gligen_objs,
+                donate_latents=prep.donate_latents)
+        out_d = {"samples": DeviceLatent(out), **_latent_meta(latent_image),
                  "local_batch": prep.local_batch, "fanout": prep.fanout}
         return (out_d, dict(out_d))
 
@@ -1538,8 +1544,9 @@ class SamplerCustomAdvanced(Op):
                 sigmas_override=np.asarray(sigmas, np.float32),
                 middle_context=prep.mid_context, cfg2=cfg2,
                 guidance=guidance, c_concat=prep.c_concat,
-                gligen_objs=prep.gligen_objs)
-        out_d = {"samples": out, **_latent_meta(latent_image),
+                gligen_objs=prep.gligen_objs,
+                donate_latents=prep.donate_latents)
+        out_d = {"samples": DeviceLatent(out), **_latent_meta(latent_image),
                  "local_batch": prep.local_batch, "fanout": prep.fanout}
         return (out_d, dict(out_d))
 
@@ -1571,8 +1578,10 @@ class KSampler(Op):
                 noise_mask=prep.noise_mask, control=prep.control,
                 middle_context=prep.mid_context, cfg2=prep.cfg2,
                 guidance=prep.guidance, c_concat=prep.c_concat,
-                gligen_objs=prep.gligen_objs)
-        out_d = {"samples": out, "local_batch": prep.local_batch,
+                gligen_objs=prep.gligen_objs,
+                donate_latents=prep.donate_latents)
+        out_d = {"samples": DeviceLatent(out),
+                 "local_batch": prep.local_batch,
                  "fanout": prep.fanout}
         if "noise_mask" in latent_image:   # ComfyUI keeps the mask on the
             out_d["noise_mask"] = latent_image["noise_mask"]  # latent
@@ -1616,8 +1625,10 @@ class KSamplerAdvanced(Op):
                     str(return_with_leftover_noise) == "disable"),
                 middle_context=prep.mid_context, cfg2=prep.cfg2,
                 guidance=prep.guidance, c_concat=prep.c_concat,
-                gligen_objs=prep.gligen_objs)
-        out_d = {"samples": out, "local_batch": prep.local_batch,
+                gligen_objs=prep.gligen_objs,
+                donate_latents=prep.donate_latents)
+        out_d = {"samples": DeviceLatent(out),
+                 "local_batch": prep.local_batch,
                  "fanout": prep.fanout}
         if "noise_mask" in latent_image:
             out_d["noise_mask"] = latent_image["noise_mask"]
@@ -1767,6 +1778,13 @@ class _SampleInputs:
     c_concat: object = None
     # GLIGEN grounding token pair (cond, null), batch-matched
     gligen_objs: object = None
+    # True when ``latents`` is a buffer freshly created by the prep
+    # (host->device put or a resharding copy): the jitted denoise loop may
+    # then DONATE it — the graph holds no other reference, so aliasing the
+    # noised carry onto it halves peak latent memory.  False when the
+    # value arrived device-resident (e.g. a hires chain reusing an
+    # upstream KSampler's output that other nodes may also consume).
+    donate_latents: bool = False
 
 
 def _maybe_gligen_model(model, *conds):
@@ -1804,7 +1822,13 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
             middle = pn
             guidance = "perp_neg"
             cfg2 = float(getattr(model, "perp_neg_scale", 1.0))
-    lat = np.asarray(latent_image["samples"], np.float32)
+    # device-resident tensor plane: the latent stays a jax.Array end to
+    # end — only its SHAPE is consulted here.  A host array (fresh
+    # EmptyLatentImage batch, a numpy-edited latent) pays one counted
+    # h2d put and yields a donation-safe fresh buffer.
+    raw = latent_image["samples"]
+    raw_arr = raw.data if isinstance(raw, DeviceTensor) else raw
+    lat = as_device_array(raw)
     fanout = int(latent_image.get("fanout", 1))
     total = lat.shape[0]
     local_b = int(latent_image.get("local_batch", total // max(fanout, 1)))
@@ -2116,13 +2140,14 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
             cc = coll.shard_batch(cc, mesh)
         c_concat = jnp.asarray(cc)
 
-    return _SampleInputs(latents=jnp.asarray(lat_dev), context=ctx_arr,
+    return _SampleInputs(latents=lat_dev, context=ctx_arr,
                          uncond=unc_arr, seeds=seeds, sample_idx=local_idx,
                          y=y, local_batch=local_b, fanout=fanout,
                          noise_mask=mask, control=control,
                          mid_context=mid_ctx, guidance=guidance,
                          cfg2=cfg2, c_concat=c_concat,
-                         gligen_objs=gligen_objs)
+                         gligen_objs=gligen_objs,
+                         donate_latents=lat_dev is not raw_arr)
 
 
 def _unclip_vector_cond(pipe, cond: Conditioning, batch: int):
@@ -2218,8 +2243,11 @@ class VAEDecode(Op):
             # would make the HTTP paths (clipped by the uint8 wire) diverge
             # from the SPMD/local paths (unclipped)
             img = jnp.clip(
-                vae.vae_decode(jnp.asarray(samples["samples"])), 0.0, 1.0)
-        return (ImageBatch(img, **_image_meta(samples)),)
+                vae.vae_decode(as_device_array(samples["samples"])),
+                0.0, 1.0)
+        # stays on device: the next host edge (SaveImage PNG encode, HTTP
+        # wire) pays the fetch, not this op boundary
+        return (DeviceImage(img, **_image_meta(samples)),)
 
 
 @register_op
@@ -2235,10 +2263,10 @@ class VAEDecodeTiled(Op):
         ctx.check_interrupt()
         with Timer("vae_decode_tiled"):
             img = jnp.clip(vae.vae_decode_tiled(
-                jnp.asarray(samples["samples"]), tile_size=int(tile_size),
-                overlap=int(overlap),
+                as_device_array(samples["samples"]),
+                tile_size=int(tile_size), overlap=int(overlap),
                 check_interrupt=ctx.check_interrupt), 0.0, 1.0)
-        return (ImageBatch(img, **_image_meta(samples)),)
+        return (DeviceImage(img, **_image_meta(samples)),)
 
 
 @register_op
@@ -2276,15 +2304,16 @@ def _expand_encoded_latent(ctx: OpContext, pixels, lat):
         # — re-tiling would square the fan-out
         local_b = int(getattr(pixels, "local_batch", None)
                       or b // in_fan)
-        return ({"samples": lat, "local_batch": local_b,
+        return ({"samples": DeviceLatent(lat), "local_batch": local_b,
                  "fanout": in_fan},)
     fanout = max(ctx.fanout, 1)
     if fanout > 1:
-        # host-side tile (EmptyLatentImage convention): KSampler pulls
-        # the latent to host anyway, so duplicating on-device would add
-        # a fanout-times device->host transfer for identical bytes
-        lat = np.tile(np.asarray(lat), (fanout, 1, 1, 1))
-    return ({"samples": lat, "local_batch": b, "fanout": fanout},)
+        # duplicate ON device: KSampler now consumes the latent
+        # device-resident, so a host-side tile would force a d2h+h2d
+        # round trip of the whole batch for identical bytes
+        lat = jnp.tile(as_device_array(lat), (fanout, 1, 1, 1))
+    return ({"samples": DeviceLatent(lat), "local_batch": b,
+             "fanout": fanout},)
 
 
 @register_op
@@ -2297,7 +2326,9 @@ class VAEEncode(Op):
     TYPE = "VAEEncode"
 
     def execute(self, ctx: OpContext, pixels, vae):
-        img = jnp.asarray(as_image_array(pixels))
+        # device path: a DeviceImage source (hires-fix chain) never
+        # bounces through host on its way into the encoder
+        img = as_device_image(pixels)
         with Timer("vae_encode"):
             lat = vae.vae_encode(img)
         return _expand_encoded_latent(ctx, pixels, lat)

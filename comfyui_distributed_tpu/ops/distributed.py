@@ -22,14 +22,19 @@ import asyncio
 import json
 from typing import Any, Dict
 
+import jax
 import numpy as np
 
 from comfyui_distributed_tpu.ops.base import (
     CONTROL,
+    DeviceImage,
+    DeviceTensor,
     Op,
     OpContext,
     SeedValue,
+    as_device_image,
     as_image_array,
+    fanout_meta,
     register_op,
 )
 from comfyui_distributed_tpu.utils import constants as C
@@ -82,28 +87,47 @@ class DistributedCollector(Op):
     def execute(self, ctx: OpContext, images, multi_job_id="",
                 is_worker=None, master_url="", enabled_worker_ids="[]",
                 worker_batch_size=1, worker_id="", pass_through=False):
-        arr = as_image_array(images)
         if pass_through:
             # downstream of a distributed upscaler: tiles were already
-            # collected there (reference gpupanel.js:1146-1154)
-            return (arr,)
+            # collected there (reference gpupanel.js:1146-1154); keep the
+            # value's residency — normalizing through host here would be
+            # a gratuitous fetch
+            if isinstance(images, DeviceTensor):
+                return (images,)
+            return (as_image_array(images),)
         is_worker = ctx.is_worker if is_worker is None else is_worker
 
         if is_worker and (master_url or ctx.master_url):
+            # true host edge: the images leave this process as PNGs
+            arr = as_image_array(images)
             self._send_to_master(ctx, arr, multi_job_id,
                                  master_url or ctx.master_url,
                                  worker_id or ctx.worker_id)
             return (arr,)
 
         if multi_job_id and ctx.job_store is not None:
-            gathered = self._collect_http(ctx, arr, multi_job_id,
-                                          enabled_worker_ids)
+            # true host edge: remote results arrive over HTTP and
+            # concatenate with ours on host
+            gathered = self._collect_http(ctx, as_image_array(images),
+                                          multi_job_id, enabled_worker_ids)
             return (gathered,)
 
         # SPMD mode: batch already replica-major (master first) by
-        # construction — ordering parity with distributed.py:1424-1438
+        # construction — ordering parity with distributed.py:1424-1438.
+        # For a device-resident batch the gather is an IN-PROGRAM device
+        # operation: the timer measures the actual wait for the sharded
+        # batch (flushing XLA's async dispatch), not a host no-op copy,
+        # and the batch STAYS on device — downstream ops (tiled upscaler,
+        # SaveImage) pull it to host only at their own true edges.  A
+        # batch that already lives on host (an image-space numpy op
+        # upstream) stays host — uploading it just to re-fetch would ADD
+        # a full-batch round trip.
         with Timer("collector_gather"):
-            out = np.asarray(arr)
+            if isinstance(images, (DeviceTensor, jax.Array)):
+                out = DeviceImage(jax.block_until_ready(
+                    as_device_image(images)), **fanout_meta(images))
+            else:
+                out = as_image_array(images)
         if getattr(images, "fanout", 1) > 1:
             debug_log(f"collector: gathered {out.shape[0]} images from "
                       f"{images.fanout} mesh replicas")

@@ -40,6 +40,7 @@ from comfyui_distributed_tpu.ops.base import (
     Conditioning,
     Op,
     OpContext,
+    as_device_array,
     as_image_array,
     register_op,
 )
@@ -152,9 +153,11 @@ class UltimateSDUpscaleDistributed(Op):
                     wins[..., 0], lh, lw, n))
             srange = entry_sigma_range(pipe.schedule, e)
             if mesh is not None:
-                ce = coll.shard_batch(np.asarray(ce), mesh)
+                # shard_batch reshards device arrays in place — no host
+                # round trip on the way to the mesh
+                ce = coll.shard_batch(ce, mesh)
                 if am is not None and am.shape[0] == n:
-                    am = coll.shard_batch(np.asarray(am), mesh)
+                    am = coll.shard_batch(am, mesh)
             entries.append((ce, am,
                             float(getattr(e, "area_strength", 1.0)),
                             srange))
@@ -163,7 +166,7 @@ class UltimateSDUpscaleDistributed(Op):
                     pipe, adm_cond_source(pipe.family, e, positive),
                     n, th, tw)
                 if mesh is not None:
-                    ye = coll.shard_batch(np.asarray(ye), mesh)
+                    ye = coll.shard_batch(ye, mesh)
                 ys.append(ye)
         return entries, ys
 
@@ -219,16 +222,22 @@ class UltimateSDUpscaleDistributed(Op):
                 pipe, neg_entries, n, positions, p, img_size, lat_hw,
                 t_align, positive, tiles_hw, mesh)
             y = (y_conds + y_unconds) if y_conds or y_unconds else None
-            tiles_dev = jnp.asarray(tiles)
+            tiles_dev = as_device_array(tiles)
             if mesh is not None:
-                tiles_dev = coll.shard_batch(tiles, mesh)
+                tiles_dev = coll.shard_batch(tiles_dev, mesh)
             lat = pipe.vae_encode(tiles_dev)
+            # encode -> sample -> decode never leaves the device; the
+            # tile-latent buffer is fresh (vae_encode output, consumed
+            # only here) so the denoise loop donates it.  ONE counted
+            # fetch hands the refined tiles to the host-side blend.
             out_lat = pipe.sample(
                 lat, ctx_arr, unc_arr, seeds,
                 steps=p["steps"], cfg=p["cfg"],
                 sampler_name=p["sampler_name"], scheduler=p["scheduler"],
-                denoise=p["denoise"], add_noise=True, sample_idx=idx, y=y)
-            return np.clip(np.asarray(pipe.vae_decode(out_lat)), 0.0, 1.0)
+                denoise=p["denoise"], add_noise=True, sample_idx=idx, y=y,
+                donate_latents=True)
+            return as_image_array(
+                jnp.clip(pipe.vae_decode(out_lat), 0.0, 1.0))
         ctx_arr = jnp.repeat(positive.context, n, axis=0)
         unc_arr = jnp.repeat(negative.context, n, axis=0)
         y = None
@@ -255,27 +264,30 @@ class UltimateSDUpscaleDistributed(Op):
             mid_arr = jnp.repeat(c, n, axis=0)
             guidance = "perp_neg"
             cfg2 = float(getattr(pipe, "perp_neg_scale", 1.0))
-        tiles_dev = jnp.asarray(tiles)
+        tiles_dev = as_device_array(tiles)
         if shard and ctx.runtime is not None:
             mesh = ctx.runtime.mesh
-            tiles_dev = coll.shard_batch(tiles, mesh)
-            ctx_arr = coll.shard_batch(np.asarray(ctx_arr), mesh)
-            unc_arr = coll.shard_batch(np.asarray(unc_arr), mesh)
+            tiles_dev = coll.shard_batch(tiles_dev, mesh)
+            ctx_arr = coll.shard_batch(ctx_arr, mesh)
+            unc_arr = coll.shard_batch(unc_arr, mesh)
             if y is not None:
-                y = coll.shard_batch(np.asarray(y), mesh)
+                y = coll.shard_batch(y, mesh)
             if mid_arr is not None:
-                mid_arr = coll.shard_batch(np.asarray(mid_arr), mesh)
+                mid_arr = coll.shard_batch(mid_arr, mesh)
         lat = pipe.vae_encode(tiles_dev)
         out_lat = pipe.sample(
             lat, ctx_arr, unc_arr, seeds,
             steps=p["steps"], cfg=p["cfg"], sampler_name=p["sampler_name"],
             scheduler=p["scheduler"], denoise=p["denoise"],
             add_noise=True, sample_idx=idx, y=y,
-            middle_context=mid_arr, cfg2=cfg2, guidance=guidance)
+            middle_context=mid_arr, cfg2=cfg2, guidance=guidance,
+            donate_latents=True)
         # clamp at the decode boundary (ComfyUI VAEDecode parity): the
         # worker->master PNG wire clips to [0,1], so unclamped local tiles
-        # would blend differently from the same tile shipped over HTTP
-        return np.clip(np.asarray(pipe.vae_decode(out_lat)), 0.0, 1.0)
+        # would blend differently from the same tile shipped over HTTP.
+        # Clip ON device, then ONE counted fetch for the host-side blend.
+        return as_image_array(
+            jnp.clip(pipe.vae_decode(out_lat), 0.0, 1.0))
 
     def _window_to_extracted(self, tile: np.ndarray, pos: Tuple[int, int],
                              p: Dict[str, Any], img_size: Tuple[int, int]

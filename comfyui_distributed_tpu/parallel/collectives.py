@@ -15,15 +15,38 @@ Reference semantics preserved:
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:                                    # JAX >= 0.6: top-level export
+    from jax import shard_map as _shard_map_impl
+except ImportError:                     # older JAX: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
 
 from comfyui_distributed_tpu.utils.constants import DATA_AXIS
+
+# the replication-check kwarg was renamed check_rep -> check_vma across JAX
+# versions; resolve the installed spelling once
+_SHARD_MAP_REP_KW = next(
+    (kw for kw in ("check_vma", "check_rep")
+     if kw in inspect.signature(_shard_map_impl).parameters), None)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map``: one spelling for every call site
+    (here, ``parallel/ring.py``, tests).  ``check_vma=False`` disables the
+    static replication checker under whichever name the installed JAX
+    uses (``check_vma``, formerly ``check_rep``)."""
+    kwargs = {}
+    if _SHARD_MAP_REP_KW is not None:
+        kwargs[_SHARD_MAP_REP_KW] = check_vma
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kwargs)
 
 
 def replica_seeds(base_seed: int, num_replicas: int,
@@ -62,8 +85,12 @@ def gather_batch(x: jax.Array) -> np.ndarray:
     """Gather: fetch a (possibly sharded) array to host, preserving axis
     order — the analog of the reference's collector drain + ordered
     ``torch.cat`` (``distributed.py:1281-1459``), with ordering guaranteed by
-    construction instead of by sorting worker ids."""
-    return np.asarray(jax.device_get(x))
+    construction instead of by sorting worker ids.  This is a device->host
+    EDGE and is counted as such (utils.trace)."""
+    from comfyui_distributed_tpu.utils.trace import record_transfer
+    arr = np.asarray(jax.device_get(x))
+    record_transfer("d2h", arr.nbytes)
+    return arr
 
 
 def all_gather_data(x: jax.Array, mesh: Mesh) -> jax.Array:

@@ -51,7 +51,18 @@ def force_cpu_platform(n_devices: int) -> None:
         pass
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # older JAX: the option doesn't exist — the XLA flag (read at
+        # client creation, i.e. after the clear_backends above) is the
+        # portable spelling
+        import re
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", "")).strip()
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+            .strip())
 
 
 _PROBE_SRC = r"""

@@ -28,8 +28,11 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+# version-portable shard_map (check_vma/check_rep shim) — ONE shim for
+# every call site, see parallel/collectives.py
+from comfyui_distributed_tpu.parallel.collectives import shard_map
 
 from comfyui_distributed_tpu.utils.constants import (
     DATA_AXIS,

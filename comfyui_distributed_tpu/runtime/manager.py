@@ -22,11 +22,68 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from comfyui_distributed_tpu.utils import config as cfg_mod
+from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils import process as proc
 from comfyui_distributed_tpu.utils.constants import WORKER_STARTUP_DELAY
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 
 MASTER_PID_ENV = "DTPU_MASTER_PID"
+
+_compile_cache_dir: Optional[str] = None
+_compile_cache_lock = threading.Lock()
+
+
+def enable_persistent_compile_cache(
+        cache_dir: Optional[str] = None,
+        min_compile_secs: Optional[float] = None,
+        default_dir: Optional[str] = None) -> Optional[str]:
+    """Turn on JAX's persistent (on-disk) XLA compilation cache.
+
+    Makes compilation a ONE-TIME cost across process restarts: a warm
+    cache turns the cold-start SDXL compile into a trace + deserialize.
+    Resolution order for the directory: explicit ``cache_dir`` >
+    ``DTPU_COMPILE_CACHE_DIR`` env > ``default_dir`` (a caller's
+    preferred location — bench/tests pass the repo-local ``.jax_cache``)
+    > the default under ``~/.cache``; the values "0"/"off"/"" in the
+    env disable the cache entirely.
+
+    The resolved dir is re-exported to ``os.environ`` so workers spawned
+    by :class:`WorkerProcessManager` (which inherit the environment)
+    share one cache with the master — every participant compiles each
+    program at most once per fleet, not once per process.  Idempotent;
+    returns the active dir (None when disabled)."""
+    global _compile_cache_dir
+    with _compile_cache_lock:
+        if cache_dir is None:
+            cache_dir = os.environ.get(C.COMPILE_CACHE_ENV)
+            if cache_dir is not None \
+                    and cache_dir.strip().lower() in ("", "0", "off"):
+                debug_log("persistent compile cache disabled via env")
+                return None
+            cache_dir = cache_dir or default_dir \
+                or C.COMPILE_CACHE_DEFAULT_DIR
+        cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+        if _compile_cache_dir == cache_dir:
+            return _compile_cache_dir
+        import jax
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(C.COMPILE_CACHE_MIN_COMPILE_SECS
+                      if min_compile_secs is None else min_compile_secs))
+            # cache every entry that clears the time bar, regardless of
+            # serialized size
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except Exception as e:  # noqa: BLE001 - cache is an optimization
+            log(f"persistent compile cache unavailable: {e!r}")
+            return None
+        os.environ[C.COMPILE_CACHE_ENV] = cache_dir
+        _compile_cache_dir = cache_dir
+        log(f"persistent compile cache at {cache_dir}")
+        return cache_dir
 
 
 class WorkerProcessManager:

@@ -40,6 +40,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import itertools
+import json
 import os
 import threading
 import time
@@ -283,9 +284,38 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         return web.json_response(st)
 
     async def metrics(request):
-        from comfyui_distributed_tpu.utils.trace import GLOBAL_PHASES
+        from comfyui_distributed_tpu.utils.trace import (
+            GLOBAL_PHASES, counters_snapshot)
         return web.json_response({**state.metrics,
-                                  "phases": GLOBAL_PHASES.snapshot()})
+                                  "phases": GLOBAL_PHASES.snapshot(),
+                                  # host<->device transfer bytes per node
+                                  # + jit trace/XLA compile counts: the
+                                  # tensor-plane health signals (steady
+                                  # serving => retraces stop growing)
+                                  **counters_snapshot()})
+
+    async def warmup(request):
+        """AOT warmup (registry.DiffusionPipeline.warmup): compile +
+        execute the serving-shaped programs for a checkpoint so the next
+        matching /prompt pays dispatch cost only.  Body: {"ckpt_name",
+        "width", "height", "batch", "steps", "cfg", "sampler_name",
+        "scheduler", "denoise"} — all optional but ckpt_name."""
+        from comfyui_distributed_tpu.models import registry
+        data = await request.json() if request.can_read_body else {}
+        ckpt = data.get("ckpt_name", "model.safetensors")
+        kwargs = {k: data[k] for k in
+                  ("height", "width", "batch", "steps", "cfg",
+                   "sampler_name", "scheduler", "denoise") if k in data}
+        loop = asyncio.get_running_loop()
+
+        def run():
+            pipe = registry.load_pipeline(ckpt,
+                                          models_dir=state.models_dir)
+            return pipe.warmup(**kwargs)
+
+        # compile happens off the event loop; the control plane stays up
+        timings = await loop.run_in_executor(None, run)
+        return ok({"ckpt_name": ckpt, "timings": timings})
 
     # --- profiling (the subsystem the reference lacks, SURVEY.md §5) -------
 
@@ -622,6 +652,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/distributed/network_info", network_info)
     r.add_get("/distributed/status", status)
     r.add_get("/distributed/metrics", metrics)
+    r.add_post("/distributed/warmup", warmup)
     r.add_get("/distributed/workers_status", workers_status)
     r.add_post("/distributed/cluster/clear_memory", cluster_clear_memory)
     r.add_post("/distributed/cluster/interrupt", cluster_interrupt)
@@ -654,6 +685,32 @@ def serve(host: str = "0.0.0.0", port: int = 8288,
     """Blocking server entry point."""
     state = state or ServerState()
     state.port = port
+    # compilation is a one-time cost: persistent XLA cache across restarts
+    # (spawned workers inherit the resolved dir and share it), plus an
+    # optional startup warmup — DTPU_WARMUP='{"ckpt_name": ..., "width":
+    # ..., ...}' AOT-compiles the serving shape before the first request
+    from comfyui_distributed_tpu.runtime.manager import \
+        enable_persistent_compile_cache
+    enable_persistent_compile_cache()
+    # NOTE: the warmup thread compiles while the server is already
+    # accepting requests; jax.monitoring events are process-wide, so a
+    # prompt executed DURING warmup may report the warmup's traces in its
+    # ExecutionResult.retraces — read the zero-retrace steady-state
+    # signal only after warmup completes (its completion is logged).
+    warmup_spec = os.environ.get("DTPU_WARMUP")
+    if warmup_spec and not state.is_worker:
+        def startup_warmup():
+            try:
+                spec = json.loads(warmup_spec)
+                from comfyui_distributed_tpu.models import registry
+                ckpt = spec.pop("ckpt_name", "model.safetensors")
+                registry.load_pipeline(
+                    ckpt, models_dir=state.models_dir).warmup(**spec)
+            except Exception as e:  # noqa: BLE001 - warmup is best-effort
+                log(f"startup warmup failed: {type(e).__name__}: {e}")
+
+        threading.Thread(target=startup_warmup, daemon=True,
+                         name="dtpu-warmup").start()
     app = build_app(state)
     if not state.is_worker:
         # master-IP autodetect: save the recommended private-range IP as

@@ -51,3 +51,15 @@ TILE_AXIS = DATA_AXIS    # tiles shard over the same physical axis as replicas
 # --- wire formats -----------------------------------------------------------
 TENSOR_WIRE_DTYPE = "float32"
 IMAGE_WIRE_FORMAT = "png"        # lossless, reference parity (compress_level=0)
+
+# --- persistent compilation cache -------------------------------------------
+# Directory for JAX's persistent (on-disk) XLA compilation cache.  Resolution
+# (runtime/manager.enable_persistent_compile_cache): explicit arg > this env
+# > COMPILE_CACHE_DEFAULT_DIR.  Set to "0"/"off" to disable.  The resolved
+# dir is re-exported into the environment so spawned HTTP workers share one
+# cache with the master.
+COMPILE_CACHE_ENV = "DTPU_COMPILE_CACHE_DIR"
+COMPILE_CACHE_DEFAULT_DIR = "~/.cache/comfyui_distributed_tpu/xla_cache"
+# only persist compilations worth the disk round trip; 0 also caches the
+# tiny convert/broadcast jits (useful in tests, noisy in production)
+COMPILE_CACHE_MIN_COMPILE_SECS = 0.5

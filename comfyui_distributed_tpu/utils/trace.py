@@ -9,7 +9,14 @@ Here profiling is a first-class subsystem:
   ``GET /distributed/metrics``;
 - XLA/device traces via ``jax.profiler`` (viewable in TensorBoard /
   Perfetto), driven by ``POST /distributed/profile/start`` + ``/stop`` or
-  the :func:`trace` context manager.
+  the :func:`trace` context manager;
+- host<->device transfer accounting (:class:`TransferStats`): every device
+  edge in the ops layer reports bytes through :func:`record_transfer`,
+  attributed to the executing workflow node (:func:`node_scope`) — the
+  software-measurable proxy for "tensors never leave HBM";
+- retrace/compile counters (:class:`RetraceStats`) fed by
+  ``jax.monitoring`` events: a steady-state serving process must report
+  ZERO new traces on a repeated workflow (``install_jax_monitoring``).
 """
 
 from __future__ import annotations
@@ -18,7 +25,7 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from comfyui_distributed_tpu.utils.logging import log
 
@@ -107,3 +114,154 @@ def device_trace(out_dir: Optional[str] = None):
         yield d
     finally:
         stop_device_trace()
+
+
+# --- host<->device transfer accounting ---------------------------------------
+
+class TransferStats:
+    """Per-label host<->device transfer byte/call counters (thread-safe).
+
+    Labels are workflow node ids when a :func:`node_scope` is active,
+    ``"_unattributed"`` otherwise.  Directions: ``d2h`` (device fetch —
+    the expensive edge the tensor plane exists to eliminate) and ``h2d``
+    (host put)."""
+
+    def __init__(self) -> None:
+        self._stats: Dict[str, Dict[str, float]] = {}
+        self._lock = threading.Lock()
+
+    def record(self, direction: str, nbytes: int,
+               label: Optional[str] = None) -> None:
+        key = label or "_unattributed"
+        with self._lock:
+            s = self._stats.setdefault(
+                key, {"d2h_bytes": 0, "d2h_calls": 0,
+                      "h2d_bytes": 0, "h2d_calls": 0})
+            s[f"{direction}_bytes"] += int(nbytes)
+            s[f"{direction}_calls"] += 1
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._stats.items()}
+
+    def total(self, direction: str) -> int:
+        with self._lock:
+            return sum(int(v[f"{direction}_bytes"])
+                       for v in self._stats.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+# process-wide sink (feeds /distributed/metrics); executors push a per-run
+# sink on top so ExecutionResult can report per-node transfers for just
+# that run
+GLOBAL_TRANSFERS = TransferStats()
+
+_transfer_state = threading.local()
+
+
+def _sinks() -> List[TransferStats]:
+    return getattr(_transfer_state, "sinks", None) or []
+
+
+@contextmanager
+def transfer_sink(sink: TransferStats):
+    """Additionally record this thread's transfers into ``sink`` (the
+    executor's per-run accounting)."""
+    stack = getattr(_transfer_state, "sinks", None)
+    if stack is None:
+        stack = _transfer_state.sinks = []
+    stack.append(sink)
+    try:
+        yield sink
+    finally:
+        stack.remove(sink)
+
+
+@contextmanager
+def node_scope(node_id: str):
+    """Attribute transfers recorded inside the block to a workflow node."""
+    prev = getattr(_transfer_state, "node", None)
+    _transfer_state.node = str(node_id)
+    try:
+        yield
+    finally:
+        _transfer_state.node = prev
+
+
+def current_node() -> Optional[str]:
+    return getattr(_transfer_state, "node", None)
+
+
+def record_transfer(direction: str, nbytes: int) -> None:
+    """Report one host<->device edge (``direction`` in {"d2h", "h2d"}) from
+    the ops layer; attribution and per-run fan-out happen here."""
+    label = current_node()
+    GLOBAL_TRANSFERS.record(direction, nbytes, label)
+    for sink in _sinks():
+        sink.record(direction, nbytes, label)
+
+
+# --- retrace / compile counters ----------------------------------------------
+
+class RetraceStats:
+    """Monotonic counters over ``jax.monitoring`` events (thread-safe).
+
+    ``traces`` counts jaxpr traces (every cache-missed jit call),
+    ``compiles`` counts backend (XLA) compilations — with the persistent
+    compilation cache warm, a retrace can hit the disk cache and skip the
+    backend compile, so the two differ."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.traces = 0
+        self.compiles = 0
+
+    def bump(self, what: str) -> None:
+        with self._lock:
+            setattr(self, what, getattr(self, what) + 1)
+
+    def mark(self) -> Dict[str, int]:
+        with self._lock:
+            return {"traces": self.traces, "compiles": self.compiles}
+
+    def since(self, mark: Dict[str, int]) -> Dict[str, int]:
+        with self._lock:
+            return {"traces": self.traces - mark["traces"],
+                    "compiles": self.compiles - mark["compiles"]}
+
+
+GLOBAL_RETRACES = RetraceStats()
+
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_monitoring_installed = False
+_monitoring_lock = threading.Lock()
+
+
+def install_jax_monitoring() -> None:
+    """Register the (process-global, idempotent) ``jax.monitoring``
+    listener feeding :data:`GLOBAL_RETRACES`.  Cheap to call per run."""
+    global _monitoring_installed
+    with _monitoring_lock:
+        if _monitoring_installed:
+            return
+        import jax.monitoring as monitoring
+
+        def on_duration(name: str, duration: float, **kw) -> None:
+            if name == _TRACE_EVENT:
+                GLOBAL_RETRACES.bump("traces")
+            elif name == _COMPILE_EVENT:
+                GLOBAL_RETRACES.bump("compiles")
+
+        monitoring.register_event_duration_secs_listener(on_duration)
+        _monitoring_installed = True
+
+
+def counters_snapshot() -> Dict[str, Any]:
+    """One payload for /distributed/metrics and bench artifacts."""
+    return {"transfers": GLOBAL_TRANSFERS.snapshot(),
+            "retraces": GLOBAL_RETRACES.mark()}

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from comfyui_distributed_tpu.ops.base import OpContext, get_op
+from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.constants import \
     DISTRIBUTED_NODE_TYPES as DISTRIBUTED_TYPES
 from comfyui_distributed_tpu.workflow.graph import (
@@ -30,12 +31,30 @@ class ExecutionResult:
     images: List[np.ndarray]             # all Preview/Save collected images
     timings: Dict[str, float]            # node id -> seconds
     total_s: float = 0.0
+    # per-node host<->device transfer accounting for THIS run (node id ->
+    # {d2h_bytes, d2h_calls, h2d_bytes, h2d_calls}): the proof that the
+    # tensor plane stayed on device between ops — zero d2h on the
+    # KSampler->VAEDecode->Collector spine, fetches only at true host
+    # edges (SaveImage/Preview/HTTP wire)
+    transfers: Dict[str, Dict[str, float]] = \
+        dataclasses.field(default_factory=dict)
+    # jit traces / XLA compiles observed during this run; a repeated
+    # workflow must report {"traces": 0, "compiles": 0}
+    retraces: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def image_batch(self) -> Optional[np.ndarray]:
         if not self.images:
             return None
         return np.stack(self.images, axis=0)
+
+    def host_transfer_bytes(self, direction: str = "d2h",
+                            nodes: Optional[List[str]] = None) -> int:
+        """Total transferred bytes for the run (optionally restricted to a
+        node subset)."""
+        items = self.transfers.items() if nodes is None else \
+            ((n, self.transfers.get(n, {})) for n in nodes)
+        return int(sum(v.get(f"{direction}_bytes", 0) for _, v in items))
 
 
 class WorkflowExecutor:
@@ -85,37 +104,50 @@ class WorkflowExecutor:
 
         outputs: Dict[str, Tuple] = {}
         timings: Dict[str, float] = {}
+        # per-run transfer/retrace accounting: every device edge in the
+        # ops layer reports through utils.trace; attribute to the
+        # executing node and keep a run-local ledger alongside the
+        # process-global one
+        trace_mod.install_jax_monitoring()
+        run_transfers = trace_mod.TransferStats()
+        retrace_mark = trace_mod.GLOBAL_RETRACES.mark()
         t_start = time.perf_counter()
 
-        for nid in graph.topo_order():
-            self.ctx.fanout = fanout if (fan_nodes is None
-                                         or nid in fan_nodes) else 1
-            node = graph.nodes[nid]
-            op = get_op(node.class_type)
-            kwargs: Dict[str, Any] = {}
-            for name, value in node.inputs.items():
-                if name == "__widgets__":
-                    continue
-                if isinstance(value, (list, tuple)) and len(value) == 2 \
-                        and not isinstance(value[0], (list, dict)) \
-                        and isinstance(value[1], int) \
-                        and str(value[0]) in graph.nodes:
-                    src, slot = str(value[0]), int(value[1])
-                    kwargs[name] = outputs[src][slot]
-                else:
-                    kwargs[name] = value
-            # hidden inputs: graph-embedded first, then per-run overrides
-            for hname, hval in {**node.hidden,
-                                **hidden.get(nid, {})}.items():
-                if hname in op.HIDDEN:
-                    kwargs[hname] = hval
-            debug_log(f"exec node {nid} ({node.class_type})")
-            t0 = time.perf_counter()
-            outputs[nid] = op.execute(self.ctx, **kwargs)
-            timings[nid] = time.perf_counter() - t0
+        with trace_mod.transfer_sink(run_transfers):
+            for nid in graph.topo_order():
+                self.ctx.fanout = fanout if (fan_nodes is None
+                                             or nid in fan_nodes) else 1
+                node = graph.nodes[nid]
+                op = get_op(node.class_type)
+                kwargs: Dict[str, Any] = {}
+                for name, value in node.inputs.items():
+                    if name == "__widgets__":
+                        continue
+                    if isinstance(value, (list, tuple)) and len(value) == 2 \
+                            and not isinstance(value[0], (list, dict)) \
+                            and isinstance(value[1], int) \
+                            and str(value[0]) in graph.nodes:
+                        src, slot = str(value[0]), int(value[1])
+                        kwargs[name] = outputs[src][slot]
+                    else:
+                        kwargs[name] = value
+                # hidden inputs: graph-embedded first, then per-run
+                # overrides
+                for hname, hval in {**node.hidden,
+                                    **hidden.get(nid, {})}.items():
+                    if hname in op.HIDDEN:
+                        kwargs[hname] = hval
+                debug_log(f"exec node {nid} ({node.class_type})")
+                t0 = time.perf_counter()
+                with trace_mod.node_scope(nid):
+                    outputs[nid] = op.execute(self.ctx, **kwargs)
+                timings[nid] = time.perf_counter() - t0
 
         total = time.perf_counter() - t_start
         self.ctx.node_timings.update(timings)
-        return ExecutionResult(outputs=outputs,
-                               images=list(self.ctx.saved_images),
-                               timings=timings, total_s=total)
+        return ExecutionResult(
+            outputs=outputs,
+            images=list(self.ctx.saved_images),
+            timings=timings, total_s=total,
+            transfers=run_transfers.snapshot(),
+            retraces=trace_mod.GLOBAL_RETRACES.since(retrace_mark))
