@@ -117,14 +117,20 @@ def parse_args(argv=None):
                         "budget — killing a TPU client mid-claim wedges "
                         "the server-side lease, so the probe must resolve "
                         "naturally: devices or UNAVAILABLE)")
-    p.add_argument("--phase", default=None, choices=["tensor_plane"],
+    p.add_argument("--phase", default=None,
+                   choices=["tensor_plane", "pipeline"],
                    help="run ONE named software-proxy phase. "
                         "'tensor_plane': repeated 2-image SPMD txt2img on "
                         "the CPU backend reporting host_transfer_mb_per_"
                         "image, n_retraces_second_run (must be 0) and "
                         "cold/warm time-to-first-image — the "
                         "device-resident data-plane proof that needs no "
-                        "TPU")
+                        "TPU. "
+                        "'pipeline': serial-vs-overlapped serving "
+                        "throughput for a 4-prompt queue on the CPU tiny "
+                        "model — imgs/s both ways, the coalesced group's "
+                        "single-dispatch proof (exec_runs==1, zero new "
+                        "traces) and a device-idle-fraction estimate")
     p.add_argument("--scaling-sweep", action="store_true",
                    help="virtual-mesh SPMD overhead sweep instead of the "
                         "single-chip throughput bench")
@@ -209,7 +215,8 @@ def parse_args(argv=None):
     if args.family is None:
         args.family = "sd15" if args.upscale else "sdxl"
     if args.steps is None:
-        args.steps = 8 if args.scaling_sweep else 20
+        args.steps = 8 if args.scaling_sweep else \
+            (2 if args.phase == "pipeline" else 20)
     if args.family == "tiny":
         # clamp HERE, not after backend init: the failure payload's metric
         # name must match the success series' name for the same invocation
@@ -223,6 +230,8 @@ def log(msg):
 
 
 def metric_name(args):
+    if getattr(args, "phase", None) == "pipeline":
+        return "pipeline_overlap_speedup_4prompt"
     if getattr(args, "phase", None) == "tensor_plane":
         return "tensor_plane_warm_ttfi_s"
     if args.real_ckpt:
@@ -245,6 +254,8 @@ def metric_name(args):
 
 
 def metric_unit(args):
+    if getattr(args, "phase", None) == "pipeline":
+        return "x"
     if getattr(args, "phase", None) == "tensor_plane":
         return "sec/run"
     if args.scaling_sweep or args.multiproc_sweep:
@@ -785,6 +796,180 @@ def run_tensor_plane(args):
     emit(args, payload)
 
 
+def _pipeline_prompt(seed: int, steps: int = 2, size: int = 32):
+    """The serving-shaped tiny txt2img prompt the pipeline phase queues:
+    coalescable by construction (safe node set, EmptyLatentImage source,
+    per-prompt variation confined to the KSampler seed)."""
+    return {
+        "7": {"class_type": "CheckpointLoaderSimple",
+              "inputs": {"ckpt_name": "tiny.safetensors"}},
+        "5": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "a lighthouse", "clip": ["7", 1]}},
+        "6": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["7", 1]}},
+        "9": {"class_type": "EmptyLatentImage",
+              "inputs": {"width": size, "height": size, "batch_size": 1}},
+        "8": {"class_type": "KSampler",
+              "inputs": {"model": ["7", 0], "positive": ["5", 0],
+                         "negative": ["6", 0], "latent_image": ["9", 0],
+                         "seed": seed, "steps": steps, "cfg": 2.0,
+                         "sampler_name": "euler", "scheduler": "normal",
+                         "denoise": 1.0}},
+        "1": {"class_type": "VAEDecode",
+              "inputs": {"samples": ["8", 0], "vae": ["7", 2]}},
+        "3": {"class_type": "PreviewImage", "inputs": {"images": ["1", 0]}},
+    }
+
+
+def measure_pipeline(n_prompts: int = 4, steps: int = 2,
+                     wait_s: float = 300.0):
+    """Serial-vs-overlapped serving comparison on the CPU tiny model —
+    the measurement core behind ``--phase pipeline`` (also called
+    in-process by tests/test_pipeline.py so the acceptance invariants
+    are asserted without a subprocess).
+
+    Both configurations run the SAME ``n_prompts`` seed-variation queue
+    through a real ServerState exec loop:
+
+    * **serial** — overlap and coalescing off: one prompt per dispatch,
+      host edges inline (the seed behavior);
+    * **overlapped** — the pipelined executor: the burst coalesces into
+      ONE batched dispatch (asserted via the exec_runs counter and the
+      retrace mark) and host edges ride the encoder pool.
+
+    Returns the metrics dict; caller decides pass/fail."""
+    import tempfile
+
+    from comfyui_distributed_tpu.server.app import ServerState
+    from comfyui_distributed_tpu.utils import trace as tr
+
+    os.environ.setdefault("DTPU_DEFAULT_FAMILY", "tiny")
+
+    def wait_all(st, pids):
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            hist = {p: st._history.get(p) for p in pids}
+            if all(h is not None for h in hist.values()):
+                bad = {p: h for p, h in hist.items()
+                       if h["status"] != "success"}
+                assert not bad, f"pipeline bench prompts failed: {bad}"
+                return
+            time.sleep(0.01)
+        raise TimeoutError(f"prompts never finished: {pids}")
+
+    def state(overlap, coalesce):
+        tmp = tempfile.mkdtemp(prefix="bench_pipe_")
+        return ServerState(config_path=os.path.join(tmp, "cfg.json"),
+                           input_dir=tmp, output_dir=tmp,
+                           overlap=overlap, coalesce=coalesce)
+
+    def staged_burst(st):
+        """Enqueue the burst while the exec gate is held so the whole
+        queue is visible to ONE pop — the steady-traffic shape (prompts
+        queued behind an in-flight job) without racing the pop."""
+        st._exec_gate.clear()
+        pids = [st.enqueue_prompt(_pipeline_prompt(100 + i, steps=steps),
+                                  "bench") for i in range(n_prompts)]
+        st._exec_gate.set()
+        return pids
+
+    def stage_totals():
+        return {k: v["total_s"]
+                for k, v in tr.GLOBAL_STAGES.snapshot().items()}
+
+    def idle_fraction(before, after, wall, host_inline):
+        compute = after.get("compute", 0.0) - before.get("compute", 0.0)
+        busy = compute
+        if host_inline:
+            # serial mode runs d2h/encode INSIDE the executor: subtract
+            # them back out for the device-busy estimate
+            for k in ("d2h", "encode"):
+                busy -= after.get(k, 0.0) - before.get(k, 0.0)
+        return max(0.0, min(1.0, 1.0 - busy / max(wall, 1e-9)))
+
+    # --- serial baseline ---------------------------------------------------
+    st = state(overlap=False, coalesce=False)
+    wait_all(st, [st.enqueue_prompt(_pipeline_prompt(1, steps=steps),
+                                    "warm")])       # compile batch-1
+    runs0 = tr.GLOBAL_COUNTERS.get("exec_runs")
+    s0 = stage_totals()
+    t0 = time.perf_counter()
+    wait_all(st, staged_burst(st))
+    serial_s = time.perf_counter() - t0
+    serial_runs = tr.GLOBAL_COUNTERS.get("exec_runs") - runs0
+    serial_idle = idle_fraction(s0, stage_totals(), serial_s,
+                                host_inline=True)
+    st.drain(10)
+
+    # --- overlapped + coalesced --------------------------------------------
+    st = state(overlap=True, coalesce=True)
+    wait_all(st, staged_burst(st))                  # compile batch-N
+    runs0 = tr.GLOBAL_COUNTERS.get("exec_runs")
+    batches0 = tr.GLOBAL_COUNTERS.get("coalesced_batches")
+    retrace_mark = tr.GLOBAL_RETRACES.mark()
+    s0 = stage_totals()
+    t0 = time.perf_counter()
+    wait_all(st, staged_burst(st))
+    overlap_s = time.perf_counter() - t0
+    overlap_runs = tr.GLOBAL_COUNTERS.get("exec_runs") - runs0
+    overlap_batches = tr.GLOBAL_COUNTERS.get("coalesced_batches") - batches0
+    retraces = tr.GLOBAL_RETRACES.since(retrace_mark)
+    overlap_idle = idle_fraction(s0, stage_totals(), overlap_s,
+                                 host_inline=False)
+    st.drain(10)
+
+    return {
+        "n_prompts": n_prompts,
+        "serial_s": round(serial_s, 4),
+        "overlapped_s": round(overlap_s, 4),
+        "serial_imgs_per_s": round(n_prompts / serial_s, 4),
+        "overlapped_imgs_per_s": round(n_prompts / overlap_s, 4),
+        "speedup": round(serial_s / max(overlap_s, 1e-9), 4),
+        "serial_exec_runs": serial_runs,
+        "overlapped_exec_runs": overlap_runs,
+        "coalesced_batches": overlap_batches,
+        "retraces_timed_round": int(retraces.get("traces", 0)),
+        "device_idle_fraction_serial": round(serial_idle, 4),
+        "device_idle_fraction_overlapped": round(overlap_idle, 4),
+    }
+
+
+def run_pipeline(args):
+    """``--phase pipeline``: the overlapped-executor proof (ISSUE 2) —
+    overlapped/coalesced serving must beat the serial loop >=1.3x on a
+    4-prompt queue AND dispatch the group as ONE compiled execution."""
+    from comfyui_distributed_tpu.parallel.mesh import force_cpu_platform
+    force_cpu_platform(1)
+    enable_compile_cache()
+    m = measure_pipeline(n_prompts=4, steps=args.steps if args.steps else 2)
+    log(f"serial {m['serial_imgs_per_s']} img/s vs overlapped "
+        f"{m['overlapped_imgs_per_s']} img/s -> {m['speedup']}x; "
+        f"coalesced dispatches {m['overlapped_exec_runs']} "
+        f"(serial {m['serial_exec_runs']}); idle "
+        f"{m['device_idle_fraction_serial']} -> "
+        f"{m['device_idle_fraction_overlapped']}")
+    payload = {
+        "metric": metric_name(args),
+        "value": m["speedup"],
+        "unit": metric_unit(args),
+        "vs_baseline": 1.0,
+        **m,
+    }
+    problems = []
+    if m["speedup"] < 1.3:
+        problems.append(f"speedup {m['speedup']} < 1.3x")
+    if m["overlapped_exec_runs"] != 1:
+        problems.append(f"coalesced group took "
+                        f"{m['overlapped_exec_runs']} dispatches (want 1)")
+    if m["retraces_timed_round"] != 0:
+        problems.append(f"retraces_timed_round="
+                        f"{m['retraces_timed_round']} (want 0)")
+    if problems:
+        payload["error"] = {"stage": "pipeline_invariants",
+                            "detail": "; ".join(problems)}
+    emit(args, payload)
+
+
 def run_suite(args):
     """The driver's default invocation: budget-capped backend escape
     (ladder_budget — ≤~20% of the claim window), then cheapest-first
@@ -1240,6 +1425,8 @@ def main():
     try:
         if args.phase == "tensor_plane":
             run_tensor_plane(args)
+        elif args.phase == "pipeline":
+            run_pipeline(args)
         elif args.real_ckpt:
             run_real_ckpt(args)
         elif args.multiproc_sweep:

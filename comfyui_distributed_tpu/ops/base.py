@@ -76,6 +76,11 @@ class SeedValue:
     run without a DistributedSeed node."""
     base: int
     distributed: bool = False
+    # batch-coalescing scheduler (workflow/scheduler.py): one seed PER
+    # COALESCED PROMPT; _prepare_sample_inputs repeats each over its
+    # prompt's local batch so every prompt keeps the exact noise stream
+    # a serial run would have drawn
+    per_prompt: Any = None
 
     def __index__(self) -> int:
         return int(self.base)
@@ -90,6 +95,15 @@ class OpContext:
     input_dir: Optional[str] = None
     output_dir: Optional[str] = None
     fanout: int = 1                    # data-parallel replicas for this run
+    # batch-coalescing scheduler: number of signature-identical prompts
+    # merged into this run; EmptyLatentImage multiplies its batch by it
+    coalesce: int = 1
+    # overlapped pipeline (utils.net.HostIOPool): when set, OUTPUT-node
+    # host edges (d2h fetch, PNG encode, disk write) defer onto the pool
+    # and land in image_futures instead of saved_images — job N's encode
+    # overlaps job N+1's denoise loop
+    host_pool: Any = None
+    image_futures: List[Any] = dataclasses.field(default_factory=list)
     # distributed identity (hidden-input defaults for all ops)
     is_worker: bool = False
     worker_id: str = ""
@@ -109,10 +123,29 @@ class OpContext:
     # gpupanel.js:1344-1358)
     prompt_json: Any = None
     extra_pnginfo: Any = None
+    # per-run hidden-input overrides (executor.execute's ``hidden`` arg):
+    # SaveImage reads the coalescing scheduler's per-prompt widget lists
+    # out of this to embed per-prompt metadata
+    hidden_overrides: Dict[str, Dict[str, Any]] = \
+        dataclasses.field(default_factory=dict)
 
     def check_interrupt(self):
         if self.interrupt_event is not None and self.interrupt_event.is_set():
             raise InterruptedError("execution interrupted")
+
+    def collect_images(self, make_host_images) -> None:
+        """OUTPUT-node image collection point.  ``make_host_images()``
+        performs the host edge (d2h fetch + optional encode/disk write)
+        and returns the per-image list.  Without a host pool it runs
+        inline into ``saved_images`` (the classic serial path); with one
+        it defers onto the pool and the future lands in
+        ``image_futures`` — submission order preserves collection order,
+        and ``ExecutionResult.wait_host`` reassembles the list."""
+        if self.host_pool is None:
+            self.saved_images.extend(make_host_images())
+        else:
+            self.image_futures.append(self.host_pool.submit(
+                make_host_images))
 
 
 class Op:

@@ -10,6 +10,7 @@ import dataclasses
 import json
 import math
 import os
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -36,6 +37,7 @@ from comfyui_distributed_tpu.utils.image import (
     resize_image,
     tensor_to_pil,
 )
+from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.logging import Timer, debug_log, log
 
 
@@ -1137,7 +1139,9 @@ class EmptyLatentImage(Op):
 
     def execute(self, ctx: OpContext, width: int, height: int,
                 batch_size: int = 1):
-        total = int(batch_size) * max(ctx.fanout, 1)
+        # coalesced runs lay the batch out PROMPT-MAJOR: [prompt0 x b,
+        # prompt1 x b, ...] — the order scheduler.split_images relies on
+        total = int(batch_size) * max(ctx.fanout, 1) * max(ctx.coalesce, 1)
         lat = np.zeros((total, height // 8, width // 8, 4), np.float32)
         return ({"samples": lat, "local_batch": int(batch_size),
                  "fanout": max(ctx.fanout, 1)},)
@@ -1560,11 +1564,19 @@ class KSampler(Op):
     WIDGETS = ["seed", CONTROL, "steps", "cfg", "sampler_name", "scheduler",
                "denoise"]
     DEFAULTS = {"denoise": 1.0}
+    # coalesced_seeds: per-prompt seed list injected by the batch-
+    # coalescing scheduler (workflow/scheduler.py) as a hidden override —
+    # JSON-safe ints, so the merged graph's PNG metadata stays clean
+    HIDDEN = ["coalesced_seeds"]
 
     def execute(self, ctx: OpContext, model, seed, steps, cfg, sampler_name,
                 scheduler, positive: Conditioning, negative: Conditioning,
-                latent_image, denoise: float = 1.0):
+                latent_image, denoise: float = 1.0, coalesced_seeds=None):
         ctx.check_interrupt()
+        if coalesced_seeds is not None and not isinstance(seed, SeedValue):
+            seed = SeedValue(int(seed),
+                             per_prompt=np.asarray(coalesced_seeds,
+                                                   np.uint64))
         model = _maybe_gligen_model(model, positive, negative)
         prep = _prepare_sample_inputs(ctx, model, seed, latent_image,
                                       positive, negative)
@@ -1835,14 +1847,25 @@ def _prepare_sample_inputs(ctx: OpContext, model, seed, latent_image,
 
     if isinstance(seed, SeedValue):
         base, distributed = seed.base, seed.distributed
+        per_prompt = getattr(seed, "per_prompt", None)
     else:
-        base, distributed = int(seed), False
+        base, distributed, per_prompt = int(seed), False, None
     if fanout > 1 and distributed:
         seeds = coll.replica_seeds(base, fanout, local_b)
+    elif per_prompt is not None and len(per_prompt) > 0 \
+            and total % len(per_prompt) == 0:
+        # coalesced group: prompt-major layout, each prompt's seed
+        # repeated over its own local batch — together with the tiled
+        # fold index below, every sample draws EXACTLY the (seed, idx)
+        # noise stream its serial run would have drawn
+        seeds = np.repeat(np.asarray(per_prompt, np.uint64),
+                          total // len(per_prompt))
     else:
         seeds = np.full((total,), np.uint64(base), np.uint64)
-    local_idx = np.tile(np.arange(local_b, dtype=np.uint32),
-                        max(fanout, 1))[:total]
+    # fold index cycles per local batch: fanout replicas, and coalesced
+    # prompts, each restart at 0 (a prompt's batch is its own batch-of-b)
+    reps = -(-total // max(local_b, 1))
+    local_idx = np.tile(np.arange(local_b, dtype=np.uint32), reps)[:total]
     if latent_image.get("seed_fixed_batch"):
         # LatentBatchSeedBehavior 'fixed': one noise stream for the
         # whole local batch (replica offsets still apply via seeds)
@@ -4114,9 +4137,22 @@ class PreviewImage(Op):
     OUTPUT_NODE = True
 
     def execute(self, ctx: OpContext, images):
-        arr = as_image_array(images)
-        ctx.saved_images.extend(list(arr))
+        def host_side():
+            with trace_mod.stage("d2h"):
+                arr = as_image_array(images)
+            return list(arr)
+
+        # overlapped pipeline: the d2h fetch rides the host-IO pool (it
+        # also absorbs the wait for the still-running device program —
+        # nothing synchronizes the executor thread)
+        ctx.collect_images(host_side)
         return ()
+
+
+# the save counter scan+write must be atomic across pool threads: two
+# overlapped jobs saving under one prefix would otherwise read the same
+# counter and overwrite each other
+_save_counter_lock = threading.Lock()
 
 
 @register_op
@@ -4128,37 +4164,58 @@ class SaveImage(Op):
 
     def execute(self, ctx: OpContext, images,
                 filename_prefix: str = "DistributedTPU"):
-        arr = as_image_array(images)
-        ctx.saved_images.extend(list(arr))
-        if ctx.output_dir:
-            probe = _safe_output_path(ctx.output_dir,
-                                      f"{filename_prefix}_00000.png")
-            d, fname = os.path.split(probe)
-            base = fname[:-len("_00000.png")]
-            os.makedirs(d, exist_ok=True)
-            # counters continue across runs — a second queue of the same
-            # workflow must never overwrite earlier outputs (ComfyUI's
-            # incrementing-counter save semantics)
-            start = _next_image_counter(d, base)
-            meta = _png_metadata(ctx)
-            for i in range(arr.shape[0]):
-                tensor_to_pil(arr, i).save(
-                    os.path.join(d, f"{base}_{start + i:05d}.png"),
-                    pnginfo=meta)
+        # snapshot the metadata NOW: ctx.prompt_json/extra_pnginfo are
+        # reassigned per run, and the deferred closure may execute while
+        # the next job is already being set up.  Coalesced runs get one
+        # metadata per MERGED PROMPT (each with its own seed values) so
+        # a saved PNG dragged back into a UI reproduces ITS image.
+        output_dir = ctx.output_dir
+        metas = _png_metadata_per_prompt(ctx)
+
+        def host_side():
+            with trace_mod.stage("d2h"):
+                arr = as_image_array(images)
+            if output_dir:
+                probe = _safe_output_path(output_dir,
+                                          f"{filename_prefix}_00000.png")
+                d, fname = os.path.split(probe)
+                base = fname[:-len("_00000.png")]
+                os.makedirs(d, exist_ok=True)
+                # prompt-major batch: image i belongs to prompt i // per
+                per = arr.shape[0] // len(metas) \
+                    if arr.shape[0] % len(metas) == 0 else arr.shape[0]
+                with trace_mod.stage("encode"), _save_counter_lock:
+                    # counters continue across runs — a second queue of
+                    # the same workflow must never overwrite earlier
+                    # outputs (ComfyUI's incrementing-counter semantics)
+                    start = _next_image_counter(d, base)
+                    for i in range(arr.shape[0]):
+                        meta = metas[min(i // max(per, 1),
+                                         len(metas) - 1)]
+                        tensor_to_pil(arr, i).save(
+                            os.path.join(d, f"{base}_{start + i:05d}.png"),
+                            pnginfo=meta)
+            return list(arr)
+
+        ctx.collect_images(host_side)
         return ()
 
 
-def _png_metadata(ctx: OpContext):
+def _png_metadata(ctx: OpContext, prompt_json=None):
     """PIL ``PngInfo`` carrying the executing prompt + extra_pnginfo as
     tEXt chunks (ComfyUI's save contract: ``prompt`` = API-format graph,
     plus one chunk per extra_pnginfo key — typically ``workflow``, the
     UI-format doc the reference ships with every dispatch,
-    ``gpupanel.js:1344-1358``).  None when there is nothing to embed."""
+    ``gpupanel.js:1344-1358``).  None when there is nothing to embed.
+    ``prompt_json`` overrides ``ctx.prompt_json`` (the coalesced
+    per-prompt rewrite)."""
     meta = None
-    if getattr(ctx, "prompt_json", None) is not None:
+    if prompt_json is None:
+        prompt_json = getattr(ctx, "prompt_json", None)
+    if prompt_json is not None:
         from PIL.PngImagePlugin import PngInfo
         meta = PngInfo()
-        meta.add_text("prompt", json.dumps(ctx.prompt_json))
+        meta.add_text("prompt", json.dumps(prompt_json))
     extra = getattr(ctx, "extra_pnginfo", None)
     if extra:
         if meta is None:
@@ -4167,6 +4224,35 @@ def _png_metadata(ctx: OpContext):
         for k, v in dict(extra).items():
             meta.add_text(str(k), json.dumps(v))
     return meta
+
+
+def _png_metadata_per_prompt(ctx: OpContext) -> list:
+    """One PngInfo per prompt merged into this run (length 1 when not
+    coalesced).  The merged graph is prompt 0's; each other prompt's
+    metadata re-applies its own masked widget values from the
+    scheduler's ``coalesced_<widget>s`` hidden overrides, so the
+    ``prompt`` chunk a user reloads carries THEIR seed."""
+    k = max(int(getattr(ctx, "coalesce", 1)), 1)
+    overrides = getattr(ctx, "hidden_overrides", None) or {}
+    base_json = getattr(ctx, "prompt_json", None)
+    if k <= 1 or not overrides or base_json is None:
+        return [_png_metadata(ctx)] * k
+    import copy as _copy
+    metas = []
+    for j in range(k):
+        pj = _copy.deepcopy(base_json)
+        for nid, ov in overrides.items():
+            node = pj.get(nid)
+            if not isinstance(node, dict):
+                continue
+            for key, vals in ov.items():
+                if key.startswith("coalesced_") and key.endswith("s") \
+                        and isinstance(vals, (list, tuple)) \
+                        and j < len(vals):
+                    widget = key[len("coalesced_"):-1]
+                    node.setdefault("inputs", {})[widget] = vals[j]
+        metas.append(_png_metadata(ctx, prompt_json=pj))
+    return metas
 
 
 def _next_image_counter(dirpath: str, base: str,

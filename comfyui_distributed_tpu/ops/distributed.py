@@ -124,8 +124,16 @@ class DistributedCollector(Op):
         # a full-batch round trip.
         with Timer("collector_gather"):
             if isinstance(images, (DeviceTensor, jax.Array)):
-                out = DeviceImage(jax.block_until_ready(
-                    as_device_image(images)), **fanout_meta(images))
+                gathered = as_device_image(images)
+                if ctx.host_pool is None:
+                    # serial path: flush XLA's async dispatch here so the
+                    # timer measures the real wait for the sharded batch
+                    gathered = jax.block_until_ready(gathered)
+                # overlapped pipeline: do NOT synchronize at this op
+                # boundary — the deferred host edge (PNG/HTTP in the
+                # host-IO pool) absorbs the wait while the next job's
+                # compute dispatches
+                out = DeviceImage(gathered, **fanout_meta(images))
             else:
                 out = as_image_array(images)
         if getattr(images, "fanout", 1) > 1:
@@ -137,27 +145,54 @@ class DistributedCollector(Op):
 
     def _send_to_master(self, ctx: OpContext, arr: np.ndarray,
                         multi_job_id: str, master_url: str, worker_id: str):
-        async def send_all():
-            for i in range(arr.shape[0]):
-                png = encode_png(arr[i:i + 1])
+        """Pipelined upload: image i+1's encode runs on an executor
+        thread WHILE image i's POST is in flight (double-buffering), and
+        the payload format is negotiated per master — raw tensor
+        (npy+zstd/deflate, no quantize/filter pass) when the master
+        advertises it, PNG otherwise."""
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        from comfyui_distributed_tpu.utils.image import encode_tensor
+        from comfyui_distributed_tpu.utils.net import (
+            negotiate_wire_format, wire_codec)
 
-                def make_form(i=i, png=png):
+        async def send_all():
+            fmt = await negotiate_wire_format(master_url)
+            codec = wire_codec(master_url)
+            loop = asyncio.get_running_loop()
+            n = arr.shape[0]
+
+            def prep(i):
+                with trace_mod.stage("encode"):
+                    if fmt == C.TENSOR_WIRE_CONTENT_TYPE:
+                        return (encode_tensor(arr[i:i + 1], codec),
+                                fmt, "dtt")
+                    return encode_png(arr[i:i + 1]), "image/png", "png"
+
+            nxt = loop.run_in_executor(None, prep, 0)
+            for i in range(n):
+                payload, ctype, ext = await nxt
+                if i + 1 < n:  # prefetch: encode i+1 during i's upload
+                    nxt = loop.run_in_executor(None, prep, i + 1)
+
+                def make_form(i=i, payload=payload, ctype=ctype, ext=ext):
                     import aiohttp
                     form = aiohttp.FormData()
                     form.add_field("multi_job_id", multi_job_id)
                     form.add_field("worker_id", str(worker_id))
                     form.add_field("image_index", str(i))
-                    form.add_field("is_last", "true" if i == arr.shape[0] - 1
+                    form.add_field("is_last", "true" if i == n - 1
                                    else "false")
-                    form.add_field("image", png, filename=f"img_{i}.png",
-                                   content_type="image/png")
+                    form.add_field("image", payload,
+                                   filename=f"img_{i}.{ext}",
+                                   content_type=ctype)
                     return form
 
                 # retry with backoff — absorbs transient master stalls and
                 # the prepare-race 404 exactly like the tile path
-                await post_form_with_retry(
-                    f"{master_url}/distributed/job_complete", make_form,
-                    timeout=C.TILE_SEND_TIMEOUT, what="job_complete")
+                with trace_mod.stage("upload"):
+                    await post_form_with_retry(
+                        f"{master_url}/distributed/job_complete", make_form,
+                        timeout=C.TILE_SEND_TIMEOUT, what="job_complete")
 
         if ctx.server_loop is not None:
             run_async_in_loop(send_all(), ctx.server_loop,

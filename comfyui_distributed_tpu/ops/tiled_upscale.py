@@ -175,12 +175,18 @@ class UltimateSDUpscaleDistributed(Op):
                       negative: Conditioning, p: Dict[str, Any],
                       positions: Sequence[Tuple[int, int]] = None,
                       img_size: Tuple[int, int] = None,
-                      shard: bool = False) -> np.ndarray:
+                      shard: bool = False,
+                      return_device: bool = False) -> np.ndarray:
         """VAE-encode -> sample(denoise) -> decode a [N, th, tw, C] tile
         batch.  Per-tile seed = seed + tile_idx with a fixed fold index so
         results are layout-independent.  Regional conditionings (siblings
         / area masks) refine with their masks cropped per tile window
-        (``_regional_entries``)."""
+        (``_regional_entries``).
+
+        ``return_device``: hand back the decoded batch still ON DEVICE —
+        the worker send path fetches tile-by-tile so tile k+1's d2h can
+        overlap tile k's HTTP upload (double-buffering) instead of one
+        big synchronous fetch before the first byte moves."""
         from comfyui_distributed_tpu.ops.basic import _sdxl_vector_cond
         n = tiles.shape[0]
         seeds = np.asarray([p["seed"] + int(t) for t in tile_indices],
@@ -236,8 +242,8 @@ class UltimateSDUpscaleDistributed(Op):
                 sampler_name=p["sampler_name"], scheduler=p["scheduler"],
                 denoise=p["denoise"], add_noise=True, sample_idx=idx, y=y,
                 donate_latents=True)
-            return as_image_array(
-                jnp.clip(pipe.vae_decode(out_lat), 0.0, 1.0))
+            decoded = jnp.clip(pipe.vae_decode(out_lat), 0.0, 1.0)
+            return decoded if return_device else as_image_array(decoded)
         ctx_arr = jnp.repeat(positive.context, n, axis=0)
         unc_arr = jnp.repeat(negative.context, n, axis=0)
         y = None
@@ -285,9 +291,10 @@ class UltimateSDUpscaleDistributed(Op):
         # clamp at the decode boundary (ComfyUI VAEDecode parity): the
         # worker->master PNG wire clips to [0,1], so unclamped local tiles
         # would blend differently from the same tile shipped over HTTP.
-        # Clip ON device, then ONE counted fetch for the host-side blend.
-        return as_image_array(
-            jnp.clip(pipe.vae_decode(out_lat), 0.0, 1.0))
+        # Clip ON device, then ONE counted fetch for the host-side blend
+        # (or none — the worker send path streams tile-by-tile).
+        decoded = jnp.clip(pipe.vae_decode(out_lat), 0.0, 1.0)
+        return decoded if return_device else as_image_array(decoded)
 
     def _window_to_extracted(self, tile: np.ndarray, pos: Tuple[int, int],
                              p: Dict[str, Any], img_size: Tuple[int, int]
@@ -382,31 +389,66 @@ class UltimateSDUpscaleDistributed(Op):
         debug_log(f"worker {worker_id}: tiles {mine[0]}..{mine[-1]}")
         tiles = tiling.extract_tiles(image, [all_tiles[i] for i in mine],
                                      p["tile_w"], p["tile_h"], p["padding"])
+        # keep the refined batch ON DEVICE: the send loop fetches one
+        # tile at a time, overlapping tile k+1's d2h+encode with tile
+        # k's HTTP upload (double-buffering)
         refined = self._refine_batch(ctx, pipe, tiles, mine,
                                      positive, negative, p,
                                      positions=[all_tiles[i] for i in mine],
-                                     img_size=(w, h))
+                                     img_size=(w, h), return_device=True)
         self._send_tiles(ctx, refined, mine, all_tiles, p, multi_job_id,
                          master_url, worker_id, (w, h))
         return (image,)
 
-    def _send_tiles(self, ctx: OpContext, refined: np.ndarray,
-                    indices: Sequence[int], all_tiles, p, multi_job_id,
-                    master_url, worker_id, img_size) -> None:
+    def _send_tiles(self, ctx: OpContext, refined, indices: Sequence[int],
+                    all_tiles, p, multi_job_id, master_url, worker_id,
+                    img_size) -> None:
+        """Double-buffered tile upload: while tile k's POST is in flight,
+        tile k+1's d2h fetch + window transform + encode run on an
+        executor thread, so the NIC and the device/encoder are busy at
+        the same time.  Payload format negotiated per master (raw tensor
+        when advertised, PNG fallback)."""
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        from comfyui_distributed_tpu.utils.image import encode_tensor
+        from comfyui_distributed_tpu.utils.net import (
+            negotiate_wire_format, wire_codec)
         w, h = img_size
 
         async def send_all():
-            for k, tile_idx in enumerate(indices):
-                # the wire carries the clamped extraction region at natural
-                # size — the exact form the master's blend consumes; sending
-                # the raw window would make the master resize-distort it to
-                # the advertised extracted_width/height at image edges
+            fmt = await negotiate_wire_format(master_url)
+            codec = wire_codec(master_url)
+            loop = asyncio.get_running_loop()
+
+            def prep(k):
+                tile_idx = indices[k]
+                # d2h ONE tile (counted; refined may be a device batch)
+                with trace_mod.stage("d2h"):
+                    row = as_image_array(refined[k:k + 1])[0]
+                # the wire carries the clamped extraction region at
+                # natural size — the exact form the master's blend
+                # consumes; sending the raw window would make the master
+                # resize-distort it at image edges
                 tile, (x1, y1, x2, y2) = self._window_to_extracted(
-                    refined[k], all_tiles[tile_idx], p, (w, h))
-                png = encode_png(tile[None])
+                    row, all_tiles[tile_idx], p, (w, h))
+                with trace_mod.stage("encode"):
+                    if fmt == C.TENSOR_WIRE_CONTENT_TYPE:
+                        payload, ctype, ext = (encode_tensor(tile[None],
+                                                             codec),
+                                               fmt, "dtt")
+                    else:
+                        payload, ctype, ext = (encode_png(tile[None]),
+                                               "image/png", "png")
+                return payload, ctype, ext, (x1, y1, x2, y2)
+
+            nxt = loop.run_in_executor(None, prep, 0)
+            for k, tile_idx in enumerate(indices):
+                payload, ctype, ext, (x1, y1, x2, y2) = await nxt
+                if k + 1 < len(indices):   # prefetch the next tile's
+                    nxt = loop.run_in_executor(None, prep, k + 1)
 
                 def make_form(k=k, tile_idx=tile_idx, x1=x1, y1=y1,
-                              x2=x2, y2=y2, png=png):
+                              x2=x2, y2=y2, payload=payload, ctype=ctype,
+                              ext=ext):
                     import aiohttp
                     form = aiohttp.FormData()
                     form.add_field("multi_job_id", multi_job_id)
@@ -419,16 +461,17 @@ class UltimateSDUpscaleDistributed(Op):
                     form.add_field("padding", str(p["padding"]))
                     form.add_field("is_last", "true" if k == len(indices) - 1
                                    else "false")
-                    form.add_field("tile", png,
-                                   filename=f"tile_{tile_idx}.png",
-                                   content_type="image/png")
+                    form.add_field("tile", payload,
+                                   filename=f"tile_{tile_idx}.{ext}",
+                                   content_type=ctype)
                     return form
 
                 # exponential backoff incl. 404 (queue-not-ready race) —
                 # reference distributed_upscale.py:618-665
-                await post_form_with_retry(
-                    f"{master_url}/distributed/tile_complete", make_form,
-                    timeout=C.TILE_TRANSFER_TIMEOUT, what="tile_complete")
+                with trace_mod.stage("upload"):
+                    await post_form_with_retry(
+                        f"{master_url}/distributed/tile_complete", make_form,
+                        timeout=C.TILE_TRANSFER_TIMEOUT, what="tile_complete")
 
         if ctx.server_loop is not None:
             run_async_in_loop(send_all(), ctx.server_loop,

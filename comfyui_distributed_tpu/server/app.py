@@ -42,6 +42,7 @@ import base64
 import itertools
 import json
 import os
+import queue
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -55,23 +56,49 @@ from comfyui_distributed_tpu.runtime.manager import (
     auto_launch_workers,
 )
 from comfyui_distributed_tpu.utils import config as cfg_mod
+from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils import net as net_mod
+from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.constants import LOG_TAIL_BYTES
-from comfyui_distributed_tpu.utils.image import decode_png
+from comfyui_distributed_tpu.utils.image import decode_png, decode_tensor
 from comfyui_distributed_tpu.utils.logging import debug_log, log
+from comfyui_distributed_tpu.workflow import scheduler as sched_mod
 from comfyui_distributed_tpu.workflow.executor import WorkflowExecutor
+
+
+class QueueFullError(RuntimeError):
+    """enqueue_prompt hit the DTPU_MAX_QUEUE backpressure cap."""
+
+
+class DrainingError(RuntimeError):
+    """enqueue_prompt refused: the server is shutting down."""
+
+
+def _env_flag(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default).lower() not in ("0", "false", "off")
 
 
 class ServerState:
     """Everything the handlers share: config path, job store, process
-    manager, the execution queue and its worker thread."""
+    manager, the execution queue and its pipelined worker thread.
+
+    The execution pipeline (ISSUE 2): the exec thread pops a *group* of
+    signature-identical prompts (workflow/scheduler.py) and runs them as
+    one batched dispatch; OUTPUT-node host edges (d2h/PNG/disk) defer
+    onto a bounded host-IO pool so job N's encode overlaps job N+1's
+    denoise loop; a finalizer thread joins the deferred work and writes
+    history/metrics.  ``overlap``/``coalesce`` default from
+    DTPU_OVERLAP/DTPU_COALESCE ("0" restores the strictly serial seed
+    behavior — same thread does everything)."""
 
     def __init__(self, config_path: Optional[str] = None,
                  is_worker: bool = False,
                  input_dir: Optional[str] = None,
                  output_dir: Optional[str] = None,
                  models_dir: Optional[str] = None,
-                 start_exec_thread: bool = True):
+                 start_exec_thread: bool = True,
+                 overlap: Optional[bool] = None,
+                 coalesce: Optional[bool] = None):
         self.config_path = config_path
         self.is_worker = is_worker
         self.port: Optional[int] = None  # set by serve()
@@ -94,16 +121,42 @@ class ServerState:
             "images_received": 0, "tiles_received": 0,
             "last_execution_s": None,
         }
+        self.max_queue = int(os.environ.get(C.MAX_QUEUE_ENV,
+                                            C.MAX_QUEUE_DEFAULT))
+        self.overlap_enabled = _env_flag(C.OVERLAP_ENV) \
+            if overlap is None else bool(overlap)
+        self.coalesce_enabled = _env_flag(C.COALESCE_ENV) \
+            if coalesce is None else bool(coalesce)
+        self.coalesce_max = max(1, int(os.environ.get(
+            C.COALESCE_MAX_ENV, C.COALESCE_MAX_DEFAULT)))
+        self.host_pool = net_mod.HostIOPool() if self.overlap_enabled \
+            else None
         self._queue: List[Dict[str, Any]] = []
         self._queue_lock = threading.Lock()
         self._queue_event = threading.Event()
+        # bench/test hook: the exec loop waits here before popping, so a
+        # caller can clear it, stage a burst that must coalesce into ONE
+        # group, and set it again — no race against the pop
+        self._exec_gate = threading.Event()
+        self._exec_gate.set()
         self._running = False
+        self._draining = False
         self._history: Dict[str, Any] = {}
         self._id_counter = itertools.count()
+        # finalizer plumbing (overlap mode): (group, result, error, wall)
+        # tuples, joined off the exec thread so compute never waits on
+        # encode/disk.  FIFO -> history lands in execution order.
+        self._finalize_q: "queue.Queue" = queue.Queue()
+        self._finalize_pending = 0
+        self._exec_started = bool(start_exec_thread)
         if start_exec_thread:
             t = threading.Thread(target=self._exec_loop, daemon=True,
                                  name="dtpu-exec")
             t.start()
+            if self.overlap_enabled:
+                f = threading.Thread(target=self._finalize_loop,
+                                     daemon=True, name="dtpu-finalize")
+                f.start()
 
     def _drop_tile_queues(self, prompt: Dict[str, Any]) -> None:
         """Remove master-mode tile queues for a finished prompt.  They're
@@ -137,25 +190,60 @@ class ServerState:
     def enqueue_prompt(self, prompt: Dict[str, Any], client_id: str,
                        extra_data: Optional[Dict[str, Any]] = None) -> str:
         pid = f"p_{int(time.time() * 1000)}_{next(self._id_counter)}"
+        # signature hashed OUTSIDE the lock (it walks the whole graph):
+        # _pop_group then only compares strings under the lock
+        sig = sched_mod.coalesce_signature(prompt) \
+            if self.coalesce_enabled else None
         with self._queue_lock:
+            if self._draining:
+                raise DrainingError("server is draining; not accepting "
+                                    "prompts")
+            if len(self._queue) >= self.max_queue:
+                raise QueueFullError(
+                    f"prompt queue full ({self.max_queue})")
             self._queue.append({"id": pid, "prompt": prompt,
                                 "client_id": client_id,
-                                "extra_data": extra_data or {}})
+                                "extra_data": extra_data or {},
+                                "sig": sig,
+                                "t_enq": time.perf_counter()})
         self._queue_event.set()
         return pid
+
+    def _pop_group(self) -> Optional[List[Dict[str, Any]]]:
+        """Pop the next dispatch group: the head prompt plus the longest
+        CONTIGUOUS run of queued prompts sharing its coalescing
+        signature (capped at DTPU_MAX_COALESCE).  Contiguity is what
+        preserves per-client FIFO order: no prompt ever executes before
+        one queued ahead of it."""
+        with self._queue_lock:
+            if not self._queue:
+                self._queue_event.clear()
+                return None
+            group = [self._queue.pop(0)]
+            if self.coalesce_enabled:
+                sig = group[0].get("sig")
+                while (sig is not None and self._queue
+                       and len(group) < self.coalesce_max
+                       and self._queue[0].get("sig") == sig):
+                    group.append(self._queue.pop(0))
+            self._running = True
+        now = time.perf_counter()
+        for item in group:
+            trace_mod.GLOBAL_STAGES.record(
+                "queue_wait", now - item.get("t_enq", now))
+        return group
 
     def _exec_loop(self) -> None:
         from comfyui_distributed_tpu.parallel.mesh import get_runtime
         while True:
             self._queue_event.wait()
-            with self._queue_lock:
-                if not self._queue:
-                    self._queue_event.clear()
-                    continue
-                item = self._queue.pop(0)
-                self._running = True
+            self._exec_gate.wait()
+            group = self._pop_group()
+            if group is None:
+                continue
             self.interrupt_event.clear()
             t0 = time.perf_counter()
+            res, err = None, None
             try:
                 ctx = OpContext(
                     runtime=get_runtime(),
@@ -166,29 +254,134 @@ class ServerState:
                     job_store=self.jobs,
                     server_loop=self.loop,
                     interrupt_event=self.interrupt_event,
+                    host_pool=self.host_pool,
                 )
-                res = WorkflowExecutor(ctx).execute(
-                    item["prompt"],
-                    extra_pnginfo=item.get("extra_data", {}).get(
-                        "extra_pnginfo"))
-                self._history[item["id"]] = {
-                    "status": "success",
-                    "images": len(res.images),
-                    "duration_s": res.total_s,
-                }
-                self.metrics["prompts_executed"] += 1
-                self.metrics["last_execution_s"] = res.total_s
+                first = group[0]
+                trace_mod.GLOBAL_COUNTERS.bump("exec_runs")
+                if len(group) > 1:
+                    graph, hidden = sched_mod.build_coalesced(
+                        [it["prompt"] for it in group])
+                    ctx.coalesce = len(group)
+                    trace_mod.GLOBAL_COUNTERS.bump("coalesced_batches")
+                    trace_mod.GLOBAL_COUNTERS.bump("coalesced_prompts",
+                                                   len(group))
+                    debug_log(f"coalesced {len(group)} prompts into one "
+                              f"dispatch ({first['id']}..)")
+                    with trace_mod.stage("coalesced_batch"):
+                        res = WorkflowExecutor(ctx).execute(
+                            graph, hidden=hidden,
+                            extra_pnginfo=first.get("extra_data", {}).get(
+                                "extra_pnginfo"))
+                else:
+                    res = WorkflowExecutor(ctx).execute(
+                        first["prompt"],
+                        extra_pnginfo=first.get("extra_data", {}).get(
+                            "extra_pnginfo"))
+                trace_mod.GLOBAL_STAGES.record("compute", res.total_s)
             except Exception as e:  # noqa: BLE001 - survive bad prompts
-                log(f"prompt {item['id']} failed: {type(e).__name__}: {e}")
-                self._history[item["id"]] = {"status": "error",
-                                             "error": str(e)}
-                self.metrics["prompts_failed"] += 1
+                err = e
             finally:
-                self._drop_tile_queues(item["prompt"])
                 with self._queue_lock:
                     self._running = False
-                debug_log(f"prompt {item['id']} done in "
-                          f"{time.perf_counter() - t0:.2f}s")
+                    self._finalize_pending += 1
+            if self.overlap_enabled:
+                # hand host-side joining to the finalizer so the next
+                # group's compute starts NOW — this is the overlap
+                self._finalize_q.put((group, res, err, t0))
+            else:
+                self._finalize_group(group, res, err, t0)
+
+    def _finalize_loop(self) -> None:
+        while True:
+            group, res, err, t0 = self._finalize_q.get()
+            self._finalize_group(group, res, err, t0)
+
+    def _finalize_group(self, group, res, err, t0) -> None:
+        """Join deferred host edges, split per-prompt results, write
+        history/metrics, drop orphan tile queues."""
+        if res is not None and err is None:
+            try:
+                res.wait_host()
+            except Exception as e:  # noqa: BLE001 - host edge failed
+                err = e
+        k = len(group)
+        done_t = time.time()
+        if err is None:
+            per_prompt = sched_mod.split_images(res.images, k)
+            # metrics BEFORE history: clients poll history for
+            # completion, then read metrics — the other order would give
+            # them a window where the prompt is "done" but uncounted
+            self.metrics["prompts_executed"] += k
+            self.metrics["last_execution_s"] = res.total_s
+            for item, imgs in zip(group, per_prompt):
+                entry = {"status": "success", "images": len(imgs),
+                         "duration_s": res.total_s,
+                         "finished_at": done_t}
+                if k > 1:
+                    entry["coalesced"] = k
+                self._history[item["id"]] = entry
+        else:
+            log(f"prompt group {group[0]['id']} (x{k}) failed: "
+                f"{type(err).__name__}: {err}")
+            self.metrics["prompts_failed"] += k
+            for item in group:
+                entry = {"status": "error", "error": str(err),
+                         "finished_at": done_t}
+                if k > 1:
+                    entry["coalesced"] = k
+                self._history[item["id"]] = entry
+        for item in group:
+            self._drop_tile_queues(item["prompt"])
+        with self._queue_lock:
+            self._finalize_pending -= 1
+        debug_log(f"group {group[0]['id']} (x{k}) done in "
+                  f"{time.perf_counter() - t0:.2f}s")
+
+    # --- graceful drain -----------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop accepting prompts, let the queue, the
+        in-flight group and the host-IO pool finish (bounded by
+        DTPU_DRAIN_TIMEOUT_S), then cancel what remains.  Returns True
+        when everything drained inside the bound."""
+        if timeout is None:
+            timeout = float(os.environ.get(C.DRAIN_TIMEOUT_ENV,
+                                           C.DRAIN_TIMEOUT_DEFAULT))
+        with self._queue_lock:
+            self._draining = True
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while True:
+            with self._queue_lock:
+                # without an exec thread nothing will ever pop the queue
+                # — only in-flight/host work is drainable
+                idle = (not self._running and self._finalize_pending == 0
+                        and (not self._queue or not self._exec_started))
+            if idle and (self.host_pool is None
+                         or self.host_pool.pending == 0):
+                return True
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+        # bound exceeded: purge the not-yet-started queue FIRST (or the
+        # exec loop would keep popping groups — and clearing the
+        # interrupt flag — right through the shutdown), then cancel the
+        # in-flight work instead of dying mid-job silently (the compiled
+        # samplers poll the flag per step)
+        with self._queue_lock:
+            purged, self._queue = self._queue, []
+        done_t = time.time()
+        for item in purged:
+            self._history[item["id"]] = {
+                "status": "error",
+                "error": "cancelled: server drain timeout",
+                "finished_at": done_t}
+        self.metrics["prompts_failed"] += len(purged)
+        log(f"drain timeout after {timeout:.1f}s; cancelled "
+            f"{len(purged)} queued prompt(s), interrupting in-flight work")
+        self.interrupt_event.set()
+        if self.host_pool is not None:
+            self.host_pool.shutdown(wait=False)
+        return False
 
 
 def build_app(state: Optional[ServerState] = None) -> web.Application:
@@ -200,6 +393,11 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         state.loop = asyncio.get_running_loop()
 
     async def on_cleanup(app):
+        # graceful drain: refuse new prompts, let the in-flight group and
+        # the encoder pool finish (bounded), THEN drop the HTTP client —
+        # the exec thread used to be a daemon that died mid-job here
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, state.drain)
         await net_mod.cleanup_client_session()
 
     app.on_startup.append(on_startup)
@@ -285,9 +483,20 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
 
     async def metrics(request):
         from comfyui_distributed_tpu.utils.trace import (
-            GLOBAL_PHASES, counters_snapshot)
+            GLOBAL_PHASES, counters_snapshot, pipeline_snapshot)
         return web.json_response({**state.metrics,
                                   "phases": GLOBAL_PHASES.snapshot(),
+                                  # per-job stage timeline (queue_wait /
+                                  # coalesced_batch / compute / d2h /
+                                  # encode / upload) + scheduler and wire
+                                  # counters: the overlapped-pipeline
+                                  # health signals
+                                  "pipeline": {
+                                      **pipeline_snapshot(),
+                                      "overlap": state.overlap_enabled,
+                                      "coalesce": state.coalesce_enabled,
+                                      "max_queue": state.max_queue,
+                                  },
                                   # host<->device transfer bytes per node
                                   # + jit trace/XLA compile counts: the
                                   # tensor-plane health signals (steady
@@ -455,7 +664,34 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         mj = request.query.get("multi_job_id", "")
         exists = await state.jobs.has_tile_job(mj) or \
             await state.jobs.has_job(mj)
-        return web.json_response({"exists": exists})
+        return web.json_response({"exists": exists,
+                                  "queue_remaining":
+                                      state.queue_remaining(),
+                                  "max_queue": state.max_queue})
+
+    async def wire_formats(request):
+        """Wire negotiation (utils.net.negotiate_wire_format): workers
+        probe this once per master; a master listing the raw-tensor type
+        gets npy uploads instead of PNG — less encode CPU and fewer wire
+        bytes on the worker->master hop.  ``tensor_codecs`` names what
+        THIS build can decode so a zstd-capable worker never sends zstd
+        at a deflate-only master."""
+        from comfyui_distributed_tpu.utils.image import tensor_codecs
+        return web.json_response({
+            "formats": [C.TENSOR_WIRE_CONTENT_TYPE, "image/png"],
+            "tensor_codecs": tensor_codecs()})
+
+    def _decode_upload(field) -> Any:
+        """Multipart image/tile field -> tensor, honoring the negotiated
+        content type (raw tensor or PNG) with wire accounting."""
+        data = field.file.read()
+        if (field.content_type or "") == C.TENSOR_WIRE_CONTENT_TYPE:
+            trace_mod.GLOBAL_COUNTERS.bump("wire_tensor_msgs")
+            trace_mod.GLOBAL_COUNTERS.bump("wire_tensor_bytes", len(data))
+            return decode_tensor(data)
+        trace_mod.GLOBAL_COUNTERS.bump("wire_png_msgs")
+        trace_mod.GLOBAL_COUNTERS.bump("wire_png_bytes", len(data))
+        return decode_png(data)
 
     async def job_complete(request):
         form = await request.post()
@@ -463,11 +699,11 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         img_field = form.get("image")
         if not mj or img_field is None:
             return web.json_response({"error": "missing fields"}, status=400)
-        # PNG decode off the event loop: concurrent uploads must not stall
+        # decode off the event loop: concurrent uploads must not stall
         # the control plane (a stalled /prompt fails preflight's 300ms probe)
         loop = asyncio.get_running_loop()
         tensor = await loop.run_in_executor(
-            None, lambda: decode_png(img_field.file.read()))
+            None, lambda: _decode_upload(img_field))
         item = {
             "worker_id": form.get("worker_id", ""),
             "is_last": str(form.get("is_last", "false")).lower() == "true",
@@ -501,7 +737,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             "padding": int(form.get("padding", 0)),
             "is_last": str(form.get("is_last", "false")).lower() == "true",
             "tensor": await asyncio.get_running_loop().run_in_executor(
-                None, lambda: decode_png(tile_field.file.read())),
+                None, lambda: _decode_upload(tile_field)),
         }
         if not await state.jobs.put_tile(mj, item):
             # unknown/expired tile job -> 404; the worker's retry loop backs
@@ -584,6 +820,15 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                     "failed_workers": out.get("failed", []),
                 })
             pid = state.enqueue_prompt(prompt, client_id, extra_data)
+        except QueueFullError as e:
+            # backpressure (DTPU_MAX_QUEUE): tell the client how deep the
+            # queue is so its retry policy can back off intelligently
+            return web.json_response(
+                {"error": str(e),
+                 "queue_remaining": state.queue_remaining(),
+                 "max_queue": state.max_queue}, status=429)
+        except DrainingError as e:
+            return web.json_response({"error": str(e)}, status=503)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": str(e)}, status=400)
         return web.json_response({"prompt_id": pid,
@@ -667,6 +912,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_post("/distributed/worker/clear_launching", clear_launching)
     r.add_post("/distributed/prepare_job", prepare_job)
     r.add_get("/distributed/queue_status", queue_status)
+    r.add_get("/distributed/wire_formats", wire_formats)
     r.add_post("/distributed/job_complete", job_complete)
     r.add_post("/distributed/tile_complete", tile_complete)
     r.add_post("/distributed/load_image", load_image)

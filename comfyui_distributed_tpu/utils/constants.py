@@ -51,6 +51,43 @@ TILE_AXIS = DATA_AXIS    # tiles shard over the same physical axis as replicas
 # --- wire formats -----------------------------------------------------------
 TENSOR_WIRE_DTYPE = "float32"
 IMAGE_WIRE_FORMAT = "png"        # lossless, reference parity (compress_level=0)
+# raw-tensor fast path on the worker->master hop: npy payload compressed
+# with zstd when available, else deflate (the container may lack the
+# zstandard module; utils.image gates on import).  Negotiated per master
+# via GET /distributed/wire_formats — peers that don't advertise it get
+# PNG, exactly the reference wire.
+TENSOR_WIRE_CONTENT_TYPE = "application/x-dtpu-tensor"
+WIRE_FORMAT_ENV = "DTPU_WIRE"    # "png" forces the compatibility format
+
+# --- overlapped execution pipeline ------------------------------------------
+# Batch-coalescing scheduler + compute/host-IO overlap (server/app.py,
+# workflow/scheduler.py).  Envs resolve at ServerState construction so
+# tests can pin either path.
+MAX_QUEUE_ENV = "DTPU_MAX_QUEUE"         # /prompt backpressure cap
+MAX_QUEUE_DEFAULT = 256                  # full queue -> HTTP 429
+DRAIN_TIMEOUT_ENV = "DTPU_DRAIN_TIMEOUT_S"
+DRAIN_TIMEOUT_DEFAULT = 30.0             # graceful-shutdown drain bound
+OVERLAP_ENV = "DTPU_OVERLAP"             # "0" -> serial (host work inline)
+COALESCE_ENV = "DTPU_COALESCE"           # "0" -> one prompt per dispatch
+COALESCE_MAX_ENV = "DTPU_MAX_COALESCE"
+COALESCE_MAX_DEFAULT = 8                 # largest batched prompt group
+HOSTIO_THREADS_ENV = "DTPU_HOSTIO_THREADS"
+HOSTIO_THREADS_DEFAULT = 2               # encoder/uploader pool width
+HOSTIO_PENDING_ENV = "DTPU_HOSTIO_PENDING"
+HOSTIO_PENDING_DEFAULT = 16              # bounded: submit blocks past this
+
+# Node types the batch-coalescing scheduler may merge along the data
+# axis.  Deliberately conservative: every type here is batch-parallel
+# (per-sample math; no cross-sample state, no HTTP side channel), the
+# only batch SOURCE is EmptyLatentImage (so multiplying its batch_size
+# scales the whole graph), and per-prompt variation is confined to the
+# KSampler seed widget (masked out of the coalescing signature).
+# Anything else runs one-prompt-per-dispatch — correctness first.
+COALESCE_SAFE_NODE_TYPES = frozenset({
+    "CheckpointLoaderSimple", "CLIPTextEncode", "CLIPSetLastLayer",
+    "LoraLoader", "LoraLoaderModelOnly", "EmptyLatentImage", "KSampler",
+    "VAEDecode", "VAEDecodeTiled", "SaveImage", "PreviewImage",
+})
 
 # --- persistent compilation cache -------------------------------------------
 # Directory for JAX's persistent (on-disk) XLA compilation cache.  Resolution

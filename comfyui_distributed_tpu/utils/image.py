@@ -74,17 +74,64 @@ def decode_png(data: bytes) -> np.ndarray:
     return pil_to_tensor(img)
 
 
-def encode_npz(x) -> bytes:
-    """Raw-tensor wire format — a lossless, dtype-preserving alternative the
-    reference lacks (PNG clamps to uint8); used for latents/metadata."""
+# --- raw-tensor wire format (application/x-dtpu-tensor) ----------------------
+#
+# The PNG wire costs a float->uint8 quantize + zlib filter pass per image
+# and clamps to 8 bits; between our own processes neither is needed.  The
+# fast path ships the npy header+buffer compressed: 4-byte magic, 1 codec
+# byte, payload.  The SENDER only emits a codec the receiver advertised
+# (GET /distributed/wire_formats lists ``tensor_codecs``;
+# utils.net.negotiate_wire_format picks the best shared one) — zstd is
+# optional on both ends (the container may not ship the module — gate,
+# don't install) and zlib is the always-available floor, so a
+# zstd-capable worker never strands a deflate-only master.
+
+_TENSOR_WIRE_MAGIC = b"DTT1"
+_CODEC_ZLIB = 1
+_CODEC_ZSTD = 2
+
+try:  # optional dependency — never required
+    import zstandard as _zstd
+except ImportError:  # pragma: no cover - environment-dependent
+    _zstd = None
+
+
+def tensor_codecs() -> List[str]:
+    """Codecs THIS process can decode, best-first (wire negotiation)."""
+    return (["zstd", "zlib"] if _zstd is not None else ["zlib"])
+
+
+def encode_tensor(x, codec: str = "zlib") -> bytes:
+    """Array -> raw-tensor wire bytes (lossless, dtype-preserving).
+    ``codec`` must be one the RECEIVER advertised; default is the
+    always-decodable zlib."""
+    import zlib
     buf = io.BytesIO()
-    np.savez_compressed(buf, data=to_numpy(x))
-    return buf.getvalue()
+    np.save(buf, np.ascontiguousarray(to_numpy(x)), allow_pickle=False)
+    raw = buf.getvalue()
+    if codec == "zstd" and _zstd is not None:
+        return (_TENSOR_WIRE_MAGIC + bytes([_CODEC_ZSTD])
+                + _zstd.ZstdCompressor(level=3).compress(raw))
+    return _TENSOR_WIRE_MAGIC + bytes([_CODEC_ZLIB]) + zlib.compress(raw, 1)
 
 
-def decode_npz(data: bytes) -> np.ndarray:
-    with np.load(io.BytesIO(data)) as z:
-        return z["data"]
+def decode_tensor(data: bytes) -> np.ndarray:
+    """Raw-tensor wire bytes -> [B,H,W,C] float32 (the shape contract the
+    PNG path honors; callers see the same value either way)."""
+    import zlib
+    if data[:4] != _TENSOR_WIRE_MAGIC:
+        raise ValueError("bad tensor wire magic")
+    codec, payload = data[4], data[5:]
+    if codec == _CODEC_ZSTD:
+        if _zstd is None:
+            raise ValueError("zstd tensor payload but zstandard missing")
+        raw = _zstd.ZstdDecompressor().decompress(payload)
+    elif codec == _CODEC_ZLIB:
+        raw = zlib.decompress(payload)
+    else:
+        raise ValueError(f"unknown tensor wire codec {codec}")
+    arr = np.load(io.BytesIO(raw), allow_pickle=False)
+    return ensure_bhwc(np.asarray(arr, np.float32))
 
 
 def resize_image(x, width: int, height: int, method: str = "lanczos") -> np.ndarray:

@@ -12,6 +12,7 @@ import asyncio
 import concurrent.futures
 import socket
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import aiohttp
@@ -105,6 +106,151 @@ async def post_form_with_retry(url: str, make_form, timeout: float,
             debug_log(f"{what} retry {attempt + 1}: {e}")
             await asyncio.sleep(delay)
             delay = min(delay * 2, C.SEND_BACKOFF_CAP)
+
+
+# --- overlapped host-IO pool -------------------------------------------------
+
+class HostIOPool:
+    """Bounded encoder/uploader pool: device->host fetches, PNG/tensor
+    encodes and disk writes move here so job N's host edge overlaps job
+    N+1's device compute (JAX's async dispatch makes the overlap free
+    once nothing synchronizes on the executor thread).
+
+    Bounded on purpose: ``max_pending`` in-flight tasks, then ``submit``
+    blocks the producer — device compute can outrun a slow disk/NIC
+    without buffering unbounded decoded batches in host RAM."""
+
+    def __init__(self, max_workers: Optional[int] = None,
+                 max_pending: Optional[int] = None):
+        import concurrent.futures
+        import os
+
+        from comfyui_distributed_tpu.utils import constants as C
+        max_workers = max_workers or int(os.environ.get(
+            C.HOSTIO_THREADS_ENV, C.HOSTIO_THREADS_DEFAULT))
+        max_pending = max_pending or int(os.environ.get(
+            C.HOSTIO_PENDING_ENV, C.HOSTIO_PENDING_DEFAULT))
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, max_workers),
+            thread_name_prefix="dtpu-hostio")
+        self._slots = threading.BoundedSemaphore(max(1, max_pending))
+        self._pending = 0
+        self._idle = threading.Condition(threading.Lock())
+
+    @property
+    def pending(self) -> int:
+        with self._idle:
+            return self._pending
+
+    def submit(self, fn, *args, stage: Optional[str] = None):
+        """Schedule ``fn(*args)`` on the pool; returns a Future.
+
+        The submitting thread's transfer attribution (workflow node +
+        per-run sinks) is captured and re-entered in the worker so the
+        deferred d2h still lands in the run's ledger; ``stage`` times the
+        task into the pipeline stage timeline."""
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        captured = trace_mod.capture_transfer_context()
+        self._slots.acquire()
+        with self._idle:
+            self._pending += 1
+
+        def run():
+            try:
+                with trace_mod.transfer_context(captured):
+                    if stage:
+                        with trace_mod.stage(stage):
+                            return fn(*args)
+                    return fn(*args)
+            finally:
+                with self._idle:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.notify_all()
+                self._slots.release()
+
+        return self._pool.submit(run)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted task finished; False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._idle:
+            while self._pending > 0:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._idle.wait(timeout=remaining)
+        return True
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._pool.shutdown(wait=wait, cancel_futures=not wait)
+
+
+# --- wire-format negotiation -------------------------------------------------
+
+# master_url -> (negotiated upload content type, tensor codec); one
+# probe per master per process (a fallen-back master stays PNG until
+# reset_wire_cache()).
+_wire_formats: Dict[str, tuple] = {}
+_wire_lock = threading.Lock()
+
+
+def reset_wire_cache() -> None:
+    with _wire_lock:
+        _wire_formats.clear()
+
+
+def wire_codec(master_url: str) -> str:
+    """The tensor codec negotiated with ``master_url`` (after
+    :func:`negotiate_wire_format` ran); zlib — the floor every build
+    decodes — when nothing is cached."""
+    with _wire_lock:
+        return _wire_formats.get(master_url, ("", "zlib"))[1]
+
+
+async def negotiate_wire_format(master_url: str) -> str:
+    """The upload content type to use toward ``master_url``.
+
+    Probes ``GET /distributed/wire_formats`` once with an ``Accept``
+    header naming the raw-tensor type; a master that lists it back gets
+    raw-tensor uploads in the best codec BOTH sides support (the
+    response's ``tensor_codecs`` ∩ ours — a zstd-capable worker must
+    never send zstd at a deflate-only master), anything else (404 from
+    an older build, network error, ``DTPU_WIRE=png``) falls back to PNG
+    — the always-compatible reference wire."""
+    import os
+
+    from comfyui_distributed_tpu.utils import constants as C
+    from comfyui_distributed_tpu.utils.image import tensor_codecs
+    if os.environ.get(C.WIRE_FORMAT_ENV, "").lower() in ("png", "0", "off"):
+        return "image/png"
+    with _wire_lock:
+        cached = _wire_formats.get(master_url)
+    if cached is not None:
+        return cached[0]
+    fmt, codec = "image/png", "zlib"
+    try:
+        session = await get_client_session()
+        async with session.get(
+                f"{master_url}/distributed/wire_formats",
+                headers={"Accept": C.TENSOR_WIRE_CONTENT_TYPE},
+                timeout=aiohttp.ClientTimeout(total=5)) as r:
+            if r.status == 200:
+                body = await r.json()
+                if C.TENSOR_WIRE_CONTENT_TYPE in body.get("formats", []):
+                    fmt = C.TENSOR_WIRE_CONTENT_TYPE
+                    # peers predating codec negotiation decode zlib only
+                    theirs = body.get("tensor_codecs", ["zlib"])
+                    codec = next((c for c in tensor_codecs()
+                                  if c in theirs), "zlib")
+    except Exception as e:  # noqa: BLE001 - negotiation must never fail a job
+        debug_log(f"wire negotiation with {master_url} failed ({e}); "
+                  f"falling back to PNG")
+    with _wire_lock:
+        _wire_formats[master_url] = (fmt, codec)
+    debug_log(f"wire format for {master_url}: {fmt} ({codec})")
+    return fmt
 
 
 # --- host IP discovery (reference distributed.py:93-207) --------------------

@@ -67,6 +67,63 @@ def phase(name: str):
         GLOBAL_PHASES.record(name, time.perf_counter() - t0)
 
 
+# --- pipeline stage timeline -------------------------------------------------
+
+# Per-job stage wall-clock for the overlapped serving pipeline
+# (queue_wait / coalesced_batch / compute / d2h / encode / upload).
+# Separate from GLOBAL_PHASES so /distributed/metrics can expose the
+# pipeline timeline as its own coherent block: stage totals here overlap
+# in wall-clock (that is the point), so summing them against a run's
+# wall time yields the device-idle-fraction estimate bench.py reports.
+GLOBAL_STAGES = PhaseStats()
+
+
+@contextmanager
+def stage(name: str):
+    """Time one pipeline stage into :data:`GLOBAL_STAGES`."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        GLOBAL_STAGES.record(name, time.perf_counter() - t0)
+
+
+class CounterStats:
+    """Named monotonic counters (thread-safe) — scheduler/wire events."""
+
+    def __init__(self) -> None:
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + int(n)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return int(self._counts.get(name, 0))
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+# coalesced_batches / coalesced_prompts / exec_runs / wire_tensor_msgs /
+# wire_png_msgs / wire_bytes ... — the scheduler and wire layers bump,
+# /distributed/metrics and bench.py --phase pipeline read
+GLOBAL_COUNTERS = CounterStats()
+
+
+def pipeline_snapshot() -> Dict[str, Any]:
+    """The serving-pipeline block of /distributed/metrics."""
+    return {"stages": GLOBAL_STAGES.snapshot(),
+            "counters": GLOBAL_COUNTERS.snapshot()}
+
+
 # --- device/XLA tracing ------------------------------------------------------
 
 _trace_lock = threading.Lock()
@@ -193,6 +250,35 @@ def node_scope(node_id: str):
 
 def current_node() -> Optional[str]:
     return getattr(_transfer_state, "node", None)
+
+
+def capture_transfer_context() -> tuple:
+    """Snapshot this thread's transfer attribution (node label + per-run
+    sinks) so deferred host work keeps reporting into the run that
+    spawned it.  The sinks/node state is thread-local; without this, a
+    d2h fetch moved onto the encoder pool would vanish from the
+    run-local ``ExecutionResult.transfers`` ledger."""
+    return (current_node(), list(_sinks()))
+
+
+@contextmanager
+def transfer_context(captured: tuple):
+    """Re-enter a :func:`capture_transfer_context` snapshot on another
+    thread (the host-IO pool's worker)."""
+    node, sinks = captured
+    prev_node = getattr(_transfer_state, "node", None)
+    stack = getattr(_transfer_state, "sinks", None)
+    if stack is None:
+        stack = _transfer_state.sinks = []
+    added = [s for s in sinks if s not in stack]
+    stack.extend(added)
+    _transfer_state.node = node
+    try:
+        yield
+    finally:
+        _transfer_state.node = prev_node
+        for s in added:
+            stack.remove(s)
 
 
 def record_transfer(direction: str, nbytes: int) -> None:

@@ -190,6 +190,15 @@ async def dispatch_to_worker(worker: Dict[str, Any], graph: Graph,
     async with session.post(
             worker_url(worker) + "/prompt", json=payload,
             timeout=aiohttp.ClientTimeout(total=30)) as r:
+        if r.status == 429:
+            # backpressure (DTPU_MAX_QUEUE): the worker is alive but at
+            # capacity — name the condition so operators don't read it
+            # as a broken worker; the caller's failed-worker handling
+            # (reissue/partial-results) applies either way
+            text = await r.text()
+            raise RuntimeError(
+                f"worker {worker.get('id')} at queue capacity (429): "
+                f"{text[:200]}")
         if r.status != 200:
             # error bodies may be text/plain — don't let a JSON decode
             # failure mask the real status

@@ -41,6 +41,28 @@ class ExecutionResult:
     # jit traces / XLA compiles observed during this run; a repeated
     # workflow must report {"traces": 0, "compiles": 0}
     retraces: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # overlapped pipeline: OUTPUT-node host edges still in flight on the
+    # host-IO pool (one future per collecting node, submission order =
+    # topo order).  ``images`` is complete only after wait_host().
+    image_futures: List[Any] = dataclasses.field(default_factory=list)
+    # prompts merged into this run by the coalescing scheduler
+    coalesced: int = 1
+    # the run's live TransferStats: deferred host fetches record into it
+    # AFTER the compute-time snapshot, so wait_host re-snapshots
+    _transfer_stats: Any = None
+
+    def wait_host(self, timeout: Optional[float] = None
+                  ) -> "ExecutionResult":
+        """Join deferred host work (d2h/encode/disk) into ``images``.
+        Raises whatever the host-side closure raised."""
+        futures, self.image_futures = self.image_futures, []
+        for f in futures:
+            out = f.result(timeout)
+            if out:
+                self.images.extend(out)
+        if futures and self._transfer_stats is not None:
+            self.transfers = self._transfer_stats.snapshot()
+        return self
 
     @property
     def image_batch(self) -> Optional[np.ndarray]:
@@ -88,7 +110,12 @@ class WorkflowExecutor:
         # fresh per-run collection state (assign, don't clear — prior
         # ExecutionResults keep their own lists)
         self.ctx.saved_images = []
+        self.ctx.image_futures = []
         self.ctx.prompt_json = graph.to_api_format()
+        # coalesced runs: SaveImage rebuilds per-prompt metadata from the
+        # per-prompt widget overrides (coalesced_seeds etc.), so every
+        # saved PNG embeds ITS prompt's values, not prompt 0's
+        self.ctx.hidden_overrides = dict(hidden)
         self.ctx.extra_pnginfo = extra_pnginfo
         fanout = self._decide_fanout(graph)
         fan_nodes = None
@@ -150,4 +177,7 @@ class WorkflowExecutor:
             images=list(self.ctx.saved_images),
             timings=timings, total_s=total,
             transfers=run_transfers.snapshot(),
-            retraces=trace_mod.GLOBAL_RETRACES.since(retrace_mark))
+            retraces=trace_mod.GLOBAL_RETRACES.since(retrace_mark),
+            image_futures=list(self.ctx.image_futures),
+            coalesced=max(int(getattr(self.ctx, "coalesce", 1)), 1),
+            _transfer_stats=run_transfers)

@@ -1,0 +1,128 @@
+"""Batch-coalescing prompt scheduler.
+
+The serving queue's analog of continuous batching (Orca's
+iteration-level scheduling, vLLM's batched serving — PAPERS.md): queued
+prompts that would compile to the SAME SPMD program are executed as ONE
+batched dispatch along the data axis instead of N serial dispatches.
+
+What makes two prompts "the same program": the coalescing **signature**
+— a structural hash over the prompt graph (node types, links, and every
+shape-affecting input: model, resolution, steps, sampler, scheduler,
+...) with the per-prompt *data-only* widgets (the KSampler seed) masked
+out.  Signature-identical prompts differ only in masked widgets, so the
+merged run is the first prompt's graph with:
+
+- ``EmptyLatentImage`` producing ``batch_size * k`` latents
+  (``OpContext.coalesce``), and
+- each KSampler receiving the per-prompt seed list through the
+  ``coalesced_seeds`` hidden input, which ``_prepare_sample_inputs``
+  turns into prompt-major per-sample ``(seed, fold_idx)`` noise streams
+  — each prompt's samples get EXACTLY the noise a serial run would have
+  generated, so coalescing changes latency, not images.
+
+Eligibility is conservative (``COALESCE_SAFE_NODE_TYPES``): every node
+must be batch-parallel with ``EmptyLatentImage`` as the only batch
+source.  Ineligible prompts simply run one-per-dispatch; the scheduler
+never trades correctness for throughput.  Only a *contiguous* run of
+same-signature prompts at the head of the queue coalesces, so no
+prompt ever overtakes another — per-client FIFO order is preserved by
+construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.workflow.graph import Graph, parse_workflow
+
+# class_type -> widget names that are per-prompt DATA, not program shape:
+# masked out of the signature and re-injected per prompt at merge time.
+_MASKED_WIDGETS: Dict[str, Tuple[str, ...]] = {
+    "KSampler": ("seed",),
+}
+
+
+def _canonical(prompt: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The signature view of an API-format prompt: node dicts with masked
+    widgets replaced by a sentinel.  None when the prompt is not
+    coalescable (unsafe node type, hidden orchestration inputs, no
+    EmptyLatentImage/KSampler pair to batch over)."""
+    has_latent_source = False
+    has_sampler = False
+    out: Dict[str, Any] = {}
+    for nid, node in prompt.items():
+        if not isinstance(node, dict) or "class_type" not in node:
+            continue  # metadata keys ride along untouched
+        ct = node.get("class_type")
+        if ct not in C.COALESCE_SAFE_NODE_TYPES:
+            return None
+        if node.get("hidden"):
+            # orchestrated/dispatched graphs carry per-participant hidden
+            # state — never merge those
+            return None
+        has_latent_source |= ct == "EmptyLatentImage"
+        has_sampler |= ct == "KSampler"
+        inputs = dict(node.get("inputs", {}))
+        for w in _MASKED_WIDGETS.get(ct, ()):
+            if w in inputs:
+                inputs[w] = "__coalesced__"
+        out[str(nid)] = {"class_type": ct, "inputs": inputs}
+    if not out or not has_latent_source or not has_sampler:
+        return None
+    return out
+
+
+def coalesce_signature(prompt: Dict[str, Any]) -> Optional[str]:
+    """Stable signature for compiled-program grouping, or None when the
+    prompt must run alone.  Signature-equal prompts are identical except
+    for masked (data-only) widgets — the precondition
+    :func:`build_coalesced` relies on."""
+    canon = _canonical(prompt)
+    if canon is None:
+        return None
+    try:
+        blob = json.dumps(canon, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        return None
+    return hashlib.sha1(blob.encode()).hexdigest()
+
+
+def build_coalesced(prompts: List[Dict[str, Any]]
+                    ) -> Tuple[Graph, Dict[str, Dict[str, Any]]]:
+    """Merge signature-identical prompts into one executable graph.
+
+    Returns ``(graph, hidden)``: the first prompt's parsed graph plus
+    per-node hidden-input overrides carrying the per-prompt seed lists
+    (JSON-safe ints — they also flow into the saved PNG's ``prompt``
+    chunk untouched, since hidden overrides never mutate the graph)."""
+    graph = parse_workflow(prompts[0])
+    hidden: Dict[str, Dict[str, Any]] = {}
+    for nid, node in graph.nodes.items():
+        for widget in _MASKED_WIDGETS.get(node.class_type, ()):
+            per_prompt = [
+                int(p[nid]["inputs"].get(widget, node.inputs.get(widget, 0)))
+                for p in prompts]
+            hidden.setdefault(nid, {})[f"coalesced_{widget}s"] = per_prompt
+    return graph, hidden
+
+
+def split_images(images: List[Any], k: int) -> List[List[Any]]:
+    """Split a merged run's prompt-major image list back per prompt.
+
+    The batch layout is prompt-major by construction (EmptyLatentImage
+    lays out ``[prompt0 x b, prompt1 x b, ...]`` and every downstream op
+    is batch-order-preserving), so an even chunk split IS the per-prompt
+    attribution."""
+    if k <= 1:
+        return [list(images)]
+    n = len(images)
+    per = n // k if k and n % k == 0 else None
+    if per is None:
+        # defensive: a graph that emitted a non-divisible image count
+        # (should not happen for coalescable graphs) — give everything
+        # to the first prompt rather than mis-attributing
+        return [list(images)] + [[] for _ in range(k - 1)]
+    return [list(images[i * per:(i + 1) * per]) for i in range(k)]

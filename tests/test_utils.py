@@ -73,9 +73,13 @@ class TestImage:
         # uint8 quantization bound
         assert np.abs(back - x[0:1]).max() <= 1.0 / 255.0 + 1e-6
 
-    def test_npz_round_trip_exact(self, rng):
+    def test_tensor_wire_round_trip_exact(self, rng):
+        # the raw-tensor HTTP wire (replaces the old npz helpers): every
+        # negotiable codec must round-trip float32 bit-exactly
         x = rng.standard_normal((1, 8, 8, 4), dtype=np.float32)
-        assert np.array_equal(img_mod.decode_npz(img_mod.encode_npz(x)), x)
+        for codec in img_mod.tensor_codecs():
+            assert np.array_equal(
+                img_mod.decode_tensor(img_mod.encode_tensor(x, codec)), x)
 
     def test_pil_tensor_round_trip(self, rng):
         x = rng.random((1, 10, 12, 3), dtype=np.float32)
