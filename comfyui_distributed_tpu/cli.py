@@ -213,6 +213,59 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Flight-recorder reader: no id lists recent job traces; with an id,
+    pretty-prints the job's span tree (indent = parent/child, one line
+    per span with duration and status) — the headless way to answer
+    "where did THIS job spend its time, across processes"."""
+    import urllib.error
+    import urllib.request
+    if not args.prompt_id:
+        with urllib.request.urlopen(f"{args.url}/distributed/traces",
+                                    timeout=10) as r:
+            data = json.loads(r.read())
+        for t in data.get("traces", []):
+            dur = t.get("duration_s")
+            print(f"{t['prompt_id']}  {t['status']:5s}  "
+                  f"{dur if dur is not None else '?':>8}s  "
+                  f"{t['n_spans']:3d} spans  trace={t['trace_id']}")
+        if not data.get("traces"):
+            print("(no completed job traces recorded)")
+        return 0
+    try:
+        with urllib.request.urlopen(
+                f"{args.url}/distributed/trace/{args.prompt_id}",
+                timeout=10) as r:
+            rec = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        # error bodies may be plain text (older servers, proxies) — never
+        # let the JSON parse mask the real status
+        try:
+            msg = json.loads(e.read()).get("error", str(e))
+        except (ValueError, AttributeError):
+            msg = str(e)
+        print(msg, file=sys.stderr)
+        return 1
+    print(f"trace {rec['trace_id']}  job {rec['prompt_id']}  "
+          f"status={rec['status']}  {rec.get('duration_s')}s  "
+          f"{rec['n_spans']} spans")
+
+    def walk(node, depth):
+        mark = "" if node.get("status") == "ok" else \
+            f"  !{node.get('status')}"
+        attrs = node.get("attrs") or {}
+        extra = "".join(f"  {k}={v}" for k, v in attrs.items()
+                        if k in ("worker", "node", "coalesced", "job"))
+        print(f"{'  ' * depth}{node['name']}  "
+              f"{node['duration_s'] * 1e3:.1f}ms{extra}{mark}")
+        for child in node.get("children", []):
+            walk(child, depth + 1)
+
+    for root in rec.get("tree", []):
+        walk(root, 0)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="comfyui_distributed_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -262,6 +315,13 @@ def main(argv=None) -> int:
     p = sub.add_parser("status", help="query a running server")
     p.add_argument("--url", default="http://127.0.0.1:8288")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("trace", help="read a job's distributed trace "
+                                     "from a server's flight recorder")
+    p.add_argument("prompt_id", nargs="?", default=None,
+                   help="prompt id to print (omit to list recent traces)")
+    p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.set_defaults(fn=cmd_trace)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -38,6 +38,7 @@ from comfyui_distributed_tpu.ops.base import (
     register_op,
 )
 from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.image import encode_png
 from comfyui_distributed_tpu.utils.logging import Timer, debug_log, log
 from comfyui_distributed_tpu.utils.net import post_form_with_retry, run_async_in_loop
@@ -150,19 +151,34 @@ class DistributedCollector(Op):
         the payload format is negotiated per master — raw tensor
         (npy+zstd/deflate, no quantize/filter pass) when the master
         advertises it, PNG otherwise."""
-        from comfyui_distributed_tpu.utils import trace as trace_mod
         from comfyui_distributed_tpu.utils.image import encode_tensor
         from comfyui_distributed_tpu.utils.net import (
             negotiate_wire_format, wire_codec)
 
+        # the executing thread's span context must be re-entered inside
+        # the server-loop coroutine: contextvars do not follow
+        # run_coroutine_threadsafe (the span analog of the transfer
+        # context HostIOPool carries across its handoff)
+        captured_span = trace_mod.capture_span_context()
+
         async def send_all():
+            with trace_mod.use_span(captured_span):
+                await send_body()
+
+        async def send_body():
             fmt = await negotiate_wire_format(master_url)
             codec = wire_codec(master_url)
             loop = asyncio.get_running_loop()
             n = arr.shape[0]
+            trace_id = (captured_span.trace_id
+                        if captured_span is not None else None)
 
             def prep(i):
-                with trace_mod.stage("encode"):
+                # run_in_executor does NOT propagate contextvars: re-enter
+                # the job's span context on the pool thread or the encode
+                # span would silently fall out of the trace
+                with trace_mod.use_span(captured_span), \
+                        trace_mod.stage("encode"):
                     if fmt == C.TENSOR_WIRE_CONTENT_TYPE:
                         return (encode_tensor(arr[i:i + 1], codec),
                                 fmt, "dtt")
@@ -182,6 +198,14 @@ class DistributedCollector(Op):
                     form.add_field("image_index", str(i))
                     form.add_field("is_last", "true" if i == n - 1
                                    else "false")
+                    if i == n - 1 and trace_id:
+                        # ship this process's spans for the job on the
+                        # final upload: the master merges them into its
+                        # flight-recorder tree, so ONE master-side GET
+                        # reconstructs the full fan-out (the still-open
+                        # execute/job spans go provisional)
+                        form.add_field("spans", json.dumps(
+                            trace_mod.GLOBAL_TRACES.export(trace_id)))
                     form.add_field("image", payload,
                                    filename=f"img_{i}.{ext}",
                                    content_type=ctype)
@@ -192,7 +216,8 @@ class DistributedCollector(Op):
                 with trace_mod.stage("upload"):
                     await post_form_with_retry(
                         f"{master_url}/distributed/job_complete", make_form,
-                        timeout=C.TILE_SEND_TIMEOUT, what="job_complete")
+                        timeout=C.TILE_SEND_TIMEOUT, what="job_complete",
+                        headers=trace_mod.traceparent_headers())
 
         if ctx.server_loop is not None:
             run_async_in_loop(send_all(), ctx.server_loop,
@@ -253,7 +278,12 @@ class DistributedCollector(Op):
                 await ctx.job_store.remove_job(multi_job_id)
             return results
 
-        with Timer("collector_http_drain"):
+        # the collect span is the master-side half of the fan-out tree:
+        # worker execute spans (ingested off the final job_complete POST)
+        # hang next to it under the same trace_id
+        with Timer("collector_http_drain"), \
+                trace_mod.span("collect", job=multi_job_id,
+                               n_workers=len(worker_ids)):
             # outer timeout is a backstop; the in-loop deadline governs
             results = run_async_in_loop(
                 drain(), ctx.server_loop,

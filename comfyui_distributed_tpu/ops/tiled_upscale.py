@@ -413,13 +413,30 @@ class UltimateSDUpscaleDistributed(Op):
         from comfyui_distributed_tpu.utils.net import (
             negotiate_wire_format, wire_codec)
         w, h = img_size
+        # re-enter the executing thread's span context inside the
+        # server-loop coroutine (same cross-thread handoff as the image
+        # send path) so d2h/encode/upload stage spans join the job trace
+        captured_span = trace_mod.capture_span_context()
 
         async def send_all():
+            with trace_mod.use_span(captured_span):
+                await send_body()
+
+        async def send_body():
             fmt = await negotiate_wire_format(master_url)
             codec = wire_codec(master_url)
             loop = asyncio.get_running_loop()
+            trace_id = (captured_span.trace_id
+                        if captured_span is not None else None)
 
             def prep(k):
+                # run_in_executor does NOT propagate contextvars: re-enter
+                # the job's span context on the pool thread so the
+                # d2h/encode spans stay in the trace
+                with trace_mod.use_span(captured_span):
+                    return prep_body(k)
+
+            def prep_body(k):
                 tile_idx = indices[k]
                 # d2h ONE tile (counted; refined may be a device batch)
                 with trace_mod.stage("d2h"):
@@ -461,6 +478,11 @@ class UltimateSDUpscaleDistributed(Op):
                     form.add_field("padding", str(p["padding"]))
                     form.add_field("is_last", "true" if k == len(indices) - 1
                                    else "false")
+                    if k == len(indices) - 1 and trace_id:
+                        # final tile carries this process's spans for the
+                        # job — the master merges them into its tree
+                        form.add_field("spans", json.dumps(
+                            trace_mod.GLOBAL_TRACES.export(trace_id)))
                     form.add_field("tile", payload,
                                    filename=f"tile_{tile_idx}.{ext}",
                                    content_type=ctype)
@@ -471,7 +493,8 @@ class UltimateSDUpscaleDistributed(Op):
                 with trace_mod.stage("upload"):
                     await post_form_with_retry(
                         f"{master_url}/distributed/tile_complete", make_form,
-                        timeout=C.TILE_TRANSFER_TIMEOUT, what="tile_complete")
+                        timeout=C.TILE_TRANSFER_TIMEOUT, what="tile_complete",
+                        headers=trace_mod.traceparent_headers())
 
         if ctx.server_loop is not None:
             run_async_in_loop(send_all(), ctx.server_loop,
@@ -579,7 +602,10 @@ class UltimateSDUpscaleDistributed(Op):
                 await ctx.job_store.remove_tile_queue(multi_job_id)
             return collected
 
-        with Timer("tile_collect"):
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        with Timer("tile_collect"), \
+                trace_mod.span("collect", job=multi_job_id,
+                               n_workers=num_workers):
             # outer timeout is a backstop only; the deadline above governs
             return run_async_in_loop(
                 drain(), ctx.server_loop,

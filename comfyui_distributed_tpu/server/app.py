@@ -23,6 +23,10 @@ Route inventory (capability parity with reference ``distributed.py:49-599,
     POST /distributed/load_image             base64 input staging
     GET  /distributed/status                 mesh topology + runtime (new)
     GET  /distributed/metrics                counters/timings (new)
+    GET  /distributed/metrics.prom           Prometheus text exposition (new)
+    POST /distributed/metrics/reset          clear aggregate sinks (new)
+    GET  /distributed/traces                 flight-recorder index (new)
+    GET  /distributed/trace/<prompt_id>      one job's span tree (new)
 
   data plane
     POST /distributed/job_complete           multipart PNG -> image queue
@@ -188,26 +192,62 @@ class ServerState:
             return len(self._queue) + (1 if self._running else 0)
 
     def enqueue_prompt(self, prompt: Dict[str, Any], client_id: str,
-                       extra_data: Optional[Dict[str, Any]] = None) -> str:
+                       extra_data: Optional[Dict[str, Any]] = None,
+                       trace_parent: Optional[tuple] = None,
+                       trace_span: Any = None) -> str:
+        """Queue one prompt.  Every job gets a request-scoped trace: a
+        ``job`` root span that lives from enqueue to finalize and lands
+        in the flight recorder under the prompt id.  ``trace_parent`` is
+        an inbound (trace_id, parent_span_id) extracted from a peer's
+        traceparent header (this process becomes a child of the caller's
+        trace — the dispatched-worker case); ``trace_span`` hands in an
+        already-open span to adopt as the job span (the master's fan-out
+        root, so its dispatch/collect children and the local execution
+        share one tree)."""
         pid = f"p_{int(time.time() * 1000)}_{next(self._id_counter)}"
+        sp = trace_span
+        if sp is None:
+            tid, par = trace_parent if trace_parent else (None, None)
+            sp = trace_mod.start_span(
+                "job", trace_id=tid, parent_id=par,
+                attrs={"prompt_id": pid, "client_id": str(client_id),
+                       "role": "worker" if self.is_worker else "master"})
+        else:
+            sp.attrs.setdefault("prompt_id", pid)
         # signature hashed OUTSIDE the lock (it walks the whole graph):
         # _pop_group then only compares strings under the lock
         sig = sched_mod.coalesce_signature(prompt) \
             if self.coalesce_enabled else None
         with self._queue_lock:
             if self._draining:
+                self._abandon_span(sp, pid, "rejected: draining")
                 raise DrainingError("server is draining; not accepting "
                                     "prompts")
             if len(self._queue) >= self.max_queue:
+                self._abandon_span(sp, pid, "rejected: queue full")
                 raise QueueFullError(
                     f"prompt queue full ({self.max_queue})")
             self._queue.append({"id": pid, "prompt": prompt,
                                 "client_id": client_id,
                                 "extra_data": extra_data or {},
                                 "sig": sig,
+                                "span": sp,
                                 "t_enq": time.perf_counter()})
         self._queue_event.set()
         return pid
+
+    @staticmethod
+    def _abandon_span(sp, pid: str, reason: str) -> None:
+        """End + commit a job span for a prompt that never executes
+        (backpressure/drain rejections and purges still leave a
+        postmortem trace)."""
+        if sp is None:
+            return
+        sp.set_status("error", reason)
+        sp.end()
+        trace_mod.GLOBAL_TRACES.commit(
+            pid, sp.trace_id, status="error", root_span_id=sp.span_id,
+            duration_s=round(time.time() - sp.start_s, 6))
 
     def _pop_group(self) -> Optional[List[Dict[str, Any]]]:
         """Pop the next dispatch group: the head prompt plus the longest
@@ -228,9 +268,13 @@ class ServerState:
                     group.append(self._queue.pop(0))
             self._running = True
         now = time.perf_counter()
+        now_wall = time.time()
         for item in group:
-            trace_mod.GLOBAL_STAGES.record(
-                "queue_wait", now - item.get("t_enq", now))
+            wait = now - item.get("t_enq", now)
+            trace_mod.GLOBAL_STAGES.record("queue_wait", wait)
+            if item.get("span") is not None:
+                trace_mod.event_span("queue_wait", now_wall - wait,
+                                     now_wall, parent=item["span"])
         return group
 
     def _exec_loop(self) -> None:
@@ -258,25 +302,36 @@ class ServerState:
                 )
                 first = group[0]
                 trace_mod.GLOBAL_COUNTERS.bump("exec_runs")
-                if len(group) > 1:
-                    graph, hidden = sched_mod.build_coalesced(
-                        [it["prompt"] for it in group])
-                    ctx.coalesce = len(group)
-                    trace_mod.GLOBAL_COUNTERS.bump("coalesced_batches")
-                    trace_mod.GLOBAL_COUNTERS.bump("coalesced_prompts",
-                                                   len(group))
-                    debug_log(f"coalesced {len(group)} prompts into one "
-                              f"dispatch ({first['id']}..)")
-                    with trace_mod.stage("coalesced_batch"):
+                # the run executes under the HEAD prompt's job span
+                # (coalesced followers' traces stay thin — job +
+                # queue_wait — and name their leader); per-node and
+                # stage spans created inside attach to this trace
+                with trace_mod.use_span(first.get("span")), \
+                        trace_mod.span("execute",
+                                       coalesced=len(group)):
+                    if len(group) > 1:
+                        graph, hidden = sched_mod.build_coalesced(
+                            [it["prompt"] for it in group])
+                        ctx.coalesce = len(group)
+                        trace_mod.GLOBAL_COUNTERS.bump("coalesced_batches")
+                        trace_mod.GLOBAL_COUNTERS.bump("coalesced_prompts",
+                                                       len(group))
+                        debug_log(f"coalesced {len(group)} prompts into "
+                                  f"one dispatch ({first['id']}..)")
+                        for item in group[1:]:
+                            if item.get("span") is not None:
+                                item["span"].attrs["coalesced_into"] = \
+                                    first["id"]
+                        with trace_mod.stage("coalesced_batch"):
+                            res = WorkflowExecutor(ctx).execute(
+                                graph, hidden=hidden,
+                                extra_pnginfo=first.get(
+                                    "extra_data", {}).get("extra_pnginfo"))
+                    else:
                         res = WorkflowExecutor(ctx).execute(
-                            graph, hidden=hidden,
+                            first["prompt"],
                             extra_pnginfo=first.get("extra_data", {}).get(
                                 "extra_pnginfo"))
-                else:
-                    res = WorkflowExecutor(ctx).execute(
-                        first["prompt"],
-                        extra_pnginfo=first.get("extra_data", {}).get(
-                            "extra_pnginfo"))
                 trace_mod.GLOBAL_STAGES.record("compute", res.total_s)
             except Exception as e:  # noqa: BLE001 - survive bad prompts
                 err = e
@@ -298,10 +353,15 @@ class ServerState:
 
     def _finalize_group(self, group, res, err, t0) -> None:
         """Join deferred host edges, split per-prompt results, write
-        history/metrics, drop orphan tile queues."""
+        history/metrics, drop orphan tile queues, seal the group's job
+        traces into the flight recorder (+ the slow-job log line)."""
         if res is not None and err is None:
             try:
-                res.wait_host()
+                # the join runs under the head job's span so the
+                # host-edge wait is visible in the trace tree
+                with trace_mod.use_span(group[0].get("span")), \
+                        trace_mod.span("finalize"):
+                    res.wait_host()
             except Exception as e:  # noqa: BLE001 - host edge failed
                 err = e
         k = len(group)
@@ -332,6 +392,34 @@ class ServerState:
                 self._history[item["id"]] = entry
         for item in group:
             self._drop_tile_queues(item["prompt"])
+        # seal each prompt's trace: end the job span, commit to the
+        # flight recorder under the prompt id, and emit the always-on
+        # slow-job line when the end-to-end span exceeds DTPU_SLOW_JOB_S
+        status = "ok" if err is None else "error"
+        slow_thr = 0.0
+        try:
+            slow_thr = float(os.environ.get(C.SLOW_JOB_ENV, "0") or 0)
+        except ValueError:
+            pass
+        for item in group:
+            sp = item.get("span")
+            if sp is None:
+                continue
+            if err is not None:
+                sp.set_status("error", str(err))
+            dur = round(done_t - sp.start_s, 6)
+            sp.end()
+            trace_mod.GLOBAL_TRACES.commit(
+                item["id"], sp.trace_id, status=status,
+                root_span_id=sp.span_id, duration_s=dur)
+            if slow_thr > 0 and dur > slow_thr:
+                stages = trace_mod.GLOBAL_TRACES.breakdown(sp.trace_id)
+                stages.pop("job", None)
+                top = sorted(stages.items(), key=lambda kv: -kv[1])[:8]
+                log(f"SLOW job {item['id']} ({status}): {dur:.2f}s > "
+                    f"{slow_thr:g}s threshold; trace {sp.trace_id}; "
+                    "stages "
+                    + ", ".join(f"{n}={s:.2f}s" for n, s in top))
         with self._queue_lock:
             self._finalize_pending -= 1
         debug_log(f"group {group[0]['id']} (x{k}) done in "
@@ -371,6 +459,8 @@ class ServerState:
             purged, self._queue = self._queue, []
         done_t = time.time()
         for item in purged:
+            self._abandon_span(item.get("span"), item["id"],
+                               "cancelled: server drain timeout")
             self._history[item["id"]] = {
                 "status": "error",
                 "error": "cancelled: server drain timeout",
@@ -483,9 +573,22 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
 
     async def metrics(request):
         from comfyui_distributed_tpu.utils.trace import (
-            GLOBAL_PHASES, counters_snapshot, pipeline_snapshot)
+            GLOBAL_NODES, GLOBAL_PHASES, GLOBAL_TRACES,
+            counters_snapshot, pipeline_snapshot, tracing_enabled)
         return web.json_response({**state.metrics,
                                   "phases": GLOBAL_PHASES.snapshot(),
+                                  # per-node-type op latency histograms
+                                  # (count/mean/p50/p95/p99)
+                                  "nodes": GLOBAL_NODES.snapshot(),
+                                  # request-tracing health
+                                  "tracing": {
+                                      "enabled": tracing_enabled(),
+                                      "ring_size": GLOBAL_TRACES.size(),
+                                      "ring_max":
+                                          GLOBAL_TRACES.max_traces,
+                                      "dropped_spans":
+                                          GLOBAL_TRACES.dropped_spans,
+                                  },
                                   # per-job stage timeline (queue_wait /
                                   # coalesced_batch / compute / d2h /
                                   # encode / upload) + scheduler and wire
@@ -502,6 +605,76 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                   # tensor-plane health signals (steady
                                   # serving => retraces stop growing)
                                   **counters_snapshot()})
+
+    async def metrics_prom(request):
+        """Prometheus text exposition (``/distributed/metrics.prom``):
+        the trace module's stage/phase/node histograms and counters plus
+        this server's prompt/image counters and queue gauge — one
+        scrapable endpoint per participant."""
+        extra = [
+            ("dtpu_prompts_executed_total", "counter",
+             "Prompts executed to success.",
+             [({}, state.metrics["prompts_executed"])]),
+            ("dtpu_prompts_failed_total", "counter",
+             "Prompts that finished in error.",
+             [({}, state.metrics["prompts_failed"])]),
+            ("dtpu_images_received_total", "counter",
+             "Worker images received on /distributed/job_complete.",
+             [({}, state.metrics["images_received"])]),
+            ("dtpu_tiles_received_total", "counter",
+             "Worker tiles received on /distributed/tile_complete.",
+             [({}, state.metrics["tiles_received"])]),
+            ("dtpu_queue_remaining", "gauge",
+             "Prompts queued or executing.",
+             [({}, state.queue_remaining())]),
+            ("dtpu_queue_capacity", "gauge",
+             "DTPU_MAX_QUEUE backpressure cap.",
+             [({}, state.max_queue)]),
+        ]
+        text = trace_mod.prometheus_text(extra=extra)
+        return web.Response(text=text,
+                            content_type="text/plain",
+                            charset="utf-8")
+
+    async def metrics_reset(request):
+        """Guarded aggregate-metrics reset (benches and multi-phase test
+        runs stop inheriting cross-run telemetry).  DTPU_METRICS_RESET=0
+        disables the route (403).  Body {"include_traces": true} also
+        clears the flight recorder; per-prompt history and the monotonic
+        retrace counters are never touched."""
+        if os.environ.get(C.METRICS_RESET_ENV, "1").lower() \
+                in ("0", "false", "off"):
+            return web.json_response(
+                {"error": "metrics reset disabled "
+                          f"({C.METRICS_RESET_ENV}=0)"}, status=403)
+        data = await request.json() if request.can_read_body else {}
+        cleared = trace_mod.reset_aggregate_metrics()
+        if data.get("include_traces"):
+            trace_mod.GLOBAL_TRACES.reset()
+            cleared["traces"] = True
+        log("aggregate metrics reset "
+            f"(by {request.remote or 'unknown'})")
+        return ok({"cleared": cleared})
+
+    async def get_trace(request):
+        """Flight recorder: one completed job's full span tree."""
+        pid = request.match_info["prompt_id"]
+        rec = trace_mod.GLOBAL_TRACES.get(pid)
+        if rec is None:
+            return web.json_response(
+                {"error": f"no recorded trace for {pid!r} (completed "
+                          "jobs only; ring keeps the most recent "
+                          f"{trace_mod.GLOBAL_TRACES.max_traces})"},
+                status=404)
+        rec["tree"] = trace_mod.build_span_tree(rec["spans"])
+        return web.json_response(rec)
+
+    async def list_traces(request):
+        """Flight recorder index, newest first."""
+        return web.json_response({
+            "traces": trace_mod.GLOBAL_TRACES.index(),
+            "ring_max": trace_mod.GLOBAL_TRACES.max_traces,
+            "tracing_enabled": trace_mod.tracing_enabled()})
 
     async def warmup(request):
         """AOT warmup (registry.DiffusionPipeline.warmup): compile +
@@ -648,6 +821,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     # --- job data plane -----------------------------------------------------
 
     async def prepare_job(request):
+        t_recv = time.time()
         data = await request.json()
         mj = data.get("multi_job_id")
         if not mj:
@@ -657,6 +831,12 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             await state.jobs.prepare_tile_job(mj)
         else:
             await state.jobs.prepare_job(mj)
+        tp = trace_mod.parse_traceparent(
+            request.headers.get(C.TRACEPARENT_HEADER))
+        if tp is not None:
+            trace_mod.event_span("prepare_job", t_recv, time.time(),
+                                 trace_id=tp[0], parent_id=tp[1],
+                                 attrs={"job": str(mj)})
         debug_log(f"prepared {data.get('kind', 'image')} job {mj}")
         return ok()
 
@@ -693,7 +873,27 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         trace_mod.GLOBAL_COUNTERS.bump("wire_png_bytes", len(data))
         return decode_png(data)
 
+    def _ingest_remote_trace(request, form, name: str,
+                             t_recv: float, attrs: Dict[str, Any]) -> None:
+        """Stitch an inbound data-plane POST into the job's distributed
+        trace: merge the peer's shipped spans (final upload only) and
+        record the server-side receive as a child of the sender's span
+        named in its traceparent header."""
+        spans_field = form.get("spans")
+        if spans_field:
+            try:
+                trace_mod.GLOBAL_TRACES.ingest(json.loads(spans_field))
+            except (ValueError, TypeError) as e:
+                debug_log(f"bad spans field on {name}: {e}")
+        tp = trace_mod.parse_traceparent(
+            request.headers.get(C.TRACEPARENT_HEADER))
+        if tp is not None:
+            trace_mod.event_span(name, t_recv, time.time(),
+                                 trace_id=tp[0], parent_id=tp[1],
+                                 attrs=attrs)
+
     async def job_complete(request):
+        t_recv = time.time()
         form = await request.post()
         mj = form.get("multi_job_id", "")
         img_field = form.get("image")
@@ -719,9 +919,13 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             return web.json_response({"error": f"unknown job {mj}"},
                                      status=404)
         state.metrics["images_received"] += 1
+        _ingest_remote_trace(request, form, "receive_image", t_recv,
+                             {"job": str(mj),
+                              "worker": str(form.get("worker_id", ""))})
         return ok()
 
     async def tile_complete(request):
+        t_recv = time.time()
         form = await request.post()
         mj = form.get("multi_job_id", "")
         tile_field = form.get("tile")
@@ -745,6 +949,10 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             return web.json_response({"error": f"unknown tile job {mj}"},
                                      status=404)
         state.metrics["tiles_received"] += 1
+        _ingest_remote_trace(request, form, "receive_tile", t_recv,
+                             {"job": str(mj),
+                              "worker": str(form.get("worker_id", "")),
+                              "tile_idx": int(form.get("tile_idx", 0))})
         return ok()
 
     async def load_image(request):
@@ -793,33 +1001,64 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         # dispatch so saved PNGs embed the source workflow (reference
         # gpupanel.js:1344-1358)
         extra_data = data.get("extra_data") or {}
+        # inbound trace context: a dispatching master's traceparent makes
+        # this process's execution a child of ITS trace (the worker half
+        # of the distributed tree); absent/malformed headers mean a fresh
+        # local root — propagation can never fail a request
+        trace_parent = trace_mod.parse_traceparent(
+            request.headers.get(C.TRACEPARENT_HEADER))
         try:
             cfg = await _orchestration_config(prompt)
             if cfg is not None:
                 # headless interceptor (reference setupInterceptor,
                 # gpupanel.js:819-834): fan out to enabled HTTP workers,
-                # enqueue the master's prepared share locally
+                # enqueue the master's prepared share locally.  ONE root
+                # span covers the whole fan-out: preflight/dispatch spans
+                # (orchestrate), the local execution and the collector
+                # drain all parent under it, and the worker ships its
+                # spans back on the final data-plane POST — the flight
+                # recorder then holds the full cross-process tree.
                 from comfyui_distributed_tpu.workflow.orchestrate import (
                     run_distributed)
+                tid, par = trace_parent if trace_parent else (None, None)
+                root = trace_mod.start_span(
+                    "job", trace_id=tid, parent_id=par,
+                    attrs={"client_id": str(client_id), "role": "master",
+                           "fanout": True})
 
                 async def enqueue_graph(g):
                     return state.enqueue_prompt(g.to_api_format(),
-                                                client_id, extra_data)
+                                                client_id, extra_data,
+                                                trace_span=root)
 
                 host = cfg.get("master", {}).get("host") or "127.0.0.1"
                 master_url = f"http://{host}:{state.port or 8288}"
-                out = await run_distributed(
-                    prompt, master_url,
-                    workers=cfg_mod.enabled_workers(cfg),
-                    master_dispatch=enqueue_graph, job_store=state.jobs,
-                    client_id=client_id, extra_data=extra_data)
+                try:
+                    with trace_mod.use_span(root):
+                        out = await run_distributed(
+                            prompt, master_url,
+                            workers=cfg_mod.enabled_workers(cfg),
+                            master_dispatch=enqueue_graph,
+                            job_store=state.jobs,
+                            client_id=client_id, extra_data=extra_data)
+                except Exception:
+                    # the fan-out died before the exec thread adopted the
+                    # root (finalize would have sealed it) — seal here so
+                    # the failure still leaves a postmortem trace
+                    if root is not None and root.end_s is None \
+                            and not root.attrs.get("prompt_id"):
+                        state._abandon_span(
+                            root, f"failed_{root.trace_id[:12]}",
+                            "fan-out failed before enqueue")
+                    raise
                 return web.json_response({
                     "prompt_id": out["result"],
                     "number": state.queue_remaining(),
                     "workers": out["workers"],
                     "failed_workers": out.get("failed", []),
                 })
-            pid = state.enqueue_prompt(prompt, client_id, extra_data)
+            pid = state.enqueue_prompt(prompt, client_id, extra_data,
+                                       trace_parent=trace_parent)
         except QueueFullError as e:
             # backpressure (DTPU_MAX_QUEUE): tell the client how deep the
             # queue is so its retry policy can back off intelligently
@@ -897,6 +1136,10 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/distributed/network_info", network_info)
     r.add_get("/distributed/status", status)
     r.add_get("/distributed/metrics", metrics)
+    r.add_get("/distributed/metrics.prom", metrics_prom)
+    r.add_post("/distributed/metrics/reset", metrics_reset)
+    r.add_get("/distributed/traces", list_traces)
+    r.add_get("/distributed/trace/{prompt_id}", get_trace)
     r.add_post("/distributed/warmup", warmup)
     r.add_get("/distributed/workers_status", workers_status)
     r.add_post("/distributed/cluster/clear_memory", cluster_clear_memory)

@@ -89,6 +89,26 @@ COALESCE_SAFE_NODE_TYPES = frozenset({
     "VAEDecode", "VAEDecodeTiled", "SaveImage", "PreviewImage",
 })
 
+# --- observability (request-scoped tracing + telemetry) ----------------------
+# Dapper-style always-on request tracing (utils/trace.py spans): every job
+# gets a trace; spans propagate over the distributed HTTP edges via
+# W3C-traceparent headers and land in a bounded per-job flight recorder
+# served by GET /distributed/trace/<prompt_id>.
+TRACE_ENV = "DTPU_TRACE"                 # "0" disables span creation
+TRACE_RING_ENV = "DTPU_TRACE_RING"       # flight-recorder ring size
+TRACE_RING_DEFAULT = 128                 # completed job traces retained
+TRACE_MAX_SPANS = 512                    # per-trace span cap (then dropped)
+TRACEPARENT_HEADER = "traceparent"       # W3C trace-context header name
+SLOW_JOB_ENV = "DTPU_SLOW_JOB_S"         # >0: always-on slow-job log line
+LOG_JSON_ENV = "DTPU_LOG_JSON"           # "1": JSON log lines with trace ids
+METRICS_RESET_ENV = "DTPU_METRICS_RESET"  # "0" disables POST .../metrics/reset
+
+# Fixed latency-histogram bucket bounds (seconds) shared by the JSON
+# percentiles and the Prometheus exposition: 1 ms .. 60 s exponential-ish,
+# wide enough for a CPU-tiny step and a real SDXL compile alike.
+HISTOGRAM_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                       0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
 # --- persistent compilation cache -------------------------------------------
 # Directory for JAX's persistent (on-disk) XLA compilation cache.  Resolution
 # (runtime/manager.enable_persistent_compile_cache): explicit arg > this env

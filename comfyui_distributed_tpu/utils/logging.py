@@ -4,16 +4,50 @@ Capability parity with reference ``utils/logging.py:21-28`` (``log`` /
 ``debug_log`` with a config-gated debug tier) but without the reference's
 read-the-config-file-on-every-call behaviour — debug state is a process-local
 flag refreshed by the config layer on load/save.
+
+``DTPU_LOG_JSON=1`` switches every line to one JSON object stamped with
+the active request-trace correlation fields (``trace_id``/``span_id``/
+``prompt_id`` from ``utils.trace.current_trace_ids``), so a log
+aggregator can join log lines to the flight-recorder trace of the job
+that emitted them.  Toggleable at runtime via :func:`set_json_logs`.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
 import time
 
 _PREFIX = "[DistributedTPU]"
+_LOG_JSON_ENV = "DTPU_LOG_JSON"   # mirrored in utils.constants.LOG_JSON_ENV
+                                  # (kept literal here: logging sits below
+                                  # constants-importing modules)
+
+
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per line with trace correlation fields."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {"ts": round(record.created, 6),
+               "level": record.levelname.lower(),
+               "msg": record.getMessage()}
+        try:
+            # lazy import: trace sits above logging in the utils
+            # dependency order (same pattern as Timer below)
+            from comfyui_distributed_tpu.utils.trace import \
+                current_trace_ids
+            ids = current_trace_ids()
+        except Exception:  # noqa: BLE001 - logging must never raise
+            ids = None
+        if ids:
+            out.update(ids)
+        return json.dumps(out, ensure_ascii=False, default=str)
+
+
+_PLAIN_FORMATTER = logging.Formatter("%(message)s")
+_JSON_FORMATTER = _JsonFormatter()
 
 _logger = logging.getLogger("comfyui_distributed_tpu")
 if not _logger.handlers:
@@ -22,6 +56,27 @@ if not _logger.handlers:
     _logger.addHandler(_h)
     _logger.setLevel(logging.INFO)
     _logger.propagate = False
+
+_json_enabled = False
+
+
+def set_json_logs(enabled: bool) -> None:
+    """Swap the handler formatter between plain and JSON mode (start
+    value from DTPU_LOG_JSON)."""
+    global _json_enabled
+    _json_enabled = bool(enabled)
+    fmt = _JSON_FORMATTER if _json_enabled else _PLAIN_FORMATTER
+    for h in _logger.handlers:
+        h.setFormatter(fmt)
+
+
+def json_logs_enabled() -> bool:
+    return _json_enabled
+
+
+if os.environ.get(_LOG_JSON_ENV, "").strip().lower() \
+        in ("1", "true", "yes", "on"):
+    set_json_logs(True)
 
 _ENV_DEBUG = os.environ.get("DISTRIBUTED_TPU_DEBUG")
 _env_forced = (_ENV_DEBUG is not None

@@ -82,11 +82,16 @@ def run_async_in_loop(coro, loop: asyncio.AbstractEventLoop,
 
 async def post_form_with_retry(url: str, make_form, timeout: float,
                                max_retries: Optional[int] = None,
-                               what: str = "upload") -> None:
+                               what: str = "upload",
+                               headers: Optional[Dict[str, str]] = None
+                               ) -> None:
     """POST a multipart form with exponential backoff, retrying any error
     including 404 (the queue-not-ready race the reference's tile sender
     retries through, ``distributed_upscale.py:618-665``).  ``make_form``
-    is a zero-arg factory — FormData payloads are single-use."""
+    is a zero-arg factory — FormData payloads are single-use.
+    ``headers`` rides every attempt (the worker->master data-plane hop
+    carries its traceparent here so the master can stitch the job's
+    distributed trace together)."""
     from comfyui_distributed_tpu.utils import constants as C
     retries = max_retries if max_retries is not None else C.SEND_MAX_RETRIES
     session = await get_client_session()
@@ -94,7 +99,7 @@ async def post_form_with_retry(url: str, make_form, timeout: float,
     for attempt in range(retries):
         try:
             async with session.post(
-                    url, data=make_form(),
+                    url, data=make_form(), headers=headers or None,
                     timeout=aiohttp.ClientTimeout(total=timeout)) as resp:
                 if resp.status == 200:
                     return
@@ -146,18 +151,22 @@ class HostIOPool:
         """Schedule ``fn(*args)`` on the pool; returns a Future.
 
         The submitting thread's transfer attribution (workflow node +
-        per-run sinks) is captured and re-entered in the worker so the
-        deferred d2h still lands in the run's ledger; ``stage`` times the
-        task into the pipeline stage timeline."""
+        per-run sinks) AND its request-trace span context are captured and
+        re-entered in the worker, so the deferred d2h still lands in the
+        run's ledger and deferred stage spans still attach to the job's
+        trace; ``stage`` times the task into the pipeline stage
+        timeline."""
         from comfyui_distributed_tpu.utils import trace as trace_mod
         captured = trace_mod.capture_transfer_context()
+        captured_span = trace_mod.capture_span_context()
         self._slots.acquire()
         with self._idle:
             self._pending += 1
 
         def run():
             try:
-                with trace_mod.transfer_context(captured):
+                with trace_mod.transfer_context(captured), \
+                        trace_mod.use_span(captured_span):
                     if stage:
                         with trace_mod.stage(stage):
                             return fn(*args)

@@ -1,4 +1,4 @@
-"""Tracing / profiling subsystem.
+"""Tracing / profiling / request-scoped telemetry subsystem.
 
 The reference has NONE (SURVEY.md §5: "Tracing / profiling: ABSENT" — its
 only timing is a preflight elapsed-ms debug line, ``gpupanel.js:1502``).
@@ -6,7 +6,17 @@ Here profiling is a first-class subsystem:
 
 - phase wall-clock aggregation (:class:`PhaseStats`) fed by
   ``utils.logging.Timer`` and the executor's per-node timings, surfaced on
-  ``GET /distributed/metrics``;
+  ``GET /distributed/metrics`` — now with fixed-bucket latency histograms
+  and p50/p95/p99 per phase (:class:`LatencyHistogram`), also rendered as
+  Prometheus text by :func:`prometheus_text` for ``/distributed/metrics.prom``;
+- **request-scoped distributed tracing** (Dapper-style: low-overhead,
+  always-on, propagated via RPC metadata): a :class:`Span` model
+  (``trace_id``/``span_id``/``parent_id``) with a contextvar-carried
+  current span (async-task- and thread-correct), snapshot/reattach
+  (:func:`capture_span_context`) mirroring the transfer context so spans
+  survive the HostIOPool handoff, W3C-``traceparent`` helpers for the
+  distributed HTTP edges, and a bounded per-job flight recorder
+  (:class:`FlightRecorder`) behind ``GET /distributed/trace/<prompt_id>``;
 - XLA/device traces via ``jax.profiler`` (viewable in TensorBoard /
   Perfetto), driven by ``POST /distributed/profile/start`` + ``/stop`` or
   the :func:`trace` context manager;
@@ -17,37 +27,143 @@ Here profiling is a first-class subsystem:
 - retrace/compile counters (:class:`RetraceStats`) fed by
   ``jax.monitoring`` events: a steady-state serving process must report
   ZERO new traces on a repeated workflow (``install_jax_monitoring``).
+
+Telemetry never touches traced code paths: spans and histograms are pure
+host-side Python around (never inside) the jitted programs, so tracing-on
+vs tracing-off must show zero retrace delta (``bench.py --phase
+observability`` proves the overhead stays within noise).
 """
 
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 import time
+from collections import OrderedDict
 from contextlib import contextmanager
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
+from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils.logging import log
 
 
-class PhaseStats:
-    """Aggregated per-phase wall-clock: count/total/max (thread-safe)."""
+class LatencyHistogram:
+    """Fixed-bucket latency histogram with percentile estimation.
 
-    def __init__(self) -> None:
-        self._stats: Dict[str, Dict[str, float]] = {}
+    Prometheus-shaped: per-bucket counts over
+    :data:`constants.HISTOGRAM_BUCKETS_S` plus an overflow (+Inf) bucket,
+    with sum/count/max — enough for ``_bucket``/``_sum``/``_count`` series
+    AND interpolated p50/p95/p99 without storing samples (thread-safe)."""
+
+    __slots__ = ("bounds", "counts", "overflow", "count", "sum_s", "max_s",
+                 "_lock")
+
+    def __init__(self, bounds: Tuple[float, ...] = C.HISTOGRAM_BUCKETS_S):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * len(self.bounds)
+        self.overflow = 0
+        self.count = 0
+        self.sum_s = 0.0
+        self.max_s = 0.0
         self._lock = threading.Lock()
 
-    def record(self, phase: str, seconds: float) -> None:
+    def record(self, seconds: float) -> None:
+        s = max(float(seconds), 0.0)
         with self._lock:
-            s = self._stats.setdefault(
-                phase, {"count": 0, "total_s": 0.0, "max_s": 0.0})
-            s["count"] += 1
-            s["total_s"] += seconds
-            s["max_s"] = max(s["max_s"], seconds)
+            self.count += 1
+            self.sum_s += s
+            self.max_s = max(self.max_s, s)
+            for i, le in enumerate(self.bounds):
+                if s <= le:
+                    self.counts[i] += 1
+                    return
+            self.overflow += 1
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """``[(le, cumulative_count), ..., (inf, total)]`` — the
+        Prometheus ``_bucket`` series."""
+        return self.prom_series()[0]
+
+    def prom_series(self) -> Tuple[List[Tuple[float, int]], float, int]:
+        """``(buckets, sum, count)`` read under ONE lock acquisition —
+        the Prometheus invariant (+Inf bucket == _count) must hold even
+        against a concurrent record() mid-scrape."""
+        with self._lock:
+            out, cum = [], 0
+            for le, n in zip(self.bounds, self.counts):
+                cum += n
+                out.append((le, cum))
+            out.append((float("inf"), cum + self.overflow))
+            return out, self.sum_s, self.count
+
+    def _percentile(self, q: float) -> float:
+        """Caller holds the lock.  Linear interpolation inside the bucket
+        holding the target rank; the overflow bucket interpolates toward
+        the observed max."""
+        if self.count == 0:
+            return 0.0
+        target = max(min(q, 1.0), 0.0) * self.count
+        cum, lo = 0, 0.0
+        for le, n in zip(self.bounds, self.counts):
+            if n and cum + n >= target:
+                frac = (target - cum) / n
+                hi = min(le, self.max_s) if self.max_s > 0 else le
+                return min(lo + (max(hi, lo) - lo) * frac, self.max_s)
+            cum += n
+            lo = le
+        if self.overflow:
+            frac = (target - cum) / self.overflow
+            hi = max(self.max_s, lo)
+            return lo + (hi - lo) * frac
+        return self.max_s
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (q in [0, 1])."""
+        with self._lock:
+            return self._percentile(q)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            count, sum_s, max_s = self.count, self.sum_s, self.max_s
+            return {"count": count, "total_s": sum_s, "max_s": max_s,
+                    "mean_s": sum_s / count if count else 0.0,
+                    "p50_s": self._percentile(0.50),
+                    "p95_s": self._percentile(0.95),
+                    "p99_s": self._percentile(0.99)}
+
+
+class PhaseStats:
+    """Aggregated per-phase wall-clock (thread-safe).
+
+    Historically count/total/max only; each phase now carries a
+    :class:`LatencyHistogram`, so ``snapshot()`` additionally reports
+    mean and p50/p95/p99 and :meth:`histograms` feeds the Prometheus
+    ``_bucket`` series.  The legacy keys (``count``/``total_s``/``max_s``)
+    are preserved — existing readers (bench, tests) keep working."""
+
+    def __init__(self) -> None:
+        self._stats: Dict[str, LatencyHistogram] = {}
+        self._lock = threading.Lock()
+
+    def _hist(self, phase: str) -> LatencyHistogram:
+        with self._lock:
+            h = self._stats.get(phase)
+            if h is None:
+                h = self._stats[phase] = LatencyHistogram()
+            return h
+
+    def record(self, phase: str, seconds: float) -> None:
+        self._hist(phase).record(seconds)
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
-            return {k: dict(v) for k, v in self._stats.items()}
+            items = list(self._stats.items())
+        return {k: h.snapshot() for k, h in items}
+
+    def histograms(self) -> Dict[str, LatencyHistogram]:
+        with self._lock:
+            return dict(self._stats)
 
     def reset(self) -> None:
         with self._lock:
@@ -78,14 +194,32 @@ def phase(name: str):
 GLOBAL_STAGES = PhaseStats()
 
 
+# Per-node-type op wall-clock (the executor records every node execution
+# here by class_type): the latency histogram behind the
+# dtpu_node_seconds Prometheus family and the "nodes" metrics block.
+GLOBAL_NODES = PhaseStats()
+
+
 @contextmanager
 def stage(name: str):
-    """Time one pipeline stage into :data:`GLOBAL_STAGES`."""
+    """Time one pipeline stage into :data:`GLOBAL_STAGES`.
+
+    When a request trace is active (``current_span()``), the stage is ALSO
+    recorded as a child span of the same name, so the flight recorder's
+    per-job tree shows exactly where the wall-clock went — the aggregate
+    histogram and the per-job trace are fed by one instrumentation
+    point."""
     t0 = time.perf_counter()
+    sp = _begin_span(name)
     try:
         yield
+    except BaseException:
+        if sp is not None:
+            sp.set_status("error")
+        raise
     finally:
         GLOBAL_STAGES.record(name, time.perf_counter() - t0)
+        _end_span(sp)
 
 
 class CounterStats:
@@ -152,9 +286,14 @@ def stop_device_trace() -> str:
     with _trace_lock:
         if _trace_dir is None:
             raise RuntimeError("no trace running")
-        jax.profiler.stop_trace()
         out = _trace_dir
-        _trace_dir = None
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            # a raising stop_trace must still clear the state: leaving
+            # _trace_dir set would wedge every later start_device_trace
+            # with "trace already running" for the life of the process
+            _trace_dir = None
         log(f"device trace stopped -> {out}")
         return out
 
@@ -351,3 +490,602 @@ def counters_snapshot() -> Dict[str, Any]:
     """One payload for /distributed/metrics and bench artifacts."""
     return {"transfers": GLOBAL_TRANSFERS.snapshot(),
             "retraces": GLOBAL_RETRACES.mark()}
+
+
+# --- request-scoped distributed tracing (spans) ------------------------------
+#
+# Dapper-lite: always-on, low-overhead, propagated through RPC metadata.
+# A span is a named timed interval with a trace_id shared by every span of
+# one job (across processes) and a parent_id forming the tree.  The
+# current span rides a contextvar — correct across asyncio task
+# boundaries (each task gets a context copy at creation) and explicit
+# across thread handoffs via capture_span_context()/use_span(), the span
+# analog of capture_transfer_context.
+
+_tracing_enabled = os.environ.get(C.TRACE_ENV, "1").lower() \
+    not in ("0", "false", "off")
+
+
+def set_tracing(enabled: bool) -> None:
+    """Process-wide span-creation switch (env ``DTPU_TRACE`` start value).
+    Aggregate metrics (phases/stages/counters) are unaffected — this
+    gates only the per-request span machinery."""
+    global _tracing_enabled
+    _tracing_enabled = bool(enabled)
+
+
+def tracing_enabled() -> bool:
+    return _tracing_enabled
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+class Span:
+    """One timed interval of a request trace.
+
+    ``parent`` is the in-process parent Span (None for a local root);
+    ``parent_id`` may be set without a parent object when the parent
+    lives in another process (the inbound traceparent case)."""
+
+    __slots__ = ("trace_id", "span_id", "parent", "parent_id", "name",
+                 "attrs", "start_s", "end_s", "status", "error", "_token")
+
+    def __init__(self, name: str, trace_id: Optional[str] = None,
+                 parent: Optional["Span"] = None,
+                 parent_id: Optional[str] = None,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.name = str(name)
+        self.parent = parent
+        if parent is not None:
+            self.trace_id = parent.trace_id
+            self.parent_id = parent.span_id
+        else:
+            self.trace_id = trace_id or new_trace_id()
+            self.parent_id = parent_id
+        self.span_id = new_span_id()
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+        self.start_s = time.time()
+        self.end_s: Optional[float] = None
+        self.status = "ok"
+        self.error: Optional[str] = None
+        self._token: Any = None  # contextvar token while current
+
+    def set_status(self, status: str, error: Optional[str] = None) -> None:
+        self.status = status
+        if error is not None:
+            self.error = str(error)[:500]
+
+    def end(self, status: Optional[str] = None) -> None:
+        if self.end_s is not None:
+            return  # idempotent: double-end keeps the first timing
+        if status is not None:
+            self.status = status
+        self.end_s = time.time()
+        GLOBAL_TRACES.on_end(self)
+
+    def to_dict(self, provisional: bool = False) -> Dict[str, Any]:
+        end = self.end_s if self.end_s is not None else time.time()
+        d = {"trace_id": self.trace_id, "span_id": self.span_id,
+             "parent_id": self.parent_id, "name": self.name,
+             "start_s": round(self.start_s, 6), "end_s": round(end, 6),
+             "duration_s": round(end - self.start_s, 6),
+             "status": self.status}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.error:
+            d["error"] = self.error
+        if provisional and self.end_s is None:
+            d["provisional"] = True
+        return d
+
+
+_SPAN_VAR: "contextvars.ContextVar[Optional[Span]]" = \
+    contextvars.ContextVar("dtpu_current_span", default=None)
+
+
+def current_span() -> Optional[Span]:
+    return _SPAN_VAR.get()
+
+
+def current_trace_ids() -> Optional[Dict[str, str]]:
+    """``{"trace_id", "span_id", "prompt_id"?}`` for the active span — the
+    correlation fields the JSON log mode stamps on every line."""
+    sp = _SPAN_VAR.get()
+    if sp is None:
+        return None
+    out = {"trace_id": sp.trace_id, "span_id": sp.span_id}
+    node: Optional[Span] = sp
+    while node is not None:
+        pid = node.attrs.get("prompt_id")
+        if pid:
+            out["prompt_id"] = str(pid)
+            break
+        node = node.parent
+    return out
+
+
+def start_span(name: str, trace_id: Optional[str] = None,
+               parent: Optional[Span] = None,
+               parent_id: Optional[str] = None,
+               attrs: Optional[Dict[str, Any]] = None) -> Optional[Span]:
+    """Open a span (a root when no parent is given).  Returns None with
+    tracing disabled — every consumer treats the span as optional."""
+    if not _tracing_enabled:
+        return None
+    sp = Span(name, trace_id=trace_id, parent=parent, parent_id=parent_id,
+              attrs=attrs)
+    GLOBAL_TRACES.on_start(sp)
+    return sp
+
+
+def _begin_span(name: str, **attrs: Any) -> Optional[Span]:
+    """Child of the current span, set as current; None when no trace is
+    active (stray stages outside a job never create orphan spans)."""
+    parent = _SPAN_VAR.get()
+    if parent is None or not _tracing_enabled:
+        return None
+    sp = Span(name, parent=parent, attrs=attrs or None)
+    GLOBAL_TRACES.on_start(sp)
+    sp._token = _SPAN_VAR.set(sp)
+    return sp
+
+
+def _end_span(sp: Optional[Span]) -> None:
+    if sp is None:
+        return
+    token, sp._token = sp._token, None
+    if token is not None:
+        try:
+            _SPAN_VAR.reset(token)
+        except ValueError:
+            # reset from a different context (thread/task migrated the
+            # span) — clearing by value keeps the var consistent
+            if _SPAN_VAR.get() is sp:
+                _SPAN_VAR.set(sp.parent)
+    sp.end()
+
+
+@contextmanager
+def span(name: str, **attrs: Any):
+    """Child span of the current span, current within the block; yields
+    None (and records nothing) when no trace is active."""
+    sp = _begin_span(name, **attrs)
+    try:
+        yield sp
+    except BaseException as e:
+        if sp is not None:
+            sp.set_status("error", repr(e))
+        raise
+    finally:
+        _end_span(sp)
+
+
+@contextmanager
+def use_span(sp: Optional[Span]):
+    """Make ``sp`` the current span for the block WITHOUT ending it on
+    exit (the span's owner ends it) — the reattach half of the
+    cross-thread handoff, and how the exec loop parents a run under the
+    job span created at enqueue time."""
+    if sp is None:
+        yield None
+        return
+    token = _SPAN_VAR.set(sp)
+    try:
+        yield sp
+    finally:
+        _SPAN_VAR.reset(token)
+
+
+def capture_span_context() -> Optional[Span]:
+    """Snapshot this thread's/task's span context for reattachment on
+    another thread (``with use_span(captured): ...``) — mirrors
+    :func:`capture_transfer_context` for the HostIOPool handoff."""
+    return _SPAN_VAR.get()
+
+
+def event_span(name: str, start_s: float, end_s: float,
+               parent: Optional[Span] = None,
+               trace_id: Optional[str] = None,
+               parent_id: Optional[str] = None,
+               attrs: Optional[Dict[str, Any]] = None,
+               status: str = "ok") -> Optional[Dict[str, Any]]:
+    """Record an already-finished interval as a span (queue_wait measured
+    at pop time, an inbound upload measured by the handler).  Accepts a
+    parent Span or raw (trace_id, parent_id) for remote parents."""
+    if not _tracing_enabled:
+        return None
+    if parent is not None:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    if not trace_id:
+        return None
+    d = {"trace_id": trace_id, "span_id": new_span_id(),
+         "parent_id": parent_id, "name": str(name),
+         "start_s": round(start_s, 6), "end_s": round(end_s, 6),
+         "duration_s": round(max(end_s - start_s, 0.0), 6),
+         "status": status}
+    if attrs:
+        d["attrs"] = dict(attrs)
+    GLOBAL_TRACES.add(trace_id, d)
+    return d
+
+
+# --- W3C traceparent (the propagation header) --------------------------------
+
+def format_traceparent(sp: Span) -> str:
+    """``00-<trace_id>-<span_id>-01`` (W3C trace-context, sampled)."""
+    return f"00-{sp.trace_id}-{sp.span_id}-01"
+
+
+def traceparent_headers(sp: Optional[Span] = None) -> Dict[str, str]:
+    """Headers dict carrying the current (or given) span's traceparent;
+    empty when no trace is active — callers merge unconditionally."""
+    sp = sp if sp is not None else _SPAN_VAR.get()
+    if sp is None or not _tracing_enabled:
+        return {}
+    return {C.TRACEPARENT_HEADER: format_traceparent(sp)}
+
+
+def parse_traceparent(header: Optional[str]
+                      ) -> Optional[Tuple[str, str]]:
+    """``(trace_id, parent_span_id)`` from a traceparent header, or None
+    on anything malformed (propagation must never fail a request)."""
+    if not header:
+        return None
+    parts = str(header).strip().split("-")
+    if len(parts) < 4:
+        return None
+    _, trace_id, span_id = parts[0], parts[1], parts[2]
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+# --- flight recorder ---------------------------------------------------------
+
+class FlightRecorder:
+    """Bounded ring of recent completed job traces + the accumulation
+    buffer for in-flight ones.
+
+    Spans land here as they finish (``on_end``) or arrive from a peer
+    (``ingest`` — the worker ships its spans on the final data-plane
+    POST); ``commit(prompt_id, trace_id)`` moves a trace into the ring
+    when its job finalizes.  Late arrivals for a committed trace are
+    appended to the ring entry, so a straggler tile's spans still reach
+    the postmortem.  Everything is bounded: spans per trace
+    (``TRACE_MAX_SPANS``), in-flight traces, and the ring itself
+    (``DTPU_TRACE_RING``)."""
+
+    def __init__(self, max_traces: Optional[int] = None,
+                 max_spans: int = C.TRACE_MAX_SPANS):
+        self._lock = threading.Lock()
+        self.max_traces = max_traces if max_traces is not None else \
+            max(1, int(os.environ.get(C.TRACE_RING_ENV,
+                                      C.TRACE_RING_DEFAULT)))
+        self.max_spans = max_spans
+        # trace_id -> {span_id: span dict} for in-flight traces
+        self._active: "OrderedDict[str, Dict[str, Dict]]" = OrderedDict()
+        # trace_id -> [open Span] (exported provisionally mid-flight)
+        self._open: Dict[str, List[Span]] = {}
+        # prompt_id -> committed record (the ring)
+        self._jobs: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._by_trace: Dict[str, str] = {}  # committed trace -> prompt
+        self.dropped_spans = 0
+
+    # -- span sinks ---------------------------------------------------------
+
+    def on_start(self, sp: Span) -> None:
+        with self._lock:
+            self._open.setdefault(sp.trace_id, []).append(sp)
+
+    def on_end(self, sp: Span) -> None:
+        with self._lock:
+            opens = self._open.get(sp.trace_id)
+            if opens is not None:
+                try:
+                    opens.remove(sp)
+                except ValueError:
+                    pass
+                if not opens:
+                    del self._open[sp.trace_id]
+        self.add(sp.trace_id, sp.to_dict())
+
+    def add(self, trace_id: str, span_dict: Dict[str, Any]) -> None:
+        """Insert/replace one span dict (keyed by span_id: a provisional
+        remote span is superseded by its final version)."""
+        with self._lock:
+            pid = self._by_trace.get(trace_id)
+            if pid is not None:
+                rec = self._jobs.get(pid)
+                if rec is not None and (
+                        span_dict["span_id"] in rec["_ids"]
+                        or len(rec["spans"]) < self.max_spans):
+                    if span_dict["span_id"] in rec["_ids"]:
+                        rec["spans"] = [span_dict
+                                        if s["span_id"] ==
+                                        span_dict["span_id"] else s
+                                        for s in rec["spans"]]
+                    else:
+                        rec["spans"].append(span_dict)
+                        rec["_ids"].add(span_dict["span_id"])
+                else:
+                    self.dropped_spans += 1
+                return
+            spans = self._active.get(trace_id)
+            if spans is None:
+                # bound the in-flight buffer too: a flood of orphan
+                # traces (e.g. remote spans for jobs this process never
+                # commits) must not grow without limit
+                while len(self._active) >= 4 * self.max_traces:
+                    self._active.popitem(last=False)
+                spans = self._active[trace_id] = {}
+            if span_dict["span_id"] in spans \
+                    or len(spans) < self.max_spans:
+                spans[span_dict["span_id"]] = span_dict
+            else:
+                self.dropped_spans += 1
+
+    def ingest(self, span_dicts: List[Dict[str, Any]]) -> int:
+        """Merge spans shipped from a peer process (dicts with their own
+        trace_id); malformed entries are skipped, count kept is
+        returned."""
+        kept = 0
+        for d in span_dicts or []:
+            if not isinstance(d, dict):
+                continue
+            tid, sid = d.get("trace_id"), d.get("span_id")
+            if not tid or not sid:
+                continue
+            self.add(str(tid), d)
+            kept += 1
+        return kept
+
+    def export(self, trace_id: str,
+               include_open: bool = True) -> List[Dict[str, Any]]:
+        """The trace's spans as dicts — finished ones plus (optionally)
+        still-open ones with a provisional end, for shipping to the
+        master before the local job span closes."""
+        with self._lock:
+            pid = self._by_trace.get(trace_id)
+            if pid is not None and pid in self._jobs:
+                out = list(self._jobs[pid]["spans"])
+            else:
+                out = list(self._active.get(trace_id, {}).values())
+            opens = list(self._open.get(trace_id, ())) if include_open \
+                else []
+        out.extend(sp.to_dict(provisional=True) for sp in opens)
+        return out
+
+    # -- job lifecycle ------------------------------------------------------
+
+    def commit(self, prompt_id: str, trace_id: str, status: str = "ok",
+               root_span_id: Optional[str] = None,
+               duration_s: Optional[float] = None) -> None:
+        """Seal a job's trace into the ring under its prompt id.
+
+        A trace_id may legitimately commit under more than one prompt id
+        in ONE process (single-process loopback: the worker-role job and
+        the master's fan-out job share the trace and the recorder) — the
+        later commit absorbs the earlier record's spans so whichever
+        prompt id the client holds resolves to the full tree."""
+        with self._lock:
+            by_id = dict(self._active.pop(trace_id, {}))
+            prev_pid = self._by_trace.get(trace_id)
+            if prev_pid is not None and prev_pid != str(prompt_id):
+                prev = self._jobs.get(prev_pid)
+                if prev is not None:
+                    for s in prev["spans"]:
+                        by_id.setdefault(s["span_id"], s)
+            spans = list(by_id.values())
+            rec = {"prompt_id": str(prompt_id), "trace_id": trace_id,
+                   "status": status, "root_span_id": root_span_id,
+                   "duration_s": duration_s, "finished_at": time.time(),
+                   "spans": spans,
+                   "_ids": set(by_id)}
+            self._jobs[str(prompt_id)] = rec
+            self._jobs.move_to_end(str(prompt_id))
+            self._by_trace[trace_id] = str(prompt_id)
+            while len(self._jobs) > self.max_traces:
+                _, old = self._jobs.popitem(last=False)
+                # only unmap the trace if the mapping still points at the
+                # evicted record: after a dual-commit (loopback), the
+                # newer prompt's record owns the mapping and must keep
+                # receiving late arrivals
+                if self._by_trace.get(old["trace_id"]) \
+                        == old["prompt_id"]:
+                    self._by_trace.pop(old["trace_id"], None)
+
+    def get(self, prompt_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._jobs.get(str(prompt_id))
+            if rec is None:
+                return None
+            out = {k: v for k, v in rec.items() if k != "_ids"}
+            out["spans"] = sorted(rec["spans"],
+                                  key=lambda s: s.get("start_s", 0.0))
+            out["n_spans"] = len(out["spans"])
+            return out
+
+    def index(self) -> List[Dict[str, Any]]:
+        """Newest-first job summaries for ``GET /distributed/traces``."""
+        with self._lock:
+            return [{"prompt_id": rec["prompt_id"],
+                     "trace_id": rec["trace_id"],
+                     "status": rec["status"],
+                     "duration_s": rec["duration_s"],
+                     "finished_at": rec["finished_at"],
+                     "n_spans": len(rec["spans"])}
+                    for rec in reversed(self._jobs.values())]
+
+    def breakdown(self, trace_id: str) -> Dict[str, float]:
+        """Per-span-name total seconds for one trace — the slow-job log's
+        one-line stage summary."""
+        out: Dict[str, float] = {}
+        for s in self.export(trace_id, include_open=False):
+            out[s["name"]] = round(
+                out.get(s["name"], 0.0) + float(s.get("duration_s", 0.0)),
+                6)
+        return out
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._jobs)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._open.clear()
+            self._jobs.clear()
+            self._by_trace.clear()
+            self.dropped_spans = 0
+
+
+GLOBAL_TRACES = FlightRecorder()
+
+
+def build_span_tree(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Nest span dicts by parent_id: returns the root list, each node a
+    copy with a ``children`` list (start-time ordered).  Spans whose
+    parent is unknown (a remote hop that never shipped) surface as
+    additional roots rather than vanishing."""
+    nodes = {s["span_id"]: {**s, "children": []}
+             for s in sorted(spans, key=lambda s: s.get("start_s", 0.0))}
+    roots: List[Dict[str, Any]] = []
+    for node in nodes.values():
+        parent = nodes.get(node.get("parent_id") or "")
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    return roots
+
+
+# --- Prometheus text exposition ----------------------------------------------
+
+def _prom_escape(value: Any) -> str:
+    return (str(value).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _prom_labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_prom_escape(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _prom_num(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _render_histogram_family(lines: List[str], family: str, help_text: str,
+                             stats: PhaseStats, label_key: str) -> None:
+    hists = stats.histograms()
+    lines.append(f"# HELP {family} {help_text}")
+    lines.append(f"# TYPE {family} histogram")
+    for name in sorted(hists):
+        base = {label_key: name}
+        buckets, sum_s, count = hists[name].prom_series()
+        for le, cum in buckets:
+            le_s = "+Inf" if le == float("inf") else _prom_num(le)
+            lines.append(f"{family}_bucket"
+                         f"{_prom_labels({**base, 'le': le_s})} {cum}")
+        lines.append(f"{family}_sum{_prom_labels(base)} {repr(sum_s)}")
+        lines.append(f"{family}_count{_prom_labels(base)} {count}")
+
+
+def prometheus_text(extra: Optional[List[Tuple[str, str, str,
+                                               List[Tuple[Dict, float]]]]]
+                    = None) -> str:
+    """Render the telemetry state as Prometheus text exposition format
+    (v0.0.4): stage/phase/node latency histograms (``_bucket``/``_sum``/
+    ``_count``), event counters, transfer byte counters, jit
+    trace/compile counters and the flight-recorder gauge.  ``extra`` adds
+    caller families as ``(name, type, help, [(labels, value), ...])`` —
+    the server layer appends its prompt/image counters and queue gauge."""
+    lines: List[str] = []
+    _render_histogram_family(
+        lines, "dtpu_stage_seconds",
+        "Serving-pipeline stage wall-clock (overlapping stages).",
+        GLOBAL_STAGES, "stage")
+    _render_histogram_family(
+        lines, "dtpu_phase_seconds",
+        "Internal phase wall-clock (Timer sink).",
+        GLOBAL_PHASES, "phase")
+    _render_histogram_family(
+        lines, "dtpu_node_seconds",
+        "Per-workflow-node-type op execution seconds.",
+        GLOBAL_NODES, "node_type")
+
+    lines.append("# HELP dtpu_events_total Scheduler/wire/pipeline event "
+                 "counters.")
+    lines.append("# TYPE dtpu_events_total counter")
+    for name, value in sorted(GLOBAL_COUNTERS.snapshot().items()):
+        lines.append(f"dtpu_events_total{_prom_labels({'event': name})} "
+                     f"{int(value)}")
+
+    lines.append("# HELP dtpu_transfer_bytes_total Host<->device transfer "
+                 "bytes by direction.")
+    lines.append("# TYPE dtpu_transfer_bytes_total counter")
+    for direction in ("d2h", "h2d"):
+        lines.append(
+            f"dtpu_transfer_bytes_total"
+            f"{_prom_labels({'direction': direction})} "
+            f"{GLOBAL_TRANSFERS.total(direction)}")
+
+    retr = GLOBAL_RETRACES.mark()
+    lines.append("# HELP dtpu_jit_traces_total Jaxpr traces observed "
+                 "(cache-missed jit calls).")
+    lines.append("# TYPE dtpu_jit_traces_total counter")
+    lines.append(f"dtpu_jit_traces_total {retr['traces']}")
+    lines.append("# HELP dtpu_xla_compiles_total Backend (XLA) "
+                 "compilations observed.")
+    lines.append("# TYPE dtpu_xla_compiles_total counter")
+    lines.append(f"dtpu_xla_compiles_total {retr['compiles']}")
+
+    lines.append("# HELP dtpu_trace_ring_size Completed job traces held "
+                 "by the flight recorder.")
+    lines.append("# TYPE dtpu_trace_ring_size gauge")
+    lines.append(f"dtpu_trace_ring_size {GLOBAL_TRACES.size()}")
+
+    for name, typ, help_text, samples in extra or []:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {typ}")
+        for labels, value in samples:
+            lines.append(f"{name}{_prom_labels(labels)} {_prom_num(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def reset_aggregate_metrics() -> Dict[str, Any]:
+    """POST /distributed/metrics/reset core: clear the process-wide
+    aggregate sinks (phases, stages, node timings, counters, transfers)
+    so benches and multi-phase test runs stop inheriting cross-run
+    telemetry.  Retrace counters are monotonic observations of
+    jax.monitoring and are NOT reset (readers diff marks); the flight
+    recorder keeps its per-job history unless asked."""
+    before = {"phases": len(GLOBAL_PHASES.snapshot()),
+              "stages": len(GLOBAL_STAGES.snapshot()),
+              "nodes": len(GLOBAL_NODES.snapshot()),
+              "counters": len(GLOBAL_COUNTERS.snapshot()),
+              "transfer_labels": len(GLOBAL_TRANSFERS.snapshot())}
+    GLOBAL_PHASES.reset()
+    GLOBAL_STAGES.reset()
+    GLOBAL_NODES.reset()
+    GLOBAL_COUNTERS.reset()
+    GLOBAL_TRANSFERS.reset()
+    return before

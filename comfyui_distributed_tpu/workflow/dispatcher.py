@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import aiohttp
 
 from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 from comfyui_distributed_tpu.utils.net import get_client_session
 from comfyui_distributed_tpu.workflow.graph import Graph, connected_component
@@ -182,13 +183,15 @@ async def dispatch_to_worker(worker: Dict[str, Any], graph: Graph,
     """POST the prepared prompt to a worker's /prompt
     (``_dispatchToWorker``, ``gpupanel.js:1313-1362``; ``extra_data``
     carries extra_pnginfo like the reference's dispatch payload,
-    ``:1344-1358``)."""
+    ``:1344-1358``).  The active span's W3C traceparent rides the request
+    so the worker's execution joins THIS job's distributed trace."""
     session = await get_client_session()
     payload = {"prompt": graph.to_api_format(), "client_id": client_id}
     if extra_data:
         payload["extra_data"] = extra_data
     async with session.post(
             worker_url(worker) + "/prompt", json=payload,
+            headers=trace_mod.traceparent_headers() or None,
             timeout=aiohttp.ClientTimeout(total=30)) as r:
         if r.status == 429:
             # backpressure (DTPU_MAX_QUEUE): the worker is alive but at
@@ -218,6 +221,7 @@ async def prepare_job_on(url: str, multi_job_id: str,
     async with session.post(f"{url}/distributed/prepare_job",
                             json={"multi_job_id": multi_job_id,
                                   "kind": kind},
+                            headers=trace_mod.traceparent_headers() or None,
                             timeout=aiohttp.ClientTimeout(total=5)) as r:
         if r.status != 200:
             raise RuntimeError(f"prepare_job failed: {r.status}")

@@ -166,9 +166,15 @@ class WorkflowExecutor:
                         kwargs[hname] = hval
                 debug_log(f"exec node {nid} ({node.class_type})")
                 t0 = time.perf_counter()
-                with trace_mod.node_scope(nid):
+                # node-scoped telemetry: transfer attribution + a child
+                # span in the active request trace (no-op outside a job)
+                with trace_mod.node_scope(nid), \
+                        trace_mod.span(node.class_type, node=nid):
                     outputs[nid] = op.execute(self.ctx, **kwargs)
                 timings[nid] = time.perf_counter() - t0
+                # per-node-type latency histogram (p50/p95/p99 on
+                # /distributed/metrics and the dtpu_node_seconds family)
+                trace_mod.GLOBAL_NODES.record(node.class_type, timings[nid])
 
         total = time.perf_counter() - t_start
         self.ctx.node_timings.update(timings)

@@ -30,6 +30,7 @@ import aiohttp
 
 from comfyui_distributed_tpu.utils import config as cfg_mod
 from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 from comfyui_distributed_tpu.utils.net import get_client_session
 from comfyui_distributed_tpu.workflow import dispatcher as dsp
@@ -151,6 +152,7 @@ async def _post_prompt(url: str, graph: Graph, client_id: str,
     if extra_data:
         payload["extra_data"] = extra_data
     async with session.post(f"{url}/prompt", json=payload,
+                            headers=trace_mod.traceparent_headers() or None,
                             timeout=aiohttp.ClientTimeout(total=30)) as r:
         if r.status != 200:
             raise RuntimeError(f"master rejected prompt ({r.status}): "
@@ -215,7 +217,8 @@ async def run_distributed(graph_or_doc: Any,
                                           extra_data)
 
     # 1. preflight (drop dead workers; reference gpupanel.js:842-848)
-    alive = await dsp.preflight_check(workers) if workers else []
+    with trace_mod.span("preflight", n_workers=len(workers or [])):
+        alive = await dsp.preflight_check(workers) if workers else []
     if workers and not alive:
         log("orchestrator: no workers alive, running master-only")
 
@@ -259,10 +262,14 @@ async def run_distributed(graph_or_doc: Any,
             graph, "worker", job_id_map, enabled_ids,
             master_url=master_url, worker_index=index)
         # extra_pnginfo rides every worker dispatch (reference
-        # gpupanel.js:1344-1358) so worker-saved PNGs carry the workflow
-        return await dsp.dispatch_to_worker(worker, wgraph,
-                                            client_id=client_id,
-                                            extra_data=extra_data)
+        # gpupanel.js:1344-1358) so worker-saved PNGs carry the workflow.
+        # The dispatch span is what the worker's trace parents under: its
+        # span id travels in the traceparent header dispatch_to_worker
+        # injects (the gather task inherited this job's span context).
+        with trace_mod.span("dispatch", worker=str(worker.get("id"))):
+            return await dsp.dispatch_to_worker(worker, wgraph,
+                                                client_id=client_id,
+                                                extra_data=extra_data)
 
     t0 = time.perf_counter()
     dispatches = asyncio.gather(

@@ -60,6 +60,7 @@ _MODULE_COST_S = {
     "test_server.py": 45,
     "test_tensor_plane.py": 40,
     "test_pipeline.py": 35,
+    "test_observability.py": 30,
     "test_attention.py": 35,
     "test_multihost.py": 30,
     "test_checkpoints_canonical.py": 18,
