@@ -54,6 +54,7 @@ Extra modes:
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import os
 import sys
@@ -118,7 +119,8 @@ def parse_args(argv=None):
                         "the server-side lease, so the probe must resolve "
                         "naturally: devices or UNAVAILABLE)")
     p.add_argument("--phase", default=None,
-                   choices=["tensor_plane", "pipeline", "observability"],
+                   choices=["tensor_plane", "pipeline", "observability",
+                            "fault"],
                    help="run ONE named software-proxy phase. "
                         "'tensor_plane': repeated 2-image SPMD txt2img on "
                         "the CPU backend reporting host_transfer_mb_per_"
@@ -135,7 +137,13 @@ def parse_args(argv=None):
                         "throughput on the same 4-prompt queue — the "
                         "always-on request-tracing overhead must stay "
                         "within 3% with zero new jit traces, and the "
-                        "artifact carries a sample per-job trace tree")
+                        "artifact carries a sample per-job trace tree. "
+                        "'fault': loopback master+2-worker tiled upscale "
+                        "with the cluster control plane — kills a worker "
+                        "at --kill-fraction of its tiles and reports "
+                        "completion rate, recovery latency and the "
+                        "happy-path overhead of running with the control "
+                        "plane armed (must be <=3%, zero new retraces)")
     p.add_argument("--scaling-sweep", action="store_true",
                    help="virtual-mesh SPMD overhead sweep instead of the "
                         "single-chip throughput bench")
@@ -154,6 +162,9 @@ def parse_args(argv=None):
                    help="BASELINE config 4: the distributed-img2img "
                         "variation-sweep fixture wall-clock, in-process "
                         "single participant")
+    p.add_argument("--kill-fraction", type=float, default=0.34,
+                   help="--phase fault: kill the victim worker after this "
+                        "fraction of its tiles went out (0 = before any)")
     p.add_argument("--upscale-target", type=int, default=2048,
                    help="refined output edge for --upscale (2048 = 4x the "
                         "512px test card)")
@@ -221,7 +232,8 @@ def parse_args(argv=None):
         args.family = "sd15" if args.upscale else "sdxl"
     if args.steps is None:
         args.steps = 8 if args.scaling_sweep else \
-            (2 if args.phase in ("pipeline", "observability") else 20)
+            (2 if args.phase in ("pipeline", "observability") else
+             (1 if args.phase == "fault" else 20))
     if args.family == "tiny":
         # clamp HERE, not after backend init: the failure payload's metric
         # name must match the success series' name for the same invocation
@@ -241,6 +253,8 @@ def metric_name(args):
         return "tensor_plane_warm_ttfi_s"
     if getattr(args, "phase", None) == "observability":
         return "observability_traced_imgs_per_s_4prompt"
+    if getattr(args, "phase", None) == "fault":
+        return "fault_recovery_completion_rate"
     if args.real_ckpt:
         return (f"real_ckpt_{args.family}_{args.width}x{args.height}_"
                 f"{args.steps}step_sec_per_image")
@@ -267,6 +281,8 @@ def metric_unit(args):
         return "sec/run"
     if getattr(args, "phase", None) == "observability":
         return "imgs/s"
+    if getattr(args, "phase", None) == "fault":
+        return "fraction"
     if args.scaling_sweep or args.multiproc_sweep:
         return "fraction"
     if args.upscale or args.img2img or args.real_ckpt:
@@ -1104,6 +1120,346 @@ def run_observability(args):
     emit(args, payload)
 
 
+def _fault_upscale_prompt(seed=7, size=96, tile=32, steps=1):
+    """Tiled-upscale fan-out shape for the fault phase: a deterministic
+    synthetic card (LoadImage missing-file fallback) scaled to 96px ->
+    9 tiles of 32px over master + 2 workers (3 tiles each)."""
+    return {
+        "7": {"class_type": "CheckpointLoaderSimple",
+              "inputs": {"ckpt_name": "tiny.safetensors"}},
+        "5": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "a map", "clip": ["7", 1]}},
+        "6": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["7", 1]}},
+        "10": {"class_type": "LoadImage",
+               "inputs": {"image": "__bench_fault_card__.png"}},
+        "11": {"class_type": "ImageScale",
+               "inputs": {"image": ["10", 0],
+                          "upscale_method": "bilinear", "width": size,
+                          "height": size, "crop": "disabled"}},
+        "2": {"class_type": "UltimateSDUpscaleDistributed",
+              "inputs": {"upscaled_image": ["11", 0], "model": ["7", 0],
+                         "positive": ["5", 0], "negative": ["6", 0],
+                         "vae": ["7", 2], "seed": seed, "steps": steps,
+                         "cfg": 2.0, "sampler_name": "euler",
+                         "scheduler": "normal", "denoise": 0.4,
+                         "tile_width": tile, "tile_height": tile,
+                         "padding": 8, "mask_blur": 2,
+                         "force_uniform_tiles": True}},
+        "3": {"class_type": "PreviewImage", "inputs": {"images": ["2", 0]}},
+    }
+
+
+def measure_fault(kill_fraction: float = 0.34, repeats: int = 3,
+                  jobs_per_round: int = 6, steps: int = 1,
+                  wait_s: float = 300.0):
+    """Fault-injection harness behind ``--phase fault`` (also called
+    in-process by tests): master + 2 workers as real loopback HTTP
+    servers running the tiled-upscale fan-out.
+
+    Three measurements on ONE topology (shared compile caches):
+
+    * **armed** — control plane on (DTPU_FAULT_POLICY=reassign, hedging
+      armed): best-of-``repeats`` happy-path job wall, with a retrace
+      mark around the timed rounds — armed-but-idle must be FREE (zero
+      new compiled traces, throughput within 3% of disabled);
+    * **disabled** — DTPU_FAULT_POLICY=partial + DTPU_HEDGE=0 (the seed
+      behavior): the baseline wall;
+    * **fault** — one worker killed after ``kill_fraction`` of its
+      tiles: completion rate (ledger units checked in / total — 1.0
+      means the reassignment recovered every lost tile), recovery
+      latency (fault wall minus the armed happy wall), and the
+      reassign-span proof from the flight recorder.
+    """
+    import tempfile
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from comfyui_distributed_tpu.runtime import cluster as cluster_mod
+    from comfyui_distributed_tpu.server.app import ServerState, build_app
+    from comfyui_distributed_tpu.utils import constants as C
+    from comfyui_distributed_tpu.utils import trace as tr
+
+    os.environ.setdefault("DTPU_DEFAULT_FAMILY", "tiny")
+    saved_env = {k: os.environ.get(k)
+                 for k in (C.FAULT_POLICY_ENV, C.HEDGE_ENV, C.LEASE_ENV,
+                           C.SUSPECT_PROBES_ENV)}
+    # lease/probe tuning for a single-process CPU proxy: jax compute
+    # holds the GIL in long stretches, starving the shared event loop —
+    # a too-tight lease would declare LIVE workers dead from probe
+    # timeouts and poison the happy-path rounds with spurious recovery
+    os.environ[C.LEASE_ENV] = "4.0"
+    os.environ[C.SUSPECT_PROBES_ENV] = "3"
+
+    def set_control(enabled: bool):
+        os.environ[C.FAULT_POLICY_ENV] = "reassign" if enabled \
+            else "partial"
+        os.environ[C.HEDGE_ENV] = "1" if enabled else "0"
+
+    async def go():
+        tmp = tempfile.mkdtemp(prefix="bench_fault_")
+        workers, cfg_workers = [], []
+        for i in range(2):
+            wdir = os.path.join(tmp, f"worker{i}")
+            os.makedirs(os.path.join(wdir, "in"))
+            st = ServerState(config_path=os.path.join(wdir, "cfg.json"),
+                             input_dir=os.path.join(wdir, "in"),
+                             output_dir=wdir, is_worker=True)
+            client = TestClient(TestServer(build_app(st)))
+            await client.start_server()
+            workers.append((st, client))
+            cfg_workers.append({"id": f"w{i}", "host": "127.0.0.1",
+                                "port": client.server.port,
+                                "enabled": True})
+        mdir = os.path.join(tmp, "master")
+        os.makedirs(os.path.join(mdir, "in"))
+        with open(os.path.join(mdir, "cfg.json"), "w") as f:
+            json.dump({"workers": cfg_workers,
+                       "master": {"host": "127.0.0.1"}, "settings": {}},
+                      f)
+        mstate = ServerState(config_path=os.path.join(mdir, "cfg.json"),
+                             input_dir=os.path.join(mdir, "in"),
+                             output_dir=mdir, is_worker=False)
+        mclient = TestClient(TestServer(build_app(mstate)))
+        await mclient.start_server()
+        mstate.port = mclient.server.port
+        # the poller renews worker leases for the WHOLE measurement (a
+        # production master always polls); without it the 1.5s leases
+        # expire between jobs and preflight would skip live workers
+        mstate.health.interval = 0.5
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, mstate.health.poll_once)
+        mstate.health.start()
+
+        async def post_job(seed):
+            r = await mclient.post("/prompt", json={
+                "prompt": _fault_upscale_prompt(seed=seed, steps=steps),
+                "client_id": "bench-fault"})
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            return body["prompt_id"], body.get("workers", [])
+
+        async def wait_job(pid):
+            deadline = time.monotonic() + wait_s
+            while time.monotonic() < deadline:
+                hist = await (await mclient.get("/history")).json()
+                if pid in hist:
+                    assert hist[pid]["status"] == "success", hist[pid]
+                    return
+                # tight poll: 50ms quantization would swamp a 3% delta
+                # on sub-second jobs
+                await asyncio.sleep(0.01)
+            raise TimeoutError(f"fault-bench job {pid} never finished")
+
+        async def run_job(seed):
+            t0 = time.perf_counter()
+            pid, ws = await post_job(seed)
+            assert sorted(ws) == ["w0", "w1"], \
+                f"fan-out degraded to {ws} (lease bookkeeping broken?)"
+            await wait_job(pid)
+            return pid, time.perf_counter() - t0
+
+        async def settle(timeout_s=90.0):
+            """Wait for every participant's queue to drain before the
+            next timed round: a hedged round leaves the straggler's
+            worker retrying 404s with backoff, and starting the next
+            job behind that backlog would measure the backlog, not the
+            job (and re-trigger hedges, cascading)."""
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if mstate.queue_remaining() == 0 and not any(
+                        st.queue_remaining() for st, _ in workers):
+                    return
+                await asyncio.sleep(0.1)
+
+        try:
+            # warm with recovery OFF: compile every participant's refine
+            # program (armed/disabled differ only in env knobs, never in
+            # compiled shapes) without a cold-noise hedge seeding a
+            # retry backlog into the timed rounds
+            set_control(False)
+            await run_job(seed=1)
+
+            # interleaved armed/disabled rounds (the observability
+            # phase's trick): everything that drifts over the run —
+            # allocator, page cache, container noise — hits both arms
+            # alike, so the delta isolates the control plane.  Armed
+            # rounds also record per-round counter deltas; invariants
+            # judge the BEST armed round (a noisy round may
+            # legitimately hedge a late worker, the steady state must
+            # do zero speculative work).
+            armed_rounds = []
+            disabled_s = None
+            seed = 10
+            for i in range(repeats):
+                for enabled in (True, False):
+                    await settle()
+                    set_control(enabled)
+                    h0 = tr.GLOBAL_COUNTERS.get("cluster_hedges")
+                    r0 = tr.GLOBAL_COUNTERS.get(
+                        "cluster_reassigned_units")
+                    mark = tr.GLOBAL_RETRACES.mark()
+                    # several jobs per round: a single ~0.6s CPU-tiny
+                    # job can't resolve a 3% delta through scheduler
+                    # noise
+                    dt = 0.0
+                    for j in range(jobs_per_round):
+                        _, d = await run_job(seed=seed)
+                        seed += 1
+                        dt += d
+                    dt /= jobs_per_round
+                    if enabled:
+                        armed_rounds.append({
+                            "dt": dt,
+                            "hedges": tr.GLOBAL_COUNTERS.get(
+                                "cluster_hedges") - h0,
+                            "reassigns": tr.GLOBAL_COUNTERS.get(
+                                "cluster_reassigned_units") - r0,
+                            "retraces": tr.GLOBAL_RETRACES.since(
+                                mark)["traces"],
+                        })
+                    else:
+                        disabled_s = dt if disabled_s is None \
+                            else min(disabled_s, dt)
+            best = min(armed_rounds, key=lambda r: r["dt"])
+            armed_s = best["dt"]
+            armed_retraces = best["retraces"]
+            armed_hedges = best["hedges"]
+            armed_reassigns = best["reassigns"]
+            await settle()
+
+            # fault round: kill w1 after kill_fraction of its tiles
+            set_control(True)
+            # 9 tiles over master+2 workers -> w1 owns 3; fraction->count
+            victim_tiles = 3
+            drop_after = max(0, min(victim_tiles - 1,
+                                    int(kill_fraction * victim_tiles)))
+            workers[1][0].fault_inject = {"drop_tiles_after": drop_after}
+            t0 = time.perf_counter()
+            pid, ws = await post_job(seed=99)
+            assert "w1" in ws, f"victim not dispatched to: {ws}"
+            # the dispatch landed (the POST returned after fan-out) —
+            # now the victim's server dies mid-job
+            await workers[1][1].close()
+            await wait_job(pid)
+            fault_s = time.perf_counter() - t0
+            mstate.health.stop()
+
+            snap = await (await mclient.get("/distributed/cluster")).json()
+            tile_jobs = [j for j in snap["ledger"]["completed_jobs"]
+                         if j["kind"] == "tile"]
+            job = tile_jobs[-1] if tile_jobs else {}
+            rec = tr.GLOBAL_TRACES.get(pid)
+            span_names = {s["name"] for s in rec["spans"]} \
+                if rec else set()
+            return {
+                "armed_s": armed_s, "disabled_s": disabled_s,
+                "fault_s": fault_s,
+                "armed_retraces": armed_retraces,
+                "armed_hedges": armed_hedges,
+                "armed_reassigns": armed_reassigns,
+                "drop_after": drop_after,
+                "fault_done_units": job.get("done_units", 0),
+                "fault_total_units": job.get("total_units", 9),
+                "fault_reassigned_units": job.get("reassigned_units", 0),
+                "fault_hedged_units": job.get("hedged_units", 0),
+                "reassign_span_in_trace": "reassign" in span_names
+                or "hedge" in span_names,
+            }
+        finally:
+            mstate.health.stop()
+            await mclient.close()
+            for st, client in workers:
+                try:
+                    await client.close()
+                except Exception:  # noqa: BLE001 - already closed
+                    pass
+            mstate.drain(5)
+            for st, _ in workers:
+                st.drain(5)
+
+    try:
+        m = asyncio.run(go())
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    total = max(m["fault_total_units"], 1)
+    return {
+        "kill_fraction": kill_fraction,
+        "completion_rate": round(m["fault_done_units"] / total, 4),
+        "recovery_latency_s": round(max(m["fault_s"] - m["armed_s"],
+                                        0.0), 4),
+        "happy_armed_s": round(m["armed_s"], 4),
+        "happy_disabled_s": round(m["disabled_s"], 4),
+        "happy_overhead_pct": round(
+            (m["armed_s"] - m["disabled_s"]) / m["disabled_s"] * 100.0,
+            3),
+        "happy_armed_retraces": int(m["armed_retraces"]),
+        "happy_armed_hedges": int(m["armed_hedges"]),
+        "happy_armed_reassigns": int(m["armed_reassigns"]),
+        "fault_job_s": round(m["fault_s"], 4),
+        "fault_drop_after_tiles": m["drop_after"],
+        "fault_done_units": m["fault_done_units"],
+        "fault_total_units": m["fault_total_units"],
+        "fault_reassigned_units": m["fault_reassigned_units"],
+        "fault_hedged_units": m["fault_hedged_units"],
+        "reassign_span_in_trace": bool(m["reassign_span_in_trace"]),
+    }
+
+
+def run_fault(args):
+    """``--phase fault``: the cluster control plane proof (ISSUE 4) —
+    killing 1 of 2 workers mid tiled-upscale must still complete every
+    ledger unit (reassignment), and the ARMED-but-idle happy path must
+    cost <=3% throughput with zero extra retraces."""
+    from comfyui_distributed_tpu.parallel.mesh import force_cpu_platform
+    force_cpu_platform(1)
+    enable_compile_cache()
+    m = measure_fault(kill_fraction=args.kill_fraction, steps=args.steps)
+    log(f"completion {m['completion_rate']} "
+        f"({m['fault_done_units']}/{m['fault_total_units']} units, "
+        f"{m['fault_reassigned_units']} reassigned, "
+        f"{m['fault_hedged_units']} hedged); recovery latency "
+        f"{m['recovery_latency_s']}s; happy-path overhead "
+        f"{m['happy_overhead_pct']}% (armed {m['happy_armed_s']}s vs "
+        f"disabled {m['happy_disabled_s']}s), retraces "
+        f"{m['happy_armed_retraces']}")
+    payload = {
+        "metric": metric_name(args),
+        "value": m["completion_rate"],
+        "unit": metric_unit(args),
+        "vs_baseline": 1.0,
+        **m,
+    }
+    problems = []
+    if m["completion_rate"] < 1.0:
+        problems.append(f"completion_rate {m['completion_rate']} < 1.0 "
+                        "(lost units never recovered)")
+    if m["fault_reassigned_units"] + m["fault_hedged_units"] < 1:
+        problems.append("no units were reassigned or hedged — the fault "
+                        "never engaged the control plane")
+    if not m["reassign_span_in_trace"]:
+        problems.append("no reassign/hedge span in the fault job's trace")
+    if m["happy_overhead_pct"] > 3.0:
+        problems.append(f"happy-path overhead {m['happy_overhead_pct']}% "
+                        "> 3%")
+    if m["happy_armed_retraces"] != 0:
+        problems.append(f"armed rounds retraced "
+                        f"{m['happy_armed_retraces']} times (want 0)")
+    if m["happy_armed_hedges"] + m["happy_armed_reassigns"] != 0:
+        problems.append(
+            f"armed-but-idle rounds did speculative work "
+            f"({m['happy_armed_hedges']} hedges, "
+            f"{m['happy_armed_reassigns']} reassigns — want 0)")
+    if problems:
+        payload["error"] = {"stage": "fault_invariants",
+                            "detail": "; ".join(problems)}
+    emit(args, payload)
+
+
 def run_suite(args):
     """The driver's default invocation: budget-capped backend escape
     (ladder_budget — ≤~20% of the claim window), then cheapest-first
@@ -1563,6 +1919,8 @@ def main():
             run_pipeline(args)
         elif args.phase == "observability":
             run_observability(args)
+        elif args.phase == "fault":
+            run_fault(args)
         elif args.real_ckpt:
             run_real_ckpt(args)
         elif args.multiproc_sweep:

@@ -213,6 +213,55 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_cluster(args) -> int:
+    """Cluster control-plane reader: lease-based worker states, active
+    ledger jobs with recovery counts, and the effective fault/hedge
+    policy — the headless answer to "is the cluster healthy, and what
+    happened to job X's lost tiles"."""
+    import urllib.request
+    with urllib.request.urlopen(f"{args.url}/distributed/cluster",
+                                timeout=10) as r:
+        data = json.loads(r.read())
+    if args.json:
+        print(json.dumps(data, indent=2))
+        return 0
+    print(f"policy={data['policy']}  lease={data['lease_s']}s  "
+          f"suspect_after={data['suspect_probes']} probes  "
+          f"hedge={'armed' if data['hedge']['armed'] else 'off'} "
+          f"(>= {data['hedge']['min_progress_pct']:g}% done, "
+          f"{data['hedge']['factor']:g}x latency)")
+    workers = data.get("workers", {})
+    if not workers:
+        print("(no registered workers)")
+    for wid, w in sorted(workers.items()):
+        age = w.get("last_seen_age_s")
+        lease = w.get("lease_remaining_s")
+        print(f"  {wid:16s} {w['state']:8s} "
+              f"last_seen={'never' if age is None else f'{age:.1f}s ago'}"
+              f"  lease_remaining="
+              f"{'-' if lease is None else f'{lease:.1f}s'}"
+              f"  failed_probes={w['failed_probes']}"
+              + (f"  {w.get('host')}:{w.get('port')}"
+                 if w.get("port") else ""))
+    ledger = data.get("ledger", {})
+    for jid, job in sorted(ledger.get("active_jobs", {}).items()):
+        print(f"  job {jid}: {job['done_units']}/{job['total_units']} "
+              f"{job['kind']} units, {job['reassigned_units']} "
+              f"reassigned, {job['hedged_units']} hedged")
+    for job in ledger.get("completed_jobs", [])[-5:]:
+        extra = ""
+        if job["reassigned_units"] or job["hedged_units"]:
+            extra = (f", {job['reassigned_units']} reassigned, "
+                     f"{job['hedged_units']} hedged")
+        if job["pending_units"]:
+            extra += f", LOST {job['pending_units']}"
+        print(f"  done {job['job_id']}: {job['done_units']}/"
+              f"{job['total_units']} in {job['duration_s']}s{extra}")
+    for t in data.get("transitions", [])[-8:]:
+        print(f"  transition {t['worker_id']}: {t['from']} -> {t['to']}")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Flight-recorder reader: no id lists recent job traces; with an id,
     pretty-prints the job's span tree (indent = parent/child, one line
@@ -315,6 +364,13 @@ def main(argv=None) -> int:
     p = sub.add_parser("status", help="query a running server")
     p.add_argument("--url", default="http://127.0.0.1:8288")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("cluster", help="worker lease states + work-ledger "
+                                       "jobs from a running master")
+    p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the pretty table")
+    p.set_defaults(fn=cmd_cluster)
 
     p = sub.add_parser("trace", help="read a job's distributed trace "
                                      "from a server's flight recorder")

@@ -112,6 +112,16 @@ class OpContext:
     # data plane (master mode): job store with asyncio queues + loop
     job_store: Any = None
     server_loop: Any = None
+    # cluster control plane (runtime/cluster.py): worker registry with
+    # leases + per-job work ledger — the collectors consult the registry
+    # for dead owners and check completions in through the ledger so
+    # lost units get reassigned/hedged instead of dropped.  None (CLI /
+    # SPMD mode) keeps the pre-cluster behavior.
+    cluster: Any = None
+    ledger: Any = None
+    # test/bench fault injection ({"drop_tiles_after": k, "stall_s": t});
+    # empty in production
+    fault_inject: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # collected artifacts
     saved_images: List[np.ndarray] = dataclasses.field(default_factory=list)
     node_timings: Dict[str, float] = dataclasses.field(default_factory=dict)

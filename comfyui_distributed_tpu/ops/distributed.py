@@ -83,11 +83,12 @@ class DistributedCollector(Op):
     # distributed.py:1366-1368 does the same).
     HIDDEN = ["multi_job_id", "is_worker", "master_url",
               "enabled_worker_ids", "worker_batch_size", "worker_id",
-              "pass_through"]
+              "pass_through", "dispatch_attempt"]
 
     def execute(self, ctx: OpContext, images, multi_job_id="",
                 is_worker=None, master_url="", enabled_worker_ids="[]",
-                worker_batch_size=1, worker_id="", pass_through=False):
+                worker_batch_size=1, worker_id="", pass_through=False,
+                dispatch_attempt=0):
         if pass_through:
             # downstream of a distributed upscaler: tiles were already
             # collected there (reference gpupanel.js:1146-1154); keep the
@@ -103,7 +104,8 @@ class DistributedCollector(Op):
             arr = as_image_array(images)
             self._send_to_master(ctx, arr, multi_job_id,
                                  master_url or ctx.master_url,
-                                 worker_id or ctx.worker_id)
+                                 worker_id or ctx.worker_id,
+                                 attempt=int(dispatch_attempt or 0))
             return (arr,)
 
         if multi_job_id and ctx.job_store is not None:
@@ -145,7 +147,8 @@ class DistributedCollector(Op):
     # --- worker HTTP path ---------------------------------------------------
 
     def _send_to_master(self, ctx: OpContext, arr: np.ndarray,
-                        multi_job_id: str, master_url: str, worker_id: str):
+                        multi_job_id: str, master_url: str, worker_id: str,
+                        attempt: int = 0):
         """Pipelined upload: image i+1's encode runs on an executor
         thread WHILE image i's POST is in flight (double-buffering), and
         the payload format is negotiated per master — raw tensor
@@ -196,6 +199,12 @@ class DistributedCollector(Op):
                     form.add_field("multi_job_id", multi_job_id)
                     form.add_field("worker_id", str(worker_id))
                     form.add_field("image_index", str(i))
+                    # stable across post_form_with_retry resends of THIS
+                    # send, distinct across dispatch attempts — JobStore
+                    # dedupes replays so a timed-out-but-delivered POST
+                    # can't double-insert
+                    form.add_field("idem_key",
+                                   f"{worker_id}:{i}:{attempt}")
                     form.add_field("is_last", "true" if i == n - 1
                                    else "false")
                     if i == n - 1 and trace_id:
@@ -231,40 +240,157 @@ class DistributedCollector(Op):
 
     def _collect_http(self, ctx: OpContext, master_images: np.ndarray,
                       multi_job_id: str, enabled_worker_ids: str):
+        from comfyui_distributed_tpu.runtime import cluster as cluster_mod
         worker_ids = [str(w) for w in json.loads(enabled_worker_ids or "[]")]
+        # the wire carries positional labels ("worker_i"); the ledger and
+        # registry speak config ids — enabled order maps between them
+        pos_map = {f"worker_{i}": wid for i, wid in enumerate(worker_ids)}
+        ledger = ctx.ledger
+        registry = ctx.cluster
+        policy = cluster_mod.fault_policy()
+        if ledger is not None:
+            # one ledger unit per seed slice (worker): a worker's slice is
+            # complete when its is_last image checks in
+            ledger.create_job(multi_job_id,
+                              {wid: wid for wid in worker_ids},
+                              kind="image")
+        captured_span = trace_mod.capture_span_context()
 
         async def drain():
             q = await ctx.job_store.get_queue(multi_job_id)
             # keyed by (worker, image_index): the worker's send path retries
             # with backoff, so a timed-out-but-delivered POST arrives twice —
-            # last write wins instead of duplicating an image in the batch.
-            # Indexless senders get per-worker arrival numbers (sorted after
-            # any indexed uploads) so their images are all preserved.
+            # last write wins instead of duplicating an image in the batch
+            # (the JobStore's idempotency dedupe catches most replays
+            # upstream; this keying is the in-batch backstop).  Indexless
+            # senders get per-worker arrival numbers (sorted after any
+            # indexed uploads) so their images are all preserved.
             results: Dict[str, Dict[tuple, Any]] = {}
             arrival: Dict[str, int] = {}
             done = set()
+            handled_dead = set()
             # deadline inside the loop: hitting it still returns the partial
             # batch (parity with reference distributed.py:1372-1412); an
             # outer cancellation would discard it
             loop = asyncio.get_running_loop()
             deadline = loop.time() + C.JOB_COMPLETION_TIMEOUT
+            # redispatch extensions stay below the outer backstop:
+            # blowing past it would cancel the drain and discard the
+            # partial batch the deadline semantics exist to save
+            hard_deadline = loop.time() + 2 * C.JOB_COMPLETION_TIMEOUT \
+                + C.WORKER_JOB_TIMEOUT
+            last_progress = loop.time()
+            # the master cannot regenerate another participant's seed
+            # slice in-op (no model access here) — recovery for image
+            # jobs is redispatch-only, so short polls are only worth it
+            # when the orchestrator registered a redispatcher
+            can_recover = (ledger is not None and registry is not None
+                           and policy != "partial"
+                           and ledger.has_redispatcher(multi_job_id))
+            hedge_on = (cluster_mod.hedge_armed() and ledger is not None
+                        and ledger.has_redispatcher(multi_job_id))
+            poll_s = C.CLUSTER_POLL_S if (can_recover or hedge_on) \
+                else C.WORKER_JOB_TIMEOUT
+
+            async def recover_units(units, owner, reason):
+                with trace_mod.use_span(captured_span), \
+                        trace_mod.span(reason, job=multi_job_id,
+                                       lost=str(owner)):
+                    return await ledger.redispatch(multi_job_id,
+                                                   list(units), owner)
+
             try:
-                while len(done) < len(worker_ids):
+                while True:
+                    if ledger is not None:
+                        if not ledger.pending(multi_job_id):
+                            break
+                    elif len(done) >= len(worker_ids):
+                        break
                     remaining = deadline - loop.time()
                     if remaining <= 0:
+                        done_cfg = {pos_map.get(w, w) for w in done}
                         log(f"collector: collection deadline, missing "
-                            f"{set(worker_ids) - done}; continuing partial")
+                            f"{set(worker_ids) - done_cfg}; continuing "
+                            f"partial")
                         break
+                    if ledger is not None and registry is not None \
+                            and policy != "partial":
+                        # group pending units by their CURRENT owner
+                        # (a reassigned unit's key is its original
+                        # slice id, not its owner) and act on dead ones
+                        dead_units: Dict[str, list] = {}
+                        for u, o in ledger.owners_of_pending(
+                                multi_job_id, skip_hedged=True).items():
+                            if o not in handled_dead \
+                                    and registry.state(o) \
+                                    == cluster_mod.DEAD:
+                                dead_units.setdefault(o, []).append(u)
+                        for owner, units in dead_units.items():
+                            handled_dead.add(owner)
+                            if policy == "fail":
+                                raise cluster_mod.ClusterFaultError(
+                                    f"worker {owner} died before "
+                                    f"delivering slices {sorted(units)} "
+                                    f"of {multi_job_id} "
+                                    f"({C.FAULT_POLICY_ENV}=fail)")
+                            log(f"collector: worker {owner} lease "
+                                f"expired; redispatching its slice")
+                            if await recover_units(units, owner,
+                                                   "reassign"):
+                                deadline = min(max(
+                                    deadline, loop.time()
+                                    + C.JOB_COMPLETION_TIMEOUT / 2),
+                                    hard_deadline)
+                                last_progress = loop.time()
+                            else:
+                                log(f"collector: no healthy participant "
+                                    f"for {owner}'s slice; will blend "
+                                    f"partial")
+                    if hedge_on:
+                        for unit, owner in sorted(
+                                ledger.overdue_units(
+                                    multi_job_id).items(), key=str):
+                            hedged = ledger.mark_hedged(multi_job_id,
+                                                        [unit])
+                            if not hedged:
+                                continue
+                            if await recover_units([unit], owner,
+                                                   "hedge"):
+                                log(f"collector: hedged straggler "
+                                    f"{owner}'s slice")
+                            else:
+                                # a failed hedge must not pin the unit:
+                                # hedged=True would exclude it from the
+                                # dead-owner scan forever
+                                ledger.unmark_hedged(multi_job_id,
+                                                     [unit])
                     try:
                         item = await asyncio.wait_for(
-                            q.get(), timeout=min(C.WORKER_JOB_TIMEOUT,
-                                                 remaining))
+                            q.get(), timeout=max(min(poll_s, remaining),
+                                                 0.01))
                     except asyncio.TimeoutError:
-                        missing = set(worker_ids) - done
-                        log(f"collector: timeout, missing workers {missing}; "
-                            f"continuing with partial results")
-                        break
+                        if loop.time() - last_progress \
+                                > C.WORKER_JOB_TIMEOUT:
+                            # the wire labels in `done` are positional;
+                            # map back to config ids before diffing
+                            missing = set(worker_ids) - {
+                                pos_map.get(w, w) for w in done}
+                            log(f"collector: timeout, missing workers "
+                                f"{missing}; continuing with partial "
+                                f"results")
+                            break
+                        continue
+                    last_progress = loop.time()
                     wid = str(item["worker_id"])
+                    cfg_id = pos_map.get(wid, wid)
+                    if registry is not None:
+                        # touch the RAW wire label only: a positional
+                        # "worker_N" label is unknown to the registry
+                        # (no-op) — mapping it to the config id first
+                        # would let a redispatched replacement,
+                        # impersonating the dead owner's identity,
+                        # resurrect the dead worker's lease
+                        registry.touch(wid)
                     if "image_index" in item:
                         key = (0, int(item["image_index"]))
                     else:
@@ -273,6 +399,8 @@ class DistributedCollector(Op):
                     results.setdefault(wid, {})[key] = item["tensor"]
                     if item.get("is_last"):
                         done.add(wid)
+                        if ledger is not None:
+                            ledger.check_in(multi_job_id, cfg_id, cfg_id)
             finally:
                 # drop the queue so late arrivals can't accumulate forever
                 await ctx.job_store.remove_job(multi_job_id)
@@ -281,13 +409,28 @@ class DistributedCollector(Op):
         # the collect span is the master-side half of the fan-out tree:
         # worker execute spans (ingested off the final job_complete POST)
         # hang next to it under the same trace_id
-        with Timer("collector_http_drain"), \
-                trace_mod.span("collect", job=multi_job_id,
-                               n_workers=len(worker_ids)):
-            # outer timeout is a backstop; the in-loop deadline governs
-            results = run_async_in_loop(
-                drain(), ctx.server_loop,
-                timeout=C.JOB_COMPLETION_TIMEOUT + 2 * C.WORKER_JOB_TIMEOUT)
+        try:
+            with Timer("collector_http_drain"), \
+                    trace_mod.span("collect", job=multi_job_id,
+                                   n_workers=len(worker_ids)):
+                # outer timeout is a backstop; the in-loop deadline governs
+                results = run_async_in_loop(
+                    drain(), ctx.server_loop,
+                    timeout=2 * C.JOB_COMPLETION_TIMEOUT
+                    + 2 * C.WORKER_JOB_TIMEOUT)
+            if ledger is not None and policy == "fail":
+                lost = ledger.pending(multi_job_id)
+                if lost:
+                    raise cluster_mod.ClusterFaultError(
+                        f"slices {lost} of {multi_job_id} never arrived "
+                        f"({C.FAULT_POLICY_ENV}=fail)")
+        finally:
+            if ledger is not None:
+                summary = ledger.finish_job(multi_job_id)
+                if summary and summary["pending_units"]:
+                    log(f"collector: job {multi_job_id} finished with "
+                        f"lost slices {summary['pending_units']} "
+                        f"(policy={policy})")
 
         ordered = [master_images]
         for wid in sorted(results, key=lambda w: (parse_worker_index(w), w)):

@@ -60,12 +60,16 @@ class UltimateSDUpscaleDistributed(Op):
     DEFAULTS = {"steps": 20, "cfg": 8.0, "denoise": 0.5, "tile_width": 512,
                 "tile_height": 512, "padding": 32, "mask_blur": 8,
                 "force_uniform_tiles": True}
-    # tile_indices is accepted-but-unused, mirroring the reference schema
-    # ("Unused - kept for compatibility", distributed_upscale.py:77):
-    # workers always recompute their partition from (enabled_worker_ids,
-    # worker_id) — assignment needs no communication.
+    # tile_indices defaults empty, in which case workers recompute their
+    # partition from (enabled_worker_ids, worker_id) — assignment needs
+    # no communication (reference keeps the input "Unused - kept for
+    # compatibility", distributed_upscale.py:77).  The cluster control
+    # plane (runtime/cluster.py) ACTIVATES it: a redispatched recovery
+    # graph names the exact lost units, overriding the partition math.
+    # dispatch_attempt distinguishes reissues in the idempotency key.
     HIDDEN = ["multi_job_id", "is_worker", "master_url",
-              "enabled_worker_ids", "worker_id", "tile_indices"]
+              "enabled_worker_ids", "worker_id", "tile_indices",
+              "dispatch_attempt"]
 
     def execute(self, ctx: OpContext, upscaled_image, model,
                 positive: Conditioning, negative: Conditioning, vae,
@@ -73,7 +77,7 @@ class UltimateSDUpscaleDistributed(Op):
                 tile_width, tile_height, padding, mask_blur,
                 force_uniform_tiles=True, multi_job_id="", is_worker=None,
                 master_url="", enabled_worker_ids="[]", worker_id="",
-                tile_indices=""):
+                tile_indices="", dispatch_attempt=0):
         ctx.check_interrupt()
         image = as_image_array(upscaled_image)
         tile_w = tiling.round_to_multiple(int(tile_width))
@@ -91,7 +95,10 @@ class UltimateSDUpscaleDistributed(Op):
                                     params, multi_job_id,
                                     master_url or ctx.master_url,
                                     worker_id or ctx.worker_id,
-                                    enabled_worker_ids)
+                                    enabled_worker_ids,
+                                    tile_indices=tile_indices,
+                                    dispatch_attempt=int(dispatch_attempt
+                                                         or 0))
         if multi_job_id:
             return self._run_master_http(ctx, image, model, positive,
                                          negative, params, multi_job_id,
@@ -372,18 +379,35 @@ class UltimateSDUpscaleDistributed(Op):
 
     def _run_worker(self, ctx: OpContext, image, pipe, positive, negative,
                     p, multi_job_id, master_url, worker_id,
-                    enabled_worker_ids) -> Tuple:
+                    enabled_worker_ids, tile_indices="",
+                    dispatch_attempt=0) -> Tuple:
         h, w = image.shape[1:3]
         all_tiles = tiling.calculate_tiles(w, h, p["tile_w"], p["tile_h"])
-        workers = [str(x) for x in json.loads(enabled_worker_ids or "[]")]
-        try:
-            w_index = workers.index(str(worker_id))
-        except ValueError:
-            log(f"tiled upscale worker: {worker_id!r} not in enabled list "
-                f"{workers}; nothing to do")
-            return (image,)
-        parts = tiling.partition_tiles(len(all_tiles), len(workers))
-        mine = parts[1 + w_index]
+        explicit: List[int] = []
+        if tile_indices:
+            # unit-addressed dispatch (cluster recovery/hedge path): the
+            # master named the exact units; skip the partition math so a
+            # worker outside the original enabled list can pick them up
+            try:
+                explicit = [int(i) for i in json.loads(tile_indices)]
+            except (ValueError, TypeError):
+                log(f"tiled upscale worker: bad tile_indices "
+                    f"{tile_indices!r}; falling back to partition")
+        if explicit:
+            mine = [i for i in explicit if 0 <= i < len(all_tiles)]
+            debug_log(f"worker {worker_id}: explicit units {mine} "
+                      f"(attempt {dispatch_attempt})")
+        else:
+            workers = [str(x) for x in json.loads(
+                enabled_worker_ids or "[]")]
+            try:
+                w_index = workers.index(str(worker_id))
+            except ValueError:
+                log(f"tiled upscale worker: {worker_id!r} not in enabled "
+                    f"list {workers}; nothing to do")
+                return (image,)
+            parts = tiling.partition_tiles(len(all_tiles), len(workers))
+            mine = parts[1 + w_index]
         if not mine:
             return (image,)
         debug_log(f"worker {worker_id}: tiles {mine[0]}..{mine[-1]}")
@@ -397,12 +421,13 @@ class UltimateSDUpscaleDistributed(Op):
                                      positions=[all_tiles[i] for i in mine],
                                      img_size=(w, h), return_device=True)
         self._send_tiles(ctx, refined, mine, all_tiles, p, multi_job_id,
-                         master_url, worker_id, (w, h))
+                         master_url, worker_id, (w, h),
+                         attempt=dispatch_attempt)
         return (image,)
 
     def _send_tiles(self, ctx: OpContext, refined, indices: Sequence[int],
                     all_tiles, p, multi_job_id, master_url, worker_id,
-                    img_size) -> None:
+                    img_size, attempt=0) -> None:
         """Double-buffered tile upload: while tile k's POST is in flight,
         tile k+1's d2h fetch + window transform + encode run on an
         executor thread, so the NIC and the device/encoder are busy at
@@ -423,6 +448,15 @@ class UltimateSDUpscaleDistributed(Op):
                 await send_body()
 
         async def send_body():
+            # fault injection (bench/tests only): simulate a worker that
+            # stalls (straggler) or dies after k tiles (partial failure)
+            inject = ctx.fault_inject or {}
+            stall_s = float(inject.get("stall_s", 0) or 0)
+            drop_after = inject.get("drop_tiles_after")
+            if stall_s > 0:
+                log(f"FAULT INJECTION: worker {worker_id} stalling "
+                    f"{stall_s}s before sending")
+                await asyncio.sleep(stall_s)
             fmt = await negotiate_wire_format(master_url)
             codec = wire_codec(master_url)
             loop = asyncio.get_running_loop()
@@ -459,6 +493,11 @@ class UltimateSDUpscaleDistributed(Op):
 
             nxt = loop.run_in_executor(None, prep, 0)
             for k, tile_idx in enumerate(indices):
+                if drop_after is not None and k >= int(drop_after):
+                    log(f"FAULT INJECTION: worker {worker_id} dying "
+                        f"after {k} of {len(indices)} tiles")
+                    await nxt  # retire the prefetch before vanishing
+                    return
                 payload, ctype, ext, (x1, y1, x2, y2) = await nxt
                 if k + 1 < len(indices):   # prefetch the next tile's
                     nxt = loop.run_in_executor(None, prep, k + 1)
@@ -476,6 +515,11 @@ class UltimateSDUpscaleDistributed(Op):
                     form.add_field("extracted_width", str(x2 - x1))
                     form.add_field("extracted_height", str(y2 - y1))
                     form.add_field("padding", str(p["padding"]))
+                    # stable across post_form_with_retry's resends of
+                    # THIS send, distinct across dispatch attempts —
+                    # the JobStore dedupes replays on it
+                    form.add_field("idem_key",
+                                   f"{worker_id}:{tile_idx}:{attempt}")
                     form.add_field("is_last", "true" if k == len(indices) - 1
                                    else "false")
                     if k == len(indices) - 1 and trace_id:
@@ -509,6 +553,8 @@ class UltimateSDUpscaleDistributed(Op):
     def _run_master_http(self, ctx: OpContext, image, pipe, positive,
                          negative, p, multi_job_id,
                          enabled_worker_ids) -> Tuple:
+        from comfyui_distributed_tpu.runtime import cluster as cluster_mod
+        from comfyui_distributed_tpu.utils import trace as trace_mod
         h, w = image.shape[1:3]
         all_tiles = tiling.calculate_tiles(w, h, p["tile_w"], p["tile_h"])
         workers = [str(x) for x in json.loads(enabled_worker_ids or "[]")]
@@ -517,6 +563,32 @@ class UltimateSDUpscaleDistributed(Op):
         parts = tiling.partition_tiles(len(all_tiles), len(workers))
         mine = parts[0]
         active_workers = sum(1 for part in parts[1:] if part)
+
+        # work ledger (cluster control plane): record which participant
+        # owns which tile indices BEFORE any work happens — completions
+        # check in through it (exactly-once at the blend) and whatever is
+        # still pending at the end is recoverable instead of dropped
+        ledger = ctx.ledger
+        if ledger is not None:
+            owners: Dict[int, str] = {int(i): "master" for i in mine}
+            for wi, part in enumerate(parts[1:]):
+                for i in part:
+                    owners[int(i)] = workers[wi]
+            ledger.create_job(multi_job_id, owners, kind="tile")
+
+        def refine_units(units: Sequence[int]) -> Dict[int, np.ndarray]:
+            """Master-local refine of arbitrary units (the recovery and
+            hedge path).  Per-tile seed = seed + tile_idx, so the result
+            is bit-identical to what the lost/straggling owner would
+            have produced."""
+            units = [int(u) for u in units]
+            t = tiling.extract_tiles(image, [all_tiles[i] for i in units],
+                                     p["tile_w"], p["tile_h"],
+                                     p["padding"])
+            out = self._refine_batch(
+                ctx, pipe, t, units, positive, negative, p,
+                positions=[all_tiles[i] for i in units], img_size=(w, h))
+            return {i: out[k] for k, i in enumerate(units)}
 
         # pre-create the tile queue BEFORE refining our own range: workers
         # may finish first, and put_tile requires an existing queue (the
@@ -527,24 +599,74 @@ class UltimateSDUpscaleDistributed(Op):
             run_async_in_loop(ctx.job_store.get_tile_queue(multi_job_id),
                               ctx.server_loop, timeout=C.QUEUE_INIT_TIMEOUT)
 
-        refined: Dict[int, np.ndarray] = {}
-        if mine:
-            tiles = tiling.extract_tiles(image,
-                                         [all_tiles[i] for i in mine],
-                                         p["tile_w"], p["tile_h"],
-                                         p["padding"])
-            out = self._refine_batch(
-                ctx, pipe, tiles, mine, positive, negative, p,
-                positions=[all_tiles[i] for i in mine], img_size=(w, h))
-            refined.update({i: out[k] for k, i in enumerate(mine)})
+        try:
+            refined: Dict[int, np.ndarray] = {}
+            if mine:
+                out = refine_units(mine)
+                for i, window in out.items():
+                    if ledger is None \
+                            or ledger.check_in(multi_job_id, i, "master"):
+                        refined[i] = window
 
-        if active_workers and ctx.job_store is not None:
-            collected = self._collect_tiles(ctx, multi_job_id, active_workers)
-            for tile_idx, item in collected.items():
-                # worker tiles arrive at extracted size; store at window size
-                refined[int(tile_idx)] = self._worker_tile_to_window(
-                    item, all_tiles[int(tile_idx)], p, (w, h))
-        return (self._blend_all(image, refined, all_tiles, p),)
+            if active_workers and ctx.job_store is not None:
+                collected = self._collect_tiles(
+                    ctx, multi_job_id, active_workers,
+                    refine_window=refine_units)
+                for tile_idx, item in collected.items():
+                    if "window_tensor" in item:
+                        # master-local recovery/hedge result: already at
+                        # window size
+                        refined[int(tile_idx)] = item["window_tensor"]
+                    else:
+                        # worker tiles arrive at extracted size; store at
+                        # window size
+                        refined[int(tile_idx)] = self._worker_tile_to_window(
+                            item, all_tiles[int(tile_idx)], p, (w, h))
+
+            # post-drain recovery: units still pending (collection
+            # deadline fired, or an in-drain recovery failed) are
+            # REFINED HERE by the master instead of silently keeping
+            # base pixels — unless the policy opts back into the seed's
+            # partial-result behavior
+            if ledger is not None:
+                pending = ledger.pending(multi_job_id)
+                if pending:
+                    policy = cluster_mod.fault_policy()
+                    if policy == "fail":
+                        raise cluster_mod.ClusterFaultError(
+                            f"job {multi_job_id}: units {pending} "
+                            f"unfinished at collection end "
+                            f"({C.FAULT_POLICY_ENV}=fail)")
+                    if policy == "reassign":
+                        moved = ledger.reassign(multi_job_id, pending,
+                                                "master")
+                        if moved:
+                            log(f"tiled upscale master: reassigning "
+                                f"units {moved} to master "
+                                f"(job {multi_job_id})")
+                            with trace_mod.span("reassign",
+                                                job=multi_job_id,
+                                                units=len(moved),
+                                                to="master"):
+                                out = refine_units(moved)
+                            for i, window in out.items():
+                                if ledger.check_in(multi_job_id, i,
+                                                   "master"):
+                                    refined[i] = window
+                    else:
+                        log(f"tiled upscale master: units {pending} "
+                            f"lost; blending partial "
+                            f"({C.FAULT_POLICY_ENV}=partial)")
+            return (self._blend_all(image, refined, all_tiles, p),)
+        finally:
+            if ledger is not None:
+                summary = ledger.finish_job(multi_job_id)
+                if summary and (summary["reassigned_units"]
+                                or summary["hedged_units"]):
+                    log(f"job {multi_job_id}: {summary['done_units']}/"
+                        f"{summary['total_units']} units, "
+                        f"{summary['reassigned_units']} reassigned, "
+                        f"{summary['hedged_units']} hedged")
 
     def _worker_tile_to_window(self, item, pos, p, img_size) -> np.ndarray:
         """Re-inflate an extracted-size worker tile to the uniform padded
@@ -566,11 +688,36 @@ class UltimateSDUpscaleDistributed(Op):
                       mode="edge")
 
     def _collect_tiles(self, ctx: OpContext, multi_job_id: str,
-                       num_workers: int) -> Dict[int, Any]:
+                       num_workers: int,
+                       refine_window=None) -> Dict[int, Any]:
+        """Drain the tile queue.  With the cluster control plane wired
+        (``ctx.ledger``), the drain is ledger-driven: it exits when every
+        unit has checked in, consults the worker registry each poll so a
+        lease expiry triggers recovery IMMEDIATELY (redispatch to a
+        healthy HTTP worker when the orchestrator registered one, else
+        master-local refine via ``refine_window``), and hedges overdue
+        stragglers once the job passes the progress gate — first
+        completion wins through the ledger's exactly-once check-in.
+        Without a ledger the drain is the pre-cluster done-count loop."""
+        from comfyui_distributed_tpu.runtime import cluster as cluster_mod
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        ledger = ctx.ledger if (ctx.ledger is not None
+                                and ctx.ledger.has_job(multi_job_id)) \
+            else None
+        registry = ctx.cluster
+        policy = cluster_mod.fault_policy()
+        hedge_on = cluster_mod.hedge_armed() and ledger is not None \
+            and refine_window is not None
+        # re-enter the exec thread's span context inside the server-loop
+        # coroutine (contextvars don't follow run_coroutine_threadsafe)
+        captured_span = trace_mod.capture_span_context()
+
         async def drain():
             q = await ctx.job_store.get_tile_queue(multi_job_id)
             collected: Dict[int, Any] = {}
             done = set()
+            recovery: List[Any] = []
+            handled_dead = set()
             # overall deadline enforced INSIDE the loop so hitting it still
             # returns (and blends) everything collected so far — an outer
             # cancellation would discard the partial results the timeout
@@ -578,35 +725,169 @@ class UltimateSDUpscaleDistributed(Op):
             # 448-452)
             loop = asyncio.get_running_loop()
             deadline = loop.time() + C.TILE_COLLECTION_TIMEOUT
+            # redispatch extensions must stay below the outer
+            # run_async_in_loop backstop: cascading deaths extending
+            # past it would get the whole drain cancelled and the
+            # partial results discarded
+            hard_deadline = loop.time() + 2 * C.TILE_COLLECTION_TIMEOUT \
+                + C.TILE_WAIT_TIMEOUT
+            last_progress = loop.time()
+            # short polls only when the control plane can actually act
+            # between tiles; otherwise keep the seed's long waits
+            poll_s = C.CLUSTER_POLL_S if (ledger is not None
+                                          and (registry is not None
+                                               or hedge_on)) \
+                else C.TILE_WAIT_TIMEOUT
+
+            async def recover(units, reason, lost_owner=None):
+                """Master-local refine racing the original owner; the
+                ledger's first-wins check-in settles it."""
+                attrs = {"job": multi_job_id, "units": len(units),
+                         "to": "master"}
+                if lost_owner:
+                    attrs["lost"] = str(lost_owner)
+                try:
+                    with trace_mod.use_span(captured_span), \
+                            trace_mod.span(reason, **attrs):
+                        out = await loop.run_in_executor(
+                            None, refine_window, list(units))
+                except Exception as e:  # noqa: BLE001 - post-drain
+                    # fallback still covers these units
+                    log(f"tiled upscale master: {reason} of {units} "
+                        f"failed: {type(e).__name__}: {e}")
+                    if reason == "hedge":
+                        # a failed hedge must not pin the units: still
+                        # hedge-marked they'd be skipped by the in-drain
+                        # dead-owner scan
+                        ledger.unmark_hedged(multi_job_id, list(units))
+                    return
+                for idx, window in out.items():
+                    if ledger.check_in(multi_job_id, idx, "master"):
+                        collected[int(idx)] = {"window_tensor": window}
+
+            def finished() -> bool:
+                if ledger is not None:
+                    return not ledger.pending(multi_job_id)
+                return len(done) >= num_workers
+
             try:
-                while len(done) < num_workers:
+                while not finished():
+                    recovery = [t for t in recovery if not t.done()]
                     remaining = deadline - loop.time()
                     if remaining <= 0:
                         log("tiled upscale master: collection deadline; "
+                            "handing leftovers to the fault policy"
+                            if ledger is not None else
+                            "tiled upscale master: collection deadline; "
                             "blending partial results")
                         break
+                    if ledger is not None and registry is not None \
+                            and policy != "partial":
+                        # lease-driven recovery: pending units owned by a
+                        # DEAD worker move NOW, not at the deadline
+                        by_owner: Dict[str, List[int]] = {}
+                        for u, o in ledger.owners_of_pending(
+                                multi_job_id, skip_hedged=True).items():
+                            if o != "master" and o not in handled_dead \
+                                    and registry.state(o) \
+                                    == cluster_mod.DEAD:
+                                by_owner.setdefault(o, []).append(u)
+                        for owner, units in by_owner.items():
+                            handled_dead.add(owner)
+                            if policy == "fail":
+                                raise cluster_mod.ClusterFaultError(
+                                    f"worker {owner} died with units "
+                                    f"{sorted(units)} outstanding "
+                                    f"({C.FAULT_POLICY_ENV}=fail)")
+                            log(f"tiled upscale master: worker {owner} "
+                                f"lease expired; recovering units "
+                                f"{sorted(units)}")
+                            redone = False
+                            if ledger.has_redispatcher(multi_job_id):
+                                with trace_mod.use_span(captured_span), \
+                                        trace_mod.span(
+                                            "reassign",
+                                            job=multi_job_id,
+                                            units=len(units),
+                                            lost=str(owner),
+                                            to="remote") as rsp:
+                                    redone = await ledger.redispatch(
+                                        multi_job_id, sorted(units),
+                                        owner)
+                                    if rsp is not None and not redone:
+                                        rsp.attrs["to"] = "none"
+                            if redone:
+                                # give the replacement worker room; the
+                                # post-drain fallback still backstops it
+                                deadline = min(max(
+                                    deadline, loop.time()
+                                    + C.TILE_COLLECTION_TIMEOUT / 2),
+                                    hard_deadline)
+                                last_progress = loop.time()
+                            elif refine_window is not None:
+                                moved = ledger.reassign(
+                                    multi_job_id, sorted(units), "master")
+                                if moved:
+                                    recovery.append(loop.create_task(
+                                        recover(moved, "reassign",
+                                                owner)))
+                    if hedge_on:
+                        overdue = ledger.overdue_units(multi_job_id)
+                        units = sorted(u for u, o in overdue.items()
+                                       if o != "master")
+                        if units:
+                            hedged = ledger.mark_hedged(
+                                multi_job_id, units, "master")
+                            if hedged:
+                                log(f"tiled upscale master: hedging "
+                                    f"overdue units {hedged}")
+                                recovery.append(loop.create_task(
+                                    recover(hedged, "hedge")))
                     try:
                         item = await asyncio.wait_for(
-                            q.get(), timeout=min(C.TILE_WAIT_TIMEOUT,
-                                                 remaining))
+                            q.get(), timeout=max(min(poll_s, remaining),
+                                                 0.01))
                     except asyncio.TimeoutError:
-                        log("tiled upscale master: timeout waiting for tiles; "
-                            "blending partial results")
-                        break
-                    collected[int(item["tile_idx"])] = item
+                        if recovery:
+                            continue  # master-side work is in flight
+                        if loop.time() - last_progress \
+                                > C.TILE_WAIT_TIMEOUT:
+                            log("tiled upscale master: timeout waiting "
+                                "for tiles"
+                                + ("; handing leftovers to the fault "
+                                   "policy" if ledger is not None
+                                   else "; blending partial results"))
+                            break
+                        continue
+                    last_progress = loop.time()
+                    idx = int(item["tile_idx"])
+                    wid = str(item["worker_id"])
+                    if registry is not None:
+                        registry.touch(wid)
+                    if ledger is None \
+                            or ledger.check_in(multi_job_id, idx, wid):
+                        collected[idx] = item
                     if item.get("is_last"):
-                        done.add(str(item["worker_id"]))
+                        done.add(wid)
             finally:
-                # always drop the queue — including on cancellation — so
-                # late posts 404 instead of feeding an orphan queue
-                await ctx.job_store.remove_tile_queue(multi_job_id)
+                # let in-flight master-side recovery land (its results
+                # are about to be blended) — but the queue drop must
+                # survive a cancellation delivered AT the gather await,
+                # so it lives in its own finally: an orphan queue would
+                # accept late tensors forever
+                try:
+                    if recovery:
+                        await asyncio.gather(*recovery,
+                                             return_exceptions=True)
+                finally:
+                    await ctx.job_store.remove_tile_queue(multi_job_id)
             return collected
 
-        from comfyui_distributed_tpu.utils import trace as trace_mod
         with Timer("tile_collect"), \
                 trace_mod.span("collect", job=multi_job_id,
                                n_workers=num_workers):
             # outer timeout is a backstop only; the deadline above governs
             return run_async_in_loop(
                 drain(), ctx.server_loop,
-                timeout=C.TILE_COLLECTION_TIMEOUT + 2 * C.TILE_WAIT_TIMEOUT)
+                timeout=2 * C.TILE_COLLECTION_TIMEOUT
+                + 2 * C.TILE_WAIT_TIMEOUT)

@@ -1,5 +1,10 @@
-"""Runtime services: job store, worker process manager, monitors."""
+"""Runtime services: job store, worker process manager, monitors, and
+the fault-tolerant cluster control plane (registry + work ledger)."""
 
+from comfyui_distributed_tpu.runtime.cluster import (  # noqa: F401
+    ClusterRegistry,
+    WorkLedger,
+)
 from comfyui_distributed_tpu.runtime.jobs import JobStore  # noqa: F401
 from comfyui_distributed_tpu.runtime.manager import (  # noqa: F401
     WorkerProcessManager,

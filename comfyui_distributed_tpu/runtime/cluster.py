@@ -1,0 +1,700 @@
+"""Fault-tolerant cluster control plane (ISSUE 4).
+
+The reference (and this reproduction through PR 3) distributes work
+*statically*: tile ranges and seed slices are computed from
+``(worker_index, worker_count)`` at dispatch time, and the collectors'
+only failure response is a timeout that returns a partial result — a
+dead worker permanently loses its units.  This module makes jobs
+*complete* through worker failure instead of merely surviving it,
+following MapReduce's re-execution-on-failure + backup-task model
+(Dean & Ghemawat, OSDI 2004) and the hedged-request technique from
+"The Tail at Scale" (Dean & Barroso, CACM 2013):
+
+- :class:`ClusterRegistry` — worker registry with leases.  Workers are
+  seeded from config or register over HTTP and renew via heartbeat; the
+  ``runtime/health.py`` poller and the data-plane POSTs both feed it.
+  State machine ``healthy -> suspect -> dead`` with configurable lease
+  and probe thresholds (``DTPU_LEASE_S``, ``DTPU_SUSPECT_PROBES``).
+- :class:`WorkLedger` — per-job work ledger: which participant owns
+  which tile indices / seed slices, exactly-once check-in (retried
+  POSTs and hedge losers dedupe at the blend), reassignment, a moving
+  per-unit latency estimate that drives hedging, and per-job redispatch
+  callbacks the orchestrator registers so lost units can be re-issued
+  to healthy HTTP workers.
+
+Every transition (suspect, dead, reassign, hedge win/loss) bumps a
+``GLOBAL_COUNTERS`` event (surfaced in ``/distributed/metrics`` and the
+Prometheus exposition) and the collectors emit matching spans into the
+PR 3 trace tree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils import trace as trace_mod
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+DEAD = "dead"
+UNKNOWN = "unknown"       # registered but never contacted
+
+
+class ClusterFaultError(RuntimeError):
+    """DTPU_FAULT_POLICY=fail: a participant died mid-job."""
+
+
+# --- policy / hedge knobs (read per call: cheap, and tests monkeypatch
+# the environment) ------------------------------------------------------------
+
+def fault_policy() -> str:
+    p = os.environ.get(C.FAULT_POLICY_ENV,
+                       C.FAULT_POLICY_DEFAULT).strip().lower()
+    if p not in C.FAULT_POLICIES:
+        log(f"unknown {C.FAULT_POLICY_ENV}={p!r}; using "
+            f"{C.FAULT_POLICY_DEFAULT!r}")
+        return C.FAULT_POLICY_DEFAULT
+    return p
+
+
+def hedge_armed() -> bool:
+    return os.environ.get(C.HEDGE_ENV, "1").lower() \
+        not in ("0", "false", "off")
+
+
+def hedge_pct() -> float:
+    try:
+        return float(os.environ.get(C.HEDGE_PCT_ENV, C.HEDGE_PCT_DEFAULT))
+    except ValueError:
+        return C.HEDGE_PCT_DEFAULT
+
+
+def hedge_factor() -> float:
+    try:
+        return float(os.environ.get(C.HEDGE_FACTOR_ENV,
+                                    C.HEDGE_FACTOR_DEFAULT))
+    except ValueError:
+        return C.HEDGE_FACTOR_DEFAULT
+
+
+def hedge_min_wait() -> float:
+    try:
+        return float(os.environ.get(C.HEDGE_MIN_WAIT_ENV,
+                                    C.HEDGE_MIN_WAIT_DEFAULT))
+    except ValueError:
+        return C.HEDGE_MIN_WAIT_DEFAULT
+
+
+def fault_injection(raw: Optional[str] = None) -> Dict[str, Any]:
+    """Parse the test/bench fault-injection spec (env or explicit)."""
+    raw = raw if raw is not None else os.environ.get(C.FAULT_INJECT_ENV, "")
+    if not raw:
+        return {}
+    try:
+        spec = json.loads(raw)
+        return spec if isinstance(spec, dict) else {}
+    except ValueError:
+        log(f"bad {C.FAULT_INJECT_ENV}={raw!r}; ignoring")
+        return {}
+
+
+# --- worker registry with leases --------------------------------------------
+
+class ClusterRegistry:
+    """Lease-based worker liveness, fed by heartbeats, health probes and
+    data-plane contact.  State is *computed at read time* from the lease
+    and probe counters, so a stalled poller can never hold a dead worker
+    healthy; transitions are detected on read/write and recorded (ring
+    buffer + counters) when the computed state changes."""
+
+    def __init__(self, lease_s: Optional[float] = None,
+                 suspect_probes: Optional[int] = None):
+        if lease_s is None:
+            try:
+                lease_s = float(os.environ.get(C.LEASE_ENV,
+                                               C.LEASE_DEFAULT))
+            except ValueError:
+                lease_s = C.LEASE_DEFAULT
+        if suspect_probes is None:
+            try:
+                suspect_probes = int(os.environ.get(
+                    C.SUSPECT_PROBES_ENV, C.SUSPECT_PROBES_DEFAULT))
+            except ValueError:
+                suspect_probes = C.SUSPECT_PROBES_DEFAULT
+        self.lease_s = max(float(lease_s), 0.05)
+        self.suspect_probes = max(int(suspect_probes), 1)
+        self._lock = threading.Lock()
+        self._workers: Dict[str, Dict[str, Any]] = {}
+        self._transitions: deque = deque(maxlen=C.CLUSTER_TRANSITIONS_KEPT)
+
+    # -- writes ---------------------------------------------------------------
+
+    def register(self, worker_id: str, info: Optional[Dict[str, Any]] = None,
+                 alive: bool = True) -> Dict[str, Any]:
+        """Upsert a worker.  ``alive=True`` (an explicit registration or
+        heartbeat) counts as contact and starts/renews the lease;
+        ``alive=False`` (config seeding) leaves it UNKNOWN until the
+        first probe so a configured-but-never-started worker is never
+        reported healthy."""
+        wid = str(worker_id)
+        now = time.monotonic()
+        with self._lock:
+            rec = self._workers.get(wid)
+            if rec is None:
+                rec = self._workers[wid] = {
+                    "info": dict(info or {}), "registered_at": now,
+                    "last_seen": None, "failed_probes": 0,
+                    "state": UNKNOWN,
+                }
+            elif info:
+                rec["info"].update(info)
+            if alive:
+                rec["last_seen"] = now
+                rec["failed_probes"] = 0
+            self._refresh_locked(wid, rec, now)
+            return {"worker_id": wid, "state": rec["state"],
+                    "lease_s": self.lease_s}
+
+    def heartbeat(self, worker_id: str,
+                  info: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Lease renewal; unknown workers are auto-registered (the
+        reference's workers are config-seeded, but an elastic worker
+        that only knows the master URL must be able to join)."""
+        return self.register(worker_id, info=info, alive=True)
+
+    def observe_probe(self, worker_id: str, ok: bool,
+                      info: Optional[Dict[str, Any]] = None) -> None:
+        """Health-poller feed: a successful probe renews the lease, a
+        failed one advances the suspect counter."""
+        wid = str(worker_id)
+        now = time.monotonic()
+        with self._lock:
+            rec = self._workers.get(wid)
+            if rec is None:
+                rec = self._workers[wid] = {
+                    "info": dict(info or {}), "registered_at": now,
+                    "last_seen": None, "failed_probes": 0,
+                    "state": UNKNOWN,
+                }
+            elif info:
+                rec["info"].update(info)
+            if ok:
+                rec["last_seen"] = now
+                rec["failed_probes"] = 0
+            else:
+                rec["failed_probes"] += 1
+            self._refresh_locked(wid, rec, now)
+
+    def touch(self, worker_id: str) -> None:
+        """Data-plane contact (a tile/image POST arrived) proves
+        liveness without a probe.  Only KNOWN ids renew — the image
+        path's positional ``worker_N`` labels must not pollute the
+        registry with phantom entries."""
+        wid = str(worker_id)
+        now = time.monotonic()
+        with self._lock:
+            rec = self._workers.get(wid)
+            if rec is None:
+                return
+            rec["last_seen"] = now
+            rec["failed_probes"] = 0
+            self._refresh_locked(wid, rec, now)
+
+    def seed_from_config(self, workers: List[Dict[str, Any]]) -> None:
+        """Pre-register config workers (enabled only) without marking
+        them alive."""
+        for w in workers or []:
+            if not w.get("enabled"):
+                continue
+            self.register(str(w.get("id")),
+                          info={"host": w.get("host") or "127.0.0.1",
+                                "port": w.get("port"),
+                                "name": w.get("name")},
+                          alive=False)
+
+    # -- reads ----------------------------------------------------------------
+
+    def _compute_locked(self, rec: Dict[str, Any], now: float) -> str:
+        if rec["last_seen"] is None:
+            # never contacted: config-seeded entries stay UNKNOWN (the
+            # dispatcher probes them normally) instead of racing to DEAD
+            return UNKNOWN
+        if now - rec["last_seen"] > self.lease_s:
+            return DEAD
+        if rec["failed_probes"] >= self.suspect_probes:
+            return SUSPECT
+        return HEALTHY
+
+    def _refresh_locked(self, wid: str, rec: Dict[str, Any],
+                        now: float) -> str:
+        new = self._compute_locked(rec, now)
+        old = rec["state"]
+        if new != old:
+            rec["state"] = new
+            self._transitions.append(
+                {"worker_id": wid, "from": old, "to": new,
+                 "t": time.time()})
+            trace_mod.GLOBAL_COUNTERS.bump(f"cluster_{new}_transitions")
+            (log if new in (SUSPECT, DEAD) else debug_log)(
+                f"cluster: worker {wid} {old} -> {new}")
+        return new
+
+    def state(self, worker_id: str) -> str:
+        """Effective state now; UNKNOWN for unregistered ids."""
+        wid = str(worker_id)
+        now = time.monotonic()
+        with self._lock:
+            rec = self._workers.get(wid)
+            if rec is None:
+                return UNKNOWN
+            return self._refresh_locked(wid, rec, now)
+
+    def healthy_ids(self) -> List[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [wid for wid, rec in self._workers.items()
+                    if self._refresh_locked(wid, rec, now) == HEALTHY]
+
+    def snapshot(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lock:
+            workers = {}
+            for wid, rec in self._workers.items():
+                st = self._refresh_locked(wid, rec, now)
+                age = (None if rec["last_seen"] is None
+                       else round(now - rec["last_seen"], 3))
+                workers[wid] = {
+                    "state": st,
+                    "last_seen_age_s": age,
+                    "failed_probes": rec["failed_probes"],
+                    "lease_remaining_s": (
+                        None if rec["last_seen"] is None else
+                        round(self.lease_s - (now - rec["last_seen"]), 3)),
+                    **{k: v for k, v in rec["info"].items()
+                       if k in ("host", "port", "name",
+                                "queue_remaining")},
+                }
+            return {"lease_s": self.lease_s,
+                    "suspect_probes": self.suspect_probes,
+                    "workers": workers,
+                    "transitions": list(self._transitions)}
+
+
+# --- per-job work ledger -----------------------------------------------------
+
+class WorkLedger:
+    """Which participant owns which work units, with exactly-once
+    check-in.  A *unit* is a tile index (tiled upscale) or a seed-slice
+    id (image collector); the *owner* is a participant id ("master" or
+    a worker's config id).  Completions check in through the ledger so
+    retried POSTs and hedge losers are deduped at the blend; pending
+    units can be reassigned (locally) or redispatched (to a healthy
+    HTTP worker via the orchestrator's registered callback)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        self._redispatch: Dict[str, Callable] = {}
+        self._completed: deque = deque(maxlen=C.LEDGER_COMPLETED_KEPT)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def create_job(self, job_id: str, owners: Dict[Any, str],
+                   kind: str = "tile") -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._jobs[str(job_id)] = {
+                "kind": kind,
+                "created_at": now,
+                "units": {u: {"owner": str(o), "state": "pending",
+                              "attempts": 1, "hedged": False,
+                              "hedge_owner": None, "done_by": None}
+                          for u, o in owners.items()},
+                # per-owner last-activity clock feeding the moving
+                # per-unit latency estimate (EMA of check-in intervals)
+                "owner_last": {},
+                "latency_ema": None,
+                "reassigned": 0,
+                "hedged": 0,
+            }
+
+    def has_job(self, job_id: str) -> bool:
+        with self._lock:
+            return str(job_id) in self._jobs
+
+    def finish_job(self, job_id: str) -> Optional[Dict[str, Any]]:
+        """Seal a job: drop live state, keep a bounded summary (served
+        by GET /distributed/cluster and consumed by the fault bench)."""
+        jid = str(job_id)
+        with self._lock:
+            job = self._jobs.pop(jid, None)
+            self._redispatch.pop(jid, None)
+            if job is None:
+                return None
+            units = job["units"]
+            done = sum(1 for u in units.values() if u["state"] == "done")
+            summary = {
+                "job_id": jid, "kind": job["kind"],
+                "total_units": len(units), "done_units": done,
+                "pending_units": sorted(
+                    str(u) for u, rec in units.items()
+                    if rec["state"] != "done"),
+                "reassigned_units": job["reassigned"],
+                "hedged_units": job["hedged"],
+                "duration_s": round(time.monotonic() - job["created_at"],
+                                    4),
+                "finished_at": time.time(),
+            }
+            self._completed.append(summary)
+            return summary
+
+    # -- check-in (exactly-once) ----------------------------------------------
+
+    def check_in(self, job_id: str, unit: Any, worker_id: str) -> bool:
+        """Record a unit completion.  Returns True exactly once per
+        unit — the first completion wins; retried POSTs and hedge
+        losers get False and are dropped at the blend.  Jobs the ledger
+        never saw (worker side, SPMD mode) always return True so the
+        ledger is opt-in."""
+        now = time.monotonic()
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None:
+                return True
+            rec = job["units"].get(unit)
+            if rec is None:
+                # unit the ledger didn't plan (shouldn't happen; accept
+                # rather than drop real work)
+                debug_log(f"ledger: unplanned unit {unit!r} for "
+                          f"{job_id}")
+                return True
+            if rec["state"] == "done":
+                trace_mod.GLOBAL_COUNTERS.bump(
+                    "cluster_duplicate_checkins")
+                return False
+            rec["state"] = "done"
+            rec["done_by"] = str(worker_id)
+            if rec["hedge_owner"]:
+                # attribution only when the hedge runner has its own
+                # identity (master-local tile hedges); a redispatch
+                # hedge impersonates the lost owner and stays uncounted
+                won = str(worker_id) == rec["hedge_owner"]
+                trace_mod.GLOBAL_COUNTERS.bump(
+                    "cluster_hedge_wins" if won else "cluster_hedge_losses")
+            # moving per-unit latency estimate: EMA over each owner's
+            # inter-check-in interval (first interval anchors at job
+            # creation)
+            last = job["owner_last"].get(str(worker_id),
+                                         job["created_at"])
+            sample = max(now - last, 1e-6)
+            ema = job["latency_ema"]
+            job["latency_ema"] = sample if ema is None \
+                else 0.7 * ema + 0.3 * sample
+            job["owner_last"][str(worker_id)] = now
+            return True
+
+    # -- queries --------------------------------------------------------------
+
+    def pending(self, job_id: str, owner: Optional[str] = None
+                ) -> List[Any]:
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None:
+                return []
+            return sorted(
+                (u for u, rec in job["units"].items()
+                 if rec["state"] != "done"
+                 and (owner is None or rec["owner"] == str(owner))),
+                key=str)
+
+    def owners_of_pending(self, job_id: str,
+                          skip_hedged: bool = False) -> Dict[Any, str]:
+        """Pending units and their owners; ``skip_hedged=True`` drops
+        units a hedge is already racing (recovery for those would be
+        triple work — the hedge or the post-drain fallback covers
+        them)."""
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None:
+                return {}
+            return {u: rec["owner"] for u, rec in job["units"].items()
+                    if rec["state"] != "done"
+                    and not (skip_hedged and rec["hedged"])}
+
+    def progress(self, job_id: str) -> tuple:
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None:
+                return (0, 0)
+            units = job["units"]
+            return (sum(1 for u in units.values()
+                        if u["state"] == "done"), len(units))
+
+    def latency_estimate(self, job_id: str) -> Optional[float]:
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            return None if job is None else job["latency_ema"]
+
+    def attempts(self, job_id: str, unit: Any) -> int:
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None:
+                return 0
+            rec = job["units"].get(unit)
+            return 0 if rec is None else rec["attempts"]
+
+    # -- recovery -------------------------------------------------------------
+
+    def reassign(self, job_id: str, units: List[Any],
+                 new_owner: str) -> List[Any]:
+        """Move still-pending units to ``new_owner``; returns the units
+        actually moved (a unit that completed in the meantime stays
+        put)."""
+        moved = []
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None:
+                return moved
+            for u in units:
+                rec = job["units"].get(u)
+                if rec is None or rec["state"] == "done":
+                    continue
+                rec["owner"] = str(new_owner)
+                rec["attempts"] += 1
+                moved.append(u)
+            job["reassigned"] += len(moved)
+        if moved:
+            trace_mod.GLOBAL_COUNTERS.bump("cluster_reassigned_units",
+                                           len(moved))
+        return moved
+
+    def mark_hedged(self, job_id: str, units: List[Any],
+                    hedge_owner: Optional[str] = None) -> List[Any]:
+        """Record a speculative re-issue; the original owner keeps the
+        unit (first completion wins either way).  ``hedge_owner`` names
+        the hedge runner for win/loss attribution; None records the
+        hedge without attribution (redispatch hedges impersonate the
+        lost identity on the wire)."""
+        hedged = []
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None:
+                return hedged
+            for u in units:
+                rec = job["units"].get(u)
+                if rec is None or rec["state"] == "done" \
+                        or rec["hedged"]:
+                    continue
+                rec["hedged"] = True
+                rec["hedge_owner"] = (None if hedge_owner is None
+                                      else str(hedge_owner))
+                rec["attempts"] += 1
+                hedged.append(u)
+            job["hedged"] += len(hedged)
+        if hedged:
+            trace_mod.GLOBAL_COUNTERS.bump("cluster_hedges", len(hedged))
+        return hedged
+
+    def is_hedged(self, job_id: str, unit: Any) -> bool:
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None:
+                return False
+            rec = job["units"].get(unit)
+            return bool(rec and rec["hedged"])
+
+    def unmark_hedged(self, job_id: str, units: List[Any]) -> None:
+        """Roll back a hedge that never launched (no target, dispatch
+        failed) so the unit stays eligible for dead-owner reassignment
+        and future hedges."""
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None:
+                return
+            n = 0
+            for u in units:
+                rec = job["units"].get(u)
+                if rec is not None and rec["hedged"] \
+                        and rec["state"] != "done":
+                    rec["hedged"] = False
+                    rec["hedge_owner"] = None
+                    rec["attempts"] = max(rec["attempts"] - 1, 1)
+                    n += 1
+            job["hedged"] -= n
+
+    def overdue_units(self, job_id: str,
+                      factor: Optional[float] = None,
+                      min_progress_pct: Optional[float] = None,
+                      min_wait_s: Optional[float] = None
+                      ) -> Dict[Any, str]:
+        """Hedge candidates: pending, not already hedged, whose owner
+        has been silent longer than ``max(factor x the moving latency
+        estimate, min_wait_s)`` — but only once the job is at least
+        ``min_progress_pct`` % complete (the Tail-at-Scale guard: hedge
+        the last stragglers, not the whole job; the wait floor keeps
+        the happy path hedge-free when units land in sub-second
+        bursts)."""
+        factor = hedge_factor() if factor is None else factor
+        min_pct = hedge_pct() if min_progress_pct is None \
+            else min_progress_pct
+        min_wait = hedge_min_wait() if min_wait_s is None else min_wait_s
+        now = time.monotonic()
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None or job["latency_ema"] is None:
+                return {}
+            units = job["units"]
+            done = sum(1 for u in units.values() if u["state"] == "done")
+            if not units or 100.0 * done / len(units) < min_pct:
+                return {}
+            threshold = max(factor * job["latency_ema"], min_wait)
+            out = {}
+            for u, rec in units.items():
+                if rec["state"] == "done" or rec["hedged"]:
+                    continue
+                last = job["owner_last"].get(rec["owner"],
+                                             job["created_at"])
+                if now - last > threshold:
+                    out[u] = rec["owner"]
+            return out
+
+    # -- redispatch (orchestrator-registered) ---------------------------------
+
+    def set_redispatcher(self, job_id: str, fn: Callable) -> None:
+        """``fn`` is ``async (units, lost_owner) -> bool`` — re-issue
+        the units to a healthy HTTP worker.  Registered by
+        ``workflow/orchestrate.py`` before dispatch; the collectors call
+        :meth:`redispatch` when an owner dies.  Bounded FIFO: entries
+        are popped by finish_job, but a run that crashes before its
+        collector executes would otherwise leak its graph-capturing
+        closure forever."""
+        with self._lock:
+            self._redispatch[str(job_id)] = fn
+            while len(self._redispatch) > 512:
+                self._redispatch.pop(next(iter(self._redispatch)))
+
+    def has_redispatcher(self, job_id: str) -> bool:
+        with self._lock:
+            return str(job_id) in self._redispatch
+
+    async def redispatch(self, job_id: str, units: List[Any],
+                         lost_owner: str) -> bool:
+        with self._lock:
+            fn = self._redispatch.get(str(job_id))
+        if fn is None:
+            return False
+        try:
+            ok = bool(await fn(units, lost_owner))
+        except Exception as e:  # noqa: BLE001 - recovery must not crash
+            log(f"ledger: redispatch for {job_id} failed: "
+                f"{type(e).__name__}: {e}")
+            return False
+        if ok:
+            trace_mod.GLOBAL_COUNTERS.bump("cluster_redispatches")
+        return ok
+
+    # -- introspection --------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            active = {}
+            for jid, job in self._jobs.items():
+                units = job["units"]
+                done = sum(1 for u in units.values()
+                           if u["state"] == "done")
+                active[jid] = {
+                    "kind": job["kind"],
+                    "total_units": len(units),
+                    "done_units": done,
+                    "reassigned_units": job["reassigned"],
+                    "hedged_units": job["hedged"],
+                    "latency_estimate_s": (
+                        None if job["latency_ema"] is None
+                        else round(job["latency_ema"], 4)),
+                    "age_s": round(time.monotonic() - job["created_at"],
+                                   3),
+                }
+            return {"active_jobs": active,
+                    "completed_jobs": list(self._completed)}
+
+
+# --- worker-side heartbeat ---------------------------------------------------
+
+class HeartbeatSender:
+    """Daemon thread a worker server runs to renew its lease at the
+    master (``POST /distributed/heartbeat``) every ``lease/3``.  Gated
+    on DTPU_MASTER_URL + DTPU_WORKER_ID (the process manager exports
+    both for spawned workers); external/elastic workers set them by
+    hand.  Best-effort: a down master just means the next beat retries."""
+
+    def __init__(self, master_url: str, worker_id: str,
+                 interval: Optional[float] = None,
+                 port: Optional[int] = None):
+        self.master_url = master_url.rstrip("/")
+        self.worker_id = str(worker_id)
+        self.port = port
+        if interval is None:
+            try:
+                lease = float(os.environ.get(C.LEASE_ENV, C.LEASE_DEFAULT))
+            except ValueError:
+                lease = C.LEASE_DEFAULT
+            interval = max(lease / C.HEARTBEAT_FRACTION, 0.05)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.beats_sent = 0
+
+    def beat_once(self, timeout: float = 3.0) -> bool:
+        import urllib.request
+        payload = {"worker_id": self.worker_id}
+        if self.port:
+            payload["port"] = self.port
+        req = urllib.request.Request(
+            f"{self.master_url}/distributed/heartbeat",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                r.read()
+            self.beats_sent += 1
+            return True
+        except Exception as e:  # noqa: BLE001 - best-effort renewal
+            debug_log(f"heartbeat to {self.master_url} failed: {e}")
+            return False
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dtpu-heartbeat")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.beat_once()
+
+
+def maybe_start_heartbeat(port: Optional[int] = None
+                          ) -> Optional[HeartbeatSender]:
+    """Start the worker->master heartbeat when the environment names a
+    master (spawned workers inherit DTPU_MASTER_URL/DTPU_WORKER_ID from
+    the process manager)."""
+    master = os.environ.get(C.MASTER_URL_ENV)
+    wid = os.environ.get(C.WORKER_ID_ENV)
+    if not master or not wid:
+        return None
+    hb = HeartbeatSender(master, wid, port=port)
+    hb.start()
+    log(f"heartbeat: renewing lease for {wid!r} at {master} every "
+        f"{hb.interval:.1f}s")
+    return hb

@@ -43,9 +43,13 @@ class HealthPoller:
     """Daemon polling thread + status snapshot store."""
 
     def __init__(self, config_path: Optional[str] = None, manager=None,
-                 interval: float = WORKER_CHECK_INTERVAL):
+                 interval: float = WORKER_CHECK_INTERVAL,
+                 registry=None):
         self.config_path = config_path
         self.manager = manager
+        # cluster control plane (runtime/cluster.py): every probe result
+        # feeds the worker registry's lease state machine
+        self.registry = registry
         self.interval = interval
         self._status: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
@@ -80,6 +84,12 @@ class HealthPoller:
                 "last_seen": None}
             st["enabled"] = bool(w.get("enabled"))
             snapshot[wid] = st
+            if self.registry is not None and w.get("enabled"):
+                self.registry.observe_probe(
+                    wid, st["status"] in ("online", "processing"),
+                    info={"host": w.get("host") or "127.0.0.1",
+                          "port": w.get("port"), "name": w.get("name"),
+                          "queue_remaining": st.get("queue_remaining")})
             # first successful contact clears 'launching' (reference
             # gpupanel.js:1286-1293 -> clear_launching endpoint)
             if st["status"] in ("online", "processing") \

@@ -12,17 +12,43 @@ owned by the server app directly.
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Dict
+from typing import Any, Dict, Optional, Set
+
+from comfyui_distributed_tpu.utils import trace as trace_mod
 
 
 class JobStore:
-    """Image-job and tile-job queues, asyncio-locked."""
+    """Image-job and tile-job queues, asyncio-locked.
+
+    Idempotency (ISSUE 4 satellite): ``job_complete``/``tile_complete``
+    POSTs retried by ``post_form_with_retry`` can be delivered twice (a
+    timed-out-but-received POST is resent).  Senders stamp each upload
+    with an idempotency key ``worker_id:unit_idx:attempt`` — stable
+    across HTTP retries of the same logical send, distinct across
+    dispatch attempts (reassign/hedge) — and ``put_result``/``put_tile``
+    dedupe on it: a replay is acknowledged (200, so the sender stops
+    retrying) but never enqueued twice."""
 
     def __init__(self) -> None:
         self._jobs: Dict[str, asyncio.Queue] = {}
         self._tile_jobs: Dict[str, asyncio.Queue] = {}
+        self._seen: Dict[str, Set[str]] = {}
+        self._tile_seen: Dict[str, Set[str]] = {}
         self._lock = asyncio.Lock()
         self._tile_lock = asyncio.Lock()
+
+    @staticmethod
+    def _dedupe(seen: Dict[str, Set[str]], job_id: str,
+                idem_key: Optional[str]) -> bool:
+        """True when this key was already accepted for the job."""
+        if not idem_key:
+            return False
+        keys = seen.setdefault(job_id, set())
+        if idem_key in keys:
+            trace_mod.GLOBAL_COUNTERS.bump("idem_dropped")
+            return True
+        keys.add(idem_key)
+        return False
 
     # --- image jobs (reference distributed.py:1125-1218) -------------------
 
@@ -42,21 +68,26 @@ class JobStore:
             return multi_job_id in self._jobs
 
     async def put_result(self, multi_job_id: str, item: Dict[str, Any],
-                         require_existing: bool = True) -> bool:
+                         require_existing: bool = True,
+                         idem_key: Optional[str] = None) -> bool:
         """Queue a worker result; ``require_existing`` mirrors the 404
-        behavior for unknown jobs (``distributed.py:1190-1194``)."""
+        behavior for unknown jobs (``distributed.py:1190-1194``);
+        ``idem_key`` replays are acknowledged but dropped."""
         async with self._lock:
             q = self._jobs.get(multi_job_id)
             if q is None:
                 if require_existing:
                     return False
                 q = self._jobs[multi_job_id] = asyncio.Queue()
+            if self._dedupe(self._seen, multi_job_id, idem_key):
+                return True
         await q.put(item)
         return True
 
     async def remove_job(self, multi_job_id: str) -> None:
         async with self._lock:
             self._jobs.pop(multi_job_id, None)
+            self._seen.pop(multi_job_id, None)
 
     # --- tile jobs (reference distributed_upscale.py:27-34, 711-760) -------
 
@@ -80,7 +111,8 @@ class JobStore:
             return multi_job_id in self._tile_jobs
 
     async def put_tile(self, multi_job_id: str, item: Dict[str, Any],
-                       require_existing: bool = True) -> bool:
+                       require_existing: bool = True,
+                       idem_key: Optional[str] = None) -> bool:
         """Queue a worker tile.  ``require_existing`` keeps late posts (after
         the master timed out and removed the queue) from resurrecting an
         orphan queue that would hold decoded tensors forever — the caller
@@ -92,12 +124,15 @@ class JobStore:
                 if require_existing:
                     return False
                 q = self._tile_jobs[multi_job_id] = asyncio.Queue()
+            if self._dedupe(self._tile_seen, multi_job_id, idem_key):
+                return True
         await q.put(item)
         return True
 
     async def remove_tile_queue(self, multi_job_id: str) -> None:
         async with self._tile_lock:
             self._tile_jobs.pop(multi_job_id, None)
+            self._tile_seen.pop(multi_job_id, None)
 
     def snapshot(self) -> Dict[str, Any]:
         return {

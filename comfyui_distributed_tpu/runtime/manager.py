@@ -135,6 +135,22 @@ class WorkerProcessManager:
         try:
             env = dict(os.environ)
             env[MASTER_PID_ENV] = str(os.getpid())
+            # cluster identity: the spawned worker heartbeats its lease
+            # back to this master (runtime/cluster.maybe_start_heartbeat)
+            from comfyui_distributed_tpu.utils import constants as C
+            env[C.WORKER_ID_ENV] = wid
+            if C.MASTER_URL_ENV not in env:
+                try:
+                    from comfyui_distributed_tpu.utils import config \
+                        as cfg_mod
+                    master = cfg_mod.load_config(
+                        self.config_path).get("master", {})
+                    if master.get("port"):
+                        env[C.MASTER_URL_ENV] = (
+                            f"http://{master.get('host') or '127.0.0.1'}"
+                            f":{master['port']}")
+                except Exception:  # noqa: BLE001 - heartbeat is optional
+                    pass
             # never inherit the master's pod-cluster identity: a managed
             # HTTP worker is its own single-process jax world, and a
             # duplicate jax.distributed.initialize with the master's

@@ -27,6 +27,9 @@ Route inventory (capability parity with reference ``distributed.py:49-599,
     POST /distributed/metrics/reset          clear aggregate sinks (new)
     GET  /distributed/traces                 flight-recorder index (new)
     GET  /distributed/trace/<prompt_id>      one job's span tree (new)
+    GET  /distributed/cluster                lease states + work ledger (new)
+    POST /distributed/register               elastic worker registration (new)
+    POST /distributed/heartbeat              worker lease renewal (new)
 
   data plane
     POST /distributed/job_complete           multipart PNG -> image queue
@@ -54,6 +57,7 @@ from typing import Any, Dict, List, Optional
 from aiohttp import web
 
 from comfyui_distributed_tpu.ops.base import OpContext
+from comfyui_distributed_tpu.runtime import cluster as cluster_mod
 from comfyui_distributed_tpu.runtime.jobs import JobStore
 from comfyui_distributed_tpu.runtime.manager import (
     WorkerProcessManager,
@@ -112,9 +116,23 @@ class ServerState:
         self.jobs = JobStore()
         self.manager = WorkerProcessManager(config_path=config_path,
                                             models_dir=models_dir)
+        # cluster control plane (ISSUE 4): worker registry with leases +
+        # per-job work ledger.  Seeded from config; the health poller,
+        # heartbeats and data-plane POSTs all renew leases; the
+        # collectors consult both through OpContext.
+        self.cluster = cluster_mod.ClusterRegistry()
+        self.ledger = cluster_mod.WorkLedger()
+        if not is_worker:
+            try:
+                self.cluster.seed_from_config(
+                    cfg_mod.load_config(config_path).get("workers", []))
+            except Exception as e:  # noqa: BLE001 - config is optional
+                debug_log(f"cluster seed skipped: {e}")
+        self.fault_inject = cluster_mod.fault_injection()
         from comfyui_distributed_tpu.runtime.health import HealthPoller
         self.health = HealthPoller(config_path=config_path,
-                                   manager=self.manager)
+                                   manager=self.manager,
+                                   registry=self.cluster)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         # the process-global flag: compiled samplers poll it per step
         # (runtime/interrupt.py), so /interrupt stops a sample in flight
@@ -299,6 +317,9 @@ class ServerState:
                     server_loop=self.loop,
                     interrupt_event=self.interrupt_event,
                     host_pool=self.host_pool,
+                    cluster=self.cluster,
+                    ledger=self.ledger,
+                    fault_inject=self.fault_inject,
                 )
                 first = group[0]
                 trace_mod.GLOBAL_COUNTERS.bump("exec_runs")
@@ -600,6 +621,16 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                       "coalesce": state.coalesce_enabled,
                                       "max_queue": state.max_queue,
                                   },
+                                  # cluster control plane: lease states,
+                                  # ledger activity, recovery counters
+                                  "cluster": {
+                                      **state.cluster.snapshot(),
+                                      "ledger": state.ledger.snapshot(),
+                                      "policy":
+                                          cluster_mod.fault_policy(),
+                                      "hedge_armed":
+                                          cluster_mod.hedge_armed(),
+                                  },
                                   # host<->device transfer bytes per node
                                   # + jit trace/XLA compile counts: the
                                   # tensor-plane health signals (steady
@@ -631,6 +662,14 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
              "DTPU_MAX_QUEUE backpressure cap.",
              [({}, state.max_queue)]),
         ]
+        cl_workers = state.cluster.snapshot()["workers"].values()
+        extra.append(
+            ("dtpu_cluster_workers", "gauge",
+             "Registered workers by lease state.",
+             [({"state": st},
+               sum(1 for w in cl_workers if w["state"] == st))
+              for st in (cluster_mod.HEALTHY, cluster_mod.SUSPECT,
+                         cluster_mod.DEAD, cluster_mod.UNKNOWN)]))
         text = trace_mod.prometheus_text(extra=extra)
         return web.Response(text=text,
                             content_type="text/plain",
@@ -759,6 +798,44 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
 
     async def managed_workers(request):
         return web.json_response(state.manager.get_managed_workers())
+
+    async def cluster_info(request):
+        """Cluster control plane snapshot: lease-based worker states,
+        the work ledger's active/completed jobs, and the effective
+        fault/hedge policy knobs."""
+        return web.json_response({
+            **state.cluster.snapshot(),
+            "ledger": state.ledger.snapshot(),
+            "policy": cluster_mod.fault_policy(),
+            "hedge": {"armed": cluster_mod.hedge_armed(),
+                      "min_progress_pct": cluster_mod.hedge_pct(),
+                      "factor": cluster_mod.hedge_factor()},
+        })
+
+    async def cluster_register(request):
+        """Elastic worker registration: a worker that only knows the
+        master URL joins the registry (and the lease state machine)
+        without appearing in the config file."""
+        data = await request.json()
+        wid = data.get("worker_id") or data.get("id")
+        if not wid:
+            return web.json_response({"error": "missing worker_id"},
+                                     status=400)
+        info = {k: data[k] for k in ("host", "port", "name") if k in data}
+        info.setdefault("host", request.remote)
+        return ok(state.cluster.register(str(wid), info=info))
+
+    async def cluster_heartbeat(request):
+        """Lease renewal (runtime/cluster.HeartbeatSender posts here
+        every lease/3); unknown workers are auto-registered."""
+        data = await request.json()
+        wid = data.get("worker_id") or data.get("id")
+        if not wid:
+            return web.json_response({"error": "missing worker_id"},
+                                     status=400)
+        info = {k: data[k] for k in ("host", "port", "name") if k in data}
+        info.setdefault("host", request.remote)
+        return ok(state.cluster.heartbeat(str(wid), info=info))
 
     async def workers_status(request):
         """Live worker health (the reference panel's 2s status dots,
@@ -914,10 +991,13 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         # uploads to 0 would collapse them into a single image
         if form.get("image_index") is not None:
             item["image_index"] = int(form["image_index"])
-        if not await state.jobs.put_result(mj, item):
+        if not await state.jobs.put_result(
+                mj, item, idem_key=form.get("idem_key")):
             # unknown job -> 404 so the worker's retry loop backs off
             return web.json_response({"error": f"unknown job {mj}"},
                                      status=404)
+        # a data-plane POST proves the sender is alive — renew its lease
+        state.cluster.touch(str(form.get("worker_id", "")))
         state.metrics["images_received"] += 1
         _ingest_remote_trace(request, form, "receive_image", t_recv,
                              {"job": str(mj),
@@ -943,11 +1023,13 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             "tensor": await asyncio.get_running_loop().run_in_executor(
                 None, lambda: _decode_upload(tile_field)),
         }
-        if not await state.jobs.put_tile(mj, item):
+        if not await state.jobs.put_tile(
+                mj, item, idem_key=form.get("idem_key")):
             # unknown/expired tile job -> 404; the worker's retry loop backs
             # off instead of resurrecting an orphan queue
             return web.json_response({"error": f"unknown tile job {mj}"},
                                      status=404)
+        state.cluster.touch(str(form.get("worker_id", "")))
         state.metrics["tiles_received"] += 1
         _ingest_remote_trace(request, form, "receive_tile", t_recv,
                              {"job": str(mj),
@@ -1040,7 +1122,8 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                             workers=cfg_mod.enabled_workers(cfg),
                             master_dispatch=enqueue_graph,
                             job_store=state.jobs,
-                            client_id=client_id, extra_data=extra_data)
+                            client_id=client_id, extra_data=extra_data,
+                            cluster=state.cluster, ledger=state.ledger)
                 except Exception:
                     # the fan-out died before the exec thread adopted the
                     # root (finalize would have sealed it) — seal here so
@@ -1141,6 +1224,9 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/distributed/traces", list_traces)
     r.add_get("/distributed/trace/{prompt_id}", get_trace)
     r.add_post("/distributed/warmup", warmup)
+    r.add_get("/distributed/cluster", cluster_info)
+    r.add_post("/distributed/register", cluster_register)
+    r.add_post("/distributed/heartbeat", cluster_heartbeat)
     r.add_get("/distributed/workers_status", workers_status)
     r.add_post("/distributed/cluster/clear_memory", cluster_clear_memory)
     r.add_post("/distributed/cluster/interrupt", cluster_interrupt)
@@ -1216,6 +1302,11 @@ def serve(host: str = "0.0.0.0", port: int = 8288,
         state.health.start()
     if auto_launch and not state.is_worker:
         auto_launch_workers(state.manager)
+    if state.is_worker:
+        # renew this worker's lease at the master (spawned workers
+        # inherit DTPU_MASTER_URL/DTPU_WORKER_ID from the process
+        # manager; elastic workers export them by hand)
+        cluster_mod.maybe_start_heartbeat(port=port)
     role = "worker" if state.is_worker else "master"
     log(f"{role} server listening on {host}:{port}")
     web.run_app(app, host=host, port=port, print=None)

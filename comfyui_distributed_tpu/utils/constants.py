@@ -109,6 +109,52 @@ METRICS_RESET_ENV = "DTPU_METRICS_RESET"  # "0" disables POST .../metrics/reset
 HISTOGRAM_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# --- fault-tolerant cluster control plane (runtime/cluster.py) ---------------
+# Worker registry with leases: a worker is HEALTHY while its lease (renewed
+# by heartbeat/probe/data-plane contact) is fresh, SUSPECT after
+# DTPU_SUSPECT_PROBES consecutive failed probes, DEAD once the lease
+# expires.  The per-job work ledger records which participant owns which
+# tile indices / seed slices; on lease expiry or collection deadline the
+# unfinished units are redispatched to healthy participants (master
+# included) instead of being dropped.
+LEASE_ENV = "DTPU_LEASE_S"
+LEASE_DEFAULT = 15.0             # s a worker stays alive without contact
+SUSPECT_PROBES_ENV = "DTPU_SUSPECT_PROBES"
+SUSPECT_PROBES_DEFAULT = 2       # consecutive failed probes -> suspect
+# reassign: redispatch lost units (the default); partial: the seed's
+# partial-result-on-timeout behavior; fail: raise instead of degrading
+FAULT_POLICY_ENV = "DTPU_FAULT_POLICY"
+FAULT_POLICY_DEFAULT = "reassign"
+FAULT_POLICIES = ("reassign", "partial", "fail")
+# Hedged straggler dispatch ("The Tail at Scale"): once a job is
+# >= DTPU_HEDGE_PCT % complete and a unit's owner has been silent longer
+# than DTPU_HEDGE_FACTOR x the ledger's moving per-unit latency estimate,
+# speculatively re-issue the unit to an idle participant; the ledger's
+# exactly-once check-in makes the first completion win.
+HEDGE_ENV = "DTPU_HEDGE"                 # "0" disarms hedging
+HEDGE_PCT_ENV = "DTPU_HEDGE_PCT"
+HEDGE_PCT_DEFAULT = 50.0                 # % complete before hedging arms
+HEDGE_FACTOR_ENV = "DTPU_HEDGE_FACTOR"
+HEDGE_FACTOR_DEFAULT = 3.0               # x latency estimate -> overdue
+# floor under the overdue threshold: batched check-ins collapse the
+# inter-arrival EMA toward zero, and without a floor the happy path
+# hedges sub-second units — speculative work must stay idle unless a
+# unit is ACTUALLY late.  Conservative by default (hedging trades
+# duplicate compute for tail latency; a false hedge also forces a
+# recovery-shaped recompile on the master); tune down for clusters
+# with tight, well-known unit latencies.
+HEDGE_MIN_WAIT_ENV = "DTPU_HEDGE_MIN_WAIT_S"
+HEDGE_MIN_WAIT_DEFAULT = 5.0
+CLUSTER_POLL_S = 0.25            # drain poll granularity with recovery armed
+HEARTBEAT_FRACTION = 3.0         # workers heartbeat every lease/this
+CLUSTER_TRANSITIONS_KEPT = 64    # registry transition-history ring
+LEDGER_COMPLETED_KEPT = 32       # finished-job summary ring
+MASTER_URL_ENV = "DTPU_MASTER_URL"   # worker -> master heartbeat target
+WORKER_ID_ENV = "DTPU_WORKER_ID"     # this worker's config identity
+# test/bench-only fault injection, JSON: {"drop_tiles_after": k} makes a
+# worker die after sending k tiles; {"stall_s": t} delays its first send
+FAULT_INJECT_ENV = "DTPU_FAULT_INJECT"
+
 # --- persistent compilation cache -------------------------------------------
 # Directory for JAX's persistent (on-disk) XLA compilation cache.  Resolution
 # (runtime/manager.enable_persistent_compile_cache): explicit arg > this env

@@ -94,10 +94,14 @@ async def post_form_with_retry(url: str, make_form, timeout: float,
     distributed trace together)."""
     from comfyui_distributed_tpu.utils import constants as C
     retries = max_retries if max_retries is not None else C.SEND_MAX_RETRIES
-    session = await get_client_session()
     delay = C.SEND_BACKOFF_BASE
     for attempt in range(retries):
         try:
+            # re-acquire per attempt: a peer's cleanup can close the
+            # shared session mid-retry (get_client_session then hands
+            # out a fresh one) — holding one reference across the loop
+            # would turn a transient close into N guaranteed failures
+            session = await get_client_session()
             async with session.post(
                     url, data=make_form(), headers=headers or None,
                     timeout=aiohttp.ClientTimeout(total=timeout)) as resp:

@@ -153,20 +153,45 @@ def worker_url(worker: Dict[str, Any]) -> str:
 
 
 async def preflight_check(workers: List[Dict[str, Any]],
-                          timeout: float = C.PREFLIGHT_TIMEOUT
-                          ) -> List[Dict[str, Any]]:
+                          timeout: float = C.PREFLIGHT_TIMEOUT,
+                          registry=None) -> List[Dict[str, Any]]:
     """300 ms GET /prompt per worker; offline workers are dropped from the
-    run (``performPreflightCheck``, ``gpupanel.js:1470-1517``)."""
+    run (``performPreflightCheck``, ``gpupanel.js:1470-1517``).
+
+    With a cluster ``registry`` (runtime/cluster.py) the dispatch also
+    consults the lease snapshot: a DEAD worker is dropped WITHOUT being
+    probed — a worker that died between jobs (or whose listen socket
+    outlives its process) is never dispatched to — and a SUSPECT one is
+    dispatched with a warning.  The probe result feeds the registry
+    either way, so a one-shot dispatch keeps the lease state fresh."""
     session = await get_client_session()
 
     async def probe(w):
+        wid = str(w.get("id"))
+        if registry is not None:
+            from comfyui_distributed_tpu.runtime import cluster as cl
+            st = registry.state(wid)
+            if st == cl.DEAD:
+                log(f"preflight: skipping worker {wid} — registry marks "
+                    f"it dead (lease expired)")
+                return None
+            if st == cl.SUSPECT:
+                log(f"preflight: worker {wid} is suspect "
+                    f"(failed probes); dispatching anyway")
+        ok = False
         try:
             async with session.get(
                     worker_url(w) + "/prompt",
                     timeout=aiohttp.ClientTimeout(total=timeout)) as r:
-                return w if r.status == 200 else None
+                ok = r.status == 200
         except (aiohttp.ClientError, asyncio.TimeoutError):
-            return None
+            ok = False
+        if registry is not None:
+            registry.observe_probe(
+                wid, ok, info={"host": w.get("host") or "127.0.0.1",
+                               "port": w.get("port"),
+                               "name": w.get("name")})
+        return w if ok else None
 
     t0 = time.perf_counter()
     alive = [w for w in await asyncio.gather(*(probe(w) for w in workers))

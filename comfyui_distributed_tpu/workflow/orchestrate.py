@@ -160,6 +160,132 @@ async def _post_prompt(url: str, graph: Graph, client_id: str,
         return await r.json()
 
 
+def _register_redispatchers(graph: Graph, job_id_map: Dict[str, str],
+                            enabled_ids: List[str],
+                            alive: List[Dict[str, Any]],
+                            master_url: str, client_id: str,
+                            extra_data: Optional[Dict[str, Any]],
+                            cluster, ledger) -> None:
+    """One ``async (units, lost_owner) -> bool`` callback per
+    distributed job on the ledger.  Tile jobs re-issue the EXACT lost
+    unit list via the (previously schema-only) ``tile_indices`` hidden
+    input; image jobs re-issue the lost participant's whole pruned graph
+    under its ORIGINAL positional identity so seeds and result labels
+    stay correct.  Target selection prefers registry-HEALTHY workers
+    with the shallowest known queue."""
+    import json as _json
+
+    from comfyui_distributed_tpu.runtime import cluster as cluster_mod
+    by_id = {str(w["id"]): w for w in alive}
+
+    def pick_target(lost_owner: str) -> Optional[Dict[str, Any]]:
+        candidates = []
+        # one snapshot for the whole pass: snapshot() copies the full
+        # worker map under the registry lock
+        snap_workers = cluster.snapshot()["workers"] \
+            if cluster is not None else {}
+        for wid, w in by_id.items():
+            if wid == str(lost_owner):
+                continue
+            depth = 0
+            if cluster is not None:
+                info = snap_workers.get(wid, {})
+                if info.get("state") != cluster_mod.HEALTHY:
+                    continue
+                depth = info.get("queue_remaining") or 0
+            candidates.append((depth, wid, w))
+        if not candidates:
+            return None
+        return sorted(candidates, key=lambda c: (c[0], c[1]))[0][2]
+
+    for nid, mj in job_id_map.items():
+        kind = "tile" if graph.nodes[nid].class_type in dsp.UPSCALER_TYPES \
+            else "image"
+        if kind == "image" and dsp.has_upstream_type(graph, nid,
+                                                     dsp.UPSCALER_TYPES):
+            # pass-through collector: it never collects (the upscaler
+            # upstream already did), so its job id never reaches the
+            # ledger — registering here would leak one graph-capturing
+            # closure per request
+            continue
+
+        def make(nid=nid, mj=mj, kind=kind):
+            async def redispatch(units, lost_owner):
+                # still-pending only; and units are RE-OWNED on the
+                # ledger only AFTER the dispatch succeeds — a transient
+                # dispatch failure must leave them with the lost owner
+                # so later recovery (or the post-drain fallback) still
+                # sees them
+                pending = set(ledger.pending(mj))
+                units = [u for u in units if u in pending]
+                if not units:
+                    return False
+                target = pick_target(lost_owner)
+                if target is None:
+                    return False
+                tid = str(target["id"])
+                attempt = 1 + max(ledger.attempts(mj, u) for u in units)
+
+                async def send(wgraph, batch):
+                    log(f"cluster: redispatching {kind} units "
+                        f"{batch} of {mj} ({lost_owner} -> {tid})")
+                    with trace_mod.span("redispatch", job=mj,
+                                        worker=tid,
+                                        lost=str(lost_owner),
+                                        units=len(batch)):
+                        await dsp.dispatch_to_worker(
+                            target, wgraph, client_id=client_id,
+                            extra_data=extra_data)
+                    # re-own on the ledger only AFTER the dispatch
+                    # succeeded — and only for true reassignments: a
+                    # HEDGE redispatch (unit already hedge-marked) races
+                    # the still-alive owner, who keeps the unit; first
+                    # completion wins either way
+                    moved = [u for u in batch
+                             if not ledger.is_hedged(mj, u)]
+                    if moved:
+                        ledger.reassign(mj, moved, tid)
+
+                if kind == "tile":
+                    wgraph = dsp.prepare_for_participant(
+                        graph, "worker", job_id_map, enabled_ids,
+                        master_url=master_url,
+                        worker_index=enabled_ids.index(tid))
+                    node = wgraph.nodes.get(str(nid))
+                    if node is None:
+                        return False
+                    node.hidden["tile_indices"] = _json.dumps(
+                        [int(u) for u in units])
+                    node.hidden["dispatch_attempt"] = attempt
+                    await send(wgraph, list(units))
+                    return True
+                # image job: the unit KEY is the original slice's
+                # config id — identity must follow the UNIT, not the
+                # current owner (after a first reassignment they
+                # differ: a cascaded failure would otherwise re-render
+                # the replacement's slice and never recover the lost
+                # one).  One dispatch per unit: each slice needs its
+                # own worker_index so seeds and upload labels land
+                # right.
+                sent = 0
+                for u in units:
+                    if str(u) not in enabled_ids:
+                        continue
+                    wgraph = dsp.prepare_for_participant(
+                        graph, "worker", job_id_map, enabled_ids,
+                        master_url=master_url,
+                        worker_index=enabled_ids.index(str(u)))
+                    for n2 in wgraph.nodes.values():
+                        if n2.class_type in dsp.COLLECTOR_TYPES:
+                            n2.hidden["dispatch_attempt"] = attempt
+                    await send(wgraph, [u])
+                    sent += 1
+                return sent > 0
+            return redispatch
+
+        ledger.set_redispatcher(mj, make())
+
+
 async def run_distributed(graph_or_doc: Any,
                           master_url: str,
                           workers: Optional[List[Dict[str, Any]]] = None,
@@ -169,9 +295,17 @@ async def run_distributed(graph_or_doc: Any,
                           job_store=None,
                           client_id: str = "dtpu-orchestrator",
                           job_prefix: Optional[str] = None,
-                          extra_data: Optional[Dict[str, Any]] = None
+                          extra_data: Optional[Dict[str, Any]] = None,
+                          cluster=None,
+                          ledger=None
                           ) -> Dict[str, Any]:
     """Fan a workflow out to master + enabled workers.
+
+    ``cluster``/``ledger`` (runtime/cluster.py) opt into the fault-
+    tolerant control plane: preflight consults the worker registry's
+    lease snapshot, and each distributed job gets a redispatch callback
+    registered on the ledger so the collectors can re-issue a dead or
+    straggling participant's units to a healthy worker mid-collection.
 
     The master's share runs through exactly one of:
     - ``executor``: sync callable ``(graph) -> ExecutionResult`` run on a
@@ -216,9 +350,11 @@ async def run_distributed(graph_or_doc: Any,
                 return await _post_prompt(master_url, g, client_id,
                                           extra_data)
 
-    # 1. preflight (drop dead workers; reference gpupanel.js:842-848)
+    # 1. preflight (drop dead workers; reference gpupanel.js:842-848);
+    # the registry snapshot drops lease-expired workers without a probe
     with trace_mod.span("preflight", n_workers=len(workers or [])):
-        alive = await dsp.preflight_check(workers) if workers else []
+        alive = await dsp.preflight_check(workers, registry=cluster) \
+            if workers else []
     if workers and not alive:
         log("orchestrator: no workers alive, running master-only")
 
@@ -256,6 +392,15 @@ async def run_distributed(graph_or_doc: Any,
     enabled_ids = [str(w["id"]) for w in alive]
     master_graph = dsp.prepare_for_participant(
         graph, "master", job_id_map, enabled_ids, master_url=master_url)
+
+    # cluster control plane: register a redispatcher per distributed job
+    # BEFORE the master starts collecting, so a collector that sees a
+    # lease expire (or a straggler worth hedging) can re-issue the lost
+    # units to a healthy worker instead of dropping them
+    if ledger is not None and alive:
+        _register_redispatchers(graph, job_id_map, enabled_ids, alive,
+                                master_url, client_id, extra_data,
+                                cluster, ledger)
 
     async def dispatch(worker, index):
         wgraph = dsp.prepare_for_participant(

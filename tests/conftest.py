@@ -67,6 +67,9 @@ _MODULE_COST_S = {
     "test_torch_parity.py": 18,
     "test_bench.py": 16,
     "test_packaging.py": 13,
+    # non-slow share only (the two loopback fault-acceptance tests are
+    # marked slow in-file, ~40s each with real master+worker exec loops)
+    "test_cluster.py": 12,
     "test_tiling.py": 10,
 }
 
