@@ -262,6 +262,45 @@ def cmd_cluster(args) -> int:
     return 0
 
 
+def cmd_top(args) -> int:
+    """Live fleet resource table (the headless `top` for the cluster):
+    one row per participant from the master's federated
+    ``GET /distributed/cluster/metrics`` — device memory in use / peak,
+    host RSS, utilization estimate, queue depth, snapshot age."""
+    import urllib.request
+    with urllib.request.urlopen(
+            f"{args.url}/distributed/cluster/metrics", timeout=10) as r:
+        data = json.loads(r.read())
+    if args.json:
+        print(json.dumps(data, indent=2))
+        return 0
+    parts = data.get("participants", {})
+    print(f"{'participant':16s} {'state':8s} {'mem_mb':>9s} "
+          f"{'peak_mb':>9s} {'rss_mb':>9s} {'util':>5s} {'queue':>5s} "
+          f"{'age_s':>6s}  source")
+    def mb(v):
+        return f"{v / 1e6:.1f}" if isinstance(v, (int, float)) else "-"
+    for wid, p in sorted(parts.items(),
+                         key=lambda kv: (kv[1].get("state") != "self",
+                                         kv[0])):
+        res = p.get("resources") or {}
+        util = res.get("utilization")
+        qd = res.get("queue_depth")
+        age = p.get("age_s")
+        print(f"{wid:16s} {p.get('state', '?'):8s} "
+              f"{mb(res.get('device_bytes_in_use')):>9s} "
+              f"{mb(res.get('device_peak_bytes')):>9s} "
+              f"{mb(res.get('host_rss_bytes')):>9s} "
+              f"{f'{util:.0%}' if isinstance(util, (int, float)) else '-':>5s} "
+              f"{qd if isinstance(qd, int) else '-':>5} "
+              f"{f'{age:.1f}' if isinstance(age, (int, float)) else '-':>6s}  "
+              f"{res.get('source', '?')}"
+              + ("  STALE" if p.get("stale") else ""))
+    if not parts:
+        print("(no participants reported)")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Flight-recorder reader: no id lists recent job traces; with an id,
     pretty-prints the job's span tree (indent = parent/child, one line
@@ -304,7 +343,9 @@ def cmd_trace(args) -> int:
             f"  !{node.get('status')}"
         attrs = node.get("attrs") or {}
         extra = "".join(f"  {k}={v}" for k, v in attrs.items()
-                        if k in ("worker", "node", "coalesced", "job"))
+                        if k in ("worker", "node", "coalesced", "job",
+                                 "mem_peak_mb", "mem_peak_delta_mb",
+                                 "device_peak_mb", "rss_mb"))
         print(f"{'  ' * depth}{node['name']}  "
               f"{node['duration_s'] * 1e3:.1f}ms{extra}{mark}")
         for child in node.get("children", []):
@@ -371,6 +412,14 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the pretty table")
     p.set_defaults(fn=cmd_cluster)
+
+    p = sub.add_parser("top", help="fleet resource table: device memory/"
+                                   "utilization per participant from the "
+                                   "master's federated metrics")
+    p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the table")
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("trace", help="read a job's distributed trace "
                                      "from a server's flight recorder")

@@ -207,6 +207,42 @@ class ClusterRegistry:
             rec["failed_probes"] = 0
             self._refresh_locked(wid, rec, now)
 
+    def update_resources(self, worker_id: str,
+                         snapshot: Dict[str, Any]) -> None:
+        """Retain a worker's latest resource snapshot (ISSUE 5): fed by
+        heartbeats (which now carry one) and by the federation
+        endpoint's pull-through.  Only known ids retain — same phantom
+        guard as :meth:`touch`."""
+        wid = str(worker_id)
+        if not isinstance(snapshot, dict):
+            return
+        with self._lock:
+            rec = self._workers.get(wid)
+            if rec is None:
+                return
+            rec["resources"] = dict(snapshot)
+            rec["resources_at"] = time.monotonic()
+
+    def resource_snapshots(self) -> Dict[str, Dict[str, Any]]:
+        """Latest retained resource snapshot per worker with its age
+        and the worker's address/state — the federation merge input."""
+        now = time.monotonic()
+        with self._lock:
+            out = {}
+            for wid, rec in self._workers.items():
+                st = self._refresh_locked(wid, rec, now)
+                at = rec.get("resources_at")
+                out[wid] = {
+                    "state": st,
+                    "host": rec["info"].get("host"),
+                    "port": rec["info"].get("port"),
+                    "resources": (dict(rec["resources"])
+                                  if rec.get("resources") else None),
+                    "age_s": (None if at is None
+                              else round(now - at, 3)),
+                }
+            return out
+
     def seed_from_config(self, workers: List[Dict[str, Any]]) -> None:
         """Pre-register config workers (enabled only) without marking
         them alive."""
@@ -656,6 +692,19 @@ class HeartbeatSender:
         payload = {"worker_id": self.worker_id}
         if self.port:
             payload["port"] = self.port
+        # heartbeats double as the fleet-telemetry transport (ISSUE 5):
+        # each beat carries this worker's current resource snapshot so
+        # the master's federated metrics stay fresh without a scrape
+        # fan-out.  Best-effort — a failed probe must not skip a beat —
+        # and honoring DTPU_RESOURCE=0: with the monitor disabled a
+        # fresh probe could initialize the JAX backend (seconds on a
+        # real TPU) on the heartbeat thread and blow the lease.
+        try:
+            from comfyui_distributed_tpu.utils import resource as res_mod
+            if res_mod.resource_enabled():
+                payload["resources"] = res_mod.fleet_sample()
+        except Exception as e:  # noqa: BLE001 - liveness > telemetry
+            debug_log(f"heartbeat resource snapshot failed: {e}")
         req = urllib.request.Request(
             f"{self.master_url}/distributed/heartbeat",
             data=json.dumps(payload).encode(),

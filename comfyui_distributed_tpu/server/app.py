@@ -66,6 +66,7 @@ from comfyui_distributed_tpu.runtime.manager import (
 from comfyui_distributed_tpu.utils import config as cfg_mod
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils import net as net_mod
+from comfyui_distributed_tpu.utils import resource as resource_mod
 from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.constants import LOG_TAIL_BYTES
 from comfyui_distributed_tpu.utils.image import decode_png, decode_tensor
@@ -145,6 +146,12 @@ class ServerState:
         }
         self.max_queue = int(os.environ.get(C.MAX_QUEUE_ENV,
                                             C.MAX_QUEUE_DEFAULT))
+        # resource telemetry plane (ISSUE 5): process-global sampler
+        # feeding bounded ring timeseries; queue depth reads from THIS
+        # state (the most recent ServerState in a multi-state process).
+        # DTPU_RESOURCE=0 disables; None then.
+        self.resources = resource_mod.install_monitor(
+            queue_depth_fn=self.queue_remaining)
         self.overlap_enabled = _env_flag(C.OVERLAP_ENV) \
             if overlap is None else bool(overlap)
         self.coalesce_enabled = _env_flag(C.COALESCE_ENV) \
@@ -422,12 +429,49 @@ class ServerState:
             slow_thr = float(os.environ.get(C.SLOW_JOB_ENV, "0") or 0)
         except ValueError:
             pass
+        # peak device memory + RSS ride the slow-job line and error
+        # traces (satellite: an OOM-adjacent slow job is diagnosed from
+        # the log line alone).  Executor-attributed numbers when the run
+        # survived; a fresh process probe when it died before reporting.
+        # Resolved lazily: with tracing off (no spans) nothing below
+        # reads it, and the probe shouldn't tax every finalize.
+        _job_res_cache: List[Dict[str, Any]] = []
+
+        def _job_res() -> Dict[str, Any]:
+            if _job_res_cache:
+                return _job_res_cache[0]
+            jr = res.resources if (res is not None
+                                   and getattr(res, "resources", None)) \
+                else None
+            if jr is None:
+                mem = resource_mod.device_memory_snapshot()
+                jr = {"device_peak_bytes": mem["peak_bytes_in_use"],
+                      "host_rss_bytes": resource_mod.host_rss_bytes(),
+                      "source": mem["source"]}
+            _job_res_cache.append(jr)
+            return jr
+
+        def _mem_note() -> str:
+            jr = _job_res()
+            return (f"mem device_peak="
+                    f"{jr['device_peak_bytes'] / 1e6:.1f}MB "
+                    f"rss={jr['host_rss_bytes'] / 1e6:.1f}MB "
+                    f"({jr['source']})")
         for item in group:
             sp = item.get("span")
             if sp is None:
                 continue
             if err is not None:
                 sp.set_status("error", str(err))
+                # the job never set its execute-span mem attrs (the
+                # exception aborted the executor) — stamp the root so
+                # the error trace still answers "how much memory"
+                sp.attrs.setdefault(
+                    "device_peak_mb",
+                    round(_job_res()["device_peak_bytes"] / 1e6, 2))
+                sp.attrs.setdefault(
+                    "rss_mb",
+                    round(_job_res()["host_rss_bytes"] / 1e6, 2))
             dur = round(done_t - sp.start_s, 6)
             sp.end()
             trace_mod.GLOBAL_TRACES.commit(
@@ -439,7 +483,7 @@ class ServerState:
                 top = sorted(stages.items(), key=lambda kv: -kv[1])[:8]
                 log(f"SLOW job {item['id']} ({status}): {dur:.2f}s > "
                     f"{slow_thr:g}s threshold; trace {sp.trace_id}; "
-                    "stages "
+                    f"{_mem_note()}; stages "
                     + ", ".join(f"{n}={s:.2f}s" for n, s in top))
         with self._queue_lock:
             self._finalize_pending -= 1
@@ -631,18 +675,69 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                       "hedge_armed":
                                           cluster_mod.hedge_armed(),
                                   },
+                                  # resource telemetry: current gauges +
+                                  # bounded ring-series stats (device
+                                  # memory, RSS, utilization, queue)
+                                  "resources": (
+                                      state.resources.snapshot()
+                                      if state.resources is not None
+                                      else {"enabled": False}),
                                   # host<->device transfer bytes per node
                                   # + jit trace/XLA compile counts: the
                                   # tensor-plane health signals (steady
                                   # serving => retraces stop growing)
                                   **counters_snapshot()})
 
+    _build_info_cache: List[Any] = []
+
+    def _build_info_family():
+        """``dtpu_build_info`` gauge: constant 1 with package/jax/backend
+        labels so every scrape is attributable to a build (satellite:
+        which code produced these numbers).  The labels are
+        process-lifetime constants, so they're resolved once and cached
+        — reading them must never re-hit disk metadata or initialize a
+        backend on the scrape path."""
+        if _build_info_cache:
+            return _build_info_cache[0]
+        import comfyui_distributed_tpu
+        labels = {"version": comfyui_distributed_tpu.__version__}
+        try:
+            import importlib.metadata
+            labels["version"] = importlib.metadata.version(
+                "comfyui-distributed-tpu")
+        except Exception:  # noqa: BLE001 - not installed as a dist
+            pass
+        resolved = True
+        try:
+            import jax
+            labels["jax"] = jax.__version__
+            labels["platform"] = jax.default_backend()
+        except Exception:  # noqa: BLE001 - jax mid-init / unavailable
+            labels.setdefault("jax", "unknown")
+            labels.setdefault("platform", "unknown")
+            resolved = False
+        fam = ("dtpu_build_info", "gauge",
+               "Build identity (constant 1; labels carry the info).",
+               [(labels, 1)])
+        if resolved:  # an "unknown" backend is transient — don't pin it
+            _build_info_cache.append(fam)
+        return fam
+
     async def metrics_prom(request):
         """Prometheus text exposition (``/distributed/metrics.prom``):
         the trace module's stage/phase/node histograms and counters plus
-        this server's prompt/image counters and queue gauge — one
-        scrapable endpoint per participant."""
+        this server's prompt/image counters, queue gauge, build-info
+        gauge and current resource gauges — one scrapable endpoint per
+        participant."""
+        loop = asyncio.get_running_loop()
+        # the first probe may initialize the JAX backend (seconds on a
+        # real TPU with DTPU_RESOURCE=0, where no monitor thread already
+        # did it) — keep that off the event loop so heartbeats and
+        # prompts never stall behind a scrape
+        build_info = await loop.run_in_executor(None, _build_info_family)
+        self_sample = await loop.run_in_executor(None, _self_sample)
         extra = [
+            build_info,
             ("dtpu_prompts_executed_total", "counter",
              "Prompts executed to success.",
              [({}, state.metrics["prompts_executed"])]),
@@ -670,6 +765,10 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                sum(1 for w in cl_workers if w["state"] == st))
               for st in (cluster_mod.HEALTHY, cluster_mod.SUSPECT,
                          cluster_mod.DEAD, cluster_mod.UNKNOWN)]))
+        # current resource gauges (unlabelled = this process); the
+        # worker_id-labelled fleet view lives on /cluster/metrics.prom
+        extra.extend(resource_mod.resource_prom_families(
+            {"": self_sample}))
         text = trace_mod.prometheus_text(extra=extra)
         return web.Response(text=text,
                             content_type="text/plain",
@@ -767,12 +866,29 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         import jax
 
         from comfyui_distributed_tpu.models import registry
+        # before/after memory_stats() snapshots: the response reports
+        # what the clear ACTUALLY freed, not just that it ran (satellite:
+        # on a fleet, "clear didn't free anything" is the signal that a
+        # worker is holding leaked buffers)
+        before = resource_mod.device_memory_snapshot()
+        rss_before = resource_mod.host_rss_bytes()
         registry.clear_pipeline_cache()
         jax.clear_caches()
         for _ in range(3):
             gc.collect()
-        log("cleared model/jit caches")
-        return ok()
+        after = resource_mod.device_memory_snapshot()
+        rss_after = resource_mod.host_rss_bytes()
+        freed = max(before["bytes_in_use"] - after["bytes_in_use"], 0)
+        log(f"cleared model/jit caches (freed {freed / 1e6:.1f} MB "
+            f"device, source={after['source']})")
+        return ok({
+            "freed_bytes": freed,
+            "device_bytes_before": before["bytes_in_use"],
+            "device_bytes_after": after["bytes_in_use"],
+            "host_rss_before": rss_before,
+            "host_rss_after": rss_after,
+            "source": after["source"],
+        })
 
     async def launch_worker(request):
         data = await request.json()
@@ -835,16 +951,148 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                      status=400)
         info = {k: data[k] for k in ("host", "port", "name") if k in data}
         info.setdefault("host", request.remote)
-        return ok(state.cluster.heartbeat(str(wid), info=info))
+        out = state.cluster.heartbeat(str(wid), info=info)
+        # heartbeats carry a resource snapshot (ISSUE 5): retain the
+        # latest per worker for the federated metrics endpoints
+        if isinstance(data.get("resources"), dict):
+            state.cluster.update_resources(str(wid), data["resources"])
+        return ok(out)
+
+    def _self_sample() -> Dict[str, Any]:
+        """This process's resource sample for the metrics surfaces: the
+        monitor's latest (it carries the utilization estimate, which
+        needs two samples) with the queue depth refreshed from THIS
+        state — a multi-state process's global monitor may be bound to
+        another state's queue."""
+        snap = resource_mod.fleet_sample()
+        return {**snap, "queue_depth": state.queue_remaining()}
+
+    async def resource_info(request):
+        """This participant's current resource sample + monitor state —
+        the unit the federation merges, and the pull-through target when
+        a worker's heartbeat snapshot goes stale."""
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, _self_sample)
+        return web.json_response({
+            "resources": snap,
+            "monitor": (state.resources.snapshot()
+                        if state.resources is not None
+                        else {"enabled": False}),
+        })
+
+    # wid -> monotonic time of the last FAILED federation pull (the
+    # negative cache bounding per-scrape pull latency)
+    _res_pull_failed_at: Dict[str, float] = {}
+
+    async def _fleet_resources() -> Dict[str, Any]:
+        """Merged master+workers resource view (ISSUE 5 federation).
+
+        Each registered worker contributes its latest heartbeat
+        snapshot; snapshots older than DTPU_RES_FED_TTL_S (a missed
+        heartbeat) are re-pulled live from the worker's
+        ``GET /distributed/resource`` and cached back into the registry,
+        so scrapes between heartbeats stay fresh without a per-scrape
+        fan-out.  Dead workers keep their last snapshot, aged and marked
+        stale, rather than vanishing mid-incident.  A failed pull is
+        negative-cached for the same TTL so an unreachable (but not yet
+        DEAD) worker costs one timeout per TTL window, not one per
+        scrape."""
+        import aiohttp
+
+        from comfyui_distributed_tpu.utils.net import get_client_session
+        try:
+            ttl = float(os.environ.get(C.RES_FED_TTL_ENV,
+                                       C.RES_FED_TTL_DEFAULT))
+        except ValueError:
+            ttl = C.RES_FED_TTL_DEFAULT
+        now = time.monotonic()
+        reg = state.cluster.resource_snapshots()
+        to_pull = [
+            (wid, v) for wid, v in reg.items()
+            if v.get("host") and v.get("port")
+            and v["state"] != cluster_mod.DEAD
+            and (v["age_s"] is None or v["age_s"] > ttl)
+            and now - _res_pull_failed_at.get(wid, -1e9) > ttl]
+        if to_pull:
+            session = await get_client_session()
+
+            async def pull(wid, v):
+                url = (f"http://{v['host']}:{v['port']}"
+                       "/distributed/resource")
+                try:
+                    async with session.get(
+                            url, timeout=aiohttp.ClientTimeout(
+                                total=2)) as r:
+                        if r.status == 200:
+                            body = await r.json()
+                            if isinstance(body.get("resources"), dict):
+                                state.cluster.update_resources(
+                                    wid, body["resources"])
+                                _res_pull_failed_at.pop(wid, None)
+                                return
+                except Exception as e:  # noqa: BLE001 - best-effort pull
+                    debug_log(f"resource pull from {wid} failed: {e}")
+                _res_pull_failed_at[wid] = time.monotonic()
+
+            await asyncio.gather(*(pull(wid, v) for wid, v in to_pull))
+            reg = state.cluster.resource_snapshots()
+        self_id = "master" if not state.is_worker \
+            else os.environ.get(C.WORKER_ID_ENV, "self")
+        self_snap = await asyncio.get_running_loop().run_in_executor(
+            None, _self_sample)
+        participants: Dict[str, Any] = {
+            self_id: {
+                "state": "self",
+                "resources": self_snap,
+                "age_s": 0.0,
+                "stale": False,
+            }}
+        for wid, v in reg.items():
+            if wid == self_id:
+                # a registered worker colliding with this process's own
+                # id (someone named a worker "master") still shows up,
+                # disambiguated, instead of silently vanishing
+                wid = f"{wid}@registry"
+            participants[wid] = {
+                "state": v["state"],
+                "host": v.get("host"), "port": v.get("port"),
+                "resources": v["resources"],
+                "age_s": v["age_s"],
+                "stale": v["age_s"] is None or v["age_s"] > ttl,
+            }
+        return {"participants": participants, "ttl_s": ttl}
+
+    async def cluster_metrics(request):
+        """Federated fleet resources as JSON (feeds ``cli top``)."""
+        return web.json_response(await _fleet_resources())
+
+    async def cluster_metrics_prom(request):
+        """Federated fleet resources as Prometheus text: one gauge
+        series per participant, distinguished by ``worker_id`` — the
+        single scrape point for fleet memory/utilization dashboards."""
+        fleet = await _fleet_resources()
+        parts = fleet["participants"]
+        fams = resource_mod.resource_prom_families(
+            {wid: p.get("resources") for wid, p in parts.items()},
+            ages={wid: p.get("age_s") for wid, p in parts.items()})
+        fams.append(
+            ("dtpu_res_participants", "gauge",
+             "Participants in the federated resource view.",
+             [({}, len(parts))]))
+        return web.Response(text=trace_mod.render_prom_families(fams),
+                            content_type="text/plain", charset="utf-8")
 
     async def workers_status(request):
         """Live worker health (the reference panel's 2s status dots,
         ``gpupanel.js:1233-1311``), served from the poller's snapshot."""
         return web.json_response(state.health.snapshot())
 
-    async def _fanout_to_workers(path: str) -> Dict[str, Any]:
+    async def _fanout_to_workers(path: str,
+                                 bodies: Optional[Dict[str, Any]] = None
+                                 ) -> Dict[str, Any]:
         """POST ``path`` on every enabled worker (reference toolbar fan-out,
-        ``gpupanel.js:204-306``)."""
+        ``gpupanel.js:204-306``).  ``bodies`` (optional dict) collects each
+        worker's parsed JSON response for callers that aggregate."""
         import aiohttp
 
         from comfyui_distributed_tpu.utils.net import get_client_session
@@ -861,6 +1109,11 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                         worker_url(w) + path,
                         timeout=aiohttp.ClientTimeout(total=10)) as r:
                     results[str(w["id"])] = r.status
+                    if bodies is not None and r.status == 200:
+                        try:
+                            bodies[str(w["id"])] = await r.json()
+                        except Exception:  # noqa: BLE001 - non-JSON body
+                            pass
             except Exception as e:  # noqa: BLE001 - report per-worker
                 results[str(w["id"])] = str(e)
 
@@ -869,10 +1122,20 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
 
     async def cluster_clear_memory(request):
         """Clear caches here AND on every enabled worker (reference
-        ``_handleClearMemory``, ``gpupanel.js:259-306``)."""
-        results = await _fanout_to_workers("/distributed/clear_memory")
-        await clear_memory(request)
-        return ok({"workers": results})
+        ``_handleClearMemory``, ``gpupanel.js:259-306``), aggregating
+        the bytes each participant actually freed."""
+        bodies: Dict[str, Any] = {}
+        results = await _fanout_to_workers("/distributed/clear_memory",
+                                           bodies=bodies)
+        resp = await clear_memory(request)
+        local = json.loads(resp.body.decode())
+        freed_by = {"master": int(local.get("freed_bytes", 0))}
+        for wid, body in bodies.items():
+            if isinstance(body, dict) and "freed_bytes" in body:
+                freed_by[wid] = int(body["freed_bytes"])
+        return ok({"workers": results,
+                   "freed_bytes": freed_by,
+                   "freed_bytes_total": sum(freed_by.values())})
 
     async def cluster_interrupt(request):
         """Interrupt here AND on every enabled worker (reference
@@ -1225,6 +1488,9 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/distributed/trace/{prompt_id}", get_trace)
     r.add_post("/distributed/warmup", warmup)
     r.add_get("/distributed/cluster", cluster_info)
+    r.add_get("/distributed/resource", resource_info)
+    r.add_get("/distributed/cluster/metrics", cluster_metrics)
+    r.add_get("/distributed/cluster/metrics.prom", cluster_metrics_prom)
     r.add_post("/distributed/register", cluster_register)
     r.add_post("/distributed/heartbeat", cluster_heartbeat)
     r.add_get("/distributed/workers_status", workers_status)

@@ -109,6 +109,26 @@ METRICS_RESET_ENV = "DTPU_METRICS_RESET"  # "0" disables POST .../metrics/reset
 HISTOGRAM_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# --- resource telemetry plane (utils/resource.py) ----------------------------
+# Device-memory / host-RSS / utilization sampling into bounded in-memory
+# ring timeseries (the Gorilla model: operational telemetry is only
+# useful cheap, aggregated and recent), current-value gauges on both
+# metrics surfaces, per-job HBM attribution in ExecutionResult + trace
+# attrs, and fleet federation: heartbeats carry a snapshot, the master
+# retains the latest per worker and serves the merged view on
+# GET /distributed/cluster/metrics{,.prom} with worker_id labels.
+RESOURCE_ENV = "DTPU_RESOURCE"           # "0" disables the monitor thread
+RES_INTERVAL_ENV = "DTPU_RES_INTERVAL_S"
+RES_INTERVAL_DEFAULT = 5.0               # s between monitor samples
+RES_RING_ENV = "DTPU_RES_RING"
+RES_RING_DEFAULT = 720                   # samples per series (~1h @ 5s)
+# federation pull-through cache: a worker snapshot older than this (it
+# missed a heartbeat) is re-pulled live from the worker's
+# GET /distributed/resource — and the pulled value is cached back into
+# the registry so repeated scrapes inside the TTL don't re-pull
+RES_FED_TTL_ENV = "DTPU_RES_FED_TTL_S"
+RES_FED_TTL_DEFAULT = 10.0
+
 # --- fault-tolerant cluster control plane (runtime/cluster.py) ---------------
 # Worker registry with leases: a worker is HEALTHY while its lease (renewed
 # by heartbeat/probe/data-plane contact) is fresh, SUSPECT after

@@ -1063,11 +1063,29 @@ def prometheus_text(extra: Optional[List[Tuple[str, str, str,
     lines.append("# TYPE dtpu_trace_ring_size gauge")
     lines.append(f"dtpu_trace_ring_size {GLOBAL_TRACES.size()}")
 
-    for name, typ, help_text, samples in extra or []:
+    _append_prom_families(lines, extra or [])
+    return "\n".join(lines) + "\n"
+
+
+def _append_prom_families(lines: List[str],
+                          families: List[Tuple[str, str, str,
+                                               List[Tuple[Dict, float]]]]
+                          ) -> None:
+    for name, typ, help_text, samples in families:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {typ}")
         for labels, value in samples:
             lines.append(f"{name}{_prom_labels(labels)} {_prom_num(value)}")
+
+
+def render_prom_families(families: List[Tuple[str, str, str,
+                                              List[Tuple[Dict, float]]]]
+                         ) -> str:
+    """Standalone Prometheus text for caller-supplied families only (the
+    federated cluster exposition renders fleet gauges without duplicating
+    this process's histograms)."""
+    lines: List[str] = []
+    _append_prom_families(lines, families)
     return "\n".join(lines) + "\n"
 
 

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from comfyui_distributed_tpu.ops.base import OpContext, get_op
+from comfyui_distributed_tpu.utils import resource as resource_mod
 from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.constants import \
     DISTRIBUTED_NODE_TYPES as DISTRIBUTED_TYPES
@@ -47,6 +48,18 @@ class ExecutionResult:
     image_futures: List[Any] = dataclasses.field(default_factory=list)
     # prompts merged into this run by the coalescing scheduler
     coalesced: int = 1
+    # per-run resource attribution (ISSUE 5): device memory high-water
+    # delta + absolute end-of-run gauges and host RSS, tagged with the
+    # probe source ("memory_stats" on real devices, "host_rss" on
+    # backends whose devices report None).  The same numbers land as
+    # attrs on the run's execute span, so `cli trace` shows HBM next to
+    # latency.
+    resources: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # node id -> {"peak_delta_bytes", "in_use_delta_bytes"}: which node
+    # pushed the high-water mark (peak deltas are against the running
+    # maximum, so only new highs attribute — honest, not double-counted)
+    node_memory: Dict[str, Dict[str, int]] = \
+        dataclasses.field(default_factory=dict)
     # the run's live TransferStats: deferred host fetches record into it
     # AFTER the compute-time snapshot, so wait_host re-snapshots
     _transfer_stats: Any = None
@@ -138,6 +151,14 @@ class WorkflowExecutor:
         trace_mod.install_jax_monitoring()
         run_transfers = trace_mod.TransferStats()
         retrace_mark = trace_mod.GLOBAL_RETRACES.mark()
+        # DTPU_RESOURCE=0 is the plane's kill switch: it must also cover
+        # the attribution probes (one per node + two per run) on the hot
+        # serving path, not just the monitor thread
+        res_on = resource_mod.resource_enabled()
+        mem_start = resource_mod.device_memory_snapshot() if res_on else None
+        rss_start = resource_mod.host_rss_bytes() if res_on else 0
+        node_memory: Dict[str, Dict[str, int]] = {}
+        prev_node_mem = mem_start
         t_start = time.perf_counter()
 
         with trace_mod.transfer_sink(run_transfers):
@@ -166,11 +187,30 @@ class WorkflowExecutor:
                         kwargs[hname] = hval
                 debug_log(f"exec node {nid} ({node.class_type})")
                 t0 = time.perf_counter()
+                # the previous node's end snapshot (the run-start one for
+                # the first node) IS this node's start snapshot — one
+                # probe per boundary, not two
+                node_mem0 = prev_node_mem
                 # node-scoped telemetry: transfer attribution + a child
                 # span in the active request trace (no-op outside a job)
                 with trace_mod.node_scope(nid), \
-                        trace_mod.span(node.class_type, node=nid):
+                        trace_mod.span(node.class_type, node=nid) as nsp:
                     outputs[nid] = op.execute(self.ctx, **kwargs)
+                    if res_on:
+                        node_mem1 = resource_mod.device_memory_snapshot()
+                        mem_delta = {
+                            "peak_delta_bytes": max(
+                                node_mem1["peak_bytes_in_use"]
+                                - node_mem0["peak_bytes_in_use"], 0),
+                            "in_use_delta_bytes":
+                                node_mem1["bytes_in_use"]
+                                - node_mem0["bytes_in_use"],
+                        }
+                        prev_node_mem = node_mem1
+                        node_memory[nid] = mem_delta
+                        if nsp is not None and mem_delta["peak_delta_bytes"]:
+                            nsp.attrs["mem_peak_mb"] = round(
+                                mem_delta["peak_delta_bytes"] / 1e6, 2)
                 timings[nid] = time.perf_counter() - t0
                 # per-node-type latency histogram (p50/p95/p99 on
                 # /distributed/metrics and the dtpu_node_seconds family)
@@ -178,6 +218,30 @@ class WorkflowExecutor:
 
         total = time.perf_counter() - t_start
         self.ctx.node_timings.update(timings)
+        resources: Dict[str, Any] = {}
+        if res_on:
+            mem_end = resource_mod.device_memory_snapshot()
+            rss_end = resource_mod.host_rss_bytes()
+            resources = {
+                "source": mem_end["source"],
+                "device_bytes_in_use": mem_end["bytes_in_use"],
+                "device_peak_bytes": mem_end["peak_bytes_in_use"],
+                "device_peak_delta_bytes": max(
+                    mem_end["peak_bytes_in_use"]
+                    - mem_start["peak_bytes_in_use"], 0),
+                "host_rss_bytes": rss_end,
+                "host_rss_delta_bytes": rss_end - rss_start,
+            }
+        sp = trace_mod.current_span()
+        if sp is not None and res_on:
+            # the run executes under the job's "execute" span — stamping
+            # memory here puts HBM next to latency in the trace tree
+            sp.attrs["device_peak_mb"] = round(
+                resources["device_peak_bytes"] / 1e6, 2)
+            sp.attrs["mem_peak_delta_mb"] = round(
+                resources["device_peak_delta_bytes"] / 1e6, 2)
+            sp.attrs["rss_mb"] = round(rss_end / 1e6, 2)
+            sp.attrs["mem_source"] = resources["source"]
         return ExecutionResult(
             outputs=outputs,
             images=list(self.ctx.saved_images),
@@ -186,4 +250,6 @@ class WorkflowExecutor:
             retraces=trace_mod.GLOBAL_RETRACES.since(retrace_mark),
             image_futures=list(self.ctx.image_futures),
             coalesced=max(int(getattr(self.ctx, "coalesce", 1)), 1),
+            resources=resources,
+            node_memory=node_memory,
             _transfer_stats=run_transfers)
