@@ -120,7 +120,7 @@ def parse_args(argv=None):
                         "naturally: devices or UNAVAILABLE)")
     p.add_argument("--phase", default=None,
                    choices=["tensor_plane", "pipeline", "observability",
-                            "fault", "telemetry"],
+                            "fault", "telemetry", "failover"],
                    help="run ONE named software-proxy phase. "
                         "'tensor_plane': repeated 2-image SPMD txt2img on "
                         "the CPU backend reporting host_transfer_mb_per_"
@@ -150,7 +150,14 @@ def parse_args(argv=None):
                         "— the telemetry plane must cost <=3%% with zero "
                         "new jit traces, the monitor's rings must hold "
                         "samples, and per-job memory attrs must appear "
-                        "in the job's trace")
+                        "in the job's trace. "
+                        "'failover': loopback master+standby+2 workers "
+                        "sharing one DTPU_WAL_DIR — kills the master "
+                        "mid tiled-upscale and reports the standby's "
+                        "completion rate, takeover latency, preloaded-"
+                        "vs-recomputed units and pixel equality vs the "
+                        "no-failure run, plus the restart-only (no "
+                        "standby) recovery variant")
     p.add_argument("--check", action="store_true",
                    help="perf-regression watchdog: after the run, compare "
                         "the fresh result against the most recent prior "
@@ -278,6 +285,8 @@ def metric_name(args):
         return "resource_telemetry_imgs_per_s_4prompt"
     if getattr(args, "phase", None) == "fault":
         return "fault_recovery_completion_rate"
+    if getattr(args, "phase", None) == "failover":
+        return "failover_master_kill_completion_rate"
     if args.real_ckpt:
         return (f"real_ckpt_{args.family}_{args.width}x{args.height}_"
                 f"{args.steps}step_sec_per_image")
@@ -306,7 +315,7 @@ def metric_unit(args):
         return "imgs/s"
     if getattr(args, "phase", None) == "telemetry":
         return "imgs/s"
-    if getattr(args, "phase", None) == "fault":
+    if getattr(args, "phase", None) in ("fault", "failover"):
         return "fraction"
     if args.scaling_sweep or args.multiproc_sweep:
         return "fraction"
@@ -773,6 +782,7 @@ LOWER_IS_BETTER_UNITS = ("sec/image", "sec/run", "s")
 CHECK_TOLERANCE_PCT = {
     "default": 10.0,
     "fault_recovery_completion_rate": 0.0,
+    "failover_master_kill_completion_rate": 0.0,
     "tiny_virtual_mesh_spmd_efficiency_8dev": 5.0,
     "pipeline_overlap_speedup_4prompt": 15.0,
     "observability_traced_imgs_per_s_4prompt": 15.0,
@@ -1775,6 +1785,325 @@ def run_fault(args):
     emit(args, payload)
 
 
+def _failover_upscale_prompt(seed=11, size=64, tile=32, steps=1):
+    """4-tile tiled-upscale fan-out with a SaveImage sink, so the final
+    blend lands on disk and the bit-identical comparison has pixels to
+    read (master [0,1], w0 [2], w1 [3])."""
+    p = _fault_upscale_prompt(seed=seed, size=size, tile=tile,
+                              steps=steps)
+    p["3"] = {"class_type": "SaveImage",
+              "inputs": {"images": ["2", 0],
+                         "filename_prefix": "failover"}}
+    return p
+
+
+def measure_failover(steps: int = 1, wait_s: float = 300.0):
+    """Durability/failover harness behind ``--phase failover`` (ISSUE
+    7): master + hot standby + 2 workers as loopback HTTP servers
+    sharing one ``DTPU_WAL_DIR``, running the 4-tile tiled upscale.
+
+    Three measurements on one topology:
+
+    * **baseline** — the same prompt (same seed) run to completion with
+      no failure: the bit-identical reference image;
+    * **failover** — worker w1 stalled, the master killed mid-job
+      (lease stops renewing, WAL refuses appends — the in-process proxy
+      for SIGKILL); the standby's lease watcher takes over, replays the
+      shared WAL, resumes the job, blends the spilled units from disk
+      and redispatches ONLY the unfinished unit.  Reported: completion
+      rate, takeover latency (kill -> recovered job success), preloaded
+      vs recomputed units, pixel equality against the baseline;
+    * **restart** — the no-standby variant: a fresh master process
+      re-opens the same WAL dir (same owner id reclaims the lease),
+      recovers at startup, and resumes redispatching only unfinished
+      units.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from comfyui_distributed_tpu.server.app import ServerState, build_app
+    from comfyui_distributed_tpu.utils import constants as C
+    from comfyui_distributed_tpu.utils import trace as tr
+    from comfyui_distributed_tpu.utils.image import decode_png
+
+    os.environ.setdefault("DTPU_DEFAULT_FAMILY", "tiny")
+    saved_env = {k: os.environ.get(k)
+                 for k in (C.WAL_DIR_ENV, C.MASTER_LEASE_ENV, C.LEASE_ENV,
+                           C.FAULT_POLICY_ENV, C.HEDGE_ENV,
+                           C.STANDBY_ENV, C.DRAIN_TIMEOUT_ENV)}
+    os.environ[C.MASTER_LEASE_ENV] = "2.0"
+    os.environ[C.LEASE_ENV] = "4.0"
+    os.environ[C.FAULT_POLICY_ENV] = "reassign"
+    os.environ[C.HEDGE_ENV] = "0"          # isolate the durability path
+    os.environ[C.DRAIN_TIMEOUT_ENV] = "2"
+    os.environ.pop(C.STANDBY_ENV, None)
+
+    async def go():
+        tmp = tempfile.mkdtemp(prefix="bench_failover_")
+        loop = asyncio.get_running_loop()
+        states = []          # every ServerState, for cleanup
+        clients = []
+
+        async def make_state(name, is_worker, cfg_path=None,
+                             standby=False):
+            d = os.path.join(tmp, name)
+            os.makedirs(os.path.join(d, "in"), exist_ok=True)
+            if standby:
+                os.environ[C.STANDBY_ENV] = "1"
+            try:
+                st = ServerState(
+                    config_path=cfg_path or os.path.join(d, "cfg.json"),
+                    input_dir=os.path.join(d, "in"), output_dir=d,
+                    is_worker=is_worker)
+            finally:
+                os.environ.pop(C.STANDBY_ENV, None)
+            client = TestClient(TestServer(build_app(st)))
+            await client.start_server()
+            st.port = client.server.port
+            states.append(st)
+            clients.append(client)
+            return st, client, d
+
+        async def wait_history(client, pid, t_s):
+            deadline = time.monotonic() + t_s
+            while time.monotonic() < deadline:
+                hist = await (await client.get("/history")).json()
+                if pid in hist:
+                    return hist[pid]
+                await asyncio.sleep(0.05)
+            raise TimeoutError(f"failover-bench job {pid} never "
+                               f"finished")
+
+        def newest_png(d):
+            pngs = [os.path.join(d, f) for f in os.listdir(d)
+                    if f.endswith(".png")]
+            assert pngs, f"no PNG written in {d}"
+            return max(pngs, key=os.path.getmtime)
+
+        workers, cfg_workers = [], []
+        for i in range(2):
+            st, client, _ = await make_state(f"worker{i}", True)
+            workers.append((st, client))
+            cfg_workers.append({"id": f"w{i}", "host": "127.0.0.1",
+                                "port": client.server.port,
+                                "enabled": True})
+        cfg_path = os.path.join(tmp, "cfg.json")
+        with open(cfg_path, "w") as f:
+            json.dump({"workers": cfg_workers,
+                       "master": {"host": "127.0.0.1"}, "settings": {}},
+                      f)
+
+        async def run_epoch(wal_name, baseline_png):
+            """One kill-the-master episode in its own WAL dir; returns
+            the measurement dict.  ``baseline_png`` of None means also
+            run (and return) the no-failure reference first."""
+            wal = os.path.join(tmp, wal_name)
+            os.environ[C.WAL_DIR_ENV] = wal
+            mstate, mclient, mdir = await make_state(
+                f"{wal_name}_master", False, cfg_path=cfg_path)
+            assert mstate.durable is not None, "WAL not attached"
+            mstate.resume_recovered()
+            mstate.health.interval = 0.5
+            await loop.run_in_executor(None, mstate.health.poll_once)
+            mstate.health.start()
+
+            if baseline_png is None:
+                r = await mclient.post("/prompt", json={
+                    "prompt": _failover_upscale_prompt(steps=steps),
+                    "client_id": "bench-fo-base"})
+                assert r.status == 200, await r.text()
+                pid0 = (await r.json())["prompt_id"]
+                h = await wait_history(mclient, pid0, wait_s)
+                assert h["status"] == "success", h
+                baseline_png = newest_png(mdir)
+
+            # stall w1 so the job hangs on its last tile with
+            # everything else checked in and spilled
+            workers[1][0].fault_inject = {"stall_s": 300}
+            r = await mclient.post("/prompt", json={
+                "prompt": _failover_upscale_prompt(steps=steps),
+                "client_id": "bench-fo"})
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            pid = body["prompt_id"]
+            assert sorted(body.get("workers", [])) == ["w0", "w1"], body
+            deadline = time.monotonic() + wait_s
+            while time.monotonic() < deadline:
+                snap = await (await mclient.get(
+                    "/distributed/cluster")).json()
+                if any(j["done_units"] >= 3
+                       for j in snap["ledger"]["active_jobs"].values()):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise TimeoutError("job never reached 3/4 units")
+            return mstate, mclient, pid, baseline_png
+
+        def kill(mstate):
+            """The in-process SIGKILL proxy: the lease stops renewing,
+            the WAL refuses appends, the health poller dies.  The
+            zombie's memory (queue, ledger, tile queues) is left to rot
+            exactly as a dead process's would — fencing is what keeps
+            it from corrupting the shared log."""
+            mstate.durable.simulate_crash()
+            mstate.health.stop()
+
+        dup0 = tr.GLOBAL_COUNTERS.get("cluster_duplicate_checkins")
+
+        # ---- episode 1: standby takeover --------------------------------
+        mstate, mclient, pid, baseline_png = await run_epoch(
+            "wal_standby", None)
+        sstate, sclient, sdir = await make_state(
+            "standby", False, cfg_path=cfg_path, standby=True)
+        assert sstate.durable is not None and sstate.durable.standby
+        t_kill = time.perf_counter()
+        kill(mstate)
+        workers[1][0].fault_inject = {}
+        h = await wait_history(sclient, pid, wait_s)
+        takeover_s = time.perf_counter() - t_kill
+        assert h["status"] == "success", h
+        snap = await (await sclient.get("/distributed/cluster")).json()
+        job = [j for j in snap["ledger"]["completed_jobs"]
+               if j["kind"] == "tile"][-1]
+        fo_img = np.asarray(decode_png(
+            open(newest_png(sdir), "rb").read()))
+        base_img = np.asarray(decode_png(
+            open(baseline_png, "rb").read()))
+        dur = await (await sclient.get("/distributed/durability")).json()
+        standby = {
+            "completion_rate": job["done_units"] / max(
+                job["total_units"], 1),
+            "takeover_latency_s": round(takeover_s, 3),
+            "recovered": bool(job.get("recovered")),
+            "preloaded_units": job.get("preloaded_units", 0),
+            "recomputed_units": job["total_units"]
+            - job.get("preloaded_units", 0),
+            "redispatched_units": job.get("reassigned_units", 0),
+            "bit_identical": bool(np.array_equal(fo_img, base_img)),
+            "epoch": dur.get("epoch"),
+            "takeovers": dur.get("takeovers"),
+            "wal_records": (dur.get("wal") or {}).get(
+                "records_appended"),
+        }
+
+        # ---- episode 2: restart-only (no standby) -----------------------
+        mstate2, mclient2, pid2, baseline_png = await run_epoch(
+            "wal_restart", baseline_png)
+        kill(mstate2)
+        workers[1][0].fault_inject = {}
+        # "restart the master": a fresh ServerState over the SAME WAL
+        # dir — same owner id, so the lease is reclaimed immediately
+        m3, m3client, m3dir = await make_state(
+            "restart_master", False, cfg_path=cfg_path)
+        assert m3.durable is not None and m3.durable.epoch >= 2
+        t0 = time.perf_counter()
+        resumed = await loop.run_in_executor(None, m3.resume_recovered)
+        h2 = await wait_history(m3client, pid2, wait_s)
+        restart_s = time.perf_counter() - t0
+        assert h2["status"] == "success", h2
+        snap2 = await (await m3client.get("/distributed/cluster")).json()
+        job2 = [j for j in snap2["ledger"]["completed_jobs"]
+                if j["kind"] == "tile"][-1]
+        img2 = np.asarray(decode_png(
+            open(newest_png(m3dir), "rb").read()))
+        restart = {
+            "completion_rate": job2["done_units"] / max(
+                job2["total_units"], 1),
+            "recovery_latency_s": round(restart_s, 3),
+            "resumed_prompts": resumed,
+            "recovered": bool(job2.get("recovered")),
+            "preloaded_units": job2.get("preloaded_units", 0),
+            "recomputed_units": job2["total_units"]
+            - job2.get("preloaded_units", 0),
+            "redispatched_units": job2.get("reassigned_units", 0),
+            "bit_identical": bool(np.array_equal(img2, base_img)),
+        }
+        dups = tr.GLOBAL_COUNTERS.get("cluster_duplicate_checkins") - dup0
+
+        for st in states:
+            if st.durable is not None and st.durable.wal is not None:
+                st.durable.simulate_crash()  # silence zombie appends
+        for client in clients:
+            try:
+                await client.close()
+            except Exception:  # noqa: BLE001 - already closed
+                pass
+        for st in states:
+            st.health.stop()
+            st.drain(1)
+        shutil.rmtree(tmp, ignore_errors=True)
+        return {"standby": standby, "restart": restart,
+                "duplicate_checkins_dropped": int(dups),
+                "total_units": job["total_units"]}
+
+    try:
+        return asyncio.run(go())
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_failover(args):
+    """``--phase failover``: the durable-master proof (ISSUE 7) —
+    killing the master mid tiled-upscale must hand the job to the
+    standby (completion_rate 1.0, zero duplicate blends, final image
+    bit-identical to the no-failure run), and a restart-only master
+    must resume redispatching only unfinished units."""
+    from comfyui_distributed_tpu.parallel.mesh import force_cpu_platform
+    force_cpu_platform(1)
+    enable_compile_cache()
+    m = measure_failover(steps=args.steps)
+    sb, rs = m["standby"], m["restart"]
+    log(f"standby: completion {sb['completion_rate']} in "
+        f"{sb['takeover_latency_s']}s (preloaded "
+        f"{sb['preloaded_units']}/{m['total_units']}, redispatched "
+        f"{sb['redispatched_units']}, bit_identical "
+        f"{sb['bit_identical']}); restart: completion "
+        f"{rs['completion_rate']} (preloaded {rs['preloaded_units']}, "
+        f"recomputed {rs['recomputed_units']})")
+    payload = {
+        "metric": metric_name(args),
+        "value": sb["completion_rate"],
+        "unit": metric_unit(args),
+        "vs_baseline": 1.0,
+        **{f"standby_{k}": v for k, v in sb.items()},
+        **{f"restart_{k}": v for k, v in rs.items()},
+        "duplicate_checkins_dropped": m["duplicate_checkins_dropped"],
+        "total_units": m["total_units"],
+    }
+    problems = []
+    if sb["completion_rate"] < 1.0:
+        problems.append(f"standby completion_rate "
+                        f"{sb['completion_rate']} < 1.0")
+    if not sb["bit_identical"]:
+        problems.append("failover image differs from the no-failure "
+                        "run (determinism broken)")
+    if not sb["recovered"] or sb["preloaded_units"] < 1:
+        problems.append("standby re-refined everything — the spilled "
+                        "payloads were not used")
+    if sb["recomputed_units"] >= m["total_units"]:
+        problems.append("no unit was preloaded: recovery recomputed "
+                        "the whole job")
+    if rs["completion_rate"] < 1.0:
+        problems.append(f"restart completion_rate "
+                        f"{rs['completion_rate']} < 1.0")
+    if not rs["bit_identical"]:
+        problems.append("restart-recovered image differs from the "
+                        "no-failure run")
+    if rs["preloaded_units"] < 1:
+        problems.append("restart recovery preloaded nothing")
+    if problems:
+        payload["error"] = {"stage": "failover_invariants",
+                            "detail": "; ".join(problems)}
+    emit(args, payload)
+
+
 def run_suite(args):
     """The driver's default invocation: budget-capped backend escape
     (ladder_budget — ≤~20% of the claim window), then cheapest-first
@@ -1830,6 +2159,13 @@ def run_suite(args):
         tel = _phase_subprocess("telemetry", extra=("--check",))
         if tel is not None:
             payload_b["stages"]["telemetry"] = tel
+        # failover watchdog stage: the CPU proxy re-proves the durable-
+        # master contract (standby completion 1.0, bit-identical blend)
+        # and --check flags a completion-rate regression against the
+        # prior BENCH artifact
+        fo = _phase_subprocess("failover", extra=("--check",))
+        if fo is not None:
+            payload_b["stages"]["failover"] = fo
         emit(args, payload_b)
     finally:
         try:
@@ -2258,6 +2594,8 @@ def main():
             run_telemetry(args)
         elif args.phase == "fault":
             run_fault(args)
+        elif args.phase == "failover":
+            run_failover(args)
         elif args.real_ckpt:
             run_real_ckpt(args)
         elif args.multiproc_sweep:

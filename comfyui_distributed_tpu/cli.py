@@ -43,6 +43,11 @@ def _guard_backend() -> None:
 def cmd_serve(args) -> int:
     _maybe_init_multihost()
     _guard_backend()
+    if getattr(args, "standby", False):
+        # hot-standby master: watch the primary's lease in the shared
+        # DTPU_WAL_DIR, take over (replay + resume) on expiry
+        from comfyui_distributed_tpu.utils import constants as C
+        os.environ[C.STANDBY_ENV] = "1"
     from comfyui_distributed_tpu.server.app import ServerState, serve
     state = ServerState(config_path=args.config, is_worker=False,
                         models_dir=args.models_dir)
@@ -356,6 +361,63 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_wal(args) -> int:
+    """Offline write-ahead-log inspector: segment listing with checksum
+    validation, snapshot inventory, the lease holder + epoch, per-job
+    and per-type record counts, and the replayed summary (what a
+    recovering master would resume).  Exit 1 on mid-file corruption —
+    a torn TAIL is the expected signature of a crash, not an error."""
+    from comfyui_distributed_tpu.runtime import durable as durable_mod
+    wal_dir = args.dir or durable_mod.wal_dir()
+    if not wal_dir:
+        print("no WAL dir: pass --dir or set DTPU_WAL_DIR",
+              file=sys.stderr)
+        return 2
+    if not os.path.isdir(wal_dir):
+        print(f"not a directory: {wal_dir}", file=sys.stderr)
+        return 2
+    report = durable_mod.verify(wal_dir)
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
+    lease = report["lease"]
+    print(f"wal {wal_dir}: "
+          f"{'OK' if report['ok'] else 'CORRUPT'}  "
+          f"lease={'held by ' + str(lease.get('owner')) if lease.get('held') else 'expired/free'}"
+          f"  epoch={lease.get('epoch', 0)}")
+    for seg in report["segments"]:
+        print(f"  {seg['segment']:26s} {seg['bytes']:>9d} B  "
+              f"{seg['records']:>5d} rec  {seg['checksum']}")
+    if not report["segments"]:
+        print("  (no segments)")
+    for snap in report["snapshots"]:
+        print(f"  {snap}  (snapshot)")
+    bt = report["records_by_type"]
+    if bt:
+        print("  records: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(bt.items())))
+    if args.job:
+        jobs = {j: n for j, n in report["records_by_job"].items()
+                if args.job in j}
+    else:
+        jobs = report["records_by_job"]
+    for jid, n in sorted(jobs.items()):
+        live = report["replay"]["active_jobs"].get(jid)
+        state = (f"OPEN {live['done']}/{live['total']} {live['kind']}"
+                 if live else "finished")
+        print(f"  job {jid}: {n} record(s), {state}")
+    rp = report["replay"]
+    print(f"  replay: {rp['records_replayed']} record(s) past "
+          f"{'snapshot' if rp.get('snapshot') else 'genesis'}, "
+          f"{len(rp['pending_prompts'])} in-flight prompt(s), "
+          f"{len(rp['active_jobs'])} open job(s), idem keys "
+          f"{rp['idem_keys']}")
+    if rp["torn"]:
+        print(f"  torn tail(s): {rp['torn']} (expected after a crash; "
+              f"the partial record is ignored)")
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="comfyui_distributed_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -368,6 +430,10 @@ def main(argv=None) -> int:
     common(p)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8288)
+    p.add_argument("--standby", action="store_true",
+                   help="hot-standby master: watch the primary's lease "
+                        "in DTPU_WAL_DIR and take over on expiry "
+                        "(replaying the shared WAL)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("worker", help="run a worker server")
@@ -420,6 +486,18 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the table")
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("wal", help="dump/verify a write-ahead job log: "
+                                   "segments, checksums, lease, per-job "
+                                   "record counts, replay summary")
+    p.add_argument("--dir", default=None,
+                   help="WAL directory (default: $DTPU_WAL_DIR)")
+    p.add_argument("--job", default=None,
+                   help="filter the per-job listing to ids containing "
+                        "this substring")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON report instead of the pretty listing")
+    p.set_defaults(fn=cmd_wal)
 
     p = sub.add_parser("trace", help="read a job's distributed trace "
                                      "from a server's flight recorder")

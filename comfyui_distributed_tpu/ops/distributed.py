@@ -254,6 +254,12 @@ class DistributedCollector(Op):
             ledger.create_job(multi_job_id,
                               {wid: wid for wid in worker_ids},
                               kind="image")
+        # crash recovery (durability plane): slices that completed (and
+        # spilled) before the old master died are blended from disk,
+        # never re-rendered; a missing payload downgrades the unit to
+        # pending HERE, before the drain decides what is outstanding
+        recovered_slices = ledger.load_payloads(multi_job_id) \
+            if ledger is not None else {}
         captured_span = trace_mod.capture_span_context()
 
         async def drain():
@@ -299,7 +305,29 @@ class DistributedCollector(Op):
                     return await ledger.redispatch(multi_job_id,
                                                    list(units), owner)
 
+            # crash recovery: pending units of a recovered job were
+            # dispatched by the DEAD master — their owners will never
+            # send.  The master cannot regenerate another slice in-op,
+            # so this is redispatch-or-partial, decided NOW instead of
+            # after the no-progress timeout.
+            stale = ledger.take_recovered_lost(multi_job_id) \
+                if can_recover else {}
             try:
+                for owner, units in stale.items():
+                    if policy == "fail":
+                        raise cluster_mod.ClusterFaultError(
+                            f"recovered job {multi_job_id} lost slices "
+                            f"{sorted(units)} with the old master "
+                            f"({C.FAULT_POLICY_ENV}=fail)")
+                    log(f"collector: recovered job {multi_job_id}: "
+                        f"re-issuing slices {sorted(units)} stranded "
+                        f"on {owner}")
+                    if await recover_units(units, owner, "reassign"):
+                        deadline = min(max(
+                            deadline,
+                            loop.time() + C.JOB_COMPLETION_TIMEOUT / 2),
+                            hard_deadline)
+                        last_progress = loop.time()
                 while True:
                     if ledger is not None:
                         if not ledger.pending(multi_job_id):
@@ -400,7 +428,22 @@ class DistributedCollector(Op):
                     if item.get("is_last"):
                         done.add(wid)
                         if ledger is not None:
-                            ledger.check_in(multi_job_id, cfg_id, cfg_id)
+                            # spill the whole slice with its batch keys
+                            # so a recovered master re-orders the images
+                            # exactly as this drain would have; off the
+                            # loop — a WAL-backed check-in compresses
+                            # the images and fsyncs
+                            slot = results.get(wid, {})
+                            keys = sorted(slot)
+                            await loop.run_in_executor(
+                                None, lambda: ledger.check_in(
+                                    multi_job_id, cfg_id, cfg_id,
+                                    payload=(
+                                        [np.asarray(slot[k], np.float32)
+                                         for k in keys],
+                                        {"form": "slice", "wid": wid,
+                                         "keys": [list(k)
+                                                  for k in keys]})))
             finally:
                 # drop the queue so late arrivals can't accumulate forever
                 await ctx.job_store.remove_job(multi_job_id)
@@ -432,6 +475,13 @@ class DistributedCollector(Op):
                         f"lost slices {summary['pending_units']} "
                         f"(policy={policy})")
 
+        # blend the recovered slices back in under their original wire
+        # labels (fresh arrivals — a redispatched redo — win over disk)
+        for u, (tensors, meta) in recovered_slices.items():
+            wid = str(meta.get("wid", u))
+            slot = results.setdefault(wid, {})
+            for k, t in zip(meta.get("keys", []), tensors):
+                slot.setdefault(tuple(k), t)
         ordered = [master_images]
         for wid in sorted(results, key=lambda w: (parse_worker_index(w), w)):
             imgs = [results[wid][i] for i in sorted(results[wid])]

@@ -601,11 +601,30 @@ class UltimateSDUpscaleDistributed(Op):
 
         try:
             refined: Dict[int, np.ndarray] = {}
+            if ledger is not None:
+                # crash recovery (durability plane): units completed
+                # before the old master died blend straight from their
+                # spilled payloads — never re-refined — and the master's
+                # own range shrinks to what is actually still pending
+                for u, (tensors, meta) in ledger.load_payloads(
+                        multi_job_id).items():
+                    i = int(u)
+                    if meta.get("form") == "tile":
+                        refined[i] = self._worker_tile_to_window(
+                            {**meta, "tensor": tensors[0]},
+                            all_tiles[i], p, (w, h))
+                    else:
+                        refined[i] = np.asarray(tensors[0])
+                pending_mine = {int(x) for x in ledger.pending(
+                    multi_job_id, owner="master")}
+                mine = [i for i in mine if int(i) in pending_mine]
             if mine:
                 out = refine_units(mine)
                 for i, window in out.items():
                     if ledger is None \
-                            or ledger.check_in(multi_job_id, i, "master"):
+                            or ledger.check_in(
+                                multi_job_id, i, "master",
+                                payload=([window], {"form": "window"})):
                         refined[i] = window
 
             if active_workers and ctx.job_store is not None:
@@ -650,8 +669,10 @@ class UltimateSDUpscaleDistributed(Op):
                                                 to="master"):
                                 out = refine_units(moved)
                             for i, window in out.items():
-                                if ledger.check_in(multi_job_id, i,
-                                                   "master"):
+                                if ledger.check_in(
+                                        multi_job_id, i, "master",
+                                        payload=([window],
+                                                 {"form": "window"})):
                                     refined[i] = window
                     else:
                         log(f"tiled upscale master: units {pending} "
@@ -762,15 +783,69 @@ class UltimateSDUpscaleDistributed(Op):
                         ledger.unmark_hedged(multi_job_id, list(units))
                     return
                 for idx, window in out.items():
-                    if ledger.check_in(multi_job_id, idx, "master"):
+                    # off the loop: a WAL-backed check-in spills the
+                    # payload + fsyncs the record
+                    if await loop.run_in_executor(
+                            None, lambda i=idx, w=window: ledger.check_in(
+                                multi_job_id, i, "master",
+                                payload=([w], {"form": "window"}))):
                         collected[int(idx)] = {"window_tensor": window}
+
+            async def handle_lost(owner, units, what):
+                """Move a lost participant's units: redispatch the exact
+                list to a healthy worker when the orchestrator (or crash
+                recovery) registered a callback, else race a
+                master-local refine through first-wins check-in.
+                Returns True when a redispatch went out (the deadline
+                gets extended for the replacement)."""
+                redone = False
+                if ledger.has_redispatcher(multi_job_id):
+                    with trace_mod.use_span(captured_span), \
+                            trace_mod.span("reassign",
+                                           job=multi_job_id,
+                                           units=len(units),
+                                           lost=str(owner),
+                                           to="remote") as rsp:
+                        redone = await ledger.redispatch(
+                            multi_job_id, sorted(units), owner)
+                        if rsp is not None and not redone:
+                            rsp.attrs["to"] = "none"
+                if not redone and refine_window is not None:
+                    moved = ledger.reassign(multi_job_id,
+                                            sorted(units), "master")
+                    if moved:
+                        recovery.append(loop.create_task(
+                            recover(moved, what, owner)))
+                return redone
 
             def finished() -> bool:
                 if ledger is not None:
                     return not ledger.pending(multi_job_id)
                 return len(done) >= num_workers
 
+            # crash recovery: a recovered job's pending non-master units
+            # were dispatched by the DEAD master — their owners are
+            # alive but will never (re)send.  Treat them as lost NOW
+            # (redispatch the exact unit lists, else master-local),
+            # instead of waiting out the no-progress timeout.
+            stale = ledger.take_recovered_lost(multi_job_id) \
+                if ledger is not None and policy != "partial" else {}
             try:
+                for owner, units in stale.items():
+                    if policy == "fail":
+                        raise cluster_mod.ClusterFaultError(
+                            f"recovered job {multi_job_id} lost units "
+                            f"{sorted(units)} with the old master "
+                            f"({C.FAULT_POLICY_ENV}=fail)")
+                    log(f"tiled upscale master: recovered job "
+                        f"{multi_job_id}: re-issuing units "
+                        f"{sorted(units)} stranded on {owner}")
+                    if await handle_lost(owner, units, "reassign"):
+                        deadline = min(max(
+                            deadline, loop.time()
+                            + C.TILE_COLLECTION_TIMEOUT / 2),
+                            hard_deadline)
+                        last_progress = loop.time()
                 while not finished():
                     recovery = [t for t in recovery if not t.done()]
                     remaining = deadline - loop.time()
@@ -802,21 +877,8 @@ class UltimateSDUpscaleDistributed(Op):
                             log(f"tiled upscale master: worker {owner} "
                                 f"lease expired; recovering units "
                                 f"{sorted(units)}")
-                            redone = False
-                            if ledger.has_redispatcher(multi_job_id):
-                                with trace_mod.use_span(captured_span), \
-                                        trace_mod.span(
-                                            "reassign",
-                                            job=multi_job_id,
-                                            units=len(units),
-                                            lost=str(owner),
-                                            to="remote") as rsp:
-                                    redone = await ledger.redispatch(
-                                        multi_job_id, sorted(units),
-                                        owner)
-                                    if rsp is not None and not redone:
-                                        rsp.attrs["to"] = "none"
-                            if redone:
+                            if await handle_lost(owner, units,
+                                                 "reassign"):
                                 # give the replacement worker room; the
                                 # post-drain fallback still backstops it
                                 deadline = min(max(
@@ -824,13 +886,6 @@ class UltimateSDUpscaleDistributed(Op):
                                     + C.TILE_COLLECTION_TIMEOUT / 2),
                                     hard_deadline)
                                 last_progress = loop.time()
-                            elif refine_window is not None:
-                                moved = ledger.reassign(
-                                    multi_job_id, sorted(units), "master")
-                                if moved:
-                                    recovery.append(loop.create_task(
-                                        recover(moved, "reassign",
-                                                owner)))
                     if hedge_on:
                         overdue = ledger.overdue_units(multi_job_id)
                         units = sorted(u for u, o in overdue.items()
@@ -864,9 +919,24 @@ class UltimateSDUpscaleDistributed(Op):
                     wid = str(item["worker_id"])
                     if registry is not None:
                         registry.touch(wid)
-                    if ledger is None \
-                            or ledger.check_in(multi_job_id, idx, wid):
+                    if ledger is None:
                         collected[idx] = item
+                    else:
+                        # off the loop: the WAL-backed check-in
+                        # compresses + spills the tile and fsyncs
+                        won = await loop.run_in_executor(
+                            None, lambda: ledger.check_in(
+                                multi_job_id, idx, wid,
+                                payload=([item["tensor"]], {
+                                    "form": "tile",
+                                    "x": item["x"], "y": item["y"],
+                                    "extracted_width":
+                                        item["extracted_width"],
+                                    "extracted_height":
+                                        item["extracted_height"],
+                                    "padding": item["padding"]})))
+                        if won:
+                            collected[idx] = item
                     if item.get("is_last"):
                         done.add(wid)
             finally:

@@ -339,27 +339,95 @@ class WorkLedger:
         self._jobs: Dict[str, Dict[str, Any]] = {}
         self._redispatch: Dict[str, Callable] = {}
         self._completed: deque = deque(maxlen=C.LEDGER_COMPLETED_KEPT)
+        # durability plane (ISSUE 7): when a WAL is attached, every
+        # ownership transition appends a record, winning check-ins spill
+        # their payload first, and create_job merges the crash-recovered
+        # unit states so a resumed job re-refines ONLY unfinished units
+        self._wal = None
+        self._unit_store = None
+        self._recovered_jobs: Dict[str, Dict[str, Any]] = {}
+
+    def attach_wal(self, wal, unit_store,
+                   recovered_jobs: Optional[Dict[str, Any]] = None) -> None:
+        """Wire the durability plane in (runtime/durable.py).
+        ``recovered_jobs`` is the replayed WAL state keyed by job id —
+        consumed (and cleared per job) by :meth:`create_job`."""
+        self._wal = wal
+        self._unit_store = unit_store
+        if recovered_jobs is not None:
+            self._recovered_jobs = dict(recovered_jobs)
+
+    def _wal_append(self, rtype: str, **fields) -> None:
+        """Append an ownership-transition record; fencing errors
+        PROPAGATE (a deposed master must stop mutating job state), any
+        other failure degrades to in-memory-only."""
+        if self._wal is None:
+            return
+        from comfyui_distributed_tpu.runtime import durable as dur
+        try:
+            self._wal.append(rtype, **fields)
+        except (dur.FencedError, dur.WalCrashedError):
+            raise
+        except Exception as e:  # noqa: BLE001 - durability is best-effort
+            debug_log(f"ledger: wal append {rtype} failed: {e}")
 
     # -- lifecycle ------------------------------------------------------------
 
     def create_job(self, job_id: str, owners: Dict[Any, str],
                    kind: str = "tile") -> None:
+        jid = str(job_id)
+        recovered = self._recovered_jobs.pop(jid, None)
+        rec_units = (recovered or {}).get("units", {})
         now = time.monotonic()
+        preloaded = []
         with self._lock:
-            self._jobs[str(job_id)] = {
+            units = {}
+            for u, o in owners.items():
+                ru = rec_units.get(str(u))
+                if ru is not None and ru.get("done") \
+                        and self._unit_store is not None \
+                        and ru.get("spilled") \
+                        and self._unit_store.has(jid, u):
+                    # completed before the crash AND its payload
+                    # survived: never re-refined, blended from the spill
+                    units[u] = {"owner": str(ru.get("by") or o),
+                                "state": "done", "attempts": 1,
+                                "hedged": False, "hedge_owner": None,
+                                "done_by": str(ru.get("by") or o)}
+                    preloaded.append(u)
+                else:
+                    # pending (or done-but-payload-lost: recomputed —
+                    # deterministic seeds make the redo bit-identical);
+                    # a recovered reassignment keeps its LAST owner
+                    owner = str(ru["owner"]) if ru is not None \
+                        and not ru.get("done") and ru.get("owner") \
+                        else str(o)
+                    units[u] = {"owner": owner, "state": "pending",
+                                "attempts": 1, "hedged": False,
+                                "hedge_owner": None, "done_by": None}
+            self._jobs[jid] = {
                 "kind": kind,
                 "created_at": now,
-                "units": {u: {"owner": str(o), "state": "pending",
-                              "attempts": 1, "hedged": False,
-                              "hedge_owner": None, "done_by": None}
-                          for u, o in owners.items()},
+                "units": units,
                 # per-owner last-activity clock feeding the moving
                 # per-unit latency estimate (EMA of check-in intervals)
                 "owner_last": {},
                 "latency_ema": None,
                 "reassigned": 0,
                 "hedged": 0,
+                "recovered": recovered is not None,
+                "recovered_handled": False,
+                "preloaded": list(preloaded),
             }
+        if preloaded:
+            log(f"ledger: job {jid} recovered with {len(preloaded)}/"
+                f"{len(owners)} unit(s) already durable — only the "
+                f"remainder will be re-refined")
+            trace_mod.GLOBAL_COUNTERS.bump("wal_preloaded_units",
+                                           len(preloaded))
+        self._wal_append("job_create", job=jid, kind=kind,
+                         owners={str(u): str(o)
+                                 for u, o in owners.items()})
 
     def has_job(self, job_id: str) -> bool:
         with self._lock:
@@ -384,37 +452,76 @@ class WorkLedger:
                     if rec["state"] != "done"),
                 "reassigned_units": job["reassigned"],
                 "hedged_units": job["hedged"],
+                "recovered": bool(job.get("recovered")),
+                "preloaded_units": len(job.get("preloaded") or ()),
                 "duration_s": round(time.monotonic() - job["created_at"],
                                     4),
                 "finished_at": time.time(),
             }
             self._completed.append(summary)
-            return summary
+        self._wal_append("job_finish", job=jid)
+        if self._unit_store is not None:
+            # the finish record is durable: the spilled payloads (and
+            # this job's idempotency keys, dropped by the tracker) are
+            # no longer needed for recovery
+            self._unit_store.drop_job(jid)
+        return summary
 
     # -- check-in (exactly-once) ----------------------------------------------
 
-    def check_in(self, job_id: str, unit: Any, worker_id: str) -> bool:
+    def check_in(self, job_id: str, unit: Any, worker_id: str,
+                 payload: Optional[tuple] = None) -> bool:
         """Record a unit completion.  Returns True exactly once per
         unit — the first completion wins; retried POSTs and hedge
         losers get False and are dropped at the blend.  Jobs the ledger
         never saw (worker side, SPMD mode) always return True so the
-        ledger is opt-in."""
+        ledger is opt-in.
+
+        ``payload`` (``(tensors, meta)``, durability plane) is spilled
+        to the unit store BEFORE the check-in record is appended, so a
+        recovered master blends this unit from disk instead of
+        re-refining it; a crash between spill and append leaves an
+        orphan payload that replay ignores."""
         now = time.monotonic()
+        status = self._check_in_locked(job_id, unit, worker_id, now)
+        if status == "dup":
+            return False
+        if status == "untracked":
+            return True
+        if self._wal is not None:
+            spilled = False
+            if payload is not None and self._unit_store is not None:
+                tensors, meta = payload
+                try:
+                    self._unit_store.put(str(job_id), unit, tensors,
+                                         meta)
+                    spilled = True
+                except OSError as e:
+                    debug_log(f"ledger: unit spill {job_id}/{unit} "
+                              f"failed ({e}); unit will be recomputed "
+                              f"on recovery")
+            self._wal_append("unit_checkin", job=str(job_id),
+                             unit=str(unit), by=str(worker_id),
+                             spilled=spilled)
+        return True
+
+    def _check_in_locked(self, job_id: str, unit: Any, worker_id: str,
+                         now: float) -> str:
         with self._lock:
             job = self._jobs.get(str(job_id))
             if job is None:
-                return True
+                return "untracked"
             rec = job["units"].get(unit)
             if rec is None:
                 # unit the ledger didn't plan (shouldn't happen; accept
                 # rather than drop real work)
                 debug_log(f"ledger: unplanned unit {unit!r} for "
                           f"{job_id}")
-                return True
+                return "untracked"
             if rec["state"] == "done":
                 trace_mod.GLOBAL_COUNTERS.bump(
                     "cluster_duplicate_checkins")
-                return False
+                return "dup"
             rec["state"] = "done"
             rec["done_by"] = str(worker_id)
             if rec["hedge_owner"]:
@@ -434,7 +541,7 @@ class WorkLedger:
             job["latency_ema"] = sample if ema is None \
                 else 0.7 * ema + 0.3 * sample
             job["owner_last"][str(worker_id)] = now
-            return True
+            return "won"
 
     # -- queries --------------------------------------------------------------
 
@@ -509,6 +616,9 @@ class WorkLedger:
         if moved:
             trace_mod.GLOBAL_COUNTERS.bump("cluster_reassigned_units",
                                            len(moved))
+            self._wal_append("unit_reassign", job=str(job_id),
+                             units=[str(u) for u in moved],
+                             to=str(new_owner))
         return moved
 
     def mark_hedged(self, job_id: str, units: List[Any],
@@ -536,6 +646,10 @@ class WorkLedger:
             job["hedged"] += len(hedged)
         if hedged:
             trace_mod.GLOBAL_COUNTERS.bump("cluster_hedges", len(hedged))
+            self._wal_append("unit_hedge", job=str(job_id),
+                             units=[str(u) for u in hedged],
+                             by=(None if hedge_owner is None
+                                 else str(hedge_owner)))
         return hedged
 
     def is_hedged(self, job_id: str, unit: Any) -> bool:
@@ -599,6 +713,61 @@ class WorkLedger:
                                              job["created_at"])
                 if now - last > threshold:
                     out[u] = rec["owner"]
+            return out
+
+    # -- crash recovery (durability plane) ------------------------------------
+
+    def load_payloads(self, job_id: str) -> Dict[Any, tuple]:
+        """Spilled ``(tensors, meta)`` payloads for this job's preloaded
+        (recovered-done) units — the blend inputs that replace a
+        re-refine.  A unit whose file went unreadable since create_job
+        is downgraded back to pending here, so the drain recomputes it
+        instead of blending a hole."""
+        jid = str(job_id)
+        with self._lock:
+            job = self._jobs.get(jid)
+            preloaded = list(job.get("preloaded") or ()) if job else []
+        if not preloaded or self._unit_store is None:
+            return {}
+        out: Dict[Any, tuple] = {}
+        lost = []
+        for u in preloaded:
+            payload = self._unit_store.get(jid, u)
+            if payload is None:
+                lost.append(u)
+            else:
+                out[u] = payload
+        if lost:
+            with self._lock:
+                job = self._jobs.get(jid)
+                if job is not None:
+                    for u in lost:
+                        rec = job["units"].get(u)
+                        if rec is not None:
+                            rec["state"] = "pending"
+                            rec["done_by"] = None
+                    job["preloaded"] = [u for u in job["preloaded"]
+                                        if u not in lost]
+            log(f"ledger: {len(lost)} recovered unit payload(s) of "
+                f"{jid} unreadable; recomputing them")
+        return out
+
+    def take_recovered_lost(self, job_id: str) -> Dict[str, List[Any]]:
+        """Once per recovered job: the pending units whose owner is a
+        participant from the DEAD epoch (any non-master owner — their
+        dispatches died with the old master), grouped by owner.  The
+        drains treat these exactly like lease-expired owners:
+        redispatch with explicit unit lists, else master-local refine."""
+        with self._lock:
+            job = self._jobs.get(str(job_id))
+            if job is None or not job.get("recovered") \
+                    or job.get("recovered_handled"):
+                return {}
+            job["recovered_handled"] = True
+            out: Dict[str, List[Any]] = {}
+            for u, rec in job["units"].items():
+                if rec["state"] != "done" and rec["owner"] != "master":
+                    out.setdefault(rec["owner"], []).append(u)
             return out
 
     # -- redispatch (orchestrator-registered) ---------------------------------

@@ -36,19 +36,61 @@ class JobStore:
         self._tile_seen: Dict[str, Set[str]] = {}
         self._lock = asyncio.Lock()
         self._tile_lock = asyncio.Lock()
+        # durability plane (ISSUE 7): with a WAL attached, accepted keys
+        # are appended (and fsync'd, under DTPU_WAL_SYNC=always) BEFORE
+        # the 200 ack — so an acked-but-dropped upload replayed AFTER a
+        # master restart is still recognized and deduped, instead of
+        # double-inserting into the rebuilt queue (the PR 4 note: keys
+        # used to die with the queue)
+        self._wal = None
 
-    @staticmethod
-    def _dedupe(seen: Dict[str, Set[str]], job_id: str,
-                idem_key: Optional[str]) -> bool:
-        """True when this key was already accepted for the job."""
+    def attach_wal(self, wal, recovered_idem: Optional[Dict[str, Any]]
+                   = None) -> None:
+        """Wire the write-ahead log in and reseed the replayed keys
+        (``{"image": {job: [keys]}, "tile": {...}}``)."""
+        self._wal = wal
+        if recovered_idem:
+            for job, keys in (recovered_idem.get("image") or {}).items():
+                self._seen.setdefault(str(job), set()).update(
+                    str(k) for k in keys)
+            for job, keys in (recovered_idem.get("tile") or {}).items():
+                self._tile_seen.setdefault(str(job), set()).update(
+                    str(k) for k in keys)
+
+    def _dedupe(self, seen: Dict[str, Set[str]], job_id: str,
+                idem_key: Optional[str]) -> tuple:
+        """``(duplicate, fresh_key)`` — pure bookkeeping under the
+        caller's lock; the WAL append for a fresh key happens OUTSIDE
+        the lock (and off the event loop) via :meth:`_log_idem`."""
         if not idem_key:
-            return False
+            return False, None
         keys = seen.setdefault(job_id, set())
         if idem_key in keys:
             trace_mod.GLOBAL_COUNTERS.bump("idem_dropped")
-            return True
+            return True, None
         keys.add(idem_key)
-        return False
+        return False, idem_key
+
+    def _log_idem(self, scope: str, job_id: str, idem_key: str) -> None:
+        """Durably record an accepted key (fsync per DTPU_WAL_SYNC)
+        BEFORE the upload is acked; fencing errors propagate so a
+        deposed master's data plane stops acking."""
+        from comfyui_distributed_tpu.runtime import durable as dur
+        try:
+            self._wal.append("idem", scope=scope, job=str(job_id),
+                             key=str(idem_key))
+        except (dur.FencedError, dur.WalCrashedError):
+            raise
+        except Exception as e:  # noqa: BLE001 - best-effort
+            from comfyui_distributed_tpu.utils.logging import debug_log
+            debug_log(f"jobstore: idem wal append failed: {e}")
+
+    async def _log_idem_off_loop(self, scope: str, job_id: str,
+                                 fresh_key: Optional[str]) -> None:
+        if fresh_key is None or self._wal is None:
+            return
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self._log_idem(scope, job_id, fresh_key))
 
     # --- image jobs (reference distributed.py:1125-1218) -------------------
 
@@ -79,8 +121,11 @@ class JobStore:
                 if require_existing:
                     return False
                 q = self._jobs[multi_job_id] = asyncio.Queue()
-            if self._dedupe(self._seen, multi_job_id, idem_key):
-                return True
+            dup, fresh_key = self._dedupe(self._seen, multi_job_id,
+                                          idem_key)
+        if dup:
+            return True
+        await self._log_idem_off_loop("image", multi_job_id, fresh_key)
         await q.put(item)
         return True
 
@@ -124,8 +169,11 @@ class JobStore:
                 if require_existing:
                     return False
                 q = self._tile_jobs[multi_job_id] = asyncio.Queue()
-            if self._dedupe(self._tile_seen, multi_job_id, idem_key):
-                return True
+            dup, fresh_key = self._dedupe(self._tile_seen, multi_job_id,
+                                          idem_key)
+        if dup:
+            return True
+        await self._log_idem_off_loop("tile", multi_job_id, fresh_key)
         await q.put(item)
         return True
 

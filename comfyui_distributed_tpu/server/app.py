@@ -130,6 +130,9 @@ class ServerState:
             except Exception as e:  # noqa: BLE001 - config is optional
                 debug_log(f"cluster seed skipped: {e}")
         self.fault_inject = cluster_mod.fault_injection()
+        # worker->master lease renewal (set by serve(); the rehome
+        # endpoint retargets it when a standby master takes over)
+        self.heartbeat: Optional[Any] = None
         from comfyui_distributed_tpu.runtime.health import HealthPoller
         self.health = HealthPoller(config_path=config_path,
                                    manager=self.manager,
@@ -177,6 +180,20 @@ class ServerState:
         # encode/disk.  FIFO -> history lands in execution order.
         self._finalize_q: "queue.Queue" = queue.Queue()
         self._finalize_pending = 0
+        # durability plane (ISSUE 7): with DTPU_WAL_DIR set, a master
+        # acquires (or, under DTPU_STANDBY=1, watches) the file lease,
+        # replays the write-ahead job log, and preloads the recovered
+        # ledger/idempotency state BEFORE the exec thread can pop
+        # anything.  The interrupted prompts themselves are re-enqueued
+        # by resume_recovered() once the server loop is up.
+        from comfyui_distributed_tpu.runtime import durable as durable_mod
+        try:
+            self.durable = durable_mod.DurableMaster.attach(self)
+        except durable_mod.WalError as e:
+            # a held lease (second active master) must fail LOUDLY, not
+            # boot a split-brain — but a standby construction never hits
+            # this (it only watches)
+            raise RuntimeError(f"durable master startup refused: {e}")
         self._exec_started = bool(start_exec_thread)
         if start_exec_thread:
             t = threading.Thread(target=self._exec_loop, daemon=True,
@@ -219,7 +236,9 @@ class ServerState:
     def enqueue_prompt(self, prompt: Dict[str, Any], client_id: str,
                        extra_data: Optional[Dict[str, Any]] = None,
                        trace_parent: Optional[tuple] = None,
-                       trace_span: Any = None) -> str:
+                       trace_span: Any = None,
+                       pid: Optional[str] = None,
+                       _recovered: bool = False) -> str:
         """Queue one prompt.  Every job gets a request-scoped trace: a
         ``job`` root span that lives from enqueue to finalize and lands
         in the flight recorder under the prompt id.  ``trace_parent`` is
@@ -229,7 +248,10 @@ class ServerState:
         already-open span to adopt as the job span (the master's fan-out
         root, so its dispatch/collect children and the local execution
         share one tree)."""
-        pid = f"p_{int(time.time() * 1000)}_{next(self._id_counter)}"
+        # `pid` override = crash recovery re-enqueueing an interrupted
+        # prompt under its ORIGINAL id, so clients polling /history find
+        # it on the restarted/stand-in master
+        pid = pid or f"p_{int(time.time() * 1000)}_{next(self._id_counter)}"
         sp = trace_span
         if sp is None:
             tid, par = trace_parent if trace_parent else (None, None)
@@ -258,6 +280,14 @@ class ServerState:
                                 "sig": sig,
                                 "span": sp,
                                 "t_enq": time.perf_counter()})
+        # write-ahead: the admission record is durable BEFORE the
+        # prompt_id reaches the client (a crash after the append but
+        # before the response re-runs the prompt — at-least-once at the
+        # prompt level, exactly-once per unit through the ledger).
+        # Recovery re-enqueues suppress the append: their record (the
+        # original admission) is already in the log.
+        if self.durable is not None and not _recovered:
+            self.durable.log_enqueue(pid, prompt, client_id, extra_data)
         self._queue_event.set()
         return pid
 
@@ -418,6 +448,14 @@ class ServerState:
                 if k > 1:
                     entry["coalesced"] = k
                 self._history[item["id"]] = entry
+        if self.durable is not None:
+            # the completion record closes the admission record: a
+            # crash BEFORE this point re-runs the prompt on recovery
+            # (deterministic seeds make the redo bit-identical), after
+            # it the prompt is settled history
+            for item in group:
+                self.durable.log_exec_done(
+                    item["id"], "ok" if err is None else "error")
         for item in group:
             self._drop_tile_queues(item["prompt"])
         # seal each prompt's trace: end the job span, commit to the
@@ -490,6 +528,17 @@ class ServerState:
         debug_log(f"group {group[0]['id']} (x{k}) done in "
                   f"{time.perf_counter() - t0:.2f}s")
 
+    # --- crash recovery (durability plane) ----------------------------------
+
+    def resume_recovered(self) -> int:
+        """Re-enqueue the prompts a crash interrupted (replayed from the
+        WAL at construction).  Called from on_startup — by then the
+        server loop exists, so the resumed upscale jobs' tile queues and
+        collector drains work; idempotent."""
+        if self.durable is None:
+            return 0
+        return self.durable.resume()
+
     # --- graceful drain -----------------------------------------------------
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -546,6 +595,13 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
 
     async def on_startup(app):
         state.loop = asyncio.get_running_loop()
+        # recovery resume off the event loop: it health-polls the
+        # workers and may enqueue several prompts.  Needs state.port
+        # (the recovery redispatch graphs embed this master's URL) —
+        # serve() sets it before run_app; embedded/test servers with a
+        # late-bound port call resume_recovered() themselves.
+        if state.durable is not None and state.port is not None:
+            await state.loop.run_in_executor(None, state.resume_recovered)
 
     async def on_cleanup(app):
         # graceful drain: refuse new prompts, let the in-flight group and
@@ -553,6 +609,8 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         # the exec thread used to be a daemon that died mid-job here
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, state.drain)
+        if state.durable is not None:
+            state.durable.close()
         await net_mod.cleanup_client_session()
 
     app.on_startup.append(on_startup)
@@ -640,6 +698,12 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         from comfyui_distributed_tpu.utils.trace import (
             GLOBAL_NODES, GLOBAL_PHASES, GLOBAL_TRACES,
             counters_snapshot, pipeline_snapshot, tracing_enabled)
+        # wal stats list segment files and may contend with an
+        # append's fsync/rotation under the WAL lock — off the loop
+        dur_stats = {"enabled": False}
+        if state.durable is not None:
+            dur_stats = await asyncio.get_running_loop() \
+                .run_in_executor(None, state.durable.stats)
         return web.json_response({**state.metrics,
                                   "phases": GLOBAL_PHASES.snapshot(),
                                   # per-node-type op latency histograms
@@ -675,6 +739,9 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                       "hedge_armed":
                                           cluster_mod.hedge_armed(),
                                   },
+                                  # durability plane: WAL size/sync-lag
+                                  # gauges, lease holder + epoch
+                                  "durability": dur_stats,
                                   # resource telemetry: current gauges +
                                   # bounded ring-series stats (device
                                   # memory, RSS, utilization, queue)
@@ -765,6 +832,40 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                sum(1 for w in cl_workers if w["state"] == st))
               for st in (cluster_mod.HEALTHY, cluster_mod.SUSPECT,
                          cluster_mod.DEAD, cluster_mod.UNKNOWN)]))
+        if state.durable is not None:
+            # WAL size/lag + lease gauges (satellite: the durability
+            # plane is scrapeable next to everything else).  stats()
+            # lists segment files — keep it off the event loop.
+            ds = await loop.run_in_executor(None, state.durable.stats)
+            wal = ds.get("wal") or {}
+            lease = ds.get("lease") or {}
+            extra.extend([
+                ("dtpu_wal_records_total", "counter",
+                 "Records appended to the write-ahead job log.",
+                 [({}, wal.get("records_appended", 0))]),
+                ("dtpu_wal_bytes", "gauge",
+                 "Live WAL segment bytes on disk.",
+                 [({}, wal.get("bytes", 0))]),
+                ("dtpu_wal_segments", "gauge",
+                 "Live WAL segment files.",
+                 [({}, wal.get("segments", 0))]),
+                ("dtpu_wal_unsynced_records", "gauge",
+                 "Appended records not yet fsync'd (sync lag).",
+                 [({}, wal.get("unsynced_records", 0))]),
+                ("dtpu_wal_last_sync_age_seconds", "gauge",
+                 "Seconds since the last WAL fsync.",
+                 [({}, wal.get("last_sync_age_s", 0) or 0)]),
+                ("dtpu_master_epoch", "gauge",
+                 "This process's master-lease epoch (fencing token); "
+                 "0 = standby.",
+                 [({}, ds.get("epoch", 0))]),
+                ("dtpu_master_lease_remaining_seconds", "gauge",
+                 "Seconds until the observed master lease expires.",
+                 [({}, max(lease.get("expires_in_s", 0) or 0, 0))]),
+                ("dtpu_master_takeovers_total", "counter",
+                 "Lease takeovers performed by this process.",
+                 [({}, ds.get("takeovers", 0))]),
+            ])
         # current resource gauges (unlabelled = this process); the
         # worker_id-labelled fleet view lives on /cluster/metrics.prom
         extra.extend(resource_mod.resource_prom_families(
@@ -957,6 +1058,68 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         if isinstance(data.get("resources"), dict):
             state.cluster.update_resources(str(wid), data["resources"])
         return ok(out)
+
+    async def durability_info(request):
+        """Durability plane snapshot: lease holder/epoch, WAL size and
+        sync lag, recovery counters — None-shaped when DTPU_WAL_DIR is
+        unset."""
+        if state.durable is None:
+            return web.json_response({"enabled": False})
+        stats = await asyncio.get_running_loop().run_in_executor(
+            None, state.durable.stats)
+        return web.json_response(stats)
+
+    async def takeover(request):
+        """Promote this server to master: acquire the lease (allowed
+        when it is expired, or ``{"force": true}``), replay the shared
+        WAL, resume the interrupted prompts, re-home workers.  The
+        standby's own lease watcher calls the same path automatically on
+        expiry; this endpoint is the operator's manual trigger."""
+        from comfyui_distributed_tpu.runtime import durable as durable_mod
+        if state.durable is None:
+            return web.json_response(
+                {"error": f"durability off (set {C.WAL_DIR_ENV})"},
+                status=409)
+        data = await request.json() if request.can_read_body else {}
+        loop = asyncio.get_running_loop()
+        try:
+            out = await loop.run_in_executor(
+                None, lambda: state.durable.takeover(
+                    force=bool(data.get("force"))))
+        except durable_mod.LeaseHeldError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return ok(out)
+
+    async def rehome(request):
+        """Worker side of master failover: a new master announces
+        itself; this worker retargets its lease heartbeat (and registers
+        there immediately so the new registry sees it without waiting
+        for a probe)."""
+        data = await request.json()
+        url = str(data.get("master_url", "")).rstrip("/")
+        if not url:
+            return web.json_response({"error": "missing master_url"},
+                                     status=400)
+        wid = str(data.get("worker_id", "")
+                  or os.environ.get(C.WORKER_ID_ENV, ""))
+        os.environ[C.MASTER_URL_ENV] = url
+        if wid:
+            os.environ.setdefault(C.WORKER_ID_ENV, wid)
+        hb = state.heartbeat
+        if hb is not None:
+            hb.master_url = url
+        elif wid:
+            hb = state.heartbeat = cluster_mod.HeartbeatSender(
+                url, wid, port=state.port)
+            hb.start()
+        beat = False
+        if hb is not None:
+            loop = asyncio.get_running_loop()
+            beat = await loop.run_in_executor(None, hb.beat_once)
+        log(f"re-homed to master {url}"
+            + ("" if beat else " (first heartbeat pending)"))
+        return ok({"master_url": url, "heartbeat": hb is not None,
+                   "registered": beat})
 
     def _self_sample() -> Dict[str, Any]:
         """This process's resource sample for the metrics surfaces: the
@@ -1372,9 +1535,12 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                            "fanout": True})
 
                 async def enqueue_graph(g):
-                    return state.enqueue_prompt(g.to_api_format(),
-                                                client_id, extra_data,
-                                                trace_span=root)
+                    # off the loop: with durability on, admission
+                    # appends+fsyncs a WAL record before returning
+                    api = g.to_api_format()
+                    return await asyncio.get_running_loop() \
+                        .run_in_executor(None, lambda: state.enqueue_prompt(
+                            api, client_id, extra_data, trace_span=root))
 
                 host = cfg.get("master", {}).get("host") or "127.0.0.1"
                 master_url = f"http://{host}:{state.port or 8288}"
@@ -1403,8 +1569,12 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                     "workers": out["workers"],
                     "failed_workers": out.get("failed", []),
                 })
-            pid = state.enqueue_prompt(prompt, client_id, extra_data,
-                                       trace_parent=trace_parent)
+            # off the loop: the durable admission record fsyncs before
+            # the prompt_id is acked to the client
+            pid = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: state.enqueue_prompt(
+                    prompt, client_id, extra_data,
+                    trace_parent=trace_parent))
         except QueueFullError as e:
             # backpressure (DTPU_MAX_QUEUE): tell the client how deep the
             # queue is so its retry policy can back off intelligently
@@ -1493,6 +1663,9 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/distributed/cluster/metrics.prom", cluster_metrics_prom)
     r.add_post("/distributed/register", cluster_register)
     r.add_post("/distributed/heartbeat", cluster_heartbeat)
+    r.add_get("/distributed/durability", durability_info)
+    r.add_post("/distributed/takeover", takeover)
+    r.add_post("/distributed/rehome", rehome)
     r.add_get("/distributed/workers_status", workers_status)
     r.add_post("/distributed/cluster/clear_memory", cluster_clear_memory)
     r.add_post("/distributed/cluster/interrupt", cluster_interrupt)
@@ -1572,7 +1745,7 @@ def serve(host: str = "0.0.0.0", port: int = 8288,
         # renew this worker's lease at the master (spawned workers
         # inherit DTPU_MASTER_URL/DTPU_WORKER_ID from the process
         # manager; elastic workers export them by hand)
-        cluster_mod.maybe_start_heartbeat(port=port)
+        state.heartbeat = cluster_mod.maybe_start_heartbeat(port=port)
     role = "worker" if state.is_worker else "master"
     log(f"{role} server listening on {host}:{port}")
     web.run_app(app, host=host, port=port, print=None)

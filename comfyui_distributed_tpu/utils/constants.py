@@ -175,6 +175,32 @@ WORKER_ID_ENV = "DTPU_WORKER_ID"     # this worker's config identity
 # worker die after sending k tiles; {"stall_s": t} delays its first send
 FAULT_INJECT_ENV = "DTPU_FAULT_INJECT"
 
+# --- durable job state + master failover (runtime/durable.py) ----------------
+# Write-ahead job log: every queue admission, ledger ownership transition,
+# unit check-in and idempotency-key stamp is appended as a checksummed
+# record to segment files under DTPU_WAL_DIR (unset = durability off, the
+# default — tests and single-shot CLIs pay nothing).  A restarting master
+# replays snapshot+log into a reconstructed queue/WorkLedger and resumes
+# in-flight jobs, redispatching only unfinished units; a standby
+# (DTPU_STANDBY=1) watches the master's lease file in the same dir and
+# takes over on expiry.  Fencing: WAL appends carry the holder's epoch
+# and are refused once a higher epoch has acquired the lease.
+WAL_DIR_ENV = "DTPU_WAL_DIR"
+# fsync policy: "always" (default — a record is durable before the caller
+# is acked), "off" (leave it to the OS; crash loses the page-cache tail),
+# or a float seconds value (group fsync: at most that much ack'd-but-
+# volatile history)
+WAL_SYNC_ENV = "DTPU_WAL_SYNC"
+WAL_SYNC_DEFAULT = "always"
+WAL_SEGMENT_BYTES_ENV = "DTPU_WAL_SEGMENT_BYTES"
+WAL_SEGMENT_BYTES_DEFAULT = 1 << 20    # rotate (and snapshot) at 1 MiB
+STANDBY_ENV = "DTPU_STANDBY"           # "1": observe the lease, don't acquire
+MASTER_LEASE_ENV = "DTPU_MASTER_LEASE_S"
+MASTER_LEASE_DEFAULT = 10.0            # s the master lease lives unrenewed
+MASTER_LEASE_FRACTION = 3.0            # renew every lease/this
+WAL_FENCE_CHECK_S = 0.25               # lease-file fence re-read cadence
+WAL_OWNER_ENV = "DTPU_MASTER_ID"       # lease owner identity (default: master)
+
 # --- persistent compilation cache -------------------------------------------
 # Directory for JAX's persistent (on-disk) XLA compilation cache.  Resolution
 # (runtime/manager.enable_persistent_compile_cache): explicit arg > this env

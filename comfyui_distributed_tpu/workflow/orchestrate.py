@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import json
 import os
 import re
 import time
@@ -284,6 +285,47 @@ def _register_redispatchers(graph: Graph, job_id_map: Dict[str, str],
             return redispatch
 
         ledger.set_redispatcher(mj, make())
+
+
+def register_recovery_redispatchers(state, prompt: Dict[str, Any]) -> int:
+    """Crash-recovery reuse of the redispatch machinery (ISSUE 7): a
+    recovered master-share prompt already carries its ``multi_job_id``s
+    and ``enabled_worker_ids`` as hidden inputs (the WAL persisted the
+    PREPARED graph), so its unfinished units can re-fan-out to live
+    workers with explicit unit lists — without re-running the original
+    orchestration.  Returns the number of jobs that got a callback."""
+    graph = parse_workflow(prompt)
+    job_id_map: Dict[str, str] = {}
+    enabled_ids: List[str] = []
+    for nid, node in graph.nodes.items():
+        if node.class_type not in dsp.DISTRIBUTED_TYPES:
+            continue
+        h = node.hidden
+        mj = h.get("multi_job_id")
+        if not mj or h.get("is_worker"):
+            continue
+        job_id_map[nid] = str(mj)
+        if h.get("enabled_worker_ids"):
+            try:
+                enabled_ids = [str(x) for x in
+                               json.loads(h["enabled_worker_ids"])]
+            except (ValueError, TypeError):
+                pass
+    if not job_id_map or not enabled_ids:
+        return 0
+    cfg = cfg_mod.load_config(state.config_path)
+    alive = [w for w in cfg_mod.enabled_workers(cfg)
+             if str(w.get("id")) in enabled_ids]
+    if not alive:
+        return 0
+    host = cfg.get("master", {}).get("host") or "127.0.0.1"
+    master_url = f"http://{host}:{state.port or 8288}"
+    _register_redispatchers(graph, job_id_map, enabled_ids, alive,
+                            master_url, "dtpu-recovery", None,
+                            state.cluster, state.ledger)
+    debug_log(f"recovery: registered redispatchers for "
+              f"{sorted(job_id_map.values())}")
+    return len(job_id_map)
 
 
 async def run_distributed(graph_or_doc: Any,

@@ -70,6 +70,10 @@ _MODULE_COST_S = {
     # non-slow share only (the two loopback fault-acceptance tests are
     # marked slow in-file, ~40s each with real master+worker exec loops)
     "test_cluster.py": 12,
+    # non-slow share only (the two loopback election/recovery
+    # acceptance tests are marked slow in-file, ~20s each with real
+    # master+standby+worker exec loops over a shared WAL)
+    "test_durable.py": 12,
     "test_resource.py": 12,
     "test_tiling.py": 10,
 }

@@ -1,0 +1,747 @@
+"""Durable job state + master failover (ISSUE 7, runtime/durable.py).
+
+Covers the write-ahead log (checksummed segments, snapshot+truncation,
+fsync policies, the crash-point injection matrix), the master lease with
+epoch fencing, unit-payload spill/reload, WorkLedger and JobStore
+recovery merges, the takeover/rehome HTTP surface, and — slow-marked —
+the loopback election/recovery acceptance: kill the master mid
+tiled-upscale, the standby (or a restarted master) finishes the job
+re-refining only unfinished units.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from comfyui_distributed_tpu.runtime import cluster as cl
+from comfyui_distributed_tpu.runtime import durable as dur
+from comfyui_distributed_tpu.runtime.jobs import JobStore
+from comfyui_distributed_tpu.server.app import ServerState, build_app
+from comfyui_distributed_tpu.utils import constants as C
+
+
+@pytest.fixture(autouse=True)
+def tiny_family(monkeypatch):
+    monkeypatch.setenv("DTPU_DEFAULT_FAMILY", "tiny")
+    yield
+
+
+@pytest.fixture
+def wal_dir(tmp_path):
+    return str(tmp_path / "wal")
+
+
+def mk_wal(wal_dir, owner="master", lease_s=60.0, **kw):
+    lease = dur.MasterLease(wal_dir)
+    epoch = lease.acquire(owner, lease_s)
+    return dur.WriteAheadLog(wal_dir, epoch=epoch, lease=lease, **kw), \
+        lease
+
+
+# --- record / segment layer --------------------------------------------------
+
+class TestWalCore:
+    def test_roundtrip_all_record_types(self, wal_dir):
+        wal, _ = mk_wal(wal_dir)
+        wal.append("enqueue", pid="p1", prompt={"1": {"class_type": "X"}},
+                   client_id="c", extra={"k": 1})
+        wal.append("job_create", job="j1", kind="tile",
+                   owners={"0": "master", "1": "w0", "2": "w1"})
+        wal.append("unit_checkin", job="j1", unit="0", by="master",
+                   spilled=True)
+        wal.append("unit_reassign", job="j1", units=["2"], to="master")
+        wal.append("unit_hedge", job="j1", units=["1"], by="master")
+        wal.append("idem", scope="tile", job="j1", key="w0:1:0")
+        wal.append("enqueue", pid="p2", prompt={}, client_id="c")
+        wal.append("exec_done", pid="p2", status="ok")
+        wal.close()
+        st, info = dur.replay(wal_dir)
+        assert list(st.prompts) == ["p1"]
+        assert st.prompts["p1"]["prompt"] == {"1": {"class_type": "X"}}
+        units = st.jobs["j1"]["units"]
+        assert units["0"]["done"] and units["0"]["spilled"]
+        assert not units["1"]["done"]
+        assert units["2"]["owner"] == "master"   # reassign applied
+        assert st.idem["tile"]["j1"] == ["w0:1:0"]
+        assert info["records_replayed"] == 8 and not info["torn"]
+
+    def test_job_finish_drops_job_and_idem(self, wal_dir):
+        wal, _ = mk_wal(wal_dir)
+        wal.append("job_create", job="j1", kind="image",
+                   owners={"w0": "w0"})
+        wal.append("idem", scope="image", job="j1", key="k")
+        wal.append("job_finish", job="j1")
+        wal.close()
+        st, _ = dur.replay(wal_dir)
+        assert st.jobs == {} and st.idem["image"] == {}
+
+    def test_torn_tail_tolerated_and_prior_records_survive(self, wal_dir):
+        wal, lease = mk_wal(wal_dir)
+        wal.append("enqueue", pid="p1", prompt={}, client_id="c")
+        wal.close()
+        wal2 = dur.WriteAheadLog(wal_dir, epoch=1, lease=lease)
+        wal2.inject_crash("torn")
+        with pytest.raises(dur.WalCrashedError):
+            wal2.append("exec_done", pid="p1", status="ok")
+        st, info = dur.replay(wal_dir)
+        assert "p1" in st.prompts            # torn record never applied
+        assert info["torn"]
+        report = dur.verify(wal_dir)
+        assert report["ok"]                  # torn tail != corruption
+        assert any(s["checksum"] == "torn-tail"
+                   for s in report["segments"])
+
+    def test_midfile_corruption_flagged(self, wal_dir):
+        wal, _ = mk_wal(wal_dir)
+        for i in range(6):
+            wal.append("idem", scope="tile", job="j", key=f"k{i}")
+        wal.close()
+        seg = dur.list_segments(wal_dir)[0][2]
+        data = open(seg, "rb").read()
+        open(seg, "wb").write(data[:20] + b"XX" + data[22:])
+        report = dur.verify(wal_dir)
+        assert not report["ok"]
+        assert any("CORRUPT" in s["checksum"]
+                   for s in report["segments"])
+
+    def test_rotation_snapshot_truncation_equivalence(self, wal_dir):
+        wal, _ = mk_wal(wal_dir, segment_bytes=300)
+        wal.append("job_create", job="j1", kind="tile",
+                   owners={str(i): "master" for i in range(4)})
+        for i in range(4):
+            wal.append("unit_checkin", job="j1", unit=str(i),
+                       by="master", spilled=False)
+        for i in range(20):
+            wal.append("idem", scope="tile", job="j1", key=f"k{i}")
+        wal.close()
+        segs = dur.list_segments(wal_dir)
+        snaps = dur.list_snapshots(wal_dir)
+        assert snaps, "rotation never snapshotted"
+        # truncation happened: far fewer segments than rotations
+        assert all((e, s) >= (snaps[-1][0], snaps[-1][1])
+                   for e, s, _ in segs)
+        st, _ = dur.replay(wal_dir)
+        assert all(u["done"] for u in st.jobs["j1"]["units"].values())
+        assert len(st.idem["tile"]["j1"]) == 20
+
+    def test_sync_policies(self, wal_dir):
+        wal, _ = mk_wal(wal_dir, sync="off")
+        wal.append("enqueue", pid="p", prompt={}, client_id="c")
+        assert wal.stats()["unsynced_records"] == 1
+        wal.sync()
+        assert wal.stats()["unsynced_records"] == 0
+        wal.close()
+        wal2 = dur.WriteAheadLog(wal_dir, epoch=2, sync="always")
+        wal2.append("enqueue", pid="p2", prompt={}, client_id="c")
+        assert wal2.stats()["unsynced_records"] == 0
+        wal2.close()
+
+
+class TestCrashPointMatrix:
+    """The satellite: kill the master between append/fsync/ack at every
+    transition type; recovery must converge with no duplicate and no
+    lost units.  ``post_sync`` = the record IS durable but the caller
+    never saw the ack (lost-ack); ``pre_append``/``torn`` = the record
+    is NOT durable (the caller was never acked, so the work is redone)."""
+
+    TRANSITIONS = [
+        ("enqueue", dict(pid="px", prompt={"1": {}}, client_id="c")),
+        ("job_create", dict(job="jx", kind="tile",
+                            owners={"0": "master"})),
+        ("unit_checkin", dict(job="j1", unit="1", by="w0",
+                              spilled=False)),
+        ("unit_reassign", dict(job="j1", units=["1"], to="master")),
+        ("idem", dict(scope="tile", job="j1", key="kx")),
+        ("exec_done", dict(pid="p0", status="ok")),
+        ("job_finish", dict(job="j1")),
+    ]
+
+    def _base(self, wal):
+        wal.append("enqueue", pid="p0", prompt={"1": {}}, client_id="c")
+        wal.append("job_create", job="j1", kind="tile",
+                   owners={"0": "master", "1": "w0"})
+        wal.append("unit_checkin", job="j1", unit="0", by="master",
+                   spilled=False)
+
+    @pytest.mark.parametrize("point", ["pre_append", "torn", "post_sync"])
+    def test_crash_at_every_transition(self, tmp_path, point):
+        for k, (rtype, fields) in enumerate(self.TRANSITIONS):
+            wal_dir = str(tmp_path / f"{point}_{k}")
+            wal, lease = mk_wal(wal_dir)
+            self._base(wal)
+            wal.inject_crash(point, rtype)
+            with pytest.raises(dur.WalCrashedError):
+                wal.append(rtype, **fields)
+            # every append after the crash is refused, like a dead process
+            with pytest.raises(dur.WalCrashedError):
+                wal.append("idem", scope="tile", job="j1", key="late")
+
+            st, _ = dur.replay(wal_dir)
+            # the base prefix is never lost
+            if not (rtype == "exec_done" and point == "post_sync"):
+                assert "p0" in st.prompts, (rtype, point)
+            if rtype not in ("job_finish",) or point != "post_sync":
+                assert "j1" in st.jobs, (rtype, point)
+                assert st.jobs["j1"]["units"]["0"]["done"]
+            durable = point == "post_sync"
+            if rtype == "unit_checkin":
+                assert st.jobs["j1"]["units"]["1"]["done"] == durable
+            if rtype == "enqueue":
+                assert ("px" in st.prompts) == durable
+            if rtype == "idem":
+                assert ("kx" in st.idem["tile"].get("j1", [])) == durable
+            if rtype == "job_finish":
+                assert ("j1" not in st.jobs) == durable
+            # replay is idempotent: materializing twice converges
+            st2, _ = dur.replay(wal_dir)
+            assert st2.to_json() == st.to_json(), (rtype, point)
+
+    def test_lost_ack_checkin_is_exactly_once_after_recovery(
+            self, wal_dir):
+        """post_sync at a check-in = the unit IS done on disk; the
+        caller (who never saw the ack) retries after recovery, and the
+        recovered ledger dedupes the redo at the blend."""
+        wal, lease = mk_wal(wal_dir)
+        wal.append("job_create", job="j1", kind="tile",
+                   owners={"0": "master", "1": "w0"})
+        wal.inject_crash("post_sync", "unit_checkin")
+        with pytest.raises(dur.WalCrashedError):
+            wal.append("unit_checkin", job="j1", unit="1", by="w0",
+                       spilled=False)
+        st, _ = dur.replay(wal_dir)
+        led = cl.WorkLedger()
+        wal2 = dur.WriteAheadLog(wal_dir, epoch=2, lease=lease,
+                                 tracker=st)
+        led.attach_wal(wal2, dur.UnitStore(wal_dir), dict(st.jobs))
+        led.create_job("j1", {"0": "master", "1": "w0"}, kind="tile")
+        # payload never spilled -> downgraded to pending, recomputed
+        # ONCE (unit "0" was simply never done)
+        assert sorted(led.pending("j1")) == ["0", "1"]
+        assert led.check_in("j1", "1", "w0") is True
+        assert led.check_in("j1", "1", "w0") is False  # the retried ack
+        wal2.close()
+
+
+# --- lease / fencing ---------------------------------------------------------
+
+class TestMasterLease:
+    def test_acquire_renew_expire_epochs(self, wal_dir):
+        lease = dur.MasterLease(wal_dir)
+        e1 = lease.acquire("m", 0.3)
+        assert e1 == 1 and lease.snapshot()["held"]
+        assert lease.renew("m", e1, 0.3)
+        with pytest.raises(dur.LeaseHeldError):
+            lease.acquire("standby", 0.3)
+        time.sleep(0.4)
+        assert not lease.snapshot()["held"]
+        e2 = lease.acquire("standby", 60.0)   # expired -> allowed
+        assert e2 == 2
+        assert not lease.renew("m", e1, 0.3)  # the old holder lost it
+
+    def test_same_owner_reclaims_live_lease(self, wal_dir):
+        lease = dur.MasterLease(wal_dir)
+        e1 = lease.acquire("m", 60.0)
+        e2 = lease.acquire("m", 60.0)  # crash-restart of the same owner
+        assert e2 == e1 + 1
+
+    def test_stale_epoch_append_fenced(self, wal_dir, monkeypatch):
+        monkeypatch.setattr(C, "WAL_FENCE_CHECK_S", 0.0)
+        wal, lease = mk_wal(wal_dir, owner="m")
+        wal.append("enqueue", pid="p", prompt={}, client_id="c")
+        lease.acquire("standby", 60.0, force=True)  # the fencing event
+        with pytest.raises(dur.FencedError):
+            wal.append("enqueue", pid="p2", prompt={}, client_id="c")
+        assert wal.fenced
+        st, _ = dur.replay(wal_dir)
+        assert "p2" not in st.prompts
+
+
+# --- unit store + ledger recovery -------------------------------------------
+
+class TestUnitStoreAndLedgerRecovery:
+    def test_unit_store_roundtrip(self, wal_dir):
+        us = dur.UnitStore(wal_dir)
+        t = np.random.default_rng(0).random((5, 4, 3)).astype(np.float32)
+        us.put("job/1", 3, [t], {"form": "window"})
+        assert us.has("job/1", 3) and not us.has("job/1", 4)
+        tensors, meta = us.get("job/1", 3)
+        np.testing.assert_array_equal(tensors[0], t)
+        assert meta == {"form": "window"}
+        us.drop_job("job/1")
+        assert not us.has("job/1", 3)
+
+    def _recovered_ledger(self, wal_dir, spill_units=(0,)):
+        """A ledger that lived, checked units in, 'crashed', and a
+        second ledger recovered from its WAL."""
+        wal, lease = mk_wal(wal_dir)
+        us = dur.UnitStore(wal_dir)
+        led = cl.WorkLedger()
+        led.attach_wal(wal, us, {})
+        led.create_job("j", {0: "master", 1: "w0", 2: "w1"}, kind="tile")
+        for u in spill_units:
+            assert led.check_in(
+                "j", u, "master",
+                payload=([np.full((2, 2, 3), float(u), np.float32)],
+                         {"form": "window"}))
+        wal.simulate_crash()
+        st, _ = dur.replay(wal_dir)
+        led2 = cl.WorkLedger()
+        wal2 = dur.WriteAheadLog(wal_dir, epoch=2, lease=lease,
+                                 tracker=st)
+        led2.attach_wal(wal2, us, dict(st.jobs))
+        led2.create_job("j", {0: "master", 1: "w0", 2: "w1"},
+                        kind="tile")
+        return led2
+
+    def test_preloaded_done_units_not_pending(self, wal_dir):
+        led2 = self._recovered_ledger(wal_dir, spill_units=(0, 1))
+        assert led2.pending("j") == [2]
+        payloads = led2.load_payloads("j")
+        assert set(payloads) == {0, 1}
+        tensors, meta = payloads[1]
+        assert meta["form"] == "window" and tensors[0][0, 0, 0] == 1.0
+        summary = led2.finish_job("j")
+        assert summary["recovered"] and summary["preloaded_units"] == 2
+
+    def test_missing_payload_downgrades_to_pending(self, wal_dir):
+        led2 = self._recovered_ledger(wal_dir, spill_units=(0, 1))
+        us = dur.UnitStore(wal_dir)
+        os.remove(us.path("j", 1))
+        payloads = led2.load_payloads("j")
+        assert set(payloads) == {0}
+        assert sorted(led2.pending("j")) == [1, 2]
+
+    def test_take_recovered_lost_groups_nonmaster_owners_once(
+            self, wal_dir):
+        led2 = self._recovered_ledger(wal_dir, spill_units=(0,))
+        lost = led2.take_recovered_lost("j")
+        assert lost == {"w0": [1], "w1": [2]}
+        assert led2.take_recovered_lost("j") == {}   # consumed
+        # a non-recovered job never reports lost owners
+        led2.create_job("j2", {0: "w0"}, kind="tile")
+        assert led2.take_recovered_lost("j2") == {}
+
+
+# --- JobStore idempotency persistence ---------------------------------------
+
+class TestIdemPersistence:
+    def test_keys_survive_restart_and_replays_dropped(self, wal_dir):
+        async def run():
+            wal, lease = mk_wal(wal_dir)
+            js = JobStore()
+            js.attach_wal(wal)
+            await js.prepare_tile_job("j")
+            item = {"worker_id": "w0", "tile_idx": 1, "tensor": 0}
+            assert await js.put_tile("j", item, idem_key="w0:1:0")
+            wal.simulate_crash()        # the master dies post-ack
+
+            st, _ = dur.replay(wal_dir)
+            js2 = JobStore()
+            wal2 = dur.WriteAheadLog(wal_dir, epoch=2, lease=lease,
+                                     tracker=st)
+            js2.attach_wal(wal2, st.idem)
+            await js2.prepare_tile_job("j")
+            # the acked-but-dropped upload is replayed against the NEW
+            # master: acknowledged, never enqueued
+            assert await js2.put_tile("j", item, idem_key="w0:1:0")
+            q = await js2.get_tile_queue("j")
+            assert q.qsize() == 0
+            # a fresh key still enqueues
+            assert await js2.put_tile("j", item, idem_key="w0:1:1")
+            assert q.qsize() == 1
+            wal2.close()
+        asyncio.run(run())
+
+
+# --- ServerState wiring ------------------------------------------------------
+
+class TestServerStateRecovery:
+    def test_queue_recovered_with_original_pids(self, tmp_path,
+                                                monkeypatch):
+        wal = str(tmp_path / "wal")
+        monkeypatch.setenv(C.WAL_DIR_ENV, wal)
+        st = ServerState(config_path=str(tmp_path / "cfg.json"),
+                         start_exec_thread=False)
+        assert st.durable is not None
+        p1 = st.enqueue_prompt({"1": {"class_type": "X"}}, "c1")
+        p2 = st.enqueue_prompt({"2": {"class_type": "Y"}}, "c2")
+        st.durable.simulate_crash()
+
+        st2 = ServerState(config_path=str(tmp_path / "cfg.json"),
+                          start_exec_thread=False)
+        assert st2.durable is not None and st2.durable.epoch == 2
+        assert st2.resume_recovered() == 2
+        with st2._queue_lock:
+            pids = [it["id"] for it in st2._queue]
+        assert pids == [p1, p2]
+        # resume is idempotent, and the re-enqueue did not re-log
+        assert st2.resume_recovered() == 0
+        st3_state, _ = dur.replay(wal)
+        assert sorted(st3_state.prompts) == sorted([p1, p2])
+        st2.durable.close()
+
+    def test_completed_prompts_not_resumed(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(C.WAL_DIR_ENV, str(tmp_path / "wal"))
+        st = ServerState(config_path=str(tmp_path / "cfg.json"),
+                         start_exec_thread=False)
+        pid = st.enqueue_prompt({"1": {"class_type": "X"}}, "c")
+        st.durable.log_exec_done(pid, "ok")
+        st.durable.simulate_crash()
+        st2 = ServerState(config_path=str(tmp_path / "cfg.json"),
+                          start_exec_thread=False)
+        assert st2.resume_recovered() == 0
+        st2.durable.close()
+
+    def test_no_wal_dir_means_no_durable(self, tmp_path):
+        st = ServerState(config_path=str(tmp_path / "cfg.json"),
+                         start_exec_thread=False)
+        assert st.durable is None
+        st.enqueue_prompt({"1": {}}, "c")   # and nothing breaks
+
+
+# --- HTTP surface ------------------------------------------------------------
+
+class TestDurabilityRoutes:
+    def test_durability_info_and_takeover_conflict(self, tmp_path,
+                                                   monkeypatch):
+        async def go():
+            st = ServerState(config_path=str(tmp_path / "cfg.json"),
+                             start_exec_thread=False)
+            client = TestClient(TestServer(build_app(st)))
+            await client.start_server()
+            try:
+                r = await client.get("/distributed/durability")
+                assert (await r.json()) == {"enabled": False}
+                r = await client.post("/distributed/takeover", json={})
+                assert r.status == 409
+            finally:
+                await client.close()
+        asyncio.run(go())
+
+    def test_active_master_reports_and_takeover_is_noop(self, tmp_path,
+                                                        monkeypatch):
+        monkeypatch.setenv(C.WAL_DIR_ENV, str(tmp_path / "wal"))
+
+        async def go():
+            st = ServerState(config_path=str(tmp_path / "cfg.json"),
+                             start_exec_thread=False)
+            client = TestClient(TestServer(build_app(st)))
+            await client.start_server()
+            try:
+                r = await client.get("/distributed/durability")
+                body = await r.json()
+                assert body["enabled"] and body["epoch"] == 1
+                assert body["lease"]["held"]
+                assert body["wal"]["records_appended"] == 0
+                r = await client.post("/distributed/takeover", json={})
+                assert (await r.json())["note"] == "already active"
+                # prom gauges ride the standard exposition
+                r = await client.get("/distributed/metrics.prom")
+                text = await r.text()
+                assert "dtpu_master_epoch 1" in text
+                assert "dtpu_wal_records_total" in text
+                r = await client.get("/distributed/metrics")
+                assert (await r.json())["durability"]["epoch"] == 1
+            finally:
+                await client.close()
+                st.durable.close()
+        asyncio.run(go())
+
+    def test_rehome_retargets_heartbeat(self, tmp_path, monkeypatch):
+        async def go():
+            st = ServerState(config_path=str(tmp_path / "cfg.json"),
+                             is_worker=True, start_exec_thread=False)
+            st.heartbeat = cl.HeartbeatSender("http://127.0.0.1:1",
+                                              "w0", interval=3600)
+            client = TestClient(TestServer(build_app(st)))
+            await client.start_server()
+            try:
+                r = await client.post("/distributed/rehome", json={
+                    "master_url": "http://127.0.0.1:2/",
+                    "worker_id": "w0"})
+                body = await r.json()
+                assert body["master_url"] == "http://127.0.0.1:2"
+                assert st.heartbeat.master_url == "http://127.0.0.1:2"
+                assert os.environ[C.MASTER_URL_ENV] \
+                    == "http://127.0.0.1:2"
+                r = await client.post("/distributed/rehome", json={})
+                assert r.status == 400
+            finally:
+                await client.close()
+                os.environ.pop(C.MASTER_URL_ENV, None)
+        asyncio.run(go())
+
+
+# --- loopback election/recovery acceptance (slow) ----------------------------
+
+def upscale_prompt(seed=7, size=64, tile=32, steps=1):
+    """4 tiles over master [0,1] + w0 [2] + w1 [3], saved to disk so
+    the recovered blend has comparable pixels."""
+    return {
+        "7": {"class_type": "CheckpointLoaderSimple",
+              "inputs": {"ckpt_name": "tiny.safetensors"}},
+        "5": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "a map", "clip": ["7", 1]}},
+        "6": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["7", 1]}},
+        "10": {"class_type": "LoadImage",
+               "inputs": {"image": "__durable_card__.png"}},
+        "11": {"class_type": "ImageScale",
+               "inputs": {"image": ["10", 0],
+                          "upscale_method": "bilinear",
+                          "width": size, "height": size,
+                          "crop": "disabled"}},
+        "2": {"class_type": "UltimateSDUpscaleDistributed",
+              "inputs": {"upscaled_image": ["11", 0], "model": ["7", 0],
+                         "positive": ["5", 0], "negative": ["6", 0],
+                         "vae": ["7", 2], "seed": seed, "steps": steps,
+                         "cfg": 2.0, "sampler_name": "euler",
+                         "scheduler": "normal", "denoise": 0.4,
+                         "tile_width": tile, "tile_height": tile,
+                         "padding": 8, "mask_blur": 2,
+                         "force_uniform_tiles": True}},
+        "8": {"class_type": "SaveImage",
+              "inputs": {"images": ["2", 0],
+                         "filename_prefix": "durable"}},
+    }
+
+
+async def _wait_history(client, pid, timeout_s=240.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        hist = await (await client.get("/history")).json()
+        if pid in hist:
+            return hist[pid]
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"prompt {pid} never finished")
+
+
+class _DurableCluster:
+    """Master + 2 workers over loopback HTTP with a shared WAL dir —
+    the test_cluster._Cluster topology plus the durability plane."""
+
+    def __init__(self, tmp_path):
+        self.tmp_path = tmp_path
+        self.workers = []
+        self.states = []
+        self.clients = []
+        self.cfg_path = str(tmp_path / "cfg.json")
+
+    async def make_master(self, name, standby=False):
+        d = self.tmp_path / name
+        os.makedirs(d / "in", exist_ok=True)
+        if standby:
+            os.environ[C.STANDBY_ENV] = "1"
+        try:
+            st = ServerState(config_path=self.cfg_path,
+                             input_dir=str(d / "in"),
+                             output_dir=str(d),
+                             is_worker=False)
+        finally:
+            os.environ.pop(C.STANDBY_ENV, None)
+        client = TestClient(TestServer(build_app(st)))
+        await client.start_server()
+        st.port = client.server.port
+        self.states.append(st)
+        self.clients.append(client)
+        return st, client, str(d)
+
+    async def start(self):
+        cfg_workers = []
+        for i in range(2):
+            wdir = self.tmp_path / f"worker{i}"
+            os.makedirs(wdir / "in")
+            st = ServerState(config_path=str(wdir / "cfg.json"),
+                             input_dir=str(wdir / "in"),
+                             output_dir=str(wdir), is_worker=True)
+            client = TestClient(TestServer(build_app(st)))
+            await client.start_server()
+            self.workers.append((st, client))
+            self.states.append(st)
+            self.clients.append(client)
+            cfg_workers.append({"id": f"w{i}", "host": "127.0.0.1",
+                                "port": client.server.port,
+                                "enabled": True})
+        with open(self.cfg_path, "w") as f:
+            json.dump({"workers": cfg_workers,
+                       "master": {"host": "127.0.0.1"},
+                       "settings": {}}, f)
+        return self
+
+    async def stop(self):
+        for st in self.states:
+            if getattr(st, "durable", None) is not None:
+                st.durable.simulate_crash()
+            st.health.stop()
+        for client in self.clients:
+            try:
+                await client.close()
+            except Exception:  # noqa: BLE001 - may already be closed
+                pass
+        for st in self.states:
+            st.drain(1)
+
+
+def _newest_png(d):
+    pngs = [os.path.join(d, f) for f in os.listdir(d)
+            if f.endswith(".png")]
+    assert pngs, f"no PNG in {d}"
+    return max(pngs, key=os.path.getmtime)
+
+
+def _png_pixels(path):
+    from comfyui_distributed_tpu.utils.image import decode_png
+    return np.asarray(decode_png(open(path, "rb").read()))
+
+
+async def _run_to_mid_job(clu, mclient, mstate, seed):
+    """Post the upscale with w1 stalled; return pid once >=3/4 units
+    are durable (the kill point)."""
+    clu.workers[1][0].fault_inject = {"stall_s": 300}
+    r = await mclient.post("/prompt", json={
+        "prompt": upscale_prompt(seed=seed), "client_id": "acc"})
+    assert r.status == 200, await r.text()
+    body = await r.json()
+    assert sorted(body["workers"]) == ["w0", "w1"], body
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+        snap = await (await mclient.get("/distributed/cluster")).json()
+        if any(j["done_units"] >= 3
+               for j in snap["ledger"]["active_jobs"].values()):
+            return body["prompt_id"]
+        await asyncio.sleep(0.05)
+    raise AssertionError("job never reached 3/4 done units")
+
+
+class TestFailoverAcceptance:
+    @pytest.mark.slow
+    def test_standby_election_finishes_job_bit_identical(
+            self, tmp_path, monkeypatch):
+        """THE acceptance: kill the master mid tiled-upscale; the
+        standby's lease watcher takes over, replays the shared WAL,
+        blends the spilled units and redispatches only the unfinished
+        one — completion 1.0, image bit-identical to the no-failure
+        run, workers re-homed."""
+        monkeypatch.setenv(C.WAL_DIR_ENV, str(tmp_path / "wal"))
+        monkeypatch.setenv(C.MASTER_LEASE_ENV, "2.0")
+        monkeypatch.setenv(C.LEASE_ENV, "4.0")
+        monkeypatch.setenv(C.FAULT_POLICY_ENV, "reassign")
+        monkeypatch.setenv(C.HEDGE_ENV, "0")
+        monkeypatch.setenv(C.DRAIN_TIMEOUT_ENV, "2")
+
+        async def go():
+            clu = await _DurableCluster(tmp_path).start()
+            try:
+                mstate, mclient, mdir = await clu.make_master("master")
+                assert mstate.durable is not None
+                mstate.resume_recovered()
+                mstate.health.interval = 0.5
+                await asyncio.get_running_loop().run_in_executor(
+                    None, mstate.health.poll_once)
+                mstate.health.start()
+
+                # no-failure reference (same seed as the failover run)
+                r = await mclient.post("/prompt", json={
+                    "prompt": upscale_prompt(seed=11),
+                    "client_id": "base"})
+                pid0 = (await r.json())["prompt_id"]
+                assert (await _wait_history(mclient, pid0))["status"] \
+                    == "success"
+                base = _png_pixels(_newest_png(mdir))
+
+                sstate, sclient, sdir = await clu.make_master(
+                    "standby", standby=True)
+                assert sstate.durable.standby
+
+                pid = await _run_to_mid_job(clu, mclient, mstate,
+                                            seed=11)
+                mstate.durable.simulate_crash()   # SIGKILL proxy
+                mstate.health.stop()
+                clu.workers[1][0].fault_inject = {}
+
+                hist = await _wait_history(sclient, pid)
+                assert hist["status"] == "success", hist
+
+                snap = await (await sclient.get(
+                    "/distributed/cluster")).json()
+                job = [j for j in snap["ledger"]["completed_jobs"]
+                       if j["kind"] == "tile"][-1]
+                assert job["done_units"] == job["total_units"] == 4
+                assert job["pending_units"] == []
+                assert job["recovered"] is True
+                # only the stranded unit was re-refined
+                assert job["preloaded_units"] >= 2
+                assert job["reassigned_units"] >= 1
+
+                dur_info = await (await sclient.get(
+                    "/distributed/durability")).json()
+                assert dur_info["epoch"] == 2
+                assert dur_info["takeovers"] == 1
+
+                np.testing.assert_array_equal(
+                    _png_pixels(_newest_png(sdir)), base)
+
+                # workers re-homed their heartbeats to the new master
+                for wst, _ in clu.workers:
+                    assert wst.heartbeat is not None
+                    assert str(sstate.port) in wst.heartbeat.master_url
+            finally:
+                await clu.stop()
+        asyncio.run(go())
+
+    @pytest.mark.slow
+    def test_restart_only_master_resumes_unfinished_units(
+            self, tmp_path, monkeypatch):
+        """No standby: a restarted master (same owner id reclaims the
+        lease) recovers at startup and redispatches only the units the
+        crash left unfinished."""
+        monkeypatch.setenv(C.WAL_DIR_ENV, str(tmp_path / "wal"))
+        monkeypatch.setenv(C.MASTER_LEASE_ENV, "2.0")
+        monkeypatch.setenv(C.LEASE_ENV, "4.0")
+        monkeypatch.setenv(C.FAULT_POLICY_ENV, "reassign")
+        monkeypatch.setenv(C.HEDGE_ENV, "0")
+        monkeypatch.setenv(C.DRAIN_TIMEOUT_ENV, "2")
+
+        async def go():
+            clu = await _DurableCluster(tmp_path).start()
+            try:
+                mstate, mclient, _ = await clu.make_master("master")
+                mstate.resume_recovered()
+                mstate.health.interval = 0.5
+                await asyncio.get_running_loop().run_in_executor(
+                    None, mstate.health.poll_once)
+                mstate.health.start()
+
+                pid = await _run_to_mid_job(clu, mclient, mstate,
+                                            seed=21)
+                mstate.durable.simulate_crash()
+                mstate.health.stop()
+                clu.workers[1][0].fault_inject = {}
+
+                m2, m2client, _ = await clu.make_master("master2")
+                assert m2.durable.epoch == 2
+                assert await asyncio.get_running_loop().run_in_executor(
+                    None, m2.resume_recovered) == 1
+                hist = await _wait_history(m2client, pid)
+                assert hist["status"] == "success", hist
+                snap = await (await m2client.get(
+                    "/distributed/cluster")).json()
+                job = [j for j in snap["ledger"]["completed_jobs"]
+                       if j["kind"] == "tile"][-1]
+                assert job["done_units"] == job["total_units"] == 4
+                assert job["recovered"] and job["preloaded_units"] >= 2
+                # the redo went back out to a live worker, with the
+                # reassign span in the resumed job's trace
+                r = await m2client.get(f"/distributed/trace/{pid}")
+                if r.status == 200:
+                    names = {s["name"] for s in
+                             (await r.json())["spans"]}
+                    assert "reassign" in names or "redispatch" in names
+            finally:
+                await clu.stop()
+        asyncio.run(go())
