@@ -120,7 +120,7 @@ def parse_args(argv=None):
                         "naturally: devices or UNAVAILABLE)")
     p.add_argument("--phase", default=None,
                    choices=["tensor_plane", "pipeline", "observability",
-                            "fault", "telemetry", "failover"],
+                            "fault", "telemetry", "failover", "overload"],
                    help="run ONE named software-proxy phase. "
                         "'tensor_plane': repeated 2-image SPMD txt2img on "
                         "the CPU backend reporting host_transfer_mb_per_"
@@ -157,7 +157,17 @@ def parse_args(argv=None):
                         "completion rate, takeover latency, preloaded-"
                         "vs-recomputed units and pixel equality vs the "
                         "no-failure run, plus the restart-only (no "
-                        "standby) recovery variant")
+                        "standby) recovery variant. "
+                        "'overload': elastic-fleet proof — 3 tenant "
+                        "classes under Poisson overload with chaos "
+                        "armed (dropped/delayed/5xx'd edges + one "
+                        "worker kill): per-class p95 ordering "
+                        "paid<free<batch, batch-first shedding with "
+                        "zero dropped paid jobs, autoscaler scale-up "
+                        "AND scale-down with zero flaps, plus a "
+                        "chaos-off single-tenant happy-path throughput "
+                        "compared against the prior telemetry "
+                        "baselines")
     p.add_argument("--check", action="store_true",
                    help="perf-regression watchdog: after the run, compare "
                         "the fresh result against the most recent prior "
@@ -260,7 +270,8 @@ def parse_args(argv=None):
         args.family = "sd15" if args.upscale else "sdxl"
     if args.steps is None:
         args.steps = 8 if args.scaling_sweep else \
-            (2 if args.phase in ("pipeline", "observability", "telemetry")
+            (2 if args.phase in ("pipeline", "observability", "telemetry",
+                                 "overload")
              else (1 if args.phase == "fault" else 20))
     if args.family == "tiny":
         # clamp HERE, not after backend init: the failure payload's metric
@@ -287,6 +298,8 @@ def metric_name(args):
         return "fault_recovery_completion_rate"
     if getattr(args, "phase", None) == "failover":
         return "failover_master_kill_completion_rate"
+    if getattr(args, "phase", None) == "overload":
+        return "overload_paid_completion_rate"
     if args.real_ckpt:
         return (f"real_ckpt_{args.family}_{args.width}x{args.height}_"
                 f"{args.steps}step_sec_per_image")
@@ -315,7 +328,7 @@ def metric_unit(args):
         return "imgs/s"
     if getattr(args, "phase", None) == "telemetry":
         return "imgs/s"
-    if getattr(args, "phase", None) in ("fault", "failover"):
+    if getattr(args, "phase", None) in ("fault", "failover", "overload"):
         return "fraction"
     if args.scaling_sweep or args.multiproc_sweep:
         return "fraction"
@@ -783,6 +796,7 @@ CHECK_TOLERANCE_PCT = {
     "default": 10.0,
     "fault_recovery_completion_rate": 0.0,
     "failover_master_kill_completion_rate": 0.0,
+    "overload_paid_completion_rate": 0.0,
     "tiny_virtual_mesh_spmd_efficiency_8dev": 5.0,
     "pipeline_overlap_speedup_4prompt": 15.0,
     "observability_traced_imgs_per_s_4prompt": 15.0,
@@ -2104,6 +2118,506 @@ def run_failover(args):
     emit(args, payload)
 
 
+def _percentile(values, pct):
+    """Nearest-rank percentile over a small latency sample."""
+    if not values:
+        return None
+    xs = sorted(values)
+    return xs[min(int(pct / 100.0 * (len(xs) - 1) + 0.5), len(xs) - 1)]
+
+
+def measure_overload(duration_s: float = 10.0, wait_s: float = 300.0,
+                     rates=None, seed: int = 7):
+    """Elastic-fleet-under-overload harness behind ``--phase overload``
+    (also called, scaled down, by tests/test_overload.py).
+
+    One loopback topology — master + 2 config workers, all real aiohttp
+    servers — runs four acts:
+
+    1. **happy path** (chaos off, single tenant): a warmed 4-prompt
+       coalesced burst on a default ServerState, the same methodology
+       as the pipeline/telemetry phases so the imgs/s number is
+       comparable against the BENCH_r07/r08 baselines;
+    2. **overload** (chaos ON): three tenant classes submit plain tiny
+       prompts as independent Poisson streams whose combined rate
+       exceeds the master's (coalescing-off — the mixed-traffic worst
+       case) service rate, while chaos drops/delays/5xx's the
+       data-plane + heartbeat edges.  Admission sheds batch first;
+       weighted fair dequeue orders the queue waits;
+    3. **churn**: the paid stream also carries tiled-upscale fan-out
+       jobs; worker w1 is KILLED after the first one completes — the
+       later jobs must recover through the PR 4 ledger (reassign /
+       redispatch) with the chaos still armed;
+    4. **convergence**: an armed FleetAutoscaler (injected spawner
+       building REAL in-process loopback workers that register and
+       heartbeat) must scale up under the backlog and scale back down
+       after the drain, with zero direction-reversal flaps.
+    """
+    import random
+    import tempfile
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from comfyui_distributed_tpu.runtime import autoscale as autoscale_mod
+    from comfyui_distributed_tpu.runtime import cluster as cluster_mod
+    from comfyui_distributed_tpu.server.app import ServerState, build_app
+    from comfyui_distributed_tpu.utils import chaos as chaos_mod
+    from comfyui_distributed_tpu.utils import constants as C
+    from comfyui_distributed_tpu.utils import trace as tr
+
+    os.environ.setdefault("DTPU_DEFAULT_FAMILY", "tiny")
+    rates = rates or {"paid": 3.0, "free": 3.5, "batch": 4.0}
+    saved_env = {k: os.environ.get(k)
+                 for k in (C.FAULT_POLICY_ENV, C.HEDGE_ENV, C.LEASE_ENV,
+                           C.SUSPECT_PROBES_ENV, C.MAX_QUEUE_ENV,
+                           C.TENANT_SHED_ENV, C.HEDGE_MIN_WAIT_ENV)}
+    os.environ[C.FAULT_POLICY_ENV] = "reassign"
+    os.environ[C.HEDGE_ENV] = "1"
+    # single-process CPU proxy: jax compute starves the shared loop, so
+    # leases must be generous enough that LIVE workers don't flap dead
+    os.environ[C.LEASE_ENV] = "4.0"
+    os.environ[C.SUSPECT_PROBES_ENV] = "3"
+    # queue geometry for the shed ladder: batch sheds at 30% of 64,
+    # free at 65%, paid only at a full queue the drain never lets
+    # happen — "zero dropped paid" is enforced by the threshold gap
+    os.environ[C.MAX_QUEUE_ENV] = "64"
+    os.environ[C.TENANT_SHED_ENV] = "paid=1.0,free=0.65,batch=0.3"
+
+    async def go():
+        tmp = tempfile.mkdtemp(prefix="bench_overload_")
+        rng = random.Random(seed)
+        workers, cfg_workers, heartbeats = [], [], []
+
+        async def make_worker(wid):
+            wdir = os.path.join(tmp, wid)
+            os.makedirs(os.path.join(wdir, "in"), exist_ok=True)
+            st = ServerState(config_path=os.path.join(wdir, "cfg.json"),
+                             input_dir=os.path.join(wdir, "in"),
+                             output_dir=wdir, is_worker=True)
+            client = TestClient(TestServer(build_app(st)))
+            await client.start_server()
+            return st, client
+
+        for i in range(2):
+            st, client = await make_worker(f"w{i}")
+            workers.append((st, client))
+            cfg_workers.append({"id": f"w{i}", "host": "127.0.0.1",
+                                "port": client.server.port,
+                                "enabled": True})
+        mdir = os.path.join(tmp, "master")
+        os.makedirs(os.path.join(mdir, "in"))
+        with open(os.path.join(mdir, "cfg.json"), "w") as f:
+            json.dump({"workers": cfg_workers,
+                       "master": {"host": "127.0.0.1"}, "settings": {}},
+                      f)
+
+        # act 1 — happy path on a DEFAULT (coalescing) state, chaos off,
+        # single untagged tenant: comparable to the telemetry baseline
+        happy = _serving_state(overlap=True, coalesce=True,
+                               prefix="bench_overload_happy_")
+        _wait_prompts(happy, _staged_burst(happy, 4, 2, seed0=50),
+                      wait_s, what="overload happy warm")
+        t0 = time.perf_counter()
+        _wait_prompts(happy, _staged_burst(happy, 4, 2, seed0=60),
+                      wait_s, what="overload happy")
+        happy_s = time.perf_counter() - t0
+        happy.drain(10)
+
+        # the overload master: coalescing OFF (mixed production traffic
+        # degenerates to batch=1 — the worst case the fleet must absorb)
+        mstate = ServerState(config_path=os.path.join(mdir, "cfg.json"),
+                             input_dir=os.path.join(mdir, "in"),
+                             output_dir=mdir, is_worker=False,
+                             overlap=True, coalesce=False)
+        mclient = TestClient(TestServer(build_app(mstate)))
+        await mclient.start_server()
+        mstate.port = mclient.server.port
+        master_url = f"http://127.0.0.1:{mstate.port}"
+        mstate.health.interval = 0.5
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, mstate.health.poll_once)
+        mstate.health.start()
+
+        # config workers heartbeat their leases like spawned ones would
+        for w in cfg_workers:
+            hb = cluster_mod.HeartbeatSender(master_url, w["id"],
+                                             interval=1.0,
+                                             port=w["port"])
+            hb.start()
+            heartbeats.append(hb)
+
+        # act 4 plumbing — the autoscaler, spawning REAL loopback
+        # workers (register + heartbeat) and retiring them by drain
+        spawned: dict = {}
+
+        async def spawn_async():
+            wid = f"auto{len(spawned)}"
+            st, client = await make_worker(wid)
+            hb = cluster_mod.HeartbeatSender(master_url, wid,
+                                             interval=1.0,
+                                             port=client.server.port)
+            hb.start()
+            heartbeats.append(hb)
+            spawned[wid] = (st, client, hb)
+            mstate.cluster.register(wid, info={
+                "host": "127.0.0.1", "port": client.server.port,
+                "name": wid})
+            return wid
+
+        def spawner():
+            return asyncio.run_coroutine_threadsafe(
+                spawn_async(), loop).result(timeout=30)
+
+        def retirer(wid):
+            entry = spawned.get(wid)
+            if entry is None:
+                return False
+            st, client, hb = entry
+            hb.stop()
+
+            async def close():
+                await client.close()
+            asyncio.run_coroutine_threadsafe(close(), loop).result(
+                timeout=10)
+            st.drain(2)
+            return True
+
+        def worker_queue(wid):
+            entry = spawned.get(wid)
+            if entry is not None:
+                return entry[0].queue_remaining()
+            return None   # config workers: registry hint covers them
+
+        scaler = autoscale_mod.FleetAutoscaler(
+            registry=mstate.cluster,
+            queue_depth_fn=mstate.queue_remaining,
+            util_fn=None,
+            spawner=spawner, retirer=retirer,
+            worker_queue_fn=worker_queue,
+            min_workers=2, max_workers=4,
+            up_queue=2.0, down_queue=0.5,
+            up_util=0.95, down_util=0.99,
+            window=2, cooldown_s=3.0, interval_s=0.25, drain_s=10.0)
+        mstate.autoscaler = scaler
+
+        async def post_plain(tenant, seq):
+            r = await mclient.post("/prompt", json={
+                "prompt": _pipeline_prompt(1000 + seq, steps=2),
+                "client_id": f"{tenant}-client",
+                "priority": tenant})
+            body = await r.json()
+            return r.status, body
+
+        async def post_fanout(tenant, seed_):
+            r = await mclient.post("/prompt", json={
+                "prompt": _fault_upscale_prompt(seed=seed_, steps=1),
+                "client_id": f"{tenant}-client",
+                "priority": tenant, "slo_s": 60.0})
+            body = await r.json()
+            return r.status, body
+
+        async def wait_history(pids, bound_s, require_success=True):
+            deadline = time.monotonic() + bound_s
+            while time.monotonic() < deadline:
+                hist = await (await mclient.get("/history")).json()
+                if all(p in hist for p in pids):
+                    return hist
+                await asyncio.sleep(0.05)
+            return await (await mclient.get("/history")).json()
+
+        try:
+            # warm every participant's compiled programs with chaos OFF:
+            # one plain prompt and one fan-out job
+            st_, body = await post_plain("paid", 0)
+            assert st_ == 200, body
+            await wait_history([body["prompt_id"]], wait_s)
+            st_, body = await post_fanout("paid", 5)
+            assert st_ == 200, body
+            await wait_history([body["prompt_id"]], wait_s)
+
+            # arm chaos for everything that follows (acts 2+3): the
+            # data-plane + heartbeat edges flake at ~5%, uploads corrupt
+            # at 2% — the retry/idempotency machinery must absorb it all
+            chaos_mod.set_chaos({
+                "drop_pct": 5, "delay_pct": 5, "delay_s": 0.05,
+                "http_5xx_pct": 5, "corrupt_pct": 2, "seed": seed,
+                "routes": ["/distributed/tile_complete",
+                           "/distributed/job_complete",
+                           "/distributed/heartbeat"]})
+            chaos_before = {
+                k: v for k, v in tr.GLOBAL_COUNTERS.snapshot().items()
+                if k.startswith("chaos_")}
+            scaler.start()
+
+            # act 2 + 3 — the Poisson overload window with chaos armed.
+            # Independent exponential inter-arrival streams per class;
+            # the paid stream additionally carries the fan-out jobs
+            # whose worker gets killed mid-window.
+            submissions = {cls: [] for cls in rates}   # (pid, t_submit)
+            sheds = {cls: [] for cls in rates}
+            fanout_pids = []
+            kill_at = duration_s * 0.35
+            killed = {"done": False}
+
+            async def tenant_stream(cls, rate):
+                t_end = time.monotonic() + duration_s
+                seq = 0
+                while time.monotonic() < t_end:
+                    await asyncio.sleep(rng.expovariate(rate))
+                    t_sub = time.time()
+                    status, body = await post_plain(cls, seq)
+                    seq += 1
+                    if status == 200:
+                        submissions[cls].append(
+                            (body["prompt_id"], t_sub))
+                    elif status == 429:
+                        sheds[cls].append(body.get("reason", "?"))
+                    else:
+                        raise AssertionError(
+                            f"{cls} submit -> {status}: {body}")
+
+            async def churn():
+                # fan-out job 1 completes pre-kill; then w1 dies; jobs
+                # 2 and 3 must complete through ledger recovery
+                status, body = await post_fanout("paid", 101)
+                assert status == 200, body
+                fanout_pids.append(body["prompt_id"])
+                await wait_history([body["prompt_id"]], wait_s)
+                await asyncio.sleep(max(kill_at - duration_s * 0.1, 0))
+                await workers[1][1].close()
+                killed["done"] = True
+                log("overload: killed worker w1 (chaos still armed)")
+                for s in (102, 103):
+                    status, body = await post_fanout("paid", s)
+                    assert status == 200, body
+                    fanout_pids.append(body["prompt_id"])
+
+            t_load0 = time.perf_counter()
+            await asyncio.gather(
+                churn(), *(tenant_stream(cls, r)
+                           for cls, r in rates.items()))
+            admitted_pids = [p for cls in submissions
+                             for p, _ in submissions[cls]] + fanout_pids
+            hist = await wait_history(admitted_pids, wait_s,
+                                      require_success=False)
+            load_wall = time.perf_counter() - t_load0
+
+            # act 4 — convergence: the drained fleet must scale back
+            # down (retire the autoscaled workers) without flapping
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                snap = scaler.snapshot()
+                if snap["scale_downs"] >= 1 and not snap["retiring"] \
+                        and not snap["spawned"]:
+                    break
+                await asyncio.sleep(0.25)
+            scaler.stop()
+            chaos_mod.set_chaos(None)
+            mstate.health.stop()
+
+            # gather
+            per_class = {}
+            for cls in rates:
+                lats, missing, failed = [], 0, 0
+                for pid, t_sub in submissions[cls]:
+                    h = hist.get(pid)
+                    if h is None:
+                        missing += 1
+                    elif h.get("status") != "success":
+                        failed += 1
+                    else:
+                        lats.append(h["finished_at"] - t_sub)
+                per_class[cls] = {
+                    "submitted": len(submissions[cls])
+                    + len(sheds[cls]),
+                    "admitted": len(submissions[cls]),
+                    "shed": len(sheds[cls]),
+                    "completed": len(lats),
+                    "failed": failed, "missing": missing,
+                    "p50_s": _percentile(lats, 50),
+                    "p95_s": _percentile(lats, 95),
+                }
+            fanout_ok = sum(
+                1 for p in fanout_pids
+                if (hist.get(p) or {}).get("status") == "success")
+            snap = scaler.snapshot()
+            chaos_after = {
+                k: v for k, v in tr.GLOBAL_COUNTERS.snapshot().items()
+                if k.startswith("chaos_")}
+            chaos_injected = {
+                k.split("chaos_", 1)[1]:
+                    v - chaos_before.get(k, 0)
+                for k, v in chaos_after.items()}
+            ledger_done = [j for j in mstate.ledger.snapshot()
+                           ["completed_jobs"] if j["kind"] == "tile"]
+            adm = mstate.admission.snapshot()["per_class"]
+            return {
+                "happy_s": happy_s,
+                "per_class": per_class,
+                "sheds_by_reason": {cls: dict(
+                    (r, sheds[cls].count(r)) for r in set(sheds[cls]))
+                    for cls in sheds},
+                "admission_counters": adm,
+                "fanout_jobs": len(fanout_pids) + 1,  # + the warm one
+                "fanout_completed": fanout_ok + 1,
+                "worker_killed": killed["done"],
+                "ledger_tile_jobs": [
+                    {k: j[k] for k in ("done_units", "total_units",
+                                       "reassigned_units",
+                                       "hedged_units")}
+                    for j in ledger_done[-3:]],
+                "autoscale": {k: snap[k] for k in
+                              ("scale_ups", "scale_downs", "flaps")},
+                "chaos_injected": chaos_injected,
+                "load_wall_s": load_wall,
+            }
+        finally:
+            chaos_mod.set_chaos(None)
+            scaler.stop()
+            mstate.health.stop()
+            for hb in heartbeats:
+                hb.stop()
+            await mclient.close()
+            for _st, client in list(workers) \
+                    + [(s, c) for s, c, _h in spawned.values()]:
+                try:
+                    await client.close()
+                except Exception:  # noqa: BLE001 - already closed
+                    pass
+            mstate.drain(5)
+            for st, _ in workers:
+                st.drain(5)
+            for st, _c, _h in spawned.values():
+                st.drain(2)
+
+    try:
+        m = asyncio.run(go())
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    paid = m["per_class"]["paid"]
+    paid_total = paid["admitted"] + m["fanout_jobs"] - 1  # warm excluded
+    paid_done = paid["completed"] + m["fanout_completed"] - 1
+    admitted = sum(v["admitted"] for v in m["per_class"].values()) \
+        + m["fanout_jobs"] - 1
+    completed = sum(v["completed"] for v in m["per_class"].values()) \
+        + m["fanout_completed"] - 1
+    return {
+        "duration_s": duration_s,
+        "rates_per_s": rates,
+        "happy_imgs_per_s": round(4 / m["happy_s"], 4),
+        "paid_completion_rate": round(paid_done / max(paid_total, 1), 4),
+        "completion_rate": round(completed / max(admitted, 1), 4),
+        "paid_shed": m["per_class"]["paid"]["shed"],
+        "free_shed": m["per_class"]["free"]["shed"],
+        "batch_shed": m["per_class"]["batch"]["shed"],
+        "p95_paid_s": m["per_class"]["paid"]["p95_s"],
+        "p95_free_s": m["per_class"]["free"]["p95_s"],
+        "p95_batch_s": m["per_class"]["batch"]["p95_s"],
+        "per_class": m["per_class"],
+        "sheds_by_reason": m["sheds_by_reason"],
+        "fanout_jobs": m["fanout_jobs"],
+        "fanout_completed": m["fanout_completed"],
+        "worker_killed": m["worker_killed"],
+        "ledger_tile_jobs": m["ledger_tile_jobs"],
+        "scale_ups": m["autoscale"]["scale_ups"],
+        "scale_downs": m["autoscale"]["scale_downs"],
+        "autoscale_flaps": m["autoscale"]["flaps"],
+        "chaos_injected": m["chaos_injected"],
+        "load_wall_s": round(m["load_wall_s"], 3),
+    }
+
+
+def run_overload(args):
+    """``--phase overload``: the elastic-fleet proof (ISSUE 9) — under
+    3-tenant Poisson overload with chaos armed and one worker killed,
+    paid jobs all complete, shedding is batch-first, per-class p95
+    ordering holds, and the autoscaler scales up AND down with zero
+    flaps; the chaos-off happy path stays within tolerance of the
+    prior pipeline-family baselines."""
+    from comfyui_distributed_tpu.parallel.mesh import force_cpu_platform
+    force_cpu_platform(1)
+    enable_compile_cache()
+    m = measure_overload(duration_s=10.0)
+    log(f"paid completion {m['paid_completion_rate']} "
+        f"(overall {m['completion_rate']}); shed paid/free/batch = "
+        f"{m['paid_shed']}/{m['free_shed']}/{m['batch_shed']}; p95 "
+        f"paid/free/batch = {m['p95_paid_s']}/{m['p95_free_s']}/"
+        f"{m['p95_batch_s']}; autoscale {m['scale_ups']} up "
+        f"{m['scale_downs']} down {m['autoscale_flaps']} flaps; chaos "
+        f"{m['chaos_injected']}; happy {m['happy_imgs_per_s']} imgs/s")
+    payload = {
+        "metric": metric_name(args),
+        "value": m["paid_completion_rate"],
+        "unit": metric_unit(args),
+        "vs_baseline": 1.0,
+        **m,
+    }
+    problems = []
+    if m["paid_completion_rate"] < 1.0:
+        problems.append(f"paid completion {m['paid_completion_rate']} "
+                        "< 1.0 (dropped paid jobs)")
+    if m["completion_rate"] < 1.0:
+        problems.append(f"completion_rate {m['completion_rate']} < 1.0")
+    if m["paid_shed"] != 0:
+        problems.append(f"{m['paid_shed']} paid prompts were shed "
+                        "(must be 0)")
+    if m["batch_shed"] < 1:
+        problems.append("no batch prompts shed — the overload never "
+                        "engaged the shed ladder")
+    if m["batch_shed"] < m["free_shed"]:
+        problems.append(
+            f"shed ordering inverted: batch {m['batch_shed']} < free "
+            f"{m['free_shed']}")
+    p95s = (m["p95_paid_s"], m["p95_free_s"], m["p95_batch_s"])
+    if any(p is None for p in p95s):
+        problems.append(f"missing per-class p95s: {p95s}")
+    elif not (p95s[0] < p95s[1] < p95s[2]):
+        problems.append(f"p95 ordering violated: paid {p95s[0]:.2f} / "
+                        f"free {p95s[1]:.2f} / batch {p95s[2]:.2f}")
+    if not m["worker_killed"]:
+        problems.append("worker kill never happened")
+    if m["fanout_completed"] < m["fanout_jobs"]:
+        problems.append(f"fan-out jobs lost: {m['fanout_completed']}/"
+                        f"{m['fanout_jobs']}")
+    if m["scale_ups"] < 1 or m["scale_downs"] < 1:
+        problems.append(f"autoscaler convergence not observed "
+                        f"({m['scale_ups']} up / {m['scale_downs']} "
+                        "down; want >=1 each)")
+    if m["autoscale_flaps"] != 0:
+        problems.append(f"{m['autoscale_flaps']} autoscaler flaps "
+                        "(want 0)")
+    if sum(m["chaos_injected"].values()) < 5:
+        problems.append(f"chaos injected too little: "
+                        f"{m['chaos_injected']}")
+    # happy-path guard: the admission/autoscale machinery must be free
+    # when idle — compare against the newest telemetry-family baseline
+    # (same 4-prompt coalesced-burst methodology)
+    prior = find_prior_artifact("resource_telemetry_imgs_per_s_4prompt")
+    if prior is not None:
+        base = float(prior[1].get("telemetry_on_imgs_per_s",
+                                  prior[1].get("value", 0)) or 0)
+        if base > 0:
+            delta_pct = (m["happy_imgs_per_s"] - base) / base * 100.0
+            payload["happy_vs_telemetry_baseline_pct"] = round(
+                delta_pct, 2)
+            payload["happy_baseline_artifact"] = os.path.basename(
+                prior[0])
+            if delta_pct < -25.0:
+                problems.append(
+                    f"happy-path throughput {m['happy_imgs_per_s']} "
+                    f"imgs/s is {delta_pct:.1f}% below the "
+                    f"{os.path.basename(prior[0])} baseline ({base})")
+    if problems:
+        payload["error"] = {"stage": "overload_invariants",
+                            "detail": "; ".join(problems)}
+    emit(args, payload)
+
+
 def run_suite(args):
     """The driver's default invocation: budget-capped backend escape
     (ladder_budget — ≤~20% of the claim window), then cheapest-first
@@ -2166,6 +2680,13 @@ def run_suite(args):
         fo = _phase_subprocess("failover", extra=("--check",))
         if fo is not None:
             payload_b["stages"]["failover"] = fo
+        # overload watchdog stage: the CPU proxy re-proves the elastic-
+        # fleet contract (zero dropped paid, p95 ordering, autoscaler
+        # convergence without flaps) under chaos, and --check flags a
+        # paid-completion regression against the prior BENCH artifact
+        ov = _phase_subprocess("overload", extra=("--check",))
+        if ov is not None:
+            payload_b["stages"]["overload"] = ov
         emit(args, payload_b)
     finally:
         try:
@@ -2596,6 +3117,8 @@ def main():
             run_fault(args)
         elif args.phase == "failover":
             run_failover(args)
+        elif args.phase == "overload":
+            run_overload(args)
         elif args.real_ckpt:
             run_real_ckpt(args)
         elif args.multiproc_sweep:

@@ -306,6 +306,67 @@ def cmd_top(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """Elastic-fleet reader: autoscaler state + recent decisions, the
+    federated signal it scales on, per-tenant-class admission counters
+    and the chaos spec — the headless answer to "is the fleet sized
+    right, and who is being shed"."""
+    import urllib.request
+    with urllib.request.urlopen(f"{args.url}/distributed/fleet",
+                                timeout=10) as r:
+        data = json.loads(r.read())
+    if args.json:
+        print(json.dumps(data, indent=2))
+        return 0
+    a = data.get("autoscale", {})
+    if a.get("enabled"):
+        th = a.get("thresholds", {})
+        b = a.get("bounds", {})
+        sig = a.get("signal") or {}
+        print(f"autoscaler {'RUNNING' if a.get('running') else 'stopped'}"
+              f"  workers[{b.get('min_workers')},{b.get('max_workers')}]"
+              f"  up>q/p {th.get('up_queue_per_participant')} or util "
+              f"{th.get('up_utilization')}  down<q/p "
+              f"{th.get('down_queue_per_participant')}"
+              f"  window={a.get('window')} cooldown={a.get('cooldown_s')}s")
+        util = sig.get("utilization")
+        print(f"  signal: queue={sig.get('queue_depth')} "
+              f"({sig.get('queue_per_participant')}/participant), "
+              f"util={f'{util:.0%}' if isinstance(util, (int, float)) else '-'}, "
+              f"{sig.get('live_workers')} live workers")
+        print(f"  actions: {a.get('scale_ups', 0)} up, "
+              f"{a.get('scale_downs', 0)} down, "
+              f"{a.get('flaps', 0)} flaps"
+              + (f", retiring {a['retiring']}" if a.get("retiring")
+                 else ""))
+        for d in a.get("decisions", [])[-8:]:
+            print(f"    {d['action']:4s} {d.get('reason', '')}"
+                  + (f"  worker={d['worker_id']}" if d.get("worker_id")
+                     else ""))
+    else:
+        print("autoscaler off"
+              + (" (DTPU_AUTOSCALE=1 set but not installed — worker "
+                 "or embedded server?)" if a.get("armed_env") else
+                 " (set DTPU_AUTOSCALE=1 on the master to arm)"))
+    adm = data.get("admission", {})
+    per = adm.get("per_class", {})
+    queued = adm.get("queued_by_class", {})
+    print(f"admission: default={adm.get('default_class')}  weights="
+          f"{adm.get('weights')}  shed_bars={adm.get('shed_thresholds')}"
+          f"  drain={adm.get('drain_rate_per_s')}/s")
+    for cls in adm.get("classes", sorted(per)):
+        v = per.get(cls, {})
+        print(f"  {cls:6s} queued={queued.get(cls, 0):3d}  "
+              f"admitted={v.get('admitted', 0):5d}  "
+              f"completed={v.get('completed', 0):5d}  "
+              f"shed={v.get('shed_overload', 0)} overload"
+              f"/{v.get('shed_rate', 0)} rate")
+    chaos = data.get("chaos", {})
+    if chaos.get("active"):
+        print(f"CHAOS ARMED: {chaos}")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Flight-recorder reader: no id lists recent job traces; with an id,
     pretty-prints the job's span tree (indent = parent/child, one line
@@ -486,6 +547,14 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the table")
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("fleet", help="elastic-fleet status: autoscaler "
+                                     "decisions + signal, per-tenant "
+                                     "admission counters, chaos spec")
+    p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the pretty report")
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser("wal", help="dump/verify a write-ahead job log: "
                                    "segments, checksums, lease, per-job "

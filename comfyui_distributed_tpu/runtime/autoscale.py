@@ -1,0 +1,480 @@
+"""Elastic-fleet autoscaler (ISSUE 9).
+
+The MapReduce lesson (PAPERS.md): a master that owns a work ledger and
+lease-based worker liveness can treat the worker pool itself as
+elastic — workers join by registration, leave by lease expiry, and the
+ledger reassigns whatever a leaver still owed.  This module closes the
+loop: a reconciliation thread on the master reads the fleet's
+*telemetry* (federated queue depth from the registry + the PR 5
+utilization estimate), compares it against thresholds, and spawns or
+retires workers.
+
+Convergence over reactivity — every decision passes three gates:
+
+- **sustained window**: a signal must sit beyond its threshold for
+  ``DTPU_AUTOSCALE_WINDOW`` *consecutive* samples (one noisy scrape
+  never scales anything);
+- **hysteresis**: the scale-down bars sit strictly below the scale-up
+  bars, so a signal oscillating between them does nothing;
+- **cooldown**: after any action the loop holds ``DTPU_AUTOSCALE_
+  COOLDOWN_S`` before the next one, giving the previous action time to
+  move the signal.
+
+Scale-up spawns through an injectable ``spawner`` (default: the
+process manager launches a local worker on a free port and registers
+it in the config so dispatch sees it).  Scale-down is *drain by lease
+non-renewal*: mark the victim RETIRING in the registry (the dispatcher
+stops handing it new work), wait for its queue to empty, then stop the
+process — its lease simply never renews again, the registry ages it to
+DEAD, and any unit it still owed is reassigned by the ledger exactly
+once (the PR 7 WAL makes that safe even across a master crash
+mid-retirement).
+
+Every decision lands in a bounded ring (``GET /distributed/fleet``,
+``cli fleet``) and bumps ``autoscale_*`` counters on both metrics
+surfaces; a direction reversal inside ``AUTOSCALE_FLAP_S`` of the
+previous action is counted as a **flap** — the oscillation failure the
+overload bench asserts is zero.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils import trace as trace_mod
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return int(default)
+
+
+def autoscale_armed() -> bool:
+    return os.environ.get(C.AUTOSCALE_ENV, "0").lower() \
+        in ("1", "true", "on")
+
+
+class FleetAutoscaler:
+    """Telemetry-driven reconciliation loop.
+
+    ``queue_depth_fn`` returns the MASTER's queued+running prompt count;
+    the worker half of the federated depth comes from the registry's
+    heartbeat-carried ``queue_remaining`` info.  ``util_fn`` returns the
+    fleet utilization estimate in [0, 1] (or None when telemetry is
+    off).  ``spawner()`` must start one worker and return its id (or
+    None on failure); ``retirer(worker_id)`` must stop the named
+    worker's process once the drain decided it is idle.  Both are
+    injectable so tests and the loopback bench scale real in-process
+    workers without subprocesses."""
+
+    def __init__(self,
+                 registry,
+                 queue_depth_fn: Callable[[], int],
+                 util_fn: Optional[Callable[[], Optional[float]]] = None,
+                 spawner: Optional[Callable[[], Optional[str]]] = None,
+                 retirer: Optional[Callable[[str], bool]] = None,
+                 worker_queue_fn: Optional[Callable[[str], Optional[int]]]
+                 = None,
+                 min_workers: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 up_queue: Optional[float] = None,
+                 down_queue: Optional[float] = None,
+                 up_util: Optional[float] = None,
+                 down_util: Optional[float] = None,
+                 window: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 interval_s: Optional[float] = None,
+                 drain_s: Optional[float] = None,
+                 flap_window_s: Optional[float] = None):
+        self.registry = registry
+        self.queue_depth_fn = queue_depth_fn
+        self.util_fn = util_fn
+        self.spawner = spawner
+        self.retirer = retirer
+        self.worker_queue_fn = worker_queue_fn
+        self.min_workers = _env_int(C.AUTOSCALE_MIN_ENV,
+                                    C.AUTOSCALE_MIN_DEFAULT) \
+            if min_workers is None else int(min_workers)
+        self.max_workers = _env_int(C.AUTOSCALE_MAX_ENV,
+                                    C.AUTOSCALE_MAX_DEFAULT) \
+            if max_workers is None else int(max_workers)
+        self.up_queue = _env_float(C.AUTOSCALE_UP_QUEUE_ENV,
+                                   C.AUTOSCALE_UP_QUEUE_DEFAULT) \
+            if up_queue is None else float(up_queue)
+        self.down_queue = _env_float(C.AUTOSCALE_DOWN_QUEUE_ENV,
+                                     C.AUTOSCALE_DOWN_QUEUE_DEFAULT) \
+            if down_queue is None else float(down_queue)
+        self.up_util = _env_float(C.AUTOSCALE_UP_UTIL_ENV,
+                                  C.AUTOSCALE_UP_UTIL_DEFAULT) \
+            if up_util is None else float(up_util)
+        self.down_util = _env_float(C.AUTOSCALE_DOWN_UTIL_ENV,
+                                    C.AUTOSCALE_DOWN_UTIL_DEFAULT) \
+            if down_util is None else float(down_util)
+        self.window = max(_env_int(C.AUTOSCALE_WINDOW_ENV,
+                                   C.AUTOSCALE_WINDOW_DEFAULT)
+                          if window is None else int(window), 1)
+        self.cooldown_s = _env_float(C.AUTOSCALE_COOLDOWN_ENV,
+                                     C.AUTOSCALE_COOLDOWN_DEFAULT) \
+            if cooldown_s is None else float(cooldown_s)
+        self.interval_s = max(
+            _env_float(C.AUTOSCALE_INTERVAL_ENV,
+                       C.AUTOSCALE_INTERVAL_DEFAULT)
+            if interval_s is None else float(interval_s), 0.02)
+        self.drain_s = _env_float(C.AUTOSCALE_DRAIN_ENV,
+                                  C.AUTOSCALE_DRAIN_DEFAULT) \
+            if drain_s is None else float(drain_s)
+        # a reversal is only a FLAP when it lands before the previous
+        # action could have moved the signal — i.e. within ~2 cooldowns;
+        # scaled to the configured loop tempo, capped by the constant so
+        # production cooldowns don't make every reversal a flap
+        self.flap_window_s = min(2.0 * self.cooldown_s,
+                                 C.AUTOSCALE_FLAP_S) \
+            if flap_window_s is None else float(flap_window_s)
+        # sustained-window counters (consecutive samples beyond bar)
+        self._over_streak = 0
+        self._under_streak = 0
+        self._last_action: Optional[str] = None   # "up" | "down"
+        self._last_action_t: Optional[float] = None
+        self._spawned: List[str] = []      # ids this loop created (LIFO)
+        self._retiring: Dict[str, float] = {}     # wid -> drain deadline
+        self.decisions: deque = deque(maxlen=C.AUTOSCALE_DECISIONS_KEPT)
+        self.flaps = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- signal ---------------------------------------------------------------
+
+    def fleet_signal(self) -> Dict[str, Any]:
+        """One federated sample: master queue depth + every live
+        worker's heartbeat-reported ``queue_remaining``, normalized per
+        participant, plus the utilization estimate."""
+        from comfyui_distributed_tpu.runtime import cluster as cl
+        master_q = 0
+        try:
+            master_q = int(self.queue_depth_fn() or 0)
+        except Exception as e:  # noqa: BLE001 - signal must not kill loop
+            debug_log(f"autoscale: queue probe failed: {e}")
+        worker_q = 0
+        live = 0
+        snap = self.registry.snapshot()["workers"] \
+            if self.registry is not None else {}
+        for wid, w in snap.items():
+            if w["state"] in (cl.HEALTHY, cl.SUSPECT, cl.RETIRING):
+                live += 1
+                q = self._worker_queue(wid, registry_hint=w)
+                worker_q += int(q or 0)
+        util = None
+        if self.util_fn is not None:
+            try:
+                util = self.util_fn()
+            except Exception as e:  # noqa: BLE001
+                debug_log(f"autoscale: util probe failed: {e}")
+        participants = 1 + live          # master serves too
+        depth = master_q + worker_q
+        return {
+            "queue_depth": depth,
+            "queue_per_participant": depth / participants,
+            "utilization": util,
+            "live_workers": live,
+            "participants": participants,
+        }
+
+    # -- decision -------------------------------------------------------------
+
+    def _record(self, action: str, reason: str, now: float,
+                signal: Dict[str, Any],
+                worker_id: Optional[str] = None) -> None:
+        entry = {"t": time.time(), "action": action, "reason": reason,
+                 "worker_id": worker_id,
+                 "queue_per_participant": round(
+                     signal.get("queue_per_participant", 0.0), 3),
+                 "utilization": signal.get("utilization"),
+                 "live_workers": signal.get("live_workers")}
+        with self._lock:
+            self.decisions.append(entry)
+        if action in ("up", "down"):
+            prev, prev_t = self._last_action, self._last_action_t
+            if prev is not None and prev != action \
+                    and prev_t is not None \
+                    and now - prev_t < self.flap_window_s:
+                self.flaps += 1
+                trace_mod.GLOBAL_COUNTERS.bump("autoscale_flaps")
+                log(f"autoscale: FLAP — {action} within "
+                    f"{now - prev_t:.1f}s of {prev} (hysteresis/window "
+                    f"too tight for this workload)")
+            self._last_action, self._last_action_t = action, now
+            trace_mod.GLOBAL_COUNTERS.bump(f"autoscale_{action}")
+            log(f"autoscale: scale {action} ({reason})"
+                + (f" worker={worker_id}" if worker_id else ""))
+
+    def _in_cooldown(self, now: float) -> bool:
+        return (self._last_action_t is not None
+                and now - self._last_action_t < self.cooldown_s)
+
+    def sample_once(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One reconciliation step (thread-free — tests drive this
+        directly with a fake clock).  Returns the sample + the action
+        taken ("up"/"down"/"retire_done"/None)."""
+        now = time.monotonic() if now is None else now
+        signal = self.fleet_signal()
+        # finish in-flight retirements first (their drain is async)
+        action = self._reap_retiring(now)
+        qpp = signal["queue_per_participant"]
+        util = signal["utilization"]
+        over = qpp > self.up_queue or (util is not None
+                                       and util > self.up_util)
+        under = qpp < self.down_queue and (util is None
+                                           or util < self.down_util)
+        self._over_streak = self._over_streak + 1 if over else 0
+        self._under_streak = self._under_streak + 1 if under else 0
+        if self._in_cooldown(now):
+            return {**signal, "action": action, "cooldown": True}
+        live = signal["live_workers"]
+        if over and self._over_streak >= self.window \
+                and live < self.max_workers \
+                and self.spawner is not None:
+            wid = None
+            try:
+                wid = self.spawner()
+            except Exception as e:  # noqa: BLE001 - spawn must not kill loop
+                log(f"autoscale: spawn failed: {type(e).__name__}: {e}")
+            if wid:
+                with self._lock:
+                    self._spawned.append(str(wid))
+                reason = (f"queue/participant {qpp:.2f} > "
+                          f"{self.up_queue:g}" if qpp > self.up_queue
+                          else f"utilization {util:.2f} > "
+                               f"{self.up_util:g}")
+                self.scale_ups += 1
+                self._record("up", reason, now, signal, wid)
+                action = "up"
+                self._over_streak = 0
+        elif under and self._under_streak >= self.window \
+                and live > self.min_workers \
+                and self.retirer is not None:
+            wid = self._pick_retirement_victim()
+            if wid is not None:
+                self.scale_downs += 1
+                if self.registry is not None:
+                    self.registry.set_retiring(wid, True)
+                with self._lock:
+                    self._retiring[wid] = now + self.drain_s
+                self._record(
+                    "down",
+                    f"queue/participant {qpp:.2f} < "
+                    f"{self.down_queue:g} (drain via lease non-renewal)",
+                    now, signal, wid)
+                action = "down"
+                self._under_streak = 0
+        return {**signal, "action": action, "cooldown": False}
+
+    def _pick_retirement_victim(self) -> Optional[str]:
+        """LIFO over the workers this loop spawned (the fixed config
+        fleet is never autoscaled away), skipping ones already
+        retiring."""
+        with self._lock:
+            for wid in reversed(self._spawned):
+                if wid not in self._retiring:
+                    return wid
+        return None
+
+    def _worker_queue(self, wid: str,
+                      registry_hint: Optional[Dict[str, Any]] = None
+                      ) -> Optional[int]:
+        """A worker's queued-prompt count: the injected probe when it
+        knows this worker (tests/bench reach the in-process state
+        directly), else the registry's heartbeat/health-carried
+        value."""
+        if self.worker_queue_fn is not None:
+            try:
+                q = self.worker_queue_fn(wid)
+                if q is not None:
+                    return q
+            except Exception:  # noqa: BLE001 - unknown, not zero
+                pass
+        w = registry_hint
+        if w is None and self.registry is not None:
+            w = self.registry.snapshot()["workers"].get(wid)
+        return None if w is None else w.get("queue_remaining")
+
+    def _reap_retiring(self, now: float) -> Optional[str]:
+        """Retirement completion: once a retiring worker's queue reads
+        empty (or its drain deadline passed — the ledger will reassign
+        whatever it still owed), stop its process and let the lease
+        age out.  An UNKNOWN queue waits for the deadline: retiring is
+        reversible until the process stops, so err toward patience."""
+        with self._lock:
+            pending = list(self._retiring.items())
+        finished = None
+        for wid, deadline in pending:
+            q = self._worker_queue(wid)
+            if not (q == 0 or now >= deadline):
+                continue
+            forced = q not in (0, None)
+            try:
+                if self.retirer is not None:
+                    self.retirer(wid)
+            except Exception as e:  # noqa: BLE001
+                log(f"autoscale: retire of {wid} failed: {e}")
+            with self._lock:
+                self._retiring.pop(wid, None)
+                if wid in self._spawned:
+                    self._spawned.remove(wid)
+            if self.registry is not None and not forced:
+                # drained clean: nothing in flight references this
+                # worker, so drop the tombstone.  A FORCED stop must
+                # keep the record — the drain loops detect lost owners
+                # via registry.state()==DEAD after the lease ages out,
+                # and forgetting the id now would read UNKNOWN forever,
+                # skipping the immediate ledger reassignment (and the
+                # DTPU_FAULT_POLICY=fail escalation) for whatever the
+                # worker still owed.
+                self.registry.forget(wid)
+            trace_mod.GLOBAL_COUNTERS.bump("autoscale_retired")
+            debug_log(f"autoscale: worker {wid} retired"
+                      + (" (drain deadline; lease will age to DEAD and "
+                         "the ledger reassigns the remainder)"
+                         if forced else " (drained clean)"))
+            finished = "retire_done"
+        return finished
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.sample_once()
+                except Exception as e:  # noqa: BLE001 - loop survives
+                    log(f"autoscale: reconcile error: "
+                        f"{type(e).__name__}: {e}")
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="dtpu-autoscale")
+        self._thread.start()
+        log(f"autoscale: armed (interval {self.interval_s:g}s, window "
+            f"{self.window} samples, up>{self.up_queue:g} q/p or "
+            f">{self.up_util:g} util, down<{self.down_queue:g} q/p, "
+            f"cooldown {self.cooldown_s:g}s, workers "
+            f"[{self.min_workers}, {self.max_workers}])")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2 * self.interval_s + 1.0)
+        self._thread = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": True,
+                "running": self._thread is not None
+                and self._thread.is_alive(),
+                "interval_s": self.interval_s,
+                "window": self.window,
+                "cooldown_s": self.cooldown_s,
+                "thresholds": {
+                    "up_queue_per_participant": self.up_queue,
+                    "down_queue_per_participant": self.down_queue,
+                    "up_utilization": self.up_util,
+                    "down_utilization": self.down_util,
+                },
+                "bounds": {"min_workers": self.min_workers,
+                           "max_workers": self.max_workers},
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "flaps": self.flaps,
+                "spawned": list(self._spawned),
+                "retiring": sorted(self._retiring),
+                "decisions": list(self.decisions),
+            }
+
+
+def default_spawner(state) -> Callable[[], Optional[str]]:
+    """The production spawner: add an ``auto_N`` worker on a free port
+    to the config and launch it through the process manager (it
+    inherits DTPU_MASTER_URL/DTPU_WORKER_ID, so it heartbeats its lease
+    back here and dispatch picks it up on the next fan-out)."""
+    from comfyui_distributed_tpu.utils import config as cfg_mod
+    from comfyui_distributed_tpu.utils.net import find_free_port
+    counter = {"n": 0}
+
+    def spawn() -> Optional[str]:
+        counter["n"] += 1
+        wid = f"auto_{int(time.time())}_{counter['n']}"
+        worker = {"id": wid, "name": wid, "host": "127.0.0.1",
+                  "port": find_free_port(), "enabled": True}
+        cfg_mod.mutate_config(
+            lambda cfg: cfg.setdefault("workers", []).append(worker),
+            state.config_path)
+        state.manager.launch_worker(worker)
+        return wid
+
+    return spawn
+
+
+def default_retirer(state) -> Callable[[str], bool]:
+    """The production retirer: stop the managed process and drop the
+    worker from the config (the registry ages the lease out on its
+    own)."""
+    from comfyui_distributed_tpu.utils import config as cfg_mod
+
+    def retire(worker_id: str) -> bool:
+        ok = state.manager.stop_worker(worker_id)
+        try:
+            cfg_mod.mutate_config(
+                lambda cfg: cfg_mod.delete_worker(cfg, str(worker_id)),
+                state.config_path)
+        except Exception as e:  # noqa: BLE001 - config cleanup best-effort
+            debug_log(f"autoscale: config cleanup of {worker_id}: {e}")
+        return ok
+
+    return retire
+
+
+def install(state) -> Optional[FleetAutoscaler]:
+    """Arm the autoscaler for a master ``state`` when DTPU_AUTOSCALE=1:
+    federated queue signal from the ServerState + registry, utilization
+    from the resource monitor, spawn/retire through the process
+    manager.  Returns None when unarmed (the default)."""
+    if not autoscale_armed():
+        return None
+    from comfyui_distributed_tpu.utils import resource as resource_mod
+
+    def util() -> Optional[float]:
+        snap = resource_mod.fleet_sample()
+        u = snap.get("utilization")
+        return float(u) if isinstance(u, (int, float)) else None
+
+    scaler = FleetAutoscaler(
+        registry=state.cluster,
+        queue_depth_fn=state.queue_remaining,
+        util_fn=util,
+        spawner=default_spawner(state),
+        retirer=default_retirer(state),
+    )
+    scaler.start()
+    return scaler

@@ -45,6 +45,10 @@ HEALTHY = "healthy"
 SUSPECT = "suspect"
 DEAD = "dead"
 UNKNOWN = "unknown"       # registered but never contacted
+# autoscaler drain (ISSUE 9): lease still renewing (in-flight units keep
+# checking in) but the dispatcher must not hand it NEW work; the
+# retirement completes when the lease is simply not renewed again
+RETIRING = "retiring"
 
 
 class ClusterFaultError(RuntimeError):
@@ -90,6 +94,14 @@ def hedge_min_wait() -> float:
                                     C.HEDGE_MIN_WAIT_DEFAULT))
     except ValueError:
         return C.HEDGE_MIN_WAIT_DEFAULT
+
+
+def slo_hedge_fraction() -> float:
+    try:
+        return float(os.environ.get(C.SLO_HEDGE_FRACTION_ENV,
+                                    C.SLO_HEDGE_FRACTION_DEFAULT))
+    except ValueError:
+        return C.SLO_HEDGE_FRACTION_DEFAULT
 
 
 def fault_injection(raw: Optional[str] = None) -> Dict[str, Any]:
@@ -257,6 +269,28 @@ class ClusterRegistry:
 
     # -- reads ----------------------------------------------------------------
 
+    def set_retiring(self, worker_id: str, retiring: bool = True) -> bool:
+        """Autoscaler drain flag: a retiring worker keeps its lease (its
+        in-flight units still check in) but reads as RETIRING so the
+        dispatcher stops handing it new work.  Returns False for
+        unknown ids."""
+        wid = str(worker_id)
+        now = time.monotonic()
+        with self._lock:
+            rec = self._workers.get(wid)
+            if rec is None:
+                return False
+            rec["retiring"] = bool(retiring)
+            self._refresh_locked(wid, rec, now)
+            return True
+
+    def forget(self, worker_id: str) -> bool:
+        """Drop a worker from the registry entirely (a retired worker's
+        process is gone; keeping the record would hold a DEAD tombstone
+        in every snapshot forever)."""
+        with self._lock:
+            return self._workers.pop(str(worker_id), None) is not None
+
     def _compute_locked(self, rec: Dict[str, Any], now: float) -> str:
         if rec["last_seen"] is None:
             # never contacted: config-seeded entries stay UNKNOWN (the
@@ -264,6 +298,9 @@ class ClusterRegistry:
             return UNKNOWN
         if now - rec["last_seen"] > self.lease_s:
             return DEAD
+        if rec.get("retiring"):
+            # draining: alive (lease fresh) but not dispatchable
+            return RETIRING
         if rec["failed_probes"] >= self.suspect_probes:
             return SUSPECT
         return HEALTHY
@@ -308,6 +345,7 @@ class ClusterRegistry:
                        else round(now - rec["last_seen"], 3))
                 workers[wid] = {
                     "state": st,
+                    "retiring": bool(rec.get("retiring")),
                     "last_seen_age_s": age,
                     "failed_probes": rec["failed_probes"],
                     "lease_remaining_s": (
@@ -339,6 +377,12 @@ class WorkLedger:
         self._jobs: Dict[str, Dict[str, Any]] = {}
         self._redispatch: Dict[str, Callable] = {}
         self._completed: deque = deque(maxlen=C.LEDGER_COMPLETED_KEPT)
+        # deadline-aware hedging (ISSUE 9): per-job SLO deadlines on the
+        # monotonic clock, stamped by the orchestrator BEFORE create_job
+        # (the request knows its budget; the op only knows its units).
+        # Bounded FIFO like the redispatcher map — a request whose job
+        # never materializes must not leak its deadline forever.
+        self._deadlines: Dict[str, float] = {}
         # durability plane (ISSUE 7): when a WAL is attached, every
         # ownership transition appends a record, winning check-ins spill
         # their payload first, and create_job merges the crash-recovered
@@ -440,6 +484,7 @@ class WorkLedger:
         with self._lock:
             job = self._jobs.pop(jid, None)
             self._redispatch.pop(jid, None)
+            self._deadlines.pop(jid, None)
             if job is None:
                 return None
             units = job["units"]
@@ -679,6 +724,21 @@ class WorkLedger:
                     n += 1
             job["hedged"] -= n
 
+    def set_deadline(self, job_id: str,
+                     deadline_monotonic: float) -> None:
+        """Stamp a job's SLO deadline (monotonic clock).  May be called
+        before :meth:`create_job` — the orchestrator stamps at dispatch
+        time, the op creates the job when it runs.  Re-keys
+        :meth:`overdue_units` on the remaining budget."""
+        with self._lock:
+            self._deadlines[str(job_id)] = float(deadline_monotonic)
+            while len(self._deadlines) > 512:
+                self._deadlines.pop(next(iter(self._deadlines)))
+
+    def deadline(self, job_id: str) -> Optional[float]:
+        with self._lock:
+            return self._deadlines.get(str(job_id))
+
     def overdue_units(self, job_id: str,
                       factor: Optional[float] = None,
                       min_progress_pct: Optional[float] = None,
@@ -690,7 +750,15 @@ class WorkLedger:
         ``min_progress_pct`` % complete (the Tail-at-Scale guard: hedge
         the last stragglers, not the whole job; the wait floor keeps
         the happy path hedge-free when units land in sub-second
-        bursts)."""
+        bursts).
+
+        Deadline-aware re-keying (ISSUE 9): a job stamped with an SLO
+        deadline (:meth:`set_deadline`) hedges on its REMAINING BUDGET
+        once that is tighter than the global policy — the overdue bar
+        drops to ``max(DTPU_SLO_HEDGE_FRACTION x budget left,
+        SLO_MIN_WAIT_S)`` and the min-progress gate is waived, so a job
+        about to blow its deadline hedges its first straggler instead
+        of politely waiting for 50% completion."""
         factor = hedge_factor() if factor is None else factor
         min_pct = hedge_pct() if min_progress_pct is None \
             else min_progress_pct
@@ -701,10 +769,21 @@ class WorkLedger:
             if job is None or job["latency_ema"] is None:
                 return {}
             units = job["units"]
-            done = sum(1 for u in units.values() if u["state"] == "done")
-            if not units or 100.0 * done / len(units) < min_pct:
+            if not units:
                 return {}
             threshold = max(factor * job["latency_ema"], min_wait)
+            slo_pressed = False
+            dl = self._deadlines.get(str(job_id))
+            if dl is not None:
+                budget = max(dl - now, 0.0)
+                slo_threshold = max(budget * slo_hedge_fraction(),
+                                    C.SLO_MIN_WAIT_S)
+                if slo_threshold < threshold:
+                    threshold = slo_threshold
+                    slo_pressed = True
+            done = sum(1 for u in units.values() if u["state"] == "done")
+            if not slo_pressed and 100.0 * done / len(units) < min_pct:
+                return {}
             out = {}
             for u, rec in units.items():
                 if rec["state"] == "done" or rec["hedged"]:
@@ -713,7 +792,10 @@ class WorkLedger:
                                              job["created_at"])
                 if now - last > threshold:
                     out[u] = rec["owner"]
-            return out
+        if out and slo_pressed:
+            trace_mod.GLOBAL_COUNTERS.bump("cluster_slo_overdue",
+                                           len(out))
+        return out
 
     # -- crash recovery (durability plane) ------------------------------------
 
@@ -814,10 +896,14 @@ class WorkLedger:
                 units = job["units"]
                 done = sum(1 for u in units.values()
                            if u["state"] == "done")
+                dl = self._deadlines.get(jid)
                 active[jid] = {
                     "kind": job["kind"],
                     "total_units": len(units),
                     "done_units": done,
+                    "slo_deadline_remaining_s": (
+                        None if dl is None
+                        else round(dl - time.monotonic(), 3)),
                     "reassigned_units": job["reassigned"],
                     "hedged_units": job["hedged"],
                     "latency_estimate_s": (
@@ -858,6 +944,14 @@ class HeartbeatSender:
 
     def beat_once(self, timeout: float = 3.0) -> bool:
         import urllib.request
+
+        from comfyui_distributed_tpu.utils import chaos as chaos_mod
+        cm = chaos_mod.get_chaos()
+        if cm.active and cm.heartbeat_frozen(self.worker_id):
+            # chaos harness: a frozen heartbeat ages the lease out while
+            # the process is alive — the suspect/dead/rehome edge
+            debug_log(f"chaos: heartbeat for {self.worker_id} frozen")
+            return False
         payload = {"worker_id": self.worker_id}
         if self.port:
             payload["port"] = self.port
@@ -886,6 +980,24 @@ class HeartbeatSender:
         except Exception as e:  # noqa: BLE001 - best-effort renewal
             debug_log(f"heartbeat to {self.master_url} failed: {e}")
             return False
+
+    def rehome(self, master_url: str, attempts: int = 3) -> bool:
+        """Retarget this sender at a new master and register there NOW.
+
+        The takeover fix (ISSUE 9): the first rehomed beat can race the
+        dying master's sockets (connection refused / reset while the
+        host is mid-failover), and a single best-effort beat would
+        leave this worker unregistered at the new master for a full
+        heartbeat interval — during which its lease reads as expired
+        and its in-flight units get needlessly reassigned.  A short
+        immediate retry burst closes that window: the worker is
+        re-registered on the first beat that lands."""
+        self.master_url = master_url.rstrip("/")
+        for i in range(max(attempts, 1)):
+            if self.beat_once():
+                return True
+            time.sleep(min(0.2 * (2 ** i), 1.0))
+        return False
 
     def start(self) -> None:
         if self._thread is not None:

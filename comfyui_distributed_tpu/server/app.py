@@ -46,8 +46,10 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import collections
 import itertools
 import json
+import math
 import os
 import queue
 import threading
@@ -57,8 +59,10 @@ from typing import Any, Dict, List, Optional
 from aiohttp import web
 
 from comfyui_distributed_tpu.ops.base import OpContext
+from comfyui_distributed_tpu.runtime import autoscale as autoscale_mod
 from comfyui_distributed_tpu.runtime import cluster as cluster_mod
 from comfyui_distributed_tpu.runtime.jobs import JobStore
+from comfyui_distributed_tpu.utils import chaos as chaos_mod
 from comfyui_distributed_tpu.runtime.manager import (
     WorkerProcessManager,
     auto_launch_workers,
@@ -77,6 +81,18 @@ from comfyui_distributed_tpu.workflow.executor import WorkflowExecutor
 
 class QueueFullError(RuntimeError):
     """enqueue_prompt hit the DTPU_MAX_QUEUE backpressure cap."""
+
+
+class ShedError(QueueFullError):
+    """Admission shed the prompt (class-aware overload shedding or a
+    per-client token bucket); carries the rejection detail so the 429
+    can tell the client WHY and HOW LONG to back off."""
+
+    def __init__(self, rejection: Dict[str, Any]):
+        self.rejection = dict(rejection)
+        super().__init__(
+            f"shed ({rejection.get('reason')}) for tenant class "
+            f"{rejection.get('tenant')!r}")
 
 
 class DrainingError(RuntimeError):
@@ -149,6 +165,19 @@ class ServerState:
         }
         self.max_queue = int(os.environ.get(C.MAX_QUEUE_ENV,
                                             C.MAX_QUEUE_DEFAULT))
+        # SLO-aware multi-tenant admission (ISSUE 9): priority classes
+        # with weighted fair dequeue, class-aware shedding and optional
+        # per-client token buckets.  Untagged traffic rides the highest
+        # class, so single-tenant deployments keep the plain
+        # DTPU_MAX_QUEUE backpressure semantics unchanged.
+        self.admission = sched_mod.AdmissionController()
+        # completion timestamps ring feeding the 429 Retry-After hint
+        # (drain rate = prompts finalized per second, recent window)
+        self._completions: collections.deque = collections.deque(
+            maxlen=128)
+        # elastic-fleet autoscaler: armed by serve() on a master when
+        # DTPU_AUTOSCALE=1 (runtime/autoscale.install)
+        self.autoscaler: Optional[Any] = None
         # resource telemetry plane (ISSUE 5): process-global sampler
         # feeding bounded ring timeseries; queue depth reads from THIS
         # state (the most recent ServerState in a multi-state process).
@@ -233,12 +262,24 @@ class ServerState:
         with self._queue_lock:
             return len(self._queue) + (1 if self._running else 0)
 
+    def queued_by_class(self) -> Dict[str, int]:
+        """Queued (not yet running) prompts per tenant class — the
+        admission block's live gauge on both metrics surfaces."""
+        out = {cls: 0 for cls in self.admission.classes}
+        with self._queue_lock:
+            for item in self._queue:
+                cls = item.get("tenant") or self.admission.default_class
+                out[cls] = out.get(cls, 0) + 1
+        return out
+
     def enqueue_prompt(self, prompt: Dict[str, Any], client_id: str,
                        extra_data: Optional[Dict[str, Any]] = None,
                        trace_parent: Optional[tuple] = None,
                        trace_span: Any = None,
                        pid: Optional[str] = None,
-                       _recovered: bool = False) -> str:
+                       tenant: Optional[str] = None,
+                       _recovered: bool = False,
+                       _preadmitted: bool = False) -> str:
         """Queue one prompt.  Every job gets a request-scoped trace: a
         ``job`` root span that lives from enqueue to finalize and lands
         in the flight recorder under the prompt id.  ``trace_parent`` is
@@ -252,15 +293,22 @@ class ServerState:
         # prompt under its ORIGINAL id, so clients polling /history find
         # it on the restarted/stand-in master
         pid = pid or f"p_{int(time.time() * 1000)}_{next(self._id_counter)}"
+        # an extra_data-carried priority survives paths that don't pass
+        # tenant explicitly (crash-recovery re-enqueues replay extra_data
+        # from the WAL; direct embedded callers)
+        tenant = self.admission.classify(
+            tenant or (extra_data or {}).get("priority"))
         sp = trace_span
         if sp is None:
             tid, par = trace_parent if trace_parent else (None, None)
             sp = trace_mod.start_span(
                 "job", trace_id=tid, parent_id=par,
                 attrs={"prompt_id": pid, "client_id": str(client_id),
+                       "tenant": tenant,
                        "role": "worker" if self.is_worker else "master"})
         else:
             sp.attrs.setdefault("prompt_id", pid)
+            sp.attrs.setdefault("tenant", tenant)
         # signature hashed OUTSIDE the lock (it walks the whole graph):
         # _pop_group then only compares strings under the lock
         sig = sched_mod.coalesce_signature(prompt) \
@@ -270,6 +318,18 @@ class ServerState:
                 self._abandon_span(sp, pid, "rejected: draining")
                 raise DrainingError("server is draining; not accepting "
                                     "prompts")
+            # class-aware admission (token bucket + shed thresholds);
+            # recovery re-enqueues and pre-admitted fan-out shares skip
+            # it — their admission already happened (and was WAL'd)
+            if not _recovered and not _preadmitted:
+                rejection = self.admission.admit(
+                    tenant, str(client_id), len(self._queue),
+                    self.max_queue)
+                if rejection is not None:
+                    self._abandon_span(
+                        sp, pid, f"rejected: shed "
+                                 f"({rejection['reason']}, {tenant})")
+                    raise ShedError(rejection)
             if len(self._queue) >= self.max_queue:
                 self._abandon_span(sp, pid, "rejected: queue full")
                 raise QueueFullError(
@@ -278,6 +338,7 @@ class ServerState:
                                 "client_id": client_id,
                                 "extra_data": extra_data or {},
                                 "sig": sig,
+                                "tenant": tenant,
                                 "span": sp,
                                 "t_enq": time.perf_counter()})
         # write-ahead: the admission record is durable BEFORE the
@@ -305,22 +366,22 @@ class ServerState:
             duration_s=round(time.time() - sp.start_s, 6))
 
     def _pop_group(self) -> Optional[List[Dict[str, Any]]]:
-        """Pop the next dispatch group: the head prompt plus the longest
-        CONTIGUOUS run of queued prompts sharing its coalescing
-        signature (capped at DTPU_MAX_COALESCE).  Contiguity is what
-        preserves per-client FIFO order: no prompt ever executes before
-        one queued ahead of it."""
+        """Pop the next dispatch group under weighted fair scheduling
+        (workflow/scheduler.pop_fair_group): the scheduled class's
+        head prompt plus that class's next signature-identical prompts
+        (capped at DTPU_MAX_COALESCE).  Per-class FIFO order is
+        preserved by construction — no prompt ever executes before one
+        of ITS OWN class queued ahead of it — and with a single class
+        queued (the default: untagged traffic) this is exactly the
+        legacy head-of-queue contiguous-run pop."""
         with self._queue_lock:
             if not self._queue:
                 self._queue_event.clear()
                 return None
-            group = [self._queue.pop(0)]
-            if self.coalesce_enabled:
-                sig = group[0].get("sig")
-                while (sig is not None and self._queue
-                       and len(group) < self.coalesce_max
-                       and self._queue[0].get("sig") == sig):
-                    group.append(self._queue.pop(0))
+            group = sched_mod.pop_fair_group(
+                self._queue, self.admission,
+                coalesce_max=self.coalesce_max
+                if self.coalesce_enabled else 1)
             self._running = True
         now = time.perf_counter()
         now_wall = time.time()
@@ -523,10 +584,46 @@ class ServerState:
                     f"{slow_thr:g}s threshold; trace {sp.trace_id}; "
                     f"{_mem_note()}; stages "
                     + ", ".join(f"{n}={s:.2f}s" for n, s in top))
+        # drain-rate ring + per-class completion counters: each
+        # finalized prompt frees a queue slot, which is what the 429
+        # Retry-After hint estimates from
+        self._completions.append((time.monotonic(), k))
+        if err is None:
+            for item in group:
+                self.admission.on_complete(
+                    item.get("tenant") or self.admission.default_class)
         with self._queue_lock:
             self._finalize_pending -= 1
         debug_log(f"group {group[0]['id']} (x{k}) done in "
                   f"{time.perf_counter() - t0:.2f}s")
+
+    # --- backpressure hints --------------------------------------------------
+
+    def drain_rate(self, window_s: float = 30.0) -> float:
+        """Prompts finalized per second over the recent window (0.0
+        until anything completed) — the denominator of the Retry-After
+        hint."""
+        now = time.monotonic()
+        n = sum(k for t, k in self._completions if now - t <= window_s)
+        if n <= 0:
+            return 0.0
+        oldest = min(t for t, _ in self._completions
+                     if now - t <= window_s)
+        return n / max(now - oldest, 0.5)
+
+    def retry_after_hint(self, floor_s: float = 1.0) -> int:
+        """Whole seconds a shed client should wait before retrying,
+        derived from the current backlog and the measured drain rate:
+        roughly "when will a quarter of the queue have drained".
+        Conservative bounds [1, 30] — the point is de-synchronizing the
+        retry storm, not a precise reservation."""
+        depth = self.queue_remaining()
+        rate = self.drain_rate()
+        if rate <= 0:
+            hint = 5.0          # nothing measured yet: a polite default
+        else:
+            hint = max(depth, 1) / (4.0 * rate)
+        return int(min(max(math.ceil(max(hint, floor_s)), 1), 30))
 
     # --- crash recovery (durability plane) ----------------------------------
 
@@ -549,6 +646,10 @@ class ServerState:
         if timeout is None:
             timeout = float(os.environ.get(C.DRAIN_TIMEOUT_ENV,
                                            C.DRAIN_TIMEOUT_DEFAULT))
+        if self.autoscaler is not None:
+            # a reconciliation firing mid-shutdown would spawn workers
+            # into a dying fleet
+            self.autoscaler.stop()
         with self._queue_lock:
             self._draining = True
         deadline = time.monotonic() + max(timeout, 0.0)
@@ -590,7 +691,11 @@ class ServerState:
 
 def build_app(state: Optional[ServerState] = None) -> web.Application:
     state = state or ServerState()
-    app = web.Application(client_max_size=512 * 1024 * 1024)
+    # chaos harness (ISSUE 9): with DTPU_CHAOS armed the middleware may
+    # 503/delay a fraction of inbound data-plane requests; unarmed it is
+    # one env-change check per request
+    app = web.Application(client_max_size=512 * 1024 * 1024,
+                          middlewares=[chaos_mod.middleware()])
     app["state"] = state
 
     async def on_startup(app):
@@ -742,6 +847,28 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                   # durability plane: WAL size/sync-lag
                                   # gauges, lease holder + epoch
                                   "durability": dur_stats,
+                                  # multi-tenant admission: per-class
+                                  # admitted/shed/completed counters,
+                                  # weights, shed bars, drain rate
+                                  "admission": {
+                                      **state.admission.snapshot(),
+                                      "queued_by_class":
+                                          state.queued_by_class(),
+                                      "drain_rate_per_s": round(
+                                          state.drain_rate(), 4),
+                                  },
+                                  # elastic fleet: autoscaler decisions
+                                  # ring + flap/scale counters
+                                  "autoscale": (
+                                      state.autoscaler.snapshot()
+                                      if state.autoscaler is not None
+                                      else {"enabled":
+                                            autoscale_mod
+                                            .autoscale_armed()}),
+                                  # chaos harness: armed spec + injected
+                                  # fault counters (all zero unarmed)
+                                  "chaos": chaos_mod.get_chaos()
+                                  .snapshot(),
                                   # resource telemetry: current gauges +
                                   # bounded ring-series stats (device
                                   # memory, RSS, utilization, queue)
@@ -831,7 +958,52 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
              [({"state": st},
                sum(1 for w in cl_workers if w["state"] == st))
               for st in (cluster_mod.HEALTHY, cluster_mod.SUSPECT,
-                         cluster_mod.DEAD, cluster_mod.UNKNOWN)]))
+                         cluster_mod.DEAD, cluster_mod.UNKNOWN,
+                         cluster_mod.RETIRING)]))
+        # multi-tenant admission: per-class queue gauge + decision
+        # counters (tenant label), so overload dashboards can draw the
+        # shed-first ordering directly
+        queued = state.queued_by_class()
+        adm = state.admission.snapshot()["per_class"]
+        extra.extend([
+            ("dtpu_tenant_queued", "gauge",
+             "Queued prompts by tenant class.",
+             [({"tenant": cls}, n) for cls, n in sorted(queued.items())]),
+            ("dtpu_tenant_admitted_total", "counter",
+             "Prompts admitted by tenant class.",
+             [({"tenant": cls}, v["admitted"])
+              for cls, v in sorted(adm.items())]),
+            ("dtpu_tenant_shed_total", "counter",
+             "Prompts shed (429) by tenant class and reason.",
+             [({"tenant": cls, "reason": reason},
+               v[f"shed_{reason}"])
+              for cls, v in sorted(adm.items())
+              for reason in ("rate", "overload")]),
+            ("dtpu_tenant_completed_total", "counter",
+             "Prompts completed by tenant class.",
+             [({"tenant": cls}, v["completed"])
+              for cls, v in sorted(adm.items())]),
+            ("dtpu_queue_drain_rate", "gauge",
+             "Prompts finalized per second (recent window).",
+             [({}, round(state.drain_rate(), 4))]),
+        ])
+        if state.autoscaler is not None:
+            asnap = state.autoscaler.snapshot()
+            extra.extend([
+                ("dtpu_autoscale_scale_ups_total", "counter",
+                 "Autoscaler scale-up actions.",
+                 [({}, asnap["scale_ups"])]),
+                ("dtpu_autoscale_scale_downs_total", "counter",
+                 "Autoscaler scale-down actions.",
+                 [({}, asnap["scale_downs"])]),
+                ("dtpu_autoscale_flaps_total", "counter",
+                 "Direction reversals inside the flap window "
+                 "(should stay 0).",
+                 [({}, asnap["flaps"])]),
+                ("dtpu_autoscale_retiring", "gauge",
+                 "Workers currently draining toward retirement.",
+                 [({}, len(asnap["retiring"]))]),
+            ])
         if state.durable is not None:
             # WAL size/lag + lease gauges (satellite: the durability
             # plane is scrapeable next to everything else).  stats()
@@ -1059,6 +1231,34 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             state.cluster.update_resources(str(wid), data["resources"])
         return ok(out)
 
+    async def fleet_info(request):
+        """Elastic-fleet plane (ISSUE 9): autoscaler state + decision
+        ring, the live federated signal it scales on, per-class
+        admission counters and the chaos-harness spec — the one
+        endpoint `cli fleet` renders."""
+        scaler = state.autoscaler
+        snap = {"enabled": False,
+                "armed_env": autoscale_mod.autoscale_armed()}
+        signal = None
+        if scaler is not None:
+            loop = asyncio.get_running_loop()
+            snap = scaler.snapshot()
+            # the signal probes the registry + resource monitor — keep
+            # it off the event loop like every other probe
+            signal = await loop.run_in_executor(None,
+                                                scaler.fleet_signal)
+        return web.json_response({
+            "autoscale": {**snap, "signal": signal},
+            "admission": {
+                **state.admission.snapshot(),
+                "queued_by_class": state.queued_by_class(),
+                "drain_rate_per_s": round(state.drain_rate(), 4),
+                "max_queue": state.max_queue,
+            },
+            "workers": state.cluster.snapshot()["workers"],
+            "chaos": chaos_mod.get_chaos().snapshot(),
+        })
+
     async def durability_info(request):
         """Durability plane snapshot: lease holder/epoch, WAL size and
         sync lag, recovery counters — None-shaped when DTPU_WAL_DIR is
@@ -1106,16 +1306,21 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         if wid:
             os.environ.setdefault(C.WORKER_ID_ENV, wid)
         hb = state.heartbeat
-        if hb is not None:
-            hb.master_url = url
-        elif wid:
+        if hb is None and wid:
             hb = state.heartbeat = cluster_mod.HeartbeatSender(
                 url, wid, port=state.port)
             hb.start()
         beat = False
         if hb is not None:
+            # re-register at the new master NOW, with a short retry
+            # burst (HeartbeatSender.rehome): the first beat can race
+            # the dying master's teardown, and a single best-effort
+            # beat would leave this worker unregistered — reading as
+            # lease-expired — for a full heartbeat interval, so the new
+            # master needlessly reassigns its in-flight units
             loop = asyncio.get_running_loop()
-            beat = await loop.run_in_executor(None, hb.beat_once)
+            beat = await loop.run_in_executor(None,
+                                              lambda: hb.rehome(url))
         log(f"re-homed to master {url}"
             + ("" if beat else " (first heartbeat pending)"))
         return ok({"master_url": url, "heartbeat": hb is not None,
@@ -1366,8 +1571,14 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
 
     def _decode_upload(field) -> Any:
         """Multipart image/tile field -> tensor, honoring the negotiated
-        content type (raw tensor or PNG) with wire accounting."""
+        content type (raw tensor or PNG) with wire accounting.  The
+        chaos harness may corrupt the payload HERE: the decode then
+        raises, the sender's retry re-delivers clean, and the
+        idempotency keys keep the redelivery exactly-once."""
         data = field.file.read()
+        cm = chaos_mod.get_chaos()
+        if cm.active:
+            data = cm.corrupt(data, what="tile/image upload")
         if (field.content_type or "") == C.TENSOR_WIRE_CONTENT_TYPE:
             trace_mod.GLOBAL_COUNTERS.bump("wire_tensor_msgs")
             trace_mod.GLOBAL_COUNTERS.bump("wire_tensor_bytes", len(data))
@@ -1487,6 +1698,22 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         return web.json_response(
             {"exec_info": {"queue_remaining": state.queue_remaining()}})
 
+    def _is_dispatched_share(prompt: Dict[str, Any]) -> bool:
+        """A graph some orchestrator already prepared (hidden
+        multi_job_id on a distributed node): mandatory work for a job
+        that passed admission AT ITS MASTER.  Re-shedding it here would
+        silently amputate an admitted job's worker shares, so these
+        bypass this server's own admission (the hard queue-full cap
+        still applies)."""
+        for node in prompt.values():
+            if not isinstance(node, dict) or node.get("class_type") \
+                    not in C.DISTRIBUTED_NODE_TYPES:
+                continue
+            h = {**node.get("inputs", {}), **node.get("hidden", {})}
+            if h.get("multi_job_id"):
+                return True
+        return False
+
     async def post_prompt(request):
         data = await request.json()
         prompt = data.get("prompt")
@@ -1509,6 +1736,37 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         # dispatch so saved PNGs embed the source workflow (reference
         # gpupanel.js:1344-1358)
         extra_data = data.get("extra_data") or {}
+        # multi-tenant admission (ISSUE 9): {"priority": "paid"|"free"|
+        # "batch"} classifies the request (untagged -> highest class);
+        # {"slo_s": N} stamps its distributed jobs with a deadline that
+        # re-keys the hedge machinery on the remaining budget
+        tenant = state.admission.classify(
+            data.get("priority") or extra_data.get("priority"))
+        if data.get("priority") or extra_data.get("priority"):
+            # tagged requests keep their class through extra_data (it
+            # is WAL'd with the admission record, so a crash-recovery
+            # re-enqueue resumes at the SAME priority)
+            extra_data = {**extra_data, "priority": tenant}
+        slo_s = data.get("slo_s") or extra_data.get("slo_s")
+        try:
+            slo_s = float(slo_s) if slo_s is not None else None
+        except (TypeError, ValueError):
+            slo_s = None
+        if slo_s is not None and slo_s > 0:
+            extra_data = {**extra_data, "slo_s": slo_s}
+
+        def _shed_response(rejection):
+            retry_after = max(int(rejection.get("retry_after_s", 1)),
+                              state.retry_after_hint())
+            return web.json_response(
+                {"error": f"shed ({rejection['reason']}): tenant class "
+                          f"{rejection['tenant']!r}",
+                 "tenant": rejection["tenant"],
+                 "reason": rejection["reason"],
+                 "retry_after_s": retry_after,
+                 "queue_remaining": state.queue_remaining(),
+                 "max_queue": state.max_queue},
+                status=429, headers={"Retry-After": str(retry_after)})
         # inbound trace context: a dispatching master's traceparent makes
         # this process's execution a child of ITS trace (the worker half
         # of the distributed tree); absent/malformed headers mean a fresh
@@ -1518,6 +1776,16 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         try:
             cfg = await _orchestration_config(prompt)
             if cfg is not None:
+                # admission BEFORE the fan-out: a request that will be
+                # shed must never reach the workers (they would start
+                # seed slices for a master share that was 429'd); the
+                # master-share enqueue below is then pre-admitted
+                with state._queue_lock:
+                    depth = len(state._queue)
+                rejection = state.admission.admit(
+                    tenant, str(client_id), depth, state.max_queue)
+                if rejection is not None:
+                    return _shed_response(rejection)
                 # headless interceptor (reference setupInterceptor,
                 # gpupanel.js:819-834): fan out to enabled HTTP workers,
                 # enqueue the master's prepared share locally.  ONE root
@@ -1532,7 +1800,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                 root = trace_mod.start_span(
                     "job", trace_id=tid, parent_id=par,
                     attrs={"client_id": str(client_id), "role": "master",
-                           "fanout": True})
+                           "tenant": tenant, "fanout": True})
 
                 async def enqueue_graph(g):
                     # off the loop: with durability on, admission
@@ -1540,7 +1808,8 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                     api = g.to_api_format()
                     return await asyncio.get_running_loop() \
                         .run_in_executor(None, lambda: state.enqueue_prompt(
-                            api, client_id, extra_data, trace_span=root))
+                            api, client_id, extra_data, trace_span=root,
+                            tenant=tenant, _preadmitted=True))
 
                 host = cfg.get("master", {}).get("host") or "127.0.0.1"
                 master_url = f"http://{host}:{state.port or 8288}"
@@ -1570,18 +1839,29 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                     "failed_workers": out.get("failed", []),
                 })
             # off the loop: the durable admission record fsyncs before
-            # the prompt_id is acked to the client
+            # the prompt_id is acked to the client.  Already-orchestrated
+            # shares (a peer master dispatched them) skip local admission
+            # — their job was admitted where it entered the fleet.
+            pre = _is_dispatched_share(prompt)
             pid = await asyncio.get_running_loop().run_in_executor(
                 None, lambda: state.enqueue_prompt(
                     prompt, client_id, extra_data,
-                    trace_parent=trace_parent))
+                    trace_parent=trace_parent, tenant=tenant,
+                    _preadmitted=pre))
+        except ShedError as e:
+            return _shed_response(e.rejection)
         except QueueFullError as e:
             # backpressure (DTPU_MAX_QUEUE): tell the client how deep the
-            # queue is so its retry policy can back off intelligently
+            # queue is — and when to come back (Retry-After from the
+            # measured drain rate, so shed clients back off instead of
+            # hammering in lockstep)
+            retry_after = state.retry_after_hint()
             return web.json_response(
                 {"error": str(e),
                  "queue_remaining": state.queue_remaining(),
-                 "max_queue": state.max_queue}, status=429)
+                 "retry_after_s": retry_after,
+                 "max_queue": state.max_queue}, status=429,
+                headers={"Retry-After": str(retry_after)})
         except DrainingError as e:
             return web.json_response({"error": str(e)}, status=503)
         except Exception as e:  # noqa: BLE001
@@ -1663,6 +1943,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/distributed/cluster/metrics.prom", cluster_metrics_prom)
     r.add_post("/distributed/register", cluster_register)
     r.add_post("/distributed/heartbeat", cluster_heartbeat)
+    r.add_get("/distributed/fleet", fleet_info)
     r.add_get("/distributed/durability", durability_info)
     r.add_post("/distributed/takeover", takeover)
     r.add_post("/distributed/rehome", rehome)
@@ -1739,6 +2020,10 @@ def serve(host: str = "0.0.0.0", port: int = 8288,
                         net_mod.get_recommended_ip()
             cfg_mod.mutate_config(autodetect, state.config_path)
         state.health.start()
+        # elastic fleet (ISSUE 9): DTPU_AUTOSCALE=1 arms the
+        # reconciliation loop — spawn on sustained queue/utilization
+        # pressure, retire by drain + lease non-renewal
+        state.autoscaler = autoscale_mod.install(state)
     if auto_launch and not state.is_worker:
         auto_launch_workers(state.manager)
     if state.is_worker:

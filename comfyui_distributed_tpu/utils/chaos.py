@@ -1,0 +1,237 @@
+"""Chaos fault-injection harness (ISSUE 9).
+
+A control plane that *survives* hostile conditions has to be exercised
+under them: dropped and delayed HTTP edges, spurious 5xx, frozen
+heartbeats, corrupted uploads, killed workers.  This module is the one
+switchboard for injecting those faults — env-driven for benches
+(``DTPU_CHAOS`` JSON spec), programmatic for tests
+(:func:`set_chaos`) — so the injection sites stay dumb one-liners:
+
+- ``utils/net.post_form_with_retry`` calls :meth:`ChaosMonkey.client_edge`
+  before each attempt (drop -> simulated transport error the retry loop
+  handles; delay -> added latency);
+- ``server/app.py`` installs :func:`middleware` so matching inbound
+  routes can be 5xx'd or delayed a fraction of the time (the
+  server-side half of a flaky network);
+- ``server/app.py``'s upload decoder runs payloads through
+  :meth:`ChaosMonkey.corrupt` (a corrupted tile must fail decode, 500,
+  and be retried clean — exercising idempotent redelivery);
+- ``runtime/cluster.HeartbeatSender`` consults
+  :meth:`ChaosMonkey.heartbeat_frozen` (a frozen heartbeat expires the
+  worker's lease while its process is alive — the suspect/rehome edge).
+
+Determinism: one ``random.Random`` seeded from the spec (``"seed"`` or
+``DTPU_CHAOS_SEED``), so a failing chaos run replays.  Every injection
+bumps a ``chaos_*`` event counter surfaced on both metrics surfaces;
+with no spec configured the fast path is a single ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils import trace as trace_mod
+from comfyui_distributed_tpu.utils.logging import debug_log, log
+
+
+class ChaosDropError(ConnectionError):
+    """A chaos-dropped client edge (retryable transport failure)."""
+
+
+class ChaosMonkey:
+    """One parsed injection spec + its deterministic RNG + counters."""
+
+    def __init__(self, spec: Optional[Dict[str, Any]] = None):
+        spec = dict(spec or {})
+        self.spec = spec
+        self.drop_pct = float(spec.get("drop_pct", 0) or 0)
+        self.delay_pct = float(spec.get("delay_pct", 0) or 0)
+        self.delay_s = float(spec.get("delay_s",
+                                      C.CHAOS_DELAY_DEFAULT_S) or 0)
+        self.http_5xx_pct = float(spec.get("http_5xx_pct", 0) or 0)
+        self.corrupt_pct = float(spec.get("corrupt_pct", 0) or 0)
+        # True freezes every sender; a list freezes only those worker ids
+        fh = spec.get("freeze_heartbeats", False)
+        self.freeze_all = fh is True
+        self.freeze_ids = set(str(x) for x in fh) \
+            if isinstance(fh, (list, tuple, set)) else set()
+        self.routes = tuple(spec.get("routes")
+                            or C.CHAOS_DEFAULT_ROUTES)
+        seed = spec.get("seed", os.environ.get(C.CHAOS_SEED_ENV))
+        self._rng = random.Random(int(seed) if seed is not None
+                                  else None)
+        self._lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        return bool(self.drop_pct or self.delay_pct or self.http_5xx_pct
+                    or self.corrupt_pct or self.freeze_all
+                    or self.freeze_ids)
+
+    def _roll(self, pct: float) -> bool:
+        if pct <= 0:
+            return False
+        with self._lock:
+            return self._rng.uniform(0, 100) < pct
+
+    def _bump(self, kind: str, what: str) -> None:
+        trace_mod.GLOBAL_COUNTERS.bump(f"chaos_{kind}")
+        # when the injection hits inside a traced operation (a tile
+        # send's upload span, a collector drain), pin it into the job's
+        # trace so `cli trace` shows WHERE the chaos landed
+        sp = trace_mod.capture_span_context()
+        if sp is not None:
+            now = time.time()
+            trace_mod.event_span(f"chaos_{kind}", now, now, parent=sp,
+                                 attrs={"target": str(what)[:120]})
+        debug_log(f"chaos: injected {kind} on {what}")
+
+    # -- client-side HTTP edge (post_form_with_retry) -------------------------
+
+    def client_edge(self, url: str, what: str = "send") -> float:
+        """Called before each send attempt.  Raises
+        :class:`ChaosDropError` for a dropped edge; returns the extra
+        delay (seconds, 0 for none) the caller should sleep — returned
+        rather than slept here because the call sites are async."""
+        if self._roll(self.drop_pct):
+            self._bump("drop", f"{what} {url}")
+            raise ChaosDropError(f"chaos: dropped {what} to {url}")
+        if self._roll(self.delay_pct):
+            self._bump("delay", f"{what} {url}")
+            return max(self.delay_s, 0.0)
+        return 0.0
+
+    # -- server-side HTTP edge (aiohttp middleware) ---------------------------
+
+    def route_matches(self, path: str) -> bool:
+        return any(path.startswith(r) for r in self.routes)
+
+    def server_edge(self, path: str):
+        """(status_or_None, delay_s) for an inbound request on a
+        matching route: 503 a fraction, delay a fraction, else pass."""
+        if not self.route_matches(path):
+            return None, 0.0
+        if self._roll(self.http_5xx_pct):
+            self._bump("5xx", path)
+            return 503, 0.0
+        if self._roll(self.delay_pct):
+            self._bump("delay", path)
+            return None, max(self.delay_s, 0.0)
+        return None, 0.0
+
+    # -- payload corruption (upload decode edge) ------------------------------
+
+    def corrupt(self, data: bytes, what: str = "upload") -> bytes:
+        """Maybe flip bytes in an upload payload.  The decoder then
+        fails, the server 500s, and the sender's retry re-delivers the
+        clean payload — the corruption is per-delivery, not sticky."""
+        if not data or not self._roll(self.corrupt_pct):
+            return data
+        self._bump("corrupt", what)
+        # stomp a window in the middle: headers AND checksums must not
+        # be able to hide it
+        mid = len(data) // 2
+        return data[:mid] + bytes(b ^ 0xFF
+                                  for b in data[mid:mid + 16]) \
+            + data[mid + 16:]
+
+    # -- worker lifecycle -----------------------------------------------------
+
+    def heartbeat_frozen(self, worker_id: str) -> bool:
+        if self.freeze_all or str(worker_id) in self.freeze_ids:
+            trace_mod.GLOBAL_COUNTERS.bump("chaos_heartbeat_frozen")
+            return True
+        return False
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "active": self.active,
+            "drop_pct": self.drop_pct,
+            "delay_pct": self.delay_pct,
+            "delay_s": self.delay_s,
+            "http_5xx_pct": self.http_5xx_pct,
+            "corrupt_pct": self.corrupt_pct,
+            "freeze_heartbeats": (True if self.freeze_all
+                                  else sorted(self.freeze_ids)),
+            "routes": list(self.routes),
+            "injected": {
+                k.split("chaos_", 1)[1]: v
+                for k, v in trace_mod.GLOBAL_COUNTERS.snapshot().items()
+                if k.startswith("chaos_")},
+        }
+
+
+_IDLE = ChaosMonkey()          # the zero-spec fast path (never active)
+_current: ChaosMonkey = _IDLE
+_current_from_env = False
+_env_raw_seen = ""
+_install_lock = threading.Lock()
+
+
+def set_chaos(spec: Optional[Dict[str, Any]]) -> ChaosMonkey:
+    """Install an injection spec programmatically (tests/bench);
+    ``None`` disarms.  Returns the active monkey."""
+    global _current, _current_from_env
+    with _install_lock:
+        _current = ChaosMonkey(spec) if spec else _IDLE
+        _current_from_env = False
+        if _current.active:
+            log(f"chaos: armed {json.dumps(spec, sort_keys=True)}")
+        return _current
+
+
+def get_chaos() -> ChaosMonkey:
+    """The active monkey.  The DTPU_CHAOS env is re-parsed only when its
+    raw value changes (a :func:`set_chaos` installation survives an
+    untouched env), so the per-edge cost with chaos off is one env read
+    + one string compare."""
+    global _current, _current_from_env, _env_raw_seen
+    raw = os.environ.get(C.CHAOS_ENV) or ""
+    if raw != _env_raw_seen:
+        with _install_lock:
+            _env_raw_seen = raw
+            if raw:
+                try:
+                    spec = json.loads(raw)
+                    _current = ChaosMonkey(spec
+                                           if isinstance(spec, dict)
+                                           else {})
+                    _current_from_env = True
+                    if _current.active:
+                        log(f"chaos: armed from {C.CHAOS_ENV}")
+                except ValueError:
+                    log(f"chaos: bad {C.CHAOS_ENV} JSON; ignoring")
+                    _current, _current_from_env = _IDLE, False
+            elif _current_from_env:
+                # the env spec was cleared; a programmatic spec stays
+                _current, _current_from_env = _IDLE, False
+    return _current
+
+
+def middleware():
+    """The aiohttp middleware factory ``server/app.py`` installs: 503 or
+    delay a fraction of inbound requests on matching routes.  With no
+    spec armed the overhead is the env-change check."""
+    import asyncio
+
+    from aiohttp import web
+
+    @web.middleware
+    async def chaos_middleware(request, handler):
+        cm = get_chaos()
+        if cm.active:
+            status, delay = cm.server_edge(request.path)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if status is not None:
+                return web.json_response(
+                    {"error": "chaos: injected failure"}, status=status)
+        return await handler(request)
+
+    return chaos_middleware

@@ -19,6 +19,22 @@ QUEUE_INIT_TIMEOUT = 5.0         # s for queue creation on the server loop
 SEND_MAX_RETRIES = 5
 SEND_BACKOFF_BASE = 0.5          # s; exponential, capped
 SEND_BACKOFF_CAP = 5.0
+# full jitter on the backoff (delay *= uniform[0.5, 1.0]): a fleet of
+# workers whose sends all failed at the same instant (master restart,
+# overloaded NIC) must not retry in lockstep — synchronized retry storms
+# are exactly what the chaos harness exposes under overload
+SEND_JITTER_FRACTION = 0.5
+# per-attempt wall-clock cap: a caller-provided timeout larger than
+# this is still split into <=cap attempts, so one black-holed
+# connection can't eat the whole retry budget.  Sized to the LARGEST
+# legitimate single transfer (TILE_SEND_TIMEOUT / JOB_COMPLETION: a
+# slow link really can need 60s for an image-set upload) — the cap
+# must bound pathology, never shrink a transfer that was always legal
+SEND_ATTEMPT_TIMEOUT_CAP = 60.0
+# a Retry-After header on 429/503 overrides the computed backoff (the
+# server knows its own drain rate better than our exponential guess);
+# bounded so a hostile/buggy peer can't park a sender for minutes
+RETRY_AFTER_CAP_S = 60.0
 
 # --- worker lifecycle -------------------------------------------------------
 PROCESS_TERMINATION_TIMEOUT = 5.0
@@ -200,6 +216,97 @@ MASTER_LEASE_DEFAULT = 10.0            # s the master lease lives unrenewed
 MASTER_LEASE_FRACTION = 3.0            # renew every lease/this
 WAL_FENCE_CHECK_S = 0.25               # lease-file fence re-read cadence
 WAL_OWNER_ENV = "DTPU_MASTER_ID"       # lease owner identity (default: master)
+
+# --- SLO-aware multi-tenant admission (workflow/scheduler.py) ----------------
+# Priority classes with weighted fair dequeue + class-aware shedding.
+# Unlabelled traffic defaults to the HIGHEST class so a single-tenant
+# deployment keeps the plain DTPU_MAX_QUEUE backpressure semantics
+# (paid sheds only at a genuinely full queue); tag requests with
+# {"priority": "free"|"batch"} to opt into the lower classes.
+TENANT_CLASSES = ("paid", "free", "batch")
+TENANT_DEFAULT_CLASS_ENV = "DTPU_TENANT_DEFAULT_CLASS"
+TENANT_DEFAULT_CLASS = "paid"
+# dequeue weights (stride scheduling): out of 10 scheduled groups under
+# backlog, ~6 are paid, ~3 free, ~1 batch.  "paid=6,free=3,batch=1".
+TENANT_WEIGHTS_ENV = "DTPU_TENANT_WEIGHTS"
+TENANT_WEIGHTS_DEFAULT = {"paid": 6.0, "free": 3.0, "batch": 1.0}
+# class-aware shedding: a class is 429'd once queue occupancy
+# (depth/max_queue) reaches its threshold — batch is shed first, free
+# under deeper overload, paid only when the queue is ACTUALLY full.
+TENANT_SHED_ENV = "DTPU_TENANT_SHED"      # "batch=0.5,free=0.85,paid=1"
+TENANT_SHED_DEFAULT = {"paid": 1.0, "free": 0.85, "batch": 0.5}
+# per-client token buckets (admission rate limiting): sustained
+# prompts/s and burst size per client_id.  0/unset = unlimited (the
+# back-compat default); per-class overrides via "paid=10,free=2".
+TENANT_RATE_ENV = "DTPU_TENANT_RATE"
+TENANT_BURST_ENV = "DTPU_TENANT_BURST"
+TENANT_BURST_DEFAULT = 10.0
+TENANT_BUCKETS_KEPT = 1024       # LRU bound on per-client bucket state
+# deadline-aware hedging: a request carrying {"slo_s": N} stamps its
+# distributed jobs with a deadline; the hedge-overdue threshold is then
+# re-keyed on the REMAINING SLO budget (hedge a unit silent longer than
+# SLO_HEDGE_FRACTION x the budget left) instead of the global
+# DTPU_HEDGE_FACTOR, and the min-progress gate is waived — a job about
+# to blow its deadline hedges its first straggler, not just its last.
+SLO_HEDGE_FRACTION_ENV = "DTPU_SLO_HEDGE_FRACTION"
+SLO_HEDGE_FRACTION_DEFAULT = 0.25    # hedge when silent > 25% of budget left
+SLO_MIN_WAIT_S = 0.25                # floor: never hedge sub-250ms silences
+
+# --- elastic-fleet autoscaler (runtime/autoscale.py) -------------------------
+# Reconciliation loop on the master: spawn workers when federated queue
+# depth / device utilization exceed thresholds for a sustained window,
+# retire them by drain + lease non-renewal.  Off by default
+# (DTPU_AUTOSCALE=1 arms it in serve()); every decision lands in a
+# bounded ring + GLOBAL_COUNTERS and the /distributed/fleet route.
+AUTOSCALE_ENV = "DTPU_AUTOSCALE"             # "1" arms the loop in serve()
+AUTOSCALE_INTERVAL_ENV = "DTPU_AUTOSCALE_INTERVAL_S"
+AUTOSCALE_INTERVAL_DEFAULT = 5.0
+AUTOSCALE_MIN_ENV = "DTPU_AUTOSCALE_MIN"     # floor on worker count
+AUTOSCALE_MIN_DEFAULT = 0
+AUTOSCALE_MAX_ENV = "DTPU_AUTOSCALE_MAX"     # ceiling on worker count
+AUTOSCALE_MAX_DEFAULT = 4
+# hysteresis: scale up when queue depth per participant exceeds
+# UP_QUEUE (or utilization exceeds UP_UTIL) for WINDOW consecutive
+# samples; scale down only when BOTH fall below the (strictly lower)
+# DOWN thresholds for the same sustained window.  COOLDOWN after any
+# action blocks the next one, so an oscillating signal can't flap.
+AUTOSCALE_UP_QUEUE_ENV = "DTPU_AUTOSCALE_UP_QUEUE"
+AUTOSCALE_UP_QUEUE_DEFAULT = 4.0             # queued prompts per participant
+AUTOSCALE_DOWN_QUEUE_ENV = "DTPU_AUTOSCALE_DOWN_QUEUE"
+AUTOSCALE_DOWN_QUEUE_DEFAULT = 1.0
+AUTOSCALE_UP_UTIL_ENV = "DTPU_AUTOSCALE_UP_UTIL"
+AUTOSCALE_UP_UTIL_DEFAULT = 0.85             # device-utilization fraction
+AUTOSCALE_DOWN_UTIL_ENV = "DTPU_AUTOSCALE_DOWN_UTIL"
+AUTOSCALE_DOWN_UTIL_DEFAULT = 0.30
+AUTOSCALE_WINDOW_ENV = "DTPU_AUTOSCALE_WINDOW"
+AUTOSCALE_WINDOW_DEFAULT = 3                 # consecutive samples over bar
+AUTOSCALE_COOLDOWN_ENV = "DTPU_AUTOSCALE_COOLDOWN_S"
+AUTOSCALE_COOLDOWN_DEFAULT = 30.0
+AUTOSCALE_DRAIN_ENV = "DTPU_AUTOSCALE_DRAIN_S"
+AUTOSCALE_DRAIN_DEFAULT = 30.0               # retirement drain bound
+# a direction reversal within this window of the previous action counts
+# as a FLAP (the convergence failure the bench asserts is zero)
+AUTOSCALE_FLAP_S = 60.0
+AUTOSCALE_DECISIONS_KEPT = 128               # decision-ring bound
+WORKER_STATE_RETIRING = "retiring"           # registry state during drain
+
+# --- chaos fault-injection harness (utils/chaos.py) --------------------------
+# Env/route-driven fault injection on the HTTP edges and worker
+# lifecycle, for tests and `bench.py --phase overload`.  DTPU_CHAOS is a
+# JSON spec; unset = zero overhead (one dict lookup per edge).  Fields:
+#   {"drop_pct": 5, "delay_pct": 5, "delay_s": 0.2, "http_5xx_pct": 5,
+#    "corrupt_pct": 2, "freeze_heartbeats": true|["w0"],
+#    "routes": ["/distributed/tile_complete", ...], "seed": 1234}
+# pcts are 0-100 fractions of matching edges; "routes" scopes the
+# server-side injection (default: the data-plane + /prompt edges);
+# "seed" makes a run reproducible.  Every injection bumps a
+# chaos_* GLOBAL_COUNTERS event (both metrics surfaces).
+CHAOS_ENV = "DTPU_CHAOS"
+CHAOS_SEED_ENV = "DTPU_CHAOS_SEED"
+CHAOS_DEFAULT_ROUTES = ("/prompt", "/distributed/tile_complete",
+                        "/distributed/job_complete",
+                        "/distributed/heartbeat")
+CHAOS_DELAY_DEFAULT_S = 0.25
 
 # --- persistent compilation cache -------------------------------------------
 # Directory for JAX's persistent (on-disk) XLA compilation cache.  Resolution

@@ -80,23 +80,74 @@ def run_async_in_loop(coro, loop: asyncio.AbstractEventLoop,
         raise TimeoutError(f"coroutine timed out after {timeout}s")
 
 
+def _retry_after_hint(headers) -> Optional[float]:
+    """Parse a Retry-After header (delta-seconds form only — the HTTP
+    date form isn't worth a date parser on this hot path) into a
+    bounded sleep, or None."""
+    from comfyui_distributed_tpu.utils import constants as C
+    raw = (headers or {}).get("Retry-After")
+    if raw is None:
+        return None
+    try:
+        return min(max(float(raw), 0.0), C.RETRY_AFTER_CAP_S)
+    except (TypeError, ValueError):
+        return None
+
+
+def backoff_delays(retries: int, rng=None) -> List[float]:
+    """The jittered exponential backoff schedule ``post_form_with_retry``
+    sleeps between attempts: ``min(base * 2^k, cap) * uniform[1-j, 1]``.
+
+    Jitter exists for the fleet, not the caller: when one master restart
+    fails every worker's in-flight send at the same instant, a fixed
+    cadence re-synchronizes all their retries into periodic thundering
+    herds — exactly the overload signature the chaos harness provokes.
+    Pure function (injectable ``rng``) so the de-synchronization is
+    testable."""
+    import random as _random
+
+    from comfyui_distributed_tpu.utils import constants as C
+    rng = rng or _random
+    out = []
+    delay = C.SEND_BACKOFF_BASE
+    for _ in range(max(retries - 1, 0)):
+        out.append(delay * rng.uniform(1.0 - C.SEND_JITTER_FRACTION, 1.0))
+        delay = min(delay * 2, C.SEND_BACKOFF_CAP)
+    return out
+
+
 async def post_form_with_retry(url: str, make_form, timeout: float,
                                max_retries: Optional[int] = None,
                                what: str = "upload",
                                headers: Optional[Dict[str, str]] = None
                                ) -> None:
-    """POST a multipart form with exponential backoff, retrying any error
-    including 404 (the queue-not-ready race the reference's tile sender
-    retries through, ``distributed_upscale.py:618-665``).  ``make_form``
-    is a zero-arg factory — FormData payloads are single-use.
-    ``headers`` rides every attempt (the worker->master data-plane hop
-    carries its traceparent here so the master can stitch the job's
-    distributed trace together)."""
+    """POST a multipart form with jittered exponential backoff, retrying
+    any error including 404 (the queue-not-ready race the reference's
+    tile sender retries through, ``distributed_upscale.py:618-665``).
+    ``make_form`` is a zero-arg factory — FormData payloads are
+    single-use.  ``headers`` rides every attempt (the worker->master
+    data-plane hop carries its traceparent here so the master can stitch
+    the job's distributed trace together).
+
+    Overload behavior (ISSUE 9): each attempt's wall clock is capped at
+    ``SEND_ATTEMPT_TIMEOUT_CAP`` so one black-holed connection can't eat
+    the whole retry budget; a ``Retry-After`` header on a 429/503
+    response overrides the computed backoff (the server's drain-rate
+    hint beats our exponential guess); and the chaos harness may drop or
+    delay an attempt here — the client-side half of a flaky network."""
+    from comfyui_distributed_tpu.utils import chaos as chaos_mod
     from comfyui_distributed_tpu.utils import constants as C
     retries = max_retries if max_retries is not None else C.SEND_MAX_RETRIES
-    delay = C.SEND_BACKOFF_BASE
+    delays = backoff_delays(retries)
+    attempt_timeout = min(timeout, C.SEND_ATTEMPT_TIMEOUT_CAP)
     for attempt in range(retries):
+        retry_after = None
         try:
+            cm = chaos_mod.get_chaos()
+            if cm.active:
+                extra = cm.client_edge(url, what=what)  # may raise (drop)
+                if extra > 0:
+                    await asyncio.sleep(extra)
             # re-acquire per attempt: a peer's cleanup can close the
             # shared session mid-retry (get_client_session then hands
             # out a fresh one) — holding one reference across the loop
@@ -104,17 +155,22 @@ async def post_form_with_retry(url: str, make_form, timeout: float,
             session = await get_client_session()
             async with session.post(
                     url, data=make_form(), headers=headers or None,
-                    timeout=aiohttp.ClientTimeout(total=timeout)) as resp:
+                    timeout=aiohttp.ClientTimeout(
+                        total=attempt_timeout)) as resp:
                 if resp.status == 200:
                     return
+                if resp.status in (429, 503):
+                    retry_after = _retry_after_hint(resp.headers)
                 body = await resp.text()
                 raise RuntimeError(f"{what} {resp.status}: {body[:100]}")
         except Exception as e:  # noqa: BLE001 - retry transport + status
             if attempt == retries - 1:
                 raise
             debug_log(f"{what} retry {attempt + 1}: {e}")
-            await asyncio.sleep(delay)
-            delay = min(delay * 2, C.SEND_BACKOFF_CAP)
+            # honor the server's shed hint when it's LONGER than our
+            # backoff: a 429'd sender hammering at its own cadence is
+            # the retry storm the hint exists to prevent
+            await asyncio.sleep(max(delays[attempt], retry_after or 0.0))
 
 
 # --- overlapped host-IO pool -------------------------------------------------

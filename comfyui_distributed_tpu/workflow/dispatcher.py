@@ -175,6 +175,12 @@ async def preflight_check(workers: List[Dict[str, Any]],
                 log(f"preflight: skipping worker {wid} — registry marks "
                     f"it dead (lease expired)")
                 return None
+            if st == cl.RETIRING:
+                # autoscaler drain: alive, finishing its in-flight
+                # units, but must not receive new work
+                log(f"preflight: skipping worker {wid} — retiring "
+                    f"(autoscaler drain)")
+                return None
             if st == cl.SUSPECT:
                 log(f"preflight: worker {wid} is suspect "
                     f"(failed probes); dispatching anyway")

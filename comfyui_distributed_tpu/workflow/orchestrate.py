@@ -409,6 +409,19 @@ async def run_distributed(graph_or_doc: Any,
     # 2. one multi_job_id per distributed node (reference :856-858)
     job_id_map = dsp.make_job_id_map(graph, prefix=job_prefix)
 
+    # deadline-aware hedging (ISSUE 9): a request carrying an SLO budget
+    # stamps every one of its distributed jobs with a deadline, re-keying
+    # the hedge machinery on the remaining budget instead of the global
+    # DTPU_HEDGE_FACTOR (runtime/cluster.WorkLedger.overdue_units)
+    slo_s = (extra_data or {}).get("slo_s")
+    if ledger is not None and slo_s:
+        try:
+            deadline = time.monotonic() + float(slo_s)
+            for mj in job_id_map.values():
+                ledger.set_deadline(mj, deadline)
+        except (TypeError, ValueError):
+            pass
+
     # 3. prepare queues BEFORE dispatch (reference :860-862 + IS_CHANGED);
     # when orchestrating from inside the master process, hit the job store
     # directly instead of looping through our own HTTP surface

@@ -33,9 +33,15 @@ from __future__ import annotations
 
 import hashlib
 import json
+import math
+import os
+import threading
+import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.workflow.graph import Graph, parse_workflow
 
 # class_type -> widget names that are per-prompt DATA, not program shape:
@@ -107,6 +113,251 @@ def build_coalesced(prompts: List[Dict[str, Any]]
                 for p in prompts]
             hidden.setdefault(nid, {})[f"coalesced_{widget}s"] = per_prompt
     return graph, hidden
+
+
+# --- SLO-aware multi-tenant admission (ISSUE 9) ------------------------------
+#
+# Millions-of-users posture: one heavy tenant must not starve the
+# fleet, and under overload the cheap traffic sheds first.  Three
+# mechanisms, all here so the math is unit-testable without a server:
+#
+# - per-client TOKEN BUCKETS (sustained rate + burst, off by default)
+#   reject a single client's flood before it ever occupies queue slots;
+# - CLASS-AWARE SHEDDING maps queue occupancy to a per-class 429 bar
+#   (batch sheds at 50% full, free at 85%, paid only at a truly full
+#   queue — "never drop paid" is a threshold ordering, not a prayer);
+# - WEIGHTED FAIR DEQUEUE (stride scheduling) interleaves the classes
+#   that DID get admitted, so a paid prompt's queue wait is bounded by
+#   its weight share instead of the whole backlog ahead of it.
+#   Within a class, FIFO order is preserved by construction.
+
+
+def _parse_kv_floats(raw: Optional[str],
+                     default: Dict[str, float]) -> Dict[str, float]:
+    """``"paid=6,free=3,batch=1"`` -> dict, falling back to ``default``
+    per key (and entirely on a malformed string)."""
+    out = dict(default)
+    if not raw:
+        return out
+    try:
+        for part in raw.split(","):
+            if not part.strip():
+                continue
+            k, v = part.split("=", 1)
+            out[k.strip()] = float(v)
+    except ValueError:
+        return dict(default)
+    return out
+
+
+class TokenBucket:
+    """Sustained ``rate`` tokens/s with a ``burst`` cap; starts full.
+    ``rate <= 0`` means unlimited (the back-compat default)."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self.level = self.burst
+        # anchored on first use so callers may drive time themselves
+        self._t: Optional[float] = None
+
+    def try_take(self, now: Optional[float] = None) -> bool:
+        if self.rate <= 0:
+            return True
+        now = time.monotonic() if now is None else now
+        if self._t is not None:
+            self.level = min(self.burst,
+                             self.level + (now - self._t) * self.rate)
+        self._t = now
+        if self.level >= 1.0:
+            self.level -= 1.0
+            return True
+        return False
+
+    def seconds_until_token(self, now: Optional[float] = None) -> float:
+        if self.rate <= 0 or self.level >= 1.0:
+            return 0.0
+        return (1.0 - self.level) / self.rate
+
+
+class AdmissionController:
+    """Tenant classification + admission + fair-dequeue state for one
+    serving queue.  Thread-safe (called from the aiohttp handlers and
+    the exec thread); env knobs resolve at construction so tests pin
+    them per instance."""
+
+    def __init__(self,
+                 weights: Optional[Dict[str, float]] = None,
+                 shed: Optional[Dict[str, float]] = None,
+                 rate: Optional[Dict[str, float]] = None,
+                 burst: Optional[Dict[str, float]] = None,
+                 default_class: Optional[str] = None):
+        self.classes = C.TENANT_CLASSES
+        self.weights = weights if weights is not None else _parse_kv_floats(
+            os.environ.get(C.TENANT_WEIGHTS_ENV), C.TENANT_WEIGHTS_DEFAULT)
+        self.shed = shed if shed is not None else _parse_kv_floats(
+            os.environ.get(C.TENANT_SHED_ENV), C.TENANT_SHED_DEFAULT)
+        # rate/burst: a bare float env applies to every class; the
+        # kv form overrides per class.  0 = unlimited.
+        def _rates(env, default_each):
+            raw = os.environ.get(env, "")
+            if raw and "=" not in raw:
+                try:
+                    return {cls: float(raw) for cls in self.classes}
+                except ValueError:
+                    raw = ""
+            return _parse_kv_floats(
+                raw, {cls: default_each for cls in self.classes})
+        self.rate = rate if rate is not None \
+            else _rates(C.TENANT_RATE_ENV, 0.0)
+        self.burst = burst if burst is not None \
+            else _rates(C.TENANT_BURST_ENV, C.TENANT_BURST_DEFAULT)
+        self.default_class = default_class or os.environ.get(
+            C.TENANT_DEFAULT_CLASS_ENV, C.TENANT_DEFAULT_CLASS)
+        if self.default_class not in self.classes:
+            self.default_class = C.TENANT_DEFAULT_CLASS
+        self._lock = threading.Lock()
+        # stride scheduling: per-class virtual finish time; the next
+        # dispatched class is the nonempty one with the smallest pass,
+        # which then advances by 1/weight — heavier classes advance
+        # slower, so they win more turns
+        self._pass: Dict[str, float] = {cls: 0.0 for cls in self.classes}
+        self._active_prev: set = set()
+        # per-(class, client) token buckets, LRU-bounded
+        self._buckets: "OrderedDict[str, TokenBucket]" = OrderedDict()
+        self.counters: Dict[str, Dict[str, int]] = {
+            cls: {"admitted": 0, "shed_rate": 0, "shed_overload": 0,
+                  "completed": 0}
+            for cls in self.classes}
+
+    # -- classification -------------------------------------------------------
+
+    def classify(self, priority: Any) -> str:
+        """The request's tenant class: its explicit ``priority`` field
+        when valid, else the default (highest) class — untagged traffic
+        is never shed before tagged lower classes."""
+        p = str(priority or "").strip().lower()
+        return p if p in self.classes else self.default_class
+
+    # -- admission ------------------------------------------------------------
+
+    def admit(self, tenant: str, client_id: str, depth: int,
+              max_queue: int) -> Optional[Dict[str, Any]]:
+        """Admission check for one prompt.  None = admitted; otherwise a
+        rejection dict with ``reason`` (``rate`` | ``overload``) and a
+        ``retry_after_s`` floor the caller may refine with its drain
+        rate.  Both metrics surfaces see every decision."""
+        with self._lock:
+            rate = self.rate.get(tenant, 0.0)
+            if rate > 0:
+                key = f"{tenant}:{client_id}"
+                bucket = self._buckets.get(key)
+                if bucket is None or bucket.rate != rate:
+                    bucket = TokenBucket(
+                        rate, self.burst.get(
+                            tenant, C.TENANT_BURST_DEFAULT))
+                    self._buckets[key] = bucket
+                self._buckets.move_to_end(key)
+                while len(self._buckets) > C.TENANT_BUCKETS_KEPT:
+                    self._buckets.popitem(last=False)
+                if not bucket.try_take():
+                    self.counters[tenant]["shed_rate"] += 1
+                    trace_mod.GLOBAL_COUNTERS.bump(
+                        f"tenant_shed_rate_{tenant}")
+                    return {"reason": "rate", "tenant": tenant,
+                            "retry_after_s": max(
+                                bucket.seconds_until_token(), 1.0)}
+            bar = self.shed.get(tenant, 1.0)
+            if max_queue > 0 and depth >= math.ceil(bar * max_queue):
+                self.counters[tenant]["shed_overload"] += 1
+                trace_mod.GLOBAL_COUNTERS.bump(
+                    f"tenant_shed_overload_{tenant}")
+                return {"reason": "overload", "tenant": tenant,
+                        "retry_after_s": 1.0}
+            self.counters[tenant]["admitted"] += 1
+            return None
+
+    def on_complete(self, tenant: str) -> None:
+        with self._lock:
+            if tenant in self.counters:
+                self.counters[tenant]["completed"] += 1
+
+    # -- weighted fair dequeue ------------------------------------------------
+
+    def next_class(self, queued: Dict[str, int]) -> Optional[str]:
+        """Stride scheduling over the classes with queued work: pick the
+        smallest virtual finish time, advance it by 1/weight.  A class
+        returning from idle is clamped up to the active minimum so it
+        can't burn banked credit into a starvation burst."""
+        with self._lock:
+            active = [cls for cls in self.classes if queued.get(cls)]
+            if not active:
+                return None
+            # a class returning from idle is clamped UP to the virtual
+            # time of the classes that kept running — its stale low
+            # pass is banked credit that would otherwise buy it a
+            # starvation burst
+            carried = [cls for cls in active if cls in self._active_prev]
+            if carried:
+                base = min(self._pass[cls] for cls in carried)
+                for cls in active:
+                    if cls not in self._active_prev:
+                        self._pass[cls] = max(self._pass[cls], base)
+            self._active_prev = set(active)
+            pick = min(active, key=lambda cls: (self._pass[cls],
+                                                self.classes.index(cls)))
+            self._pass[pick] += 1.0 / max(self.weights.get(pick, 1.0),
+                                          1e-9)
+            return pick
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "classes": list(self.classes),
+                "default_class": self.default_class,
+                "weights": dict(self.weights),
+                "shed_thresholds": dict(self.shed),
+                "rate_limits": {cls: r for cls, r in self.rate.items()
+                                if r > 0},
+                "tracked_clients": len(self._buckets),
+                "per_class": {cls: dict(v)
+                              for cls, v in self.counters.items()},
+            }
+
+
+def pop_fair_group(queue: List[Dict[str, Any]],
+                   admission: AdmissionController,
+                   coalesce_max: int = 1) -> List[Dict[str, Any]]:
+    """Pop the next dispatch group from a tenant-tagged queue under
+    weighted fair scheduling.  The group head is the FIRST queued item
+    of the scheduled class (per-class FIFO — within a class no prompt
+    overtakes another); coalescing then extends it with that class's
+    next items while their signatures match, stopping at the class's
+    first signature break (other classes' items are passed over, which
+    is precisely the fair-scheduling reordering).  With one class
+    queued this degenerates to the legacy head-of-queue contiguous-run
+    pop.  Caller holds the queue lock."""
+    if not queue:
+        return []
+    counts: Dict[str, int] = {}
+    for item in queue:
+        cls = item.get("tenant") or admission.default_class
+        counts[cls] = counts.get(cls, 0) + 1
+    cls = admission.next_class(counts) or admission.default_class
+    idx = next((i for i, item in enumerate(queue)
+                if (item.get("tenant") or admission.default_class)
+                == cls), 0)
+    group = [queue.pop(idx)]
+    sig = group[0].get("sig")
+    j = idx
+    while sig is not None and len(group) < coalesce_max:
+        while j < len(queue) and (queue[j].get("tenant")
+                                  or admission.default_class) != cls:
+            j += 1
+        if j >= len(queue) or queue[j].get("sig") != sig:
+            break
+        group.append(queue.pop(j))
+    return group
 
 
 def split_images(images: List[Any], k: int) -> List[List[Any]]:

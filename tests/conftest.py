@@ -70,6 +70,9 @@ _MODULE_COST_S = {
     # non-slow share only (the two loopback fault-acceptance tests are
     # marked slow in-file, ~40s each with real master+worker exec loops)
     "test_cluster.py": 12,
+    # non-slow share only (the 3-tenant overload acceptance is marked
+    # slow in-file, ~40s with a real loopback fleet + chaos)
+    "test_overload.py": 2,
     # non-slow share only (the two loopback election/recovery
     # acceptance tests are marked slow in-file, ~20s each with real
     # master+standby+worker exec loops over a shared WAL)
@@ -195,6 +198,16 @@ _SLOW_TESTS = {
     "test_server.py::TestPromptExtraPnginfo::"
     "test_extra_data_reaches_saved_pngs",
     "test_server.py::TestProfiling::test_profile_endpoints",
+    # PR 9 headroom trim (tier-1 gate budget, ROADMAP item 7): the
+    # three priciest remaining non-slow tests (25s/25s/18s measured
+    # 2026-08-04) move out of the timed gate — each is a deep-oracle
+    # variant whose cheaper siblings still run; the full `pytest
+    # tests/` (README) keeps them all
+    "test_torch_parity.py::"
+    "test_clip_text_encoder_matches_transformers[tiny]",
+    "test_checkpoints.py::test_roundtrip_exact[tiny]",
+    "test_controlnet.py::TestControlNetChaining::"
+    "test_two_live_nets_accumulate",
 }
 
 
@@ -206,6 +219,30 @@ def pytest_collection_modifyitems(session, config, items):
             item.add_marker(pytest.mark.slow)
     items.sort(key=lambda it: _MODULE_COST_S.get(
         os.path.basename(str(it.fspath)), 5))
+
+
+# Gate-budget visibility (ROADMAP item 7): the tier-1 gate runs under a
+# hard wall-clock window, and every PR grows the suite — print the
+# top-10 slowest calls at the end of EVERY run so the next session sees
+# where the budget went without re-running with --durations.
+_test_durations: dict = {}
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        _test_durations[report.nodeid] = report.duration
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if not _test_durations:
+        return
+    top = sorted(_test_durations.items(), key=lambda kv: -kv[1])[:10]
+    total = sum(_test_durations.values())
+    terminalreporter.write_sep(
+        "=", f"top-10 slowest calls (of {total:.0f}s total call time; "
+             "tier-1 window 870s)")
+    for nodeid, dur in top:
+        terminalreporter.write_line(f"{dur:7.2f}s  {nodeid}")
 
 
 @pytest.fixture(autouse=True)
