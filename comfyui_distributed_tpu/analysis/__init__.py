@@ -1,0 +1,42 @@
+"""dtpu-lint: project-invariant static analysis (ISSUE 10).
+
+Eraser's lesson (Savage et al., SOSP '97 — PAPERS.md): invariants that
+reviews keep re-finding by hand ("this field is only touched under that
+lock") become *checkable rules* once stated explicitly.  Every recent
+PR's hardening pass caught the same latent classes — event-loop-blocking
+fsyncs (PR 7), monitor restart races (PR 5), registry lifecycle bugs
+(PR 9) — so this package encodes them as an AST-based rule suite
+(stdlib ``ast`` only, zero new dependencies, never imports jax) enforced
+as a tier-1 test:
+
+- ``async-blocking`` — blocking calls (file IO, fsync, subprocess,
+  ``time.sleep``, WAL-appending ledger transitions, ...) reachable
+  directly from ``async def`` bodies without an executor offload;
+- ``lockset`` — ``# guarded-by: <lock>`` field annotations checked
+  against every ``self.<field>`` access outside a ``with <lock>:``;
+- ``spine-host-fetch`` / ``retrace-hazard`` — host-materializing calls
+  (``np.asarray``, ``.item()``, ``float()``, ``jax.device_get``) inside
+  the device-resident spine modules, and Python branching on traced
+  values inside jitted functions;
+- ``env-undeclared`` / ``env-readme-drift`` / ``metric-name`` /
+  ``span-attr`` — registry drift: ``DTPU_*`` env reads must be declared
+  in ``utils/constants.py`` AND documented in the README env table,
+  Prometheus family tuples must follow naming conventions, span attr
+  names must be in ``constants.TRACE_ATTR_WHITELIST``.
+
+Grandfathered findings live in ``baseline.json`` (audited-benign only);
+the gate fails on any NEW violation.  Per-line opt-out:
+``# dtpu-lint: ignore[rule-id] <reason>`` (the reason is mandatory).
+"""
+
+from comfyui_distributed_tpu.analysis.engine import (  # noqa: F401
+    ALL_RULES,
+    LintReport,
+    Violation,
+    baseline_path,
+    lint_project,
+    load_baseline,
+    load_project,
+    run_lint,
+    write_baseline,
+)

@@ -1,0 +1,379 @@
+"""dtpu-lint rule engine: project model, suppressions, baseline.
+
+Deliberately dependency-free (stdlib ``ast`` + ``json``): the linter
+must run anywhere the repo checks out — CI, a laptop, a TPU host mid-
+incident — without initializing a backend or importing the package
+under analysis (files are *parsed*, never imported).
+
+Key ideas:
+
+- a :class:`Project` is the parsed view of the repo (package sources +
+  README), optionally with in-memory ``overrides`` so tests can lint a
+  mutated tree without touching disk;
+- every rule is a function ``rule(project) -> [Violation]`` registered
+  in :data:`ALL_RULES` — rules may be cross-file (the drift rules
+  compare constants.py against the README);
+- suppression is per-line and *reasoned*: ``# dtpu-lint:
+  ignore[rule-id] why`` on the flagged line or the line above.  A
+  suppression without a reason does not suppress — silent opt-outs are
+  exactly the review debt this tool exists to kill;
+- the baseline maps stable violation keys -> counts.  Keys are
+  ``rule|path|scope|normalized-source-line`` (line numbers excluded on
+  purpose: unrelated edits above a grandfathered finding must not
+  resurrect it).  A count *above* the baseline's is new — adding a
+  second identical violation in the same scope is caught.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+PACKAGE_DIR = "comfyui_distributed_tpu"
+CONSTANTS_PATH = f"{PACKAGE_DIR}/utils/constants.py"
+README_PATH = "README.md"
+BASELINE_RELPATH = f"{PACKAGE_DIR}/analysis/baseline.json"
+
+# analysis must never flag itself (rule sources quote the patterns they
+# hunt) nor generated/cache dirs
+_EXCLUDED_PREFIXES = (f"{PACKAGE_DIR}/analysis/",)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dtpu-lint:\s*ignore\[([a-zA-Z0-9_,\- ]+)\]\s*(\S.*)?")
+
+_HOLDS_RE = re.compile(r"#\s*dtpu-lint:\s*holds\[([^\]]+)\]")
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str            # repo-relative, "/"-separated
+    line: int            # 1-based
+    message: str
+    scope: str = ""      # enclosing def/class qualname (baseline keying)
+    key: str = ""        # filled by lint_project
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: str
+    source: str
+    lines: List[str]
+    tree: Optional[ast.AST]        # None for non-Python files
+    parse_error: Optional[str] = None
+
+
+class Project:
+    """Parsed repo view the rules run over."""
+
+    def __init__(self, root: str, files: Dict[str, SourceFile],
+                 readme: Optional[SourceFile] = None):
+        self.root = root
+        self.files = files
+        self.readme = readme
+
+    def get(self, relpath: str) -> Optional[SourceFile]:
+        return self.files.get(relpath)
+
+    def python_files(self) -> List[SourceFile]:
+        return [f for f in self.files.values() if f.tree is not None]
+
+
+def _parse_file(relpath: str, source: str) -> SourceFile:
+    lines = source.splitlines()
+    if not relpath.endswith(".py"):
+        return SourceFile(relpath, source, lines, None)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return SourceFile(relpath, source, lines, None,
+                          parse_error=f"{e.__class__.__name__}: {e}")
+    return SourceFile(relpath, source, lines, tree)
+
+
+def load_project(root: str,
+                 overrides: Optional[Dict[str, str]] = None) -> Project:
+    """Parse the package sources under ``root`` (plus README.md).
+
+    ``overrides`` maps relpath -> replacement source, letting the tests
+    lint seeded mutations of the live tree without writing them to
+    disk; an override for a path that doesn't exist on disk is added."""
+    overrides = dict(overrides or {})
+    files: Dict[str, SourceFile] = {}
+    pkg_root = os.path.join(root, PACKAGE_DIR)
+    for dirpath, dirnames, names in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            if any(rel.startswith(p) for p in _EXCLUDED_PREFIXES):
+                continue
+            if rel in overrides:
+                continue  # parsed from the override below
+            try:
+                with open(full, "r", encoding="utf-8") as f:
+                    files[rel] = _parse_file(rel, f.read())
+            except OSError:
+                continue
+    for rel, src in overrides.items():
+        if rel == README_PATH:
+            continue
+        if not any(rel.startswith(p) for p in _EXCLUDED_PREFIXES):
+            files[rel] = _parse_file(rel, src)
+    readme = None
+    if README_PATH in overrides:
+        readme = _parse_file(README_PATH, overrides[README_PATH])
+    else:
+        try:
+            with open(os.path.join(root, README_PATH), "r",
+                      encoding="utf-8") as f:
+                readme = _parse_file(README_PATH, f.read())
+        except OSError:
+            readme = None
+    return Project(root, files, readme=readme)
+
+
+# --- suppression -------------------------------------------------------------
+
+def suppressed_rules(sf: SourceFile, line: int) -> Tuple[set, bool]:
+    """Rule-ids suppressed at ``line`` (1-based) via a reasoned
+    ``# dtpu-lint: ignore[...]`` on the line itself or the line above.
+    Returns ``(rules, reasonless_seen)`` — a reasonless marker never
+    suppresses (the second element lets callers flag it)."""
+    rules: set = set()
+    reasonless = False
+    for ln in (line, line - 1):
+        if not 1 <= ln <= len(sf.lines):
+            continue
+        text = sf.lines[ln - 1]
+        # the line-above form must be a comment-ONLY line: a trailing
+        # marker on line N suppresses N alone, never N+1
+        if ln == line - 1 and not text.lstrip().startswith("#"):
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            ids = {r.strip() for r in m.group(1).split(",")
+                   if r.strip()}
+            if m.group(2):
+                rules |= ids
+            else:
+                reasonless = True
+    return rules, reasonless
+
+
+def holds_locks(sf: SourceFile, node: ast.AST) -> set:
+    """Lock expressions a ``def`` declares it is called with held:
+    ``# dtpu-lint: holds[self._lock]`` on the def line or the line
+    above it."""
+    out: set = set()
+    line = getattr(node, "lineno", 0)
+    for ln in (line, line - 1):
+        if 1 <= ln <= len(sf.lines):
+            m = _HOLDS_RE.search(sf.lines[ln - 1])
+            if m:
+                out |= {e.strip() for e in m.group(1).split(",")
+                        if e.strip()}
+    return out
+
+
+# --- shared AST helpers ------------------------------------------------------
+
+def iter_scoped(tree: ast.AST):
+    """Yield ``(node, scope_stack)`` for every node in ``tree``, with
+    ``scope_stack`` the list of enclosing ClassDef/FunctionDef/
+    AsyncFunctionDef nodes (a scope node is yielded with ITSELF on the
+    stack).  The one scope-tracking walk every rule shares — pass the
+    stack to :func:`scope_qualname` for baseline-stable scope names.
+    The yielded stack is live (mutated as the walk continues): consume
+    it before advancing the iterator."""
+    stack: List[ast.AST] = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            is_scope = isinstance(child, (ast.ClassDef, ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+            if is_scope:
+                stack.append(child)
+            yield child, stack
+            yield from walk(child)
+            if is_scope:
+                stack.pop()
+
+    yield from walk(tree)
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted source text of a call's callee (best-effort)."""
+    try:
+        return ast.unparse(node.func)
+    except Exception:  # noqa: BLE001 - exotic callee shapes
+        return ""
+
+
+def scope_qualname(stack: List[ast.AST]) -> str:
+    parts = [getattr(n, "name", "") for n in stack
+             if isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                               ast.AsyncFunctionDef))]
+    return ".".join(p for p in parts if p)
+
+
+def norm_line(sf: SourceFile, line: int) -> str:
+    if 1 <= line <= len(sf.lines):
+        return " ".join(sf.lines[line - 1].split())
+    return ""
+
+
+def violation_key(v: Violation, sf: Optional[SourceFile]) -> str:
+    text = norm_line(sf, v.line) if sf is not None else ""
+    return f"{v.rule}|{v.path}|{v.scope}|{text}"
+
+
+# --- baseline ----------------------------------------------------------------
+
+def baseline_path(root: str) -> str:
+    return os.path.join(root, *BASELINE_RELPATH.split("/"))
+
+
+def load_baseline(root: str) -> Dict[str, int]:
+    try:
+        with open(baseline_path(root), "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    entries = data.get("entries", {}) if isinstance(data, dict) else {}
+    return {str(k): int(v) for k, v in entries.items()
+            if isinstance(v, int)}
+
+
+def write_baseline(root: str, violations: List[Violation]) -> str:
+    counts: Dict[str, int] = {}
+    for v in violations:
+        counts[v.key] = counts.get(v.key, 0) + 1
+    path = baseline_path(root)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1,
+                   "comment": "dtpu-lint grandfathered findings — "
+                              "audited-benign only; regenerate with "
+                              "`cli lint --write-baseline` after "
+                              "auditing any new entry",
+                   "entries": dict(sorted(counts.items()))},
+                  f, indent=1, sort_keys=False)
+        f.write("\n")
+    return path
+
+
+# --- report ------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LintReport:
+    violations: List[Violation]          # everything found
+    new: List[Violation]                 # beyond the baseline counts
+    baseline_total: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def _split_new(violations: List[Violation],
+               baseline: Dict[str, int]) -> List[Violation]:
+    by_key: Dict[str, List[Violation]] = {}
+    for v in violations:
+        by_key.setdefault(v.key, []).append(v)
+    new: List[Violation] = []
+    for key, group in by_key.items():
+        allowed = baseline.get(key, 0)
+        if len(group) > allowed:
+            # instances beyond the grandfathered count, in line order
+            group = sorted(group, key=lambda v: v.line)
+            new.extend(group[allowed:])
+    return sorted(new, key=lambda v: (v.path, v.line, v.rule))
+
+
+def lint_project(project: Project,
+                 rules: Optional[List[str]] = None) -> List[Violation]:
+    """Run the (selected) rules; suppressions applied, keys filled.
+    Unknown rule names raise — a misspelled ``--rule`` must never
+    select zero rules and report a clean tree."""
+    if rules is not None:
+        unknown = sorted(set(rules) - set(ALL_RULES))
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(ALL_RULES))})")
+    selected = ALL_RULES if rules is None else {
+        name: fn for name, fn in ALL_RULES.items() if name in rules}
+    out: List[Violation] = []
+    for sf in project.files.values():
+        if sf.parse_error:
+            v = Violation("parse-error", sf.path, 1, sf.parse_error)
+            v.key = violation_key(v, sf)
+            out.append(v)
+    for name, fn in selected.items():
+        for v in fn(project):
+            sf = project.get(v.path) or (
+                project.readme if v.path == README_PATH else None)
+            if sf is not None:
+                sup, reasonless = suppressed_rules(sf, v.line)
+                if v.rule in sup:
+                    continue
+                if reasonless:
+                    # diagnose the inert marker: the developer meant to
+                    # suppress, but a reasonless marker suppresses
+                    # nothing — say so instead of looking broken
+                    v.message += (" (NOTE: the reasonless `# dtpu-lint:"
+                                  " ignore[...]` marker here suppresses"
+                                  " nothing — add a reason)")
+            v.key = violation_key(v, sf)
+            out.append(v)
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def run_lint(root: Optional[str] = None,
+             overrides: Optional[Dict[str, str]] = None,
+             rules: Optional[List[str]] = None,
+             baseline: Optional[Dict[str, int]] = None) -> LintReport:
+    """The one-call entry point ``cli lint`` and the tier-1 gate use."""
+    root = root or repo_root()
+    project = load_project(root, overrides=overrides)
+    violations = lint_project(project, rules=rules)
+    if baseline is None:
+        baseline = load_baseline(root)
+    return LintReport(violations=violations,
+                      new=_split_new(violations, baseline),
+                      baseline_total=sum(baseline.values()))
+
+
+def repo_root() -> str:
+    """The checkout root: the parent of the package directory."""
+    here = os.path.dirname(os.path.abspath(__file__))   # .../analysis
+    return os.path.dirname(os.path.dirname(here))
+
+
+# --- rule registry (populated by the rule modules) ---------------------------
+
+ALL_RULES: Dict[str, Callable[[Project], List[Violation]]] = {}
+
+
+def rule(name: str):
+    def deco(fn):
+        ALL_RULES[name] = fn
+        return fn
+    return deco
+
+
+# importing the rule modules registers them; kept at the bottom so the
+# modules can import the helpers above
+from comfyui_distributed_tpu.analysis import rules_async  # noqa: E402,F401
+from comfyui_distributed_tpu.analysis import rules_lockset  # noqa: E402,F401
+from comfyui_distributed_tpu.analysis import rules_spine  # noqa: E402,F401
+from comfyui_distributed_tpu.analysis import rules_registry  # noqa: E402,F401

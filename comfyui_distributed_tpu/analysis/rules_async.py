@@ -1,0 +1,151 @@
+"""Rule family 1: ``async-blocking``.
+
+The PR 7 hardening class, now a gate: a blocking operation executed
+*directly* in an ``async def`` body stalls the whole aiohttp event loop
+— heartbeats miss, preflight's 300 ms probe fails, every in-flight
+request queues behind one fsync.  The fix pattern is always the same:
+``await loop.run_in_executor(None, <thunk>)``.
+
+Call-graph shape: we walk every ``async def`` in the package but do
+NOT descend into nested ``def``/``lambda`` bodies — those are almost
+always the executor thunks themselves (``run_in_executor(None,
+lambda: ...)``), i.e. the *correct* pattern.  A nested function that is
+in fact awaited inline can still be caught at its own ``async def``
+walk if it is async, and suppressed with a reason if genuinely safe.
+
+What counts as blocking (each entry paid for by a past incident or
+review finding):
+
+- file IO / fsync (``open``, ``os.fsync``, ``os.makedirs``,
+  ``shutil.rmtree``) — the WAL class;
+- ``time.sleep`` (``asyncio.sleep`` is the async twin and exempt);
+- subprocess management (``subprocess.*`` and the worker process
+  manager's ``launch_worker``/``stop_worker`` — terminate+wait holds
+  up to PROCESS_TERMINATION_TIMEOUT);
+- sync HTTP (``urllib.request.urlopen``);
+- device sync / backend init (``block_until_ready``,
+  ``device_memory_snapshot``, ``snapshot_now``, ``jax.clear_caches``,
+  ``load_pipeline``, pipeline ``warmup`` — seconds on a real TPU);
+- config file RMW (``load_config``/``mutate_config``);
+- WAL-appending state transitions (``enqueue_prompt``, ledger
+  ``check_in``/``reassign``/``mark_hedged``/``create_job``/
+  ``finish_job`` — each may fsync under DTPU_WAL_SYNC=always);
+- ``gc.collect`` and model-cache clears (``clear_pipeline_cache``);
+- log tailing (``tail_log``) and the blocking drains (``.drain``,
+  ``resume_recovered``, ``poll_once``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from comfyui_distributed_tpu.analysis.engine import (
+    Project, Violation, call_name, iter_scoped, rule, scope_qualname)
+
+# exact dotted-callee matches
+_EXACT = {
+    "open": "file IO",
+    "os.fsync": "fsync",
+    "os.makedirs": "directory IO",
+    "os.replace": "file IO",
+    "shutil.rmtree": "directory IO",
+    "time.sleep": "blocking sleep (use asyncio.sleep)",
+    "gc.collect": "full GC pass",
+    "jax.clear_caches": "jit-cache clear (walks every live executable)",
+}
+
+# final-attribute matches (``anything.<attr>(...)``)
+_ATTR = {
+    "fsync": "fsync",
+    "urlopen": "sync HTTP",
+    "block_until_ready": "device sync",
+    "load_config": "config file read",
+    "mutate_config": "config file RMW under the shared config lock",
+    "enqueue_prompt": "WAL append + fsync before returning",
+    "log_enqueue": "WAL append + fsync",
+    "log_exec_done": "WAL append + fsync",
+    "check_in": "ledger check-in (payload spill + WAL fsync)",
+    "reassign": "ledger reassign (WAL append + fsync)",
+    "mark_hedged": "ledger hedge mark (WAL append + fsync)",
+    "create_job": "ledger job create (WAL append + fsync)",
+    "finish_job": "ledger job finish (WAL append + fsync)",
+    "tail_log": "log-file read",
+    "launch_worker": "subprocess spawn + config IO",
+    "stop_worker": "process terminate + bounded wait",
+    "clear_pipeline_cache": "model-cache teardown",
+    "device_memory_snapshot": "device probe (may initialize the backend)",
+    "snapshot_now": "device probe (may initialize the backend)",
+    "host_rss_bytes": "procfs/psutil probe",
+    "load_pipeline": "checkpoint load",
+    "warmup": "AOT compile",
+    "resume_recovered": "recovery replay (health poll + WAL'd enqueues)",
+    "poll_once": "fleet-wide HTTP health probe",
+    "drain": "blocking drain loop",
+    "sample_once": "resource probe (may initialize the backend)",
+    "fleet_signal": "registry + resource probe",
+}
+
+# subprocess.<anything>(...) is blocking by construction
+_PREFIXES = ("subprocess.",)
+
+_RULE = "async-blocking"
+
+
+def _callee_matches(name: str) -> str:
+    if name in _EXACT:
+        return _EXACT[name]
+    for p in _PREFIXES:
+        if name.startswith(p):
+            return "subprocess call"
+    attr = name.rsplit(".", 1)[-1]
+    if "." in name and attr in _ATTR:
+        # asyncio.sleep / asyncio.drain-style twins are exempt
+        if name.startswith("asyncio."):
+            return ""
+        return _ATTR[attr]
+    return ""
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """Walk one async def body, skipping nested function scopes."""
+
+    def __init__(self, sf, scope: str, out: List[Violation]):
+        self.sf = sf
+        self.scope = scope
+        self.out = out
+
+    # nested scopes execute elsewhere (usually on the executor): stop
+    def visit_FunctionDef(self, node):  # noqa: N802
+        return
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        return
+
+    def visit_Lambda(self, node):  # noqa: N802
+        return
+
+    def visit_Call(self, node):  # noqa: N802
+        name = call_name(node)
+        why = _callee_matches(name)
+        if why:
+            self.out.append(Violation(
+                _RULE, self.sf.path, node.lineno,
+                f"`{name}(...)` ({why}) called directly on the event "
+                f"loop — offload via `await loop.run_in_executor(None, "
+                f"...)`",
+                scope=self.scope))
+        self.generic_visit(node)
+
+
+@rule(_RULE)
+def check_async_blocking(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in project.python_files():
+        for node, stack in iter_scoped(sf.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            v = _AsyncBodyVisitor(sf, scope_qualname(stack), out)
+            for stmt in node.body:
+                v.visit(stmt)
+    return out

@@ -1,0 +1,164 @@
+"""Rule family 2: ``lockset`` (guarded-by annotations, Eraser-style).
+
+Eraser (Savage et al., SOSP '97) checked the *lockset invariant*: every
+shared variable is protected by some lock held on every access.  The
+dynamic version needs a race to fire under instrumentation; this static
+version needs the invariant *stated* — a ``# guarded-by: <lock>``
+trailing comment on the field's ``self.<field> = ...`` assignment
+(conventionally in ``__init__``) — and then checks every other
+``self.<field>`` access in the class lexically sits inside a
+``with <lock>:`` block.
+
+Conventions honored (matching this codebase's existing style):
+
+- ``__init__`` is exempt — the object is unpublished while it runs;
+- methods named ``*_locked`` are exempt — the suffix is this repo's
+  caller-holds-the-lock contract (``_refresh_locked``,
+  ``_fsync_locked``, ...);
+- a ``# dtpu-lint: holds[self._lock]`` comment on a ``def`` line
+  declares the same contract for names that can't carry the suffix;
+- nested ``def`` bodies reset the held-lock set: a named closure
+  (thread target, executor thunk) runs later, when the ``with`` block
+  that lexically surrounds its *definition* has long exited.  Lambdas
+  INHERIT it instead — sort/min/max keys execute inline where they are
+  written.
+
+The checker is annotation-driven: classes without ``guarded-by``
+comments cost nothing.  The PR 9 forced-retirement bug and the PR 5
+monitor restart race both lived exactly in the gap this closes —
+decision state mutated by a reconciliation thread while HTTP handlers
+snapshot it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List
+
+from comfyui_distributed_tpu.analysis.engine import (
+    Project, SourceFile, Violation, holds_locks, rule)
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([^#]+?)\s*$")
+
+_RULE = "lockset"
+
+
+def _norm_expr(text: str) -> str:
+    return "".join(text.split())
+
+
+def _collect_annotations(sf: SourceFile,
+                         cls: ast.ClassDef) -> Dict[str, str]:
+    """field name -> normalized lock expression, from trailing
+    ``# guarded-by:`` comments on ``self.<field> = ...`` lines."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        # the comment may trail any line of a multi-line assignment
+        m = None
+        for ln in range(node.lineno,
+                        (node.end_lineno or node.lineno) + 1):
+            if ln <= len(sf.lines):
+                m = _GUARDED_RE.search(sf.lines[ln - 1])
+                if m:
+                    break
+        if not m:
+            continue
+        lock = _norm_expr(m.group(1))
+        for t in targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                out[t.attr] = lock
+    return out
+
+
+class _MethodChecker(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, cls_name: str, method_name: str,
+                 guards: Dict[str, str], held: set,
+                 out: List[Violation]):
+        self.sf = sf
+        self.scope = f"{cls_name}.{method_name}"
+        self.guards = guards
+        self.held = set(held)
+        self.out = out
+
+    # closures run later, without the lexically-surrounding locks
+    def visit_FunctionDef(self, node):  # noqa: N802
+        inner = _MethodChecker(self.sf, self.scope, node.name,
+                               self.guards,
+                               holds_locks(self.sf, node), self.out)
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # noqa: N815
+
+    def visit_Lambda(self, node):  # noqa: N802
+        # lambdas INHERIT the held set: the overwhelmingly common forms
+        # (sort keys, min/max keys, comprehension guards) execute inline
+        # where they are written.  Deferred-execution lambdas (executor
+        # thunks) appear outside `with lock:` scopes in this codebase,
+        # so inheriting stays sound there too; a counterexample needs a
+        # reasoned suppression.
+        inner = _MethodChecker(self.sf, self.scope, "<lambda>",
+                               self.guards, self.held, self.out)
+        inner.visit(node.body)
+
+    def _with(self, node):
+        added = []
+        for item in node.items:
+            try:
+                expr = _norm_expr(ast.unparse(item.context_expr))
+            except Exception:  # noqa: BLE001
+                continue
+            added.append(expr)
+        self.held |= set(added)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held -= set(added)
+
+    def visit_With(self, node):  # noqa: N802
+        self._with(node)
+
+    def visit_AsyncWith(self, node):  # noqa: N802
+        self._with(node)
+
+    def visit_Attribute(self, node):  # noqa: N802
+        if isinstance(node.value, ast.Name) and node.value.id == "self" \
+                and node.attr in self.guards:
+            lock = self.guards[node.attr]
+            if lock not in self.held:
+                self.out.append(Violation(
+                    _RULE, self.sf.path, node.lineno,
+                    f"`self.{node.attr}` (guarded-by {lock}) accessed "
+                    f"without holding {lock}",
+                    scope=self.scope))
+        self.generic_visit(node)
+
+
+@rule(_RULE)
+def check_lockset(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in project.python_files():
+        for cls in [n for n in ast.walk(sf.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            guards = _collect_annotations(sf, cls)
+            if not guards:
+                continue
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if meth.name == "__init__" \
+                        or meth.name.endswith("_locked"):
+                    continue
+                checker = _MethodChecker(
+                    sf, cls.name, meth.name, guards,
+                    holds_locks(sf, meth), out)
+                for stmt in meth.body:
+                    checker.visit(stmt)
+    return out

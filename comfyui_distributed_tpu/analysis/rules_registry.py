@@ -1,0 +1,291 @@
+"""Rule family 4: registry drift.
+
+The Dapper posture: observability guarantees are only guarantees when
+they are *always on and complete*.  Three registries in this repo rot
+by hand-sync — the ``DTPU_*`` env table (PR 9 added 14 rows manually),
+the Prometheus family names, and the span-attr vocabulary `cli trace`
+renders — so drift becomes a gate:
+
+- ``env-undeclared`` — every ``os.environ``/``os.getenv`` read of a
+  ``DTPU_*`` name anywhere in the package must have that name declared
+  (as a string literal) in ``utils/constants.py``.  Reads through a
+  module-level ``FOO_ENV = "DTPU_..."`` constant are resolved.
+- ``env-readme-drift`` — every ``DTPU_*`` literal declared in
+  constants.py must appear in the README's env table (rows starting
+  with ``|``), and every table row's name must be declared — both
+  directions, so neither side can grow alone.
+- ``metric-name`` — Prometheus family tuples ``(name, type, help,
+  samples)`` must use the ``dtpu_`` prefix and counters must end in
+  ``_total``; one family name cannot carry two types.
+- ``span-attr`` — every literal span-attribute key (``sp.attrs[k]``,
+  ``attrs={...}`` on start_span/event_span, ``span(name, k=...)``
+  keywords) must be in ``constants.TRACE_ATTR_WHITELIST`` — the
+  vocabulary contract between span producers and the trace readers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from comfyui_distributed_tpu.analysis.engine import (
+    CONSTANTS_PATH, README_PATH, Project, SourceFile, Violation,
+    call_name, iter_scoped, rule, scope_qualname)
+
+_ENV_NAME_RE = re.compile(r"^DTPU_[A-Z0-9_]+$")
+_README_ROW_RE = re.compile(r"DTPU_[A-Z0-9_]+")
+
+_ENV_UNDECLARED = "env-undeclared"
+_ENV_README = "env-readme-drift"
+_METRIC = "metric-name"
+_SPAN_ATTR = "span-attr"
+
+_PROM_TYPES = ("counter", "gauge", "histogram", "summary")
+
+
+def _constants_env_literals(sf: Optional[SourceFile]
+                            ) -> Dict[str, int]:
+    """DTPU_* string literals declared in constants.py -> first line."""
+    out: Dict[str, int] = {}
+    if sf is None or sf.tree is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and _ENV_NAME_RE.match(node.value):
+            out.setdefault(node.value, node.lineno)
+    return out
+
+
+def _module_env_constants(sf: SourceFile) -> Dict[str, str]:
+    """Module-level ``NAME = "DTPU_..."`` assignments (the indirection
+    manager.py/registry.py use)."""
+    out: Dict[str, str] = {}
+    if sf.tree is None:
+        return out
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and _ENV_NAME_RE.match(node.value.value):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _env_key(node: ast.AST, local_consts: Dict[str, str]
+             ) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if _ENV_NAME_RE.match(node.value) else None
+    if isinstance(node, ast.Name):
+        return local_consts.get(node.id)
+    return None
+
+
+def _iter_env_reads(sf: SourceFile, local_consts: Dict[str, str]):
+    """Yield (env_name, lineno, scope) for every env access whose key
+    resolves to a DTPU_* literal."""
+    for child, stack in iter_scoped(sf.tree):
+        name = None
+        if isinstance(child, ast.Call):
+            cn = call_name(child)
+            if cn.endswith(("environ.get", "environ.setdefault",
+                            "environ.pop")) or cn in (
+                                "os.getenv", "getenv"):
+                if child.args:
+                    name = _env_key(child.args[0], local_consts)
+        elif isinstance(child, ast.Subscript):
+            base = ""
+            try:
+                base = ast.unparse(child.value)
+            except Exception:  # noqa: BLE001
+                pass
+            if base.endswith("environ"):
+                name = _env_key(child.slice, local_consts)
+        elif isinstance(child, ast.Compare) \
+                and len(child.ops) == 1 \
+                and isinstance(child.ops[0], (ast.In, ast.NotIn)):
+            base = ""
+            try:
+                base = ast.unparse(child.comparators[0])
+            except Exception:  # noqa: BLE001
+                pass
+            if base.endswith("environ"):
+                name = _env_key(child.left, local_consts)
+        if name is not None:
+            yield name, child.lineno, scope_qualname(stack)
+
+
+@rule(_ENV_UNDECLARED)
+def check_env_undeclared(project: Project) -> List[Violation]:
+    declared = _constants_env_literals(project.get(CONSTANTS_PATH))
+    if not declared:
+        return []  # no constants module in this (test) project: skip
+    out: List[Violation] = []
+    for sf in project.python_files():
+        if sf.path == CONSTANTS_PATH:
+            continue
+        local_consts = _module_env_constants(sf)
+        for name, lineno, scope in _iter_env_reads(sf, local_consts):
+            if name not in declared:
+                out.append(Violation(
+                    _ENV_UNDECLARED, sf.path, lineno,
+                    f"env var {name} read here but not declared in "
+                    f"utils/constants.py — declare it (and add a README "
+                    f"env-table row)",
+                    scope=scope))
+    return out
+
+
+@rule(_ENV_README)
+def check_env_readme_drift(project: Project) -> List[Violation]:
+    consts = project.get(CONSTANTS_PATH)
+    declared = _constants_env_literals(consts)
+    if not declared or project.readme is None:
+        return []
+    in_table: Dict[str, int] = {}
+    for i, line in enumerate(project.readme.lines, start=1):
+        if not line.lstrip().startswith("|"):
+            continue
+        for m in _README_ROW_RE.finditer(line):
+            in_table.setdefault(m.group(0), i)
+    out: List[Violation] = []
+    for name, lineno in sorted(declared.items()):
+        if name not in in_table:
+            out.append(Violation(
+                _ENV_README, CONSTANTS_PATH, lineno,
+                f"{name} is declared here but missing from the README "
+                f"`DTPU_*` env table",
+                scope="constants"))
+    for name, lineno in sorted(in_table.items()):
+        if name not in declared:
+            out.append(Violation(
+                _ENV_README, README_PATH, lineno,
+                f"README env table names {name}, which is not declared "
+                f"in utils/constants.py",
+                scope="readme"))
+    return out
+
+
+@rule(_METRIC)
+def check_metric_names(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    seen_types: Dict[str, Tuple[str, str, int]] = {}
+    for sf in project.python_files():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Tuple) or len(node.elts) != 4:
+                continue
+            name_n, type_n, help_n = node.elts[0], node.elts[1], \
+                node.elts[2]
+            if not (isinstance(name_n, ast.Constant)
+                    and isinstance(name_n.value, str)
+                    and isinstance(type_n, ast.Constant)
+                    and type_n.value in _PROM_TYPES
+                    and isinstance(help_n, ast.Constant)
+                    and isinstance(help_n.value, str)):
+                continue
+            name, mtype = name_n.value, type_n.value
+            scope = "prom-family"
+            if not name.startswith("dtpu_"):
+                out.append(Violation(
+                    _METRIC, sf.path, node.lineno,
+                    f"metric family {name!r} must use the `dtpu_` "
+                    f"prefix", scope=scope))
+            if mtype == "counter" and not name.endswith("_total"):
+                out.append(Violation(
+                    _METRIC, sf.path, node.lineno,
+                    f"counter family {name!r} must end in `_total` "
+                    f"(Prometheus convention)", scope=scope))
+            prev = seen_types.get(name)
+            if prev is not None and prev[0] != mtype:
+                out.append(Violation(
+                    _METRIC, sf.path, node.lineno,
+                    f"metric family {name!r} declared as {mtype} here "
+                    f"but as {prev[0]} at {prev[1]}:{prev[2]}",
+                    scope=scope))
+            else:
+                seen_types.setdefault(name, (mtype, sf.path,
+                                             node.lineno))
+    return out
+
+
+# --- span attributes ---------------------------------------------------------
+
+def _whitelist(project: Project) -> Optional[Set[str]]:
+    consts = project.get(CONSTANTS_PATH)
+    if consts is None or consts.tree is None:
+        return None
+    for node in consts.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "TRACE_ATTR_WHITELIST":
+            value = node.value
+            # unwrap frozenset({...}) / set({...}) / tuple([...])
+            if isinstance(value, ast.Call) and value.args \
+                    and isinstance(value.func, ast.Name) \
+                    and value.func.id in ("frozenset", "set", "tuple"):
+                value = value.args[0]
+            try:
+                return {str(v) for v in ast.literal_eval(value)}
+            except (ValueError, TypeError):
+                return None
+    return None
+
+
+_SPAN_FACTORIES = ("start_span", "event_span", "Span")
+
+
+def _iter_span_attr_keys(sf: SourceFile):
+    for child, stack in iter_scoped(sf.tree):
+        # X.attrs["k"] = ... / X.attrs.setdefault("k", ...)
+        if isinstance(child, ast.Subscript) \
+                and isinstance(child.value, ast.Attribute) \
+                and child.value.attr == "attrs" \
+                and isinstance(child.slice, ast.Constant) \
+                and isinstance(child.slice.value, str):
+            yield (child.slice.value, child.lineno,
+                   scope_qualname(stack))
+        if isinstance(child, ast.Call):
+            cn = call_name(child)
+            attr = cn.rsplit(".", 1)[-1]
+            if cn.endswith("attrs.setdefault") and child.args \
+                    and isinstance(child.args[0], ast.Constant) \
+                    and isinstance(child.args[0].value, str):
+                yield (child.args[0].value, child.lineno,
+                       scope_qualname(stack))
+            if attr in _SPAN_FACTORIES:
+                for kw in child.keywords:
+                    if kw.arg == "attrs" \
+                            and isinstance(kw.value, ast.Dict):
+                        for k in kw.value.keys:
+                            if isinstance(k, ast.Constant) \
+                                    and isinstance(k.value, str):
+                                yield (k.value, child.lineno,
+                                       scope_qualname(stack))
+            if attr == "span":
+                for kw in child.keywords:
+                    if kw.arg is not None:
+                        yield (kw.arg, child.lineno,
+                               scope_qualname(stack))
+
+
+@rule(_SPAN_ATTR)
+def check_span_attrs(project: Project) -> List[Violation]:
+    whitelist = _whitelist(project)
+    if whitelist is None:
+        return []  # no whitelist declared (test projects): skip
+    out: List[Violation] = []
+    for sf in project.python_files():
+        # the trace module itself builds spans generically (**attrs);
+        # producers are what the vocabulary contract binds
+        if sf.path == "comfyui_distributed_tpu/utils/trace.py":
+            continue
+        for key, lineno, scope in _iter_span_attr_keys(sf):
+            if key not in whitelist:
+                out.append(Violation(
+                    _SPAN_ATTR, sf.path, lineno,
+                    f"span attr {key!r} is not in "
+                    f"constants.TRACE_ATTR_WHITELIST — add it there "
+                    f"(and teach the trace readers) or rename",
+                    scope=scope))
+    return out

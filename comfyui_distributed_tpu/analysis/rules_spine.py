@@ -1,0 +1,170 @@
+"""Rule family 3: device-spine transfer lint.
+
+PR 1's contract: IMAGE/LATENT tensors never leave the device across the
+KSampler -> VAEDecode -> Collector spine — host fetches happen only at
+true host edges (PNG encode, HTTP wire), and every one of those is
+counted.  The runtime proof is the transfer counters; this is the
+*static* half: host-materializing calls inside the spine modules
+(``ops/``, ``models/denoiser.py``, ``workflow/executor.py``) are
+flagged so a new d2h edge can't slip into a compute path silently.
+Legitimate host edges (SaveImage encode, wire send/receive, widget
+float parsing at trace time) are grandfathered in the baseline or
+suppressed with a reason at the site.
+
+Two rule ids:
+
+- ``spine-host-fetch`` — ``np.asarray``/``np.array`` (a device array
+  argument forces a d2h copy), ``jax.device_get``, ``.item()`` and
+  ``float(x)`` on non-literals (both synchronize: host control flow
+  now waits on the device stream);
+- ``retrace-hazard`` — Python ``if``/``while`` on a *parameter* of a
+  function handed to ``jax.jit`` in the same scope: branching on a
+  traced value either crashes (ConcretizationTypeError) or, with a
+  static argnum, silently forks the compile cache per value — the
+  retrace class the zero-retrace serving invariant guards.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from comfyui_distributed_tpu.analysis.engine import (
+    Project, Violation, call_name, iter_scoped, rule, scope_qualname)
+
+SPINE_PREFIXES = ("comfyui_distributed_tpu/ops/",)
+SPINE_FILES = ("comfyui_distributed_tpu/models/denoiser.py",
+               "comfyui_distributed_tpu/workflow/executor.py")
+
+_FETCH = "spine-host-fetch"
+_RETRACE = "retrace-hazard"
+
+_NP_ROOTS = ("np", "numpy")
+
+
+def _is_spine(path: str) -> bool:
+    return path in SPINE_FILES \
+        or any(path.startswith(p) for p in SPINE_PREFIXES)
+
+
+def _host_fetch_reason(node: ast.Call) -> str:
+    name = call_name(node)
+    root = name.split(".", 1)[0]
+    attr = name.rsplit(".", 1)[-1]
+    if root in _NP_ROOTS and attr in ("asarray", "array"):
+        return (f"`{name}` on a device value is a blocking d2h copy")
+    if attr == "device_get":
+        return f"`{name}` is an explicit device fetch"
+    if attr == "item" and "." in name and not node.args \
+            and not node.keywords:
+        return "`.item()` synchronizes and materializes on host"
+    if isinstance(node.func, ast.Name) and node.func.id == "float" \
+            and node.args \
+            and not isinstance(node.args[0], ast.Constant):
+        return ("`float(x)` on a non-literal synchronizes if x is a "
+                "device value")
+    return ""
+
+
+@rule(_FETCH)
+def check_spine_host_fetch(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in project.python_files():
+        if not _is_spine(sf.path):
+            continue
+        for node, stack in iter_scoped(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            why = _host_fetch_reason(node)
+            if why:
+                out.append(Violation(
+                    _FETCH, sf.path, node.lineno,
+                    f"{why} — keep the spine device-resident "
+                    f"(fetch only at counted host edges)",
+                    scope=scope_qualname(stack)))
+    return out
+
+
+# --- retrace hazards ---------------------------------------------------------
+
+def _jitted_function_names(tree: ast.AST) -> set:
+    """Names of locally-defined functions passed to ``jax.jit``/
+    ``*.jit`` (directly or via ``partial(jax.jit, ...)``) anywhere in
+    the module, plus functions decorated with a jit."""
+    jitted: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name.endswith(".jit") or name == "jit":
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        jitted.add(arg.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                dn = ""
+                try:
+                    dn = ast.unparse(deco)
+                except Exception:  # noqa: BLE001
+                    pass
+                if ".jit" in dn or dn == "jit":
+                    jitted.add(node.name)
+    return jitted
+
+
+def _static_test(test: ast.AST) -> bool:
+    """Tests that are trace-time Python (never traced values): None
+    checks, isinstance, shape/dtype/ndim/len probes, boolean literals,
+    attribute-only chains."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return True
+        if isinstance(node, ast.Call):
+            n = call_name(node)
+            if n in ("isinstance", "len", "hasattr", "getattr",
+                     "callable"):
+                return True
+        if isinstance(node, ast.Attribute) \
+                and node.attr in ("shape", "ndim", "dtype", "size"):
+            return True
+    return False
+
+
+@rule(_RETRACE)
+def check_retrace_hazard(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in project.python_files():
+        if not _is_spine(sf.path) and not sf.path.startswith(
+                "comfyui_distributed_tpu/models/"):
+            continue
+        jitted = _jitted_function_names(sf.tree)
+        if not jitted:
+            continue
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    or fn.name not in jitted:
+                continue
+            params = {a.arg for a in (fn.args.args
+                                      + fn.args.posonlyargs
+                                      + fn.args.kwonlyargs)
+                      if a.arg not in ("self", "cls")}
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if _static_test(node.test):
+                    continue
+                names = {n.id for n in ast.walk(node.test)
+                         if isinstance(n, ast.Name)}
+                hit = sorted(names & params)
+                if hit:
+                    out.append(Violation(
+                        _RETRACE, sf.path, node.lineno,
+                        f"Python branch on parameter(s) "
+                        f"{', '.join(hit)} inside jitted `{fn.name}` — "
+                        f"traced values can't drive `if`/`while` "
+                        f"(use lax.cond/select, or mark static and "
+                        f"accept a compile per value)",
+                        scope=fn.name))
+    return out

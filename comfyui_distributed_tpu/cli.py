@@ -479,6 +479,55 @@ def cmd_wal(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def cmd_lint(args) -> int:
+    """Project-invariant static analysis (dtpu-lint): run the AST rule
+    suite over the checkout and fail (exit 1) on any violation not in
+    the checked-in baseline.  Pure stdlib — never initializes a backend
+    (safe on a serving host mid-incident)."""
+    from comfyui_distributed_tpu.analysis import engine
+    root = args.root or engine.repo_root()
+    rules = args.rule or None
+    if args.write_baseline and rules:
+        # a partial run writes a partial baseline, silently destroying
+        # every other rule's audited grandfather entries
+        print("--write-baseline requires a full run: drop --rule",
+              file=sys.stderr)
+        return 2
+    try:
+        report = engine.run_lint(root=root, rules=rules)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        path = engine.write_baseline(root, report.violations)
+        print(f"baseline written: {path} "
+              f"({len(report.violations)} finding(s)) — audit every "
+              f"entry before committing")
+        return 0
+    if args.json:
+        print(json.dumps({
+            "new": [vars(v) for v in report.new],
+            "total_findings": len(report.violations),
+            "baselined": report.baseline_total,
+        }, indent=2))
+        return 1 if report.new else 0
+    shown = report.violations if args.all else report.new
+    for v in shown:
+        mark = "" if v in report.new else "  (baselined)"
+        print(f"{v.format()}{mark}")
+    if report.new:
+        print(f"\ndtpu-lint: {len(report.new)} NEW violation(s) "
+              f"({len(report.violations)} total, "
+              f"{report.baseline_total} baselined).  Fix them, add a "
+              f"reasoned `# dtpu-lint: ignore[rule] why`, or — for "
+              f"audited-benign findings only — regenerate the baseline "
+              f"with `cli lint --write-baseline`.")
+        return 1
+    print(f"dtpu-lint: clean ({len(report.violations)} baselined "
+          f"finding(s), 0 new)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="comfyui_distributed_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -567,6 +616,25 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="raw JSON report instead of the pretty listing")
     p.set_defaults(fn=cmd_wal)
+
+    p = sub.add_parser("lint", help="project-invariant static analysis: "
+                                    "async-blocking, lockset, device-"
+                                    "spine and registry-drift rules; "
+                                    "exit 1 on non-baselined findings")
+    p.add_argument("--root", default=None,
+                   help="checkout root to lint (default: this package's "
+                        "own checkout)")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="RULE_ID",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--all", action="store_true",
+                   help="print baselined findings too, not just new ones")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate the grandfather baseline from the "
+                        "current findings (audit first!)")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("trace", help="read a job's distributed trace "
                                      "from a server's flight recorder")

@@ -243,6 +243,7 @@ class DeviceTensor:
 
     def to_host(self) -> np.ndarray:
         """THE device->host edge: fetch, count, return float32 numpy."""
+        # dtpu-lint: ignore[spine-host-fetch] the one designed d2h edge — counted
         arr = np.asarray(jax.device_get(self.data), dtype=np.float32)
         record_transfer("d2h", arr.nbytes)
         return arr
@@ -269,6 +270,7 @@ class DeviceLatent(DeviceTensor):
 def put_device_array(x) -> jax.Array:
     """Host -> device put with transfer accounting (the counted inverse of
     ``DeviceTensor.to_host``)."""
+    # dtpu-lint: ignore[spine-host-fetch] h2d put on an already-host value — counted
     arr = np.asarray(x)
     record_transfer("h2d", arr.nbytes)
     return jnp.asarray(arr)
@@ -315,6 +317,7 @@ def as_image_array(x) -> np.ndarray:
     if isinstance(x, DeviceTensor):
         arr = x.to_host()
     elif isinstance(x, jax.Array):
+        # dtpu-lint: ignore[spine-host-fetch] designed host edge — counted
         arr = np.asarray(jax.device_get(x), dtype=np.float32)
         record_transfer("d2h", arr.nbytes)
     else:

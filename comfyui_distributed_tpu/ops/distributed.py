@@ -378,8 +378,11 @@ class DistributedCollector(Op):
                         for unit, owner in sorted(
                                 ledger.overdue_units(
                                     multi_job_id).items(), key=str):
-                            hedged = ledger.mark_hedged(multi_job_id,
-                                                        [unit])
+                            # off the loop: the hedge mark is a WAL
+                            # append (+ fsync under sync=always)
+                            hedged = await loop.run_in_executor(
+                                None, lambda u=unit: ledger.mark_hedged(
+                                    multi_job_id, [u]))
                             if not hedged:
                                 continue
                             if await recover_units([unit], owner,

@@ -811,8 +811,11 @@ class UltimateSDUpscaleDistributed(Op):
                         if rsp is not None and not redone:
                             rsp.attrs["to"] = "none"
                 if not redone and refine_window is not None:
-                    moved = ledger.reassign(multi_job_id,
-                                            sorted(units), "master")
+                    # off the loop: a WAL-backed reassign appends +
+                    # fsyncs the ownership record
+                    moved = await loop.run_in_executor(
+                        None, lambda: ledger.reassign(
+                            multi_job_id, sorted(units), "master"))
                     if moved:
                         recovery.append(loop.create_task(
                             recover(moved, what, owner)))
@@ -891,8 +894,11 @@ class UltimateSDUpscaleDistributed(Op):
                         units = sorted(u for u, o in overdue.items()
                                        if o != "master")
                         if units:
-                            hedged = ledger.mark_hedged(
-                                multi_job_id, units, "master")
+                            # off the loop: the hedge mark is a WAL
+                            # append (+ fsync under sync=always)
+                            hedged = await loop.run_in_executor(
+                                None, lambda: ledger.mark_hedged(
+                                    multi_job_id, units, "master"))
                             if hedged:
                                 log(f"tiled upscale master: hedging "
                                     f"overdue units {hedged}")

@@ -145,17 +145,23 @@ class FleetAutoscaler:
         self.flap_window_s = min(2.0 * self.cooldown_s,
                                  C.AUTOSCALE_FLAP_S) \
             if flap_window_s is None else float(flap_window_s)
+        # Decision state is shared between the reconciliation thread and
+        # the HTTP handlers' snapshot()/fleet route (the PR 9
+        # forced-retirement bug lived exactly in this interplay), so
+        # every field below is lock-guarded — and the lockset rule
+        # enforces it from the annotations.
         # sustained-window counters (consecutive samples beyond bar)
-        self._over_streak = 0
-        self._under_streak = 0
-        self._last_action: Optional[str] = None   # "up" | "down"
-        self._last_action_t: Optional[float] = None
-        self._spawned: List[str] = []      # ids this loop created (LIFO)
-        self._retiring: Dict[str, float] = {}     # wid -> drain deadline
-        self.decisions: deque = deque(maxlen=C.AUTOSCALE_DECISIONS_KEPT)
-        self.flaps = 0
-        self.scale_ups = 0
-        self.scale_downs = 0
+        self._over_streak = 0                     # guarded-by: self._lock
+        self._under_streak = 0                    # guarded-by: self._lock
+        self._last_action: Optional[str] = None   # guarded-by: self._lock
+        self._last_action_t: Optional[float] = None  # guarded-by: self._lock
+        self._spawned: List[str] = []             # guarded-by: self._lock
+        self._retiring: Dict[str, float] = {}     # guarded-by: self._lock
+        self.decisions: deque = deque(
+            maxlen=C.AUTOSCALE_DECISIONS_KEPT)    # guarded-by: self._lock
+        self.flaps = 0                            # guarded-by: self._lock
+        self.scale_ups = 0                        # guarded-by: self._lock
+        self.scale_downs = 0                      # guarded-by: self._lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -208,26 +214,35 @@ class FleetAutoscaler:
                      signal.get("queue_per_participant", 0.0), 3),
                  "utilization": signal.get("utilization"),
                  "live_workers": signal.get("live_workers")}
+        # decide-and-mutate under ONE lock hold (a snapshot() landing
+        # between the flap check and the last-action update used to be
+        # able to read torn decision state); logging/counters happen
+        # after release — they have their own locks
+        flap_delta: Optional[float] = None
         with self._lock:
             self.decisions.append(entry)
+            if action in ("up", "down"):
+                prev, prev_t = self._last_action, self._last_action_t
+                if prev is not None and prev != action \
+                        and prev_t is not None \
+                        and now - prev_t < self.flap_window_s:
+                    self.flaps += 1
+                    flap_delta = now - prev_t
+                self._last_action, self._last_action_t = action, now
         if action in ("up", "down"):
-            prev, prev_t = self._last_action, self._last_action_t
-            if prev is not None and prev != action \
-                    and prev_t is not None \
-                    and now - prev_t < self.flap_window_s:
-                self.flaps += 1
+            if flap_delta is not None:
                 trace_mod.GLOBAL_COUNTERS.bump("autoscale_flaps")
                 log(f"autoscale: FLAP — {action} within "
-                    f"{now - prev_t:.1f}s of {prev} (hysteresis/window "
-                    f"too tight for this workload)")
-            self._last_action, self._last_action_t = action, now
+                    f"{flap_delta:.1f}s of the previous action "
+                    f"(hysteresis/window too tight for this workload)")
             trace_mod.GLOBAL_COUNTERS.bump(f"autoscale_{action}")
             log(f"autoscale: scale {action} ({reason})"
                 + (f" worker={worker_id}" if worker_id else ""))
 
     def _in_cooldown(self, now: float) -> bool:
-        return (self._last_action_t is not None
-                and now - self._last_action_t < self.cooldown_s)
+        with self._lock:
+            return (self._last_action_t is not None
+                    and now - self._last_action_t < self.cooldown_s)
 
     def sample_once(self, now: Optional[float] = None) -> Dict[str, Any]:
         """One reconciliation step (thread-free — tests drive this
@@ -243,13 +258,19 @@ class FleetAutoscaler:
                                        and util > self.up_util)
         under = qpp < self.down_queue and (util is None
                                            or util < self.down_util)
-        self._over_streak = self._over_streak + 1 if over else 0
-        self._under_streak = self._under_streak + 1 if under else 0
+        # streaks + readiness decided under the lock (the HTTP
+        # snapshot() and a test-driven sample_once may interleave with
+        # the loop thread); the spawner/retirer — subprocess + registry
+        # I/O — runs OUTSIDE it
+        with self._lock:
+            self._over_streak = self._over_streak + 1 if over else 0
+            self._under_streak = self._under_streak + 1 if under else 0
+            over_ready = over and self._over_streak >= self.window
+            under_ready = under and self._under_streak >= self.window
         if self._in_cooldown(now):
             return {**signal, "action": action, "cooldown": True}
         live = signal["live_workers"]
-        if over and self._over_streak >= self.window \
-                and live < self.max_workers \
+        if over_ready and live < self.max_workers \
                 and self.spawner is not None:
             wid = None
             try:
@@ -259,31 +280,30 @@ class FleetAutoscaler:
             if wid:
                 with self._lock:
                     self._spawned.append(str(wid))
+                    self.scale_ups += 1
+                    self._over_streak = 0
                 reason = (f"queue/participant {qpp:.2f} > "
                           f"{self.up_queue:g}" if qpp > self.up_queue
                           else f"utilization {util:.2f} > "
                                f"{self.up_util:g}")
-                self.scale_ups += 1
                 self._record("up", reason, now, signal, wid)
                 action = "up"
-                self._over_streak = 0
-        elif under and self._under_streak >= self.window \
-                and live > self.min_workers \
+        elif under_ready and live > self.min_workers \
                 and self.retirer is not None:
             wid = self._pick_retirement_victim()
             if wid is not None:
-                self.scale_downs += 1
                 if self.registry is not None:
                     self.registry.set_retiring(wid, True)
                 with self._lock:
+                    self.scale_downs += 1
                     self._retiring[wid] = now + self.drain_s
+                    self._under_streak = 0
                 self._record(
                     "down",
                     f"queue/participant {qpp:.2f} < "
                     f"{self.down_queue:g} (drain via lease non-renewal)",
                     now, signal, wid)
                 action = "down"
-                self._under_streak = 0
         return {**signal, "action": action, "cooldown": False}
 
     def _pick_retirement_victim(self) -> Optional[str]:

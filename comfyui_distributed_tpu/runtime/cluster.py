@@ -143,8 +143,12 @@ class ClusterRegistry:
         self.lease_s = max(float(lease_s), 0.05)
         self.suspect_probes = max(int(suspect_probes), 1)
         self._lock = threading.Lock()
-        self._workers: Dict[str, Dict[str, Any]] = {}
-        self._transitions: deque = deque(maxlen=C.CLUSTER_TRANSITIONS_KEPT)
+        # fed concurrently by the health poller, heartbeat handlers,
+        # data-plane touches and the autoscaler's retire/forget path —
+        # the lockset rule holds every access to the annotation
+        self._workers: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
+        self._transitions: deque = deque(
+            maxlen=C.CLUSTER_TRANSITIONS_KEPT)         # guarded-by: self._lock
 
     # -- writes ---------------------------------------------------------------
 
@@ -374,32 +378,36 @@ class WorkLedger:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._jobs: Dict[str, Dict[str, Any]] = {}
-        self._redispatch: Dict[str, Callable] = {}
-        self._completed: deque = deque(maxlen=C.LEDGER_COMPLETED_KEPT)
+        self._jobs: Dict[str, Dict[str, Any]] = {}      # guarded-by: self._lock
+        self._redispatch: Dict[str, Callable] = {}      # guarded-by: self._lock
+        self._completed: deque = deque(
+            maxlen=C.LEDGER_COMPLETED_KEPT)             # guarded-by: self._lock
         # deadline-aware hedging (ISSUE 9): per-job SLO deadlines on the
         # monotonic clock, stamped by the orchestrator BEFORE create_job
         # (the request knows its budget; the op only knows its units).
         # Bounded FIFO like the redispatcher map — a request whose job
         # never materializes must not leak its deadline forever.
-        self._deadlines: Dict[str, float] = {}
+        self._deadlines: Dict[str, float] = {}          # guarded-by: self._lock
         # durability plane (ISSUE 7): when a WAL is attached, every
         # ownership transition appends a record, winning check-ins spill
         # their payload first, and create_job merges the crash-recovered
         # unit states so a resumed job re-refines ONLY unfinished units
         self._wal = None
         self._unit_store = None
-        self._recovered_jobs: Dict[str, Dict[str, Any]] = {}
+        self._recovered_jobs: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
 
     def attach_wal(self, wal, unit_store,
                    recovered_jobs: Optional[Dict[str, Any]] = None) -> None:
         """Wire the durability plane in (runtime/durable.py).
         ``recovered_jobs`` is the replayed WAL state keyed by job id —
         consumed (and cleared per job) by :meth:`create_job`."""
-        self._wal = wal
-        self._unit_store = unit_store
-        if recovered_jobs is not None:
-            self._recovered_jobs = dict(recovered_jobs)
+        # under the lock: a standby takeover attaches on its watcher
+        # thread while collector drains may be reading recovered state
+        with self._lock:
+            self._wal = wal
+            self._unit_store = unit_store
+            if recovered_jobs is not None:
+                self._recovered_jobs = dict(recovered_jobs)
 
     def _wal_append(self, rtype: str, **fields) -> None:
         """Append an ownership-transition record; fencing errors
@@ -420,11 +428,14 @@ class WorkLedger:
     def create_job(self, job_id: str, owners: Dict[Any, str],
                    kind: str = "tile") -> None:
         jid = str(job_id)
-        recovered = self._recovered_jobs.pop(jid, None)
-        rec_units = (recovered or {}).get("units", {})
         now = time.monotonic()
         preloaded = []
         with self._lock:
+            # consume the recovered state under the lock (it used to be
+            # popped outside — racing a concurrent takeover's attach_wal
+            # could drop or double-apply a recovered job)
+            recovered = self._recovered_jobs.pop(jid, None)
+            rec_units = (recovered or {}).get("units", {})
             units = {}
             for u, o in owners.items():
                 ru = rec_units.get(str(u))

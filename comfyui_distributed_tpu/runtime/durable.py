@@ -533,29 +533,34 @@ class WriteAheadLog:
         self.segment_bytes = _segment_bytes() if segment_bytes is None \
             else int(segment_bytes)
         self._lock = threading.Lock()
-        self._f: Optional[Any] = None
+        # append/rotate/fsync state: one writer at a time, and stats()
+        # scrapes from the HTTP handlers — everything below holds the
+        # lock (the lockset rule enforces it)
+        self._f: Optional[Any] = None       # guarded-by: self._lock
         self._seq = max([s for e, s, _ in list_segments(dirpath)],
-                        default=0) + 1
-        self._size = 0
-        self._unsynced = 0
-        self._last_sync = time.monotonic()
-        self._last_fence_check = 0.0
+                        default=0) + 1      # guarded-by: self._lock
+        self._size = 0                      # guarded-by: self._lock
+        self._unsynced = 0                  # guarded-by: self._lock
+        self._last_sync = time.monotonic()  # guarded-by: self._lock
+        self._last_fence_check = 0.0        # guarded-by: self._lock
         self.fenced = False
-        self.crashed = False
-        self.records_appended = 0
-        self.fsyncs = 0
+        self.crashed = False                # guarded-by: self._lock
+        self.records_appended = 0           # guarded-by: self._lock
+        self.fsyncs = 0                     # guarded-by: self._lock
         # test/bench crash hook: {"type": rtype-or-None, "point":
         # pre_append|torn|post_sync, "after": n matching appends}
-        self._crash: Optional[Dict[str, Any]] = None
+        self._crash: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
         os.makedirs(dirpath, exist_ok=True)
         self._open_segment()
 
     # -- segment plumbing -----------------------------------------------------
 
+    # dtpu-lint: holds[self._lock]  (only _open_segment calls it)
     def _segment_path(self) -> str:
         return os.path.join(self.dir,
                             f"wal-{self.epoch:06d}-{self._seq:06d}.log")
 
+    # dtpu-lint: holds[self._lock]  (__init__ calls it pre-publication)
     def _open_segment(self) -> None:
         if self._f is not None:
             self._f.close()
@@ -625,12 +630,15 @@ class WriteAheadLog:
         written; "torn" — half a record written, no fsync; "post_sync" —
         record durable, ack never delivered) on the ``after``-th append
         matching ``rtype`` (None = any)."""
-        self._crash = {"point": point, "type": rtype, "after": int(after)}
+        with self._lock:
+            self._crash = {"point": point, "type": rtype,
+                           "after": int(after)}
 
     def simulate_crash(self) -> None:
         """Make this WAL behave like its process died: every further
         append (and sync) raises.  Nothing else is written."""
-        self.crashed = True
+        with self._lock:
+            self.crashed = True
 
     # -- the append path ------------------------------------------------------
 

@@ -715,7 +715,9 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, state.drain)
         if state.durable is not None:
-            state.durable.close()
+            # the close fsyncs the WAL tail — off the loop like every
+            # other durability edge
+            await loop.run_in_executor(None, state.durable.close)
         await net_mod.cleanup_client_session()
 
     app.on_startup.append(on_startup)
@@ -1134,23 +1136,33 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         return web.json_response(trace_mod.trace_status())
 
     async def clear_memory(request):
-        import gc
+        # the whole probe/clear/GC pass runs off the event loop: the
+        # device probes can initialize a backend, jax.clear_caches walks
+        # every live executable and three full GC passes over a loaded
+        # model take seconds — a scrape or heartbeat must not queue
+        # behind any of it (dtpu-lint: async-blocking)
+        def clear():
+            import gc
 
-        import jax
+            import jax
 
-        from comfyui_distributed_tpu.models import registry
-        # before/after memory_stats() snapshots: the response reports
-        # what the clear ACTUALLY freed, not just that it ran (satellite:
-        # on a fleet, "clear didn't free anything" is the signal that a
-        # worker is holding leaked buffers)
-        before = resource_mod.device_memory_snapshot()
-        rss_before = resource_mod.host_rss_bytes()
-        registry.clear_pipeline_cache()
-        jax.clear_caches()
-        for _ in range(3):
-            gc.collect()
-        after = resource_mod.device_memory_snapshot()
-        rss_after = resource_mod.host_rss_bytes()
+            from comfyui_distributed_tpu.models import registry
+            # before/after memory_stats() snapshots: the response
+            # reports what the clear ACTUALLY freed, not just that it
+            # ran (satellite: on a fleet, "clear didn't free anything"
+            # is the signal that a worker is holding leaked buffers)
+            before = resource_mod.device_memory_snapshot()
+            rss_before = resource_mod.host_rss_bytes()
+            registry.clear_pipeline_cache()
+            jax.clear_caches()
+            for _ in range(3):
+                gc.collect()
+            after = resource_mod.device_memory_snapshot()
+            rss_after = resource_mod.host_rss_bytes()
+            return before, rss_before, after, rss_after
+
+        before, rss_before, after, rss_after = await asyncio \
+            .get_running_loop().run_in_executor(None, clear)
         freed = max(before["bytes_in_use"] - after["bytes_in_use"], 0)
         log(f"cleared model/jit caches (freed {freed / 1e6:.1f} MB "
             f"device, source={after['source']})")
@@ -1165,23 +1177,33 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
 
     async def launch_worker(request):
         data = await request.json()
-        cfg = cfg_mod.load_config(state.config_path)
+        # config read + subprocess spawn off the loop (dtpu-lint:
+        # async-blocking): launch_worker waits on the child and rewrites
+        # managed-process state under the manager's file lock
+        loop = asyncio.get_running_loop()
+        cfg = await loop.run_in_executor(
+            None, lambda: cfg_mod.load_config(state.config_path))
         worker = next((w for w in cfg["workers"]
                        if str(w.get("id")) == str(data.get("id"))), None)
         if worker is None:
             return web.json_response({"error": "worker not found"},
                                      status=404)
         try:
-            entry = state.manager.launch_worker(
-                worker, stop_on_master_exit=cfg["settings"].get(
-                    "stop_workers_on_master_exit", True))
+            entry = await loop.run_in_executor(
+                None, lambda: state.manager.launch_worker(
+                    worker, stop_on_master_exit=cfg["settings"].get(
+                        "stop_workers_on_master_exit", True)))
         except RuntimeError as e:
             return web.json_response({"error": str(e)}, status=409)
         return ok({"worker": entry})
 
     async def stop_worker(request):
         data = await request.json()
-        if not state.manager.stop_worker(str(data.get("id"))):
+        # terminate + bounded wait (up to PROCESS_TERMINATION_TIMEOUT)
+        # off the loop (dtpu-lint: async-blocking)
+        stopped = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: state.manager.stop_worker(str(data.get("id"))))
+        if not stopped:
             return web.json_response({"error": "not managed"}, status=404)
         return ok()
 
@@ -1515,8 +1537,11 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     async def worker_log(request):
         wid = request.query.get("id", "")
         try:
-            text = state.manager.tail_log(wid, max_bytes=int(
-                request.query.get("bytes", LOG_TAIL_BYTES)))
+            # log-file seek+read off the loop (dtpu-lint: async-blocking)
+            max_bytes = int(request.query.get("bytes", LOG_TAIL_BYTES))
+            text = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: state.manager.tail_log(
+                    wid, max_bytes=max_bytes))
         except FileNotFoundError as e:
             return web.json_response({"error": str(e)}, status=404)
         return web.json_response({"log": text})
@@ -1914,10 +1939,16 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         img = form.get("image")
         if img is None:
             return web.json_response({"error": "missing image"}, status=400)
-        os.makedirs(state.input_dir, exist_ok=True)
         name = os.path.basename(img.filename or "upload.png")
-        with open(os.path.join(state.input_dir, name), "wb") as f:
-            f.write(img.file.read())
+
+        def write():
+            # mkdir + disk write off the loop (dtpu-lint: async-blocking):
+            # a slow disk must not stall concurrent data-plane POSTs
+            os.makedirs(state.input_dir, exist_ok=True)
+            with open(os.path.join(state.input_dir, name), "wb") as f:
+                f.write(img.file.read())
+
+        await asyncio.get_running_loop().run_in_executor(None, write)
         return web.json_response({"name": name, "subfolder": "",
                                   "type": "input"})
 

@@ -308,6 +308,57 @@ CHAOS_DEFAULT_ROUTES = ("/prompt", "/distributed/tile_complete",
                         "/distributed/heartbeat")
 CHAOS_DELAY_DEFAULT_S = 0.25
 
+# --- env-var registry (dtpu-lint env-undeclared / env-readme-drift) ----------
+# Every DTPU_* environment variable the package reads must be declared
+# here as a string literal AND carry a row in the README env table —
+# the static-analysis gate (comfyui_distributed_tpu/analysis) enforces
+# both directions, so neither side can drift.  The entries below are
+# read at their point of use (models/, parallel/, cli) rather than
+# through this module; declaring them here is the registry, not a
+# refactor.
+
+# multi-host bring-up (parallel/mesh.initialize_multihost)
+COORDINATOR_ENV = "DTPU_COORDINATOR"        # host:port -> jax.distributed
+NUM_PROCESSES_ENV = "DTPU_NUM_PROCESSES"    # pod process count
+PROCESS_ID_ENV = "DTPU_PROCESS_ID"          # this host's process index
+# wedge-resistant backend startup (parallel/mesh escape ladder)
+CLAIM_WINDOW_ENV = "DTPU_CLAIM_WINDOW_S"    # stale-claim takeover window
+SKIP_BACKEND_PROBE_ENV = "DTPU_SKIP_BACKEND_PROBE"  # skip subprocess probe
+INIT_PATIENCE_ENV = "DTPU_INIT_PATIENCE_S"  # total backend-init budget
+INIT_PROBE_TIMEOUT_ENV = "DTPU_INIT_PROBE_TIMEOUT_S"  # per-probe bound
+CPU_FALLBACK_DEVICES_ENV = "DTPU_CPU_FALLBACK_DEVICES"  # virtual dev count
+# model plane (models/)
+DEFAULT_FAMILY_ENV = "DTPU_DEFAULT_FAMILY"  # family override (tests: tiny)
+BF16_WEIGHTS_ENV = "DTPU_BF16_WEIGHTS"      # bf16 weight storage toggle
+JIT_CACHE_CAP_ENV = "DTPU_JIT_CACHE_CAP"    # per-pipeline jit cache bound
+LORA_CACHE_CAP_ENV = "DTPU_LORA_CACHE_CAP"  # parsed-LoRA cache bound
+TP_MIN_SHARD_ELEMENTS_ENV = "DTPU_TP_MIN_SHARD_ELEMENTS"  # TP leaf floor
+ATTN_SCORES_BYTES_ENV = "DTPU_ATTN_SCORES_BYTES"  # attn chunking ceiling
+RING_MIN_TOKENS_ENV = "DTPU_RING_MIN_TOKENS"  # ring-attention seq floor
+# runtime/serving odds and ends
+INTERRUPT_POLL_ENV = "DTPU_INTERRUPT_POLL"  # force per-step poll on/off
+WARMUP_ENV = "DTPU_WARMUP"                  # serve-startup warmup JSON
+MODELS_DIR_ENV = "DTPU_MODELS"              # cli --models-dir default
+MASTER_PID_ENV_NAME = "DTPU_MASTER_PID"     # spawned-worker master watch
+
+# --- span-attribute whitelist (dtpu-lint span-attr) ---------------------------
+# The vocabulary contract between span producers and the trace readers
+# (`cli trace`, the flight-recorder consumers): every literal attr key
+# stamped on a span anywhere in the package must be listed here, so a
+# new attr is a conscious API addition, not drive-by drift.
+TRACE_ATTR_WHITELIST = frozenset({
+    # job identity / topology
+    "prompt_id", "client_id", "tenant", "role", "fanout", "job",
+    "worker", "node", "target",
+    # coalescing
+    "coalesced", "coalesced_into",
+    # recovery / hedging
+    "lost", "to", "units", "tile_idx", "n_workers",
+    # resource attribution (ISSUE 5)
+    "device_peak_mb", "rss_mb", "mem_peak_mb", "mem_peak_delta_mb",
+    "mem_source",
+})
+
 # --- persistent compilation cache -------------------------------------------
 # Directory for JAX's persistent (on-disk) XLA compilation cache.  Resolution
 # (runtime/manager.enable_persistent_compile_cache): explicit arg > this env

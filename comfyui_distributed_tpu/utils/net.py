@@ -199,7 +199,7 @@ class HostIOPool:
             max_workers=max(1, max_workers),
             thread_name_prefix="dtpu-hostio")
         self._slots = threading.BoundedSemaphore(max(1, max_pending))
-        self._pending = 0
+        self._pending = 0  # guarded-by: self._idle
         self._idle = threading.Condition(threading.Lock())
 
     @property

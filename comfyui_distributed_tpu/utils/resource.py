@@ -152,9 +152,9 @@ class RingTimeseries:
     def __init__(self, name: str, maxlen: int):
         self.name = str(name)
         self.maxlen = max(int(maxlen), 1)
-        self._ring: deque = deque(maxlen=self.maxlen)
+        self._ring: deque = deque(maxlen=self.maxlen)  # guarded-by: self._lock
         self._lock = threading.Lock()
-        self.total_samples = 0
+        self.total_samples = 0                         # guarded-by: self._lock
 
     def append(self, t: float, value: float) -> None:
         with self._lock:
@@ -215,12 +215,15 @@ class ResourceMonitor:
         self.queue_depth_fn = queue_depth_fn
         self.series: Dict[str, RingTimeseries] = {
             name: RingTimeseries(name, self.ring_max) for name in SERIES}
-        self._latest: Optional[Dict[str, Any]] = None
-        self._util_mark: Optional[Tuple[float, float]] = None
+        # sample_once runs on BOTH the monitor thread and on-demand
+        # callers (latest() from the heartbeat thread before the first
+        # interval) — the sample state below is lock-guarded
+        self._latest: Optional[Dict[str, Any]] = None  # guarded-by: self._lock
+        self._util_mark: Optional[Tuple[float, float]] = None  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.n_samples = 0
+        self.n_samples = 0                             # guarded-by: self._lock
 
     # -- sampling -------------------------------------------------------------
 
@@ -233,7 +236,12 @@ class ResourceMonitor:
         total = 0.0
         if hist is not None:
             _, total, _ = hist.prom_series()
-        mark, self._util_mark = self._util_mark, (now, total)
+        # swap under the lock: two concurrent sample_once calls (monitor
+        # thread + a heartbeat's on-demand latest()) racing the unguarded
+        # swap could both anchor on the same mark and double-count the
+        # compute delta
+        with self._lock:
+            mark, self._util_mark = self._util_mark, (now, total)
         if mark is None:
             return None
         dt = now - mark[0]

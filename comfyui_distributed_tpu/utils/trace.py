@@ -61,11 +61,11 @@ class LatencyHistogram:
 
     def __init__(self, bounds: Tuple[float, ...] = C.HISTOGRAM_BUCKETS_S):
         self.bounds = tuple(bounds)
-        self.counts = [0] * len(self.bounds)
-        self.overflow = 0
-        self.count = 0
-        self.sum_s = 0.0
-        self.max_s = 0.0
+        self.counts = [0] * len(self.bounds)  # guarded-by: self._lock
+        self.overflow = 0                     # guarded-by: self._lock
+        self.count = 0                        # guarded-by: self._lock
+        self.sum_s = 0.0                      # guarded-by: self._lock
+        self.max_s = 0.0                      # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def record(self, seconds: float) -> None:
@@ -97,6 +97,7 @@ class LatencyHistogram:
             out.append((float("inf"), cum + self.overflow))
             return out, self.sum_s, self.count
 
+    # dtpu-lint: holds[self._lock]
     def _percentile(self, q: float) -> float:
         """Caller holds the lock.  Linear interpolation inside the bucket
         holding the target rank; the overflow bucket interpolates toward
@@ -143,7 +144,7 @@ class PhaseStats:
     are preserved — existing readers (bench, tests) keep working."""
 
     def __init__(self) -> None:
-        self._stats: Dict[str, LatencyHistogram] = {}
+        self._stats: Dict[str, LatencyHistogram] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def _hist(self, phase: str) -> LatencyHistogram:
@@ -226,7 +227,7 @@ class CounterStats:
     """Named monotonic counters (thread-safe) — scheduler/wire events."""
 
     def __init__(self) -> None:
-        self._counts: Dict[str, int] = {}
+        self._counts: Dict[str, int] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def bump(self, name: str, n: int = 1) -> None:
@@ -323,7 +324,7 @@ class TransferStats:
     (host put)."""
 
     def __init__(self) -> None:
-        self._stats: Dict[str, Dict[str, float]] = {}
+        self._stats: Dict[str, Dict[str, float]] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def record(self, direction: str, nbytes: int,
@@ -441,8 +442,8 @@ class RetraceStats:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self.traces = 0
-        self.compiles = 0
+        self.traces = 0    # guarded-by: self._lock
+        self.compiles = 0  # guarded-by: self._lock
 
     def bump(self, what: str) -> None:
         with self._lock:
@@ -775,13 +776,16 @@ class FlightRecorder:
                                       C.TRACE_RING_DEFAULT)))
         self.max_spans = max_spans
         # trace_id -> {span_id: span dict} for in-flight traces
-        self._active: "OrderedDict[str, Dict[str, Dict]]" = OrderedDict()
+        self._active: "OrderedDict[str, Dict[str, Dict]]" = \
+            OrderedDict()                       # guarded-by: self._lock
         # trace_id -> [open Span] (exported provisionally mid-flight)
-        self._open: Dict[str, List[Span]] = {}
+        self._open: Dict[str, List[Span]] = {}  # guarded-by: self._lock
         # prompt_id -> committed record (the ring)
-        self._jobs: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
-        self._by_trace: Dict[str, str] = {}  # committed trace -> prompt
-        self.dropped_spans = 0
+        self._jobs: "OrderedDict[str, Dict[str, Any]]" = \
+            OrderedDict()                       # guarded-by: self._lock
+        # committed trace -> prompt
+        self._by_trace: Dict[str, str] = {}     # guarded-by: self._lock
+        self.dropped_spans = 0                  # guarded-by: self._lock
 
     # -- span sinks ---------------------------------------------------------
 

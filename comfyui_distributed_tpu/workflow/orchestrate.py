@@ -245,7 +245,11 @@ def _register_redispatchers(graph: Graph, job_id_map: Dict[str, str],
                     moved = [u for u in batch
                              if not ledger.is_hedged(mj, u)]
                     if moved:
-                        ledger.reassign(mj, moved, tid)
+                        # off the loop: a WAL-backed reassign appends +
+                        # fsyncs the ownership record
+                        await asyncio.get_running_loop() \
+                            .run_in_executor(None, lambda: ledger
+                                             .reassign(mj, moved, tid))
 
                 if kind == "tile":
                     wgraph = dsp.prepare_for_participant(
@@ -365,7 +369,10 @@ async def run_distributed(graph_or_doc: Any,
     graph = graph_or_doc if isinstance(graph_or_doc, Graph) \
         else parse_workflow(graph_or_doc)
     if workers is None:
-        cfg = cfg_mod.load_config(config_path)
+        # config file read off the loop (the server passes workers in;
+        # this path serves embedded callers)
+        cfg = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: cfg_mod.load_config(config_path))
         workers = cfg_mod.enabled_workers(cfg)
 
     if master_dispatch is None:

@@ -221,14 +221,16 @@ class AdmissionController:
         # dispatched class is the nonempty one with the smallest pass,
         # which then advances by 1/weight — heavier classes advance
         # slower, so they win more turns
-        self._pass: Dict[str, float] = {cls: 0.0 for cls in self.classes}
-        self._active_prev: set = set()
+        self._pass: Dict[str, float] = {
+            cls: 0.0 for cls in self.classes}    # guarded-by: self._lock
+        self._active_prev: set = set()           # guarded-by: self._lock
         # per-(class, client) token buckets, LRU-bounded
-        self._buckets: "OrderedDict[str, TokenBucket]" = OrderedDict()
+        self._buckets: "OrderedDict[str, TokenBucket]" = \
+            OrderedDict()                        # guarded-by: self._lock
         self.counters: Dict[str, Dict[str, int]] = {
             cls: {"admitted": 0, "shed_rate": 0, "shed_overload": 0,
                   "completed": 0}
-            for cls in self.classes}
+            for cls in self.classes}             # guarded-by: self._lock
 
     # -- classification -------------------------------------------------------
 

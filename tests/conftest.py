@@ -78,6 +78,9 @@ _MODULE_COST_S = {
     # master+standby+worker exec loops over a shared WAL)
     "test_durable.py": 12,
     "test_resource.py": 12,
+    # pure-AST static analysis (dtpu-lint): parses the package ~10x
+    # (fixtures + live-tree gate + seeded mutations), no device work
+    "test_analysis.py": 13,
     "test_tiling.py": 10,
 }
 

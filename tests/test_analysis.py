@@ -1,0 +1,752 @@
+"""dtpu-lint (ISSUE 10): rule fixtures, suppression/baseline semantics,
+the tier-1 gate on the live tree, seeded-mutation detection, and
+regression tests for the real violations the analyzer surfaced (and PR
+10 fixed rather than baselined).
+
+The gate contract: ``run_lint()`` on the shipped tree reports ZERO
+non-baselined violations, and each seeded mutation — an un-offloaded
+fsync in an async route, a guarded field written without its lock, an
+``np.asarray`` in the denoise spine, an undeclared ``DTPU_*`` read —
+is caught as a NEW violation against the SHIPPED baseline.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from comfyui_distributed_tpu.analysis import engine
+
+ROOT = engine.repo_root()
+PKG = "comfyui_distributed_tpu"
+
+
+def lint_sources(files, rules=None):
+    """Lint an in-memory mini-project (no disk, no baseline)."""
+    project = engine.Project(
+        ROOT,
+        {rel: engine._parse_file(rel, src)
+         for rel, src in files.items() if rel != "README.md"},
+        readme=(engine._parse_file("README.md", files["README.md"])
+                if "README.md" in files else None))
+    return engine.lint_project(project, rules=rules)
+
+
+def rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+# --- rule fixtures: async-blocking -------------------------------------------
+
+ASYNC_POS = f"""
+import os, time, asyncio
+
+async def handler(request):
+    os.fsync(3)
+    time.sleep(1)
+    state.manager.launch_worker(w)
+    return 1
+"""
+
+ASYNC_NEG = """
+import os, time, asyncio
+
+async def handler(request):
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, lambda: os.fsync(3))
+    await asyncio.sleep(1)
+
+    def thunk():
+        time.sleep(1)          # runs on the executor, not the loop
+    await loop.run_in_executor(None, thunk)
+
+def sync_helper():
+    os.fsync(3)                # sync code may block freely
+"""
+
+
+class TestAsyncBlockingRule:
+    def test_positive(self):
+        vs = lint_sources({f"{PKG}/server/app.py": ASYNC_POS},
+                          rules=["async-blocking"])
+        msgs = [v.message for v in vs]
+        assert len(vs) == 3
+        assert any("os.fsync" in m for m in msgs)
+        assert any("time.sleep" in m for m in msgs)
+        assert any("launch_worker" in m for m in msgs)
+
+    def test_negative_offloaded_and_sync(self):
+        vs = lint_sources({f"{PKG}/server/app.py": ASYNC_NEG},
+                          rules=["async-blocking"])
+        assert vs == []
+
+    def test_suppression_with_reason(self):
+        src = ASYNC_POS.replace(
+            "os.fsync(3)",
+            "os.fsync(3)  # dtpu-lint: ignore[async-blocking] test-only")
+        vs = lint_sources({f"{PKG}/server/app.py": src},
+                          rules=["async-blocking"])
+        assert len(vs) == 2  # fsync suppressed, the other two stay
+
+    def test_reasonless_suppression_does_not_suppress(self):
+        src = ASYNC_POS.replace(
+            "os.fsync(3)",
+            "os.fsync(3)  # dtpu-lint: ignore[async-blocking]")
+        vs = lint_sources({f"{PKG}/server/app.py": src},
+                          rules=["async-blocking"])
+        assert len(vs) == 3
+        # and the inert marker is diagnosed, not silently ignored
+        noted = [v for v in vs if "suppresses nothing" in v.message]
+        assert len(noted) == 1 and "os.fsync" in noted[0].message
+
+    def test_unknown_rule_id_raises(self):
+        with pytest.raises(ValueError, match="unknown rule id"):
+            lint_sources({f"{PKG}/server/app.py": ASYNC_POS},
+                         rules=["async_blocking"])  # typo: underscore
+
+
+# --- rule fixtures: lockset --------------------------------------------------
+
+LOCKSET_SRC = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0          # guarded-by: self._lock
+        self.unguarded = 0  # no annotation: never checked
+
+    def good(self):
+        with self._lock:
+            self.n += 1
+
+    def bad(self):
+        self.n += 1
+
+    def closure_bad(self):
+        def run():
+            self.n += 1     # thread target: lock NOT held
+        return run
+
+    def lambda_inline_ok(self):
+        with self._lock:
+            return max([1], key=lambda _: self.n)
+
+    def _bump_locked(self):
+        self.n += 1         # *_locked contract: caller holds it
+
+    # dtpu-lint: holds[self._lock]
+    def bump_held(self):
+        self.n += 1
+
+    def free(self):
+        self.unguarded += 1
+"""
+
+
+class TestLocksetRule:
+    def test_fixture(self):
+        vs = lint_sources({f"{PKG}/runtime/fixture.py": LOCKSET_SRC},
+                          rules=["lockset"])
+        assert [(v.scope, "self.n" in v.message) for v in vs] == [
+            ("Counter.bad", True), ("Counter.closure_bad.run", True)]
+
+    def test_init_exempt_and_with_scope_ends(self):
+        src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.x = 1  # guarded-by: self._lock
+        self.x = 2  # __init__ is pre-publication
+
+    def after_with(self):
+        with self._lock:
+            self.x = 3
+        self.x = 4  # lock released: flagged
+"""
+        vs = lint_sources({f"{PKG}/runtime/fixture.py": src},
+                          rules=["lockset"])
+        assert len(vs) == 1
+        assert "self.x = 4" in src.splitlines()[vs[0].line - 1]
+
+
+# --- rule fixtures: device spine ---------------------------------------------
+
+SPINE_SRC = """
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+def denoise_step(x, sigma):
+    y = jnp.asarray(x)            # device-side: fine
+    host = np.asarray(x)          # d2h: flagged
+    v = x.item()                  # sync: flagged
+    f = float(sigma)              # sync: flagged
+    g = float(0.5)                # literal: fine
+    d = jax.device_get(x)         # flagged
+    return y
+"""
+
+RETRACE_SRC = """
+import jax
+
+def step(x, n):
+    if x > 0:                     # traced branch: flagged
+        return x
+    if n is None:                 # trace-time check: fine
+        return x
+    while x.shape[0] > 1:         # shape probe: fine
+        break
+    return x
+
+jitted = jax.jit(step)
+"""
+
+
+class TestSpineRules:
+    def test_host_fetch_fixture(self):
+        vs = lint_sources({f"{PKG}/ops/fixture.py": SPINE_SRC},
+                          rules=["spine-host-fetch"])
+        assert len(vs) == 4
+
+    def test_outside_spine_not_flagged(self):
+        vs = lint_sources({f"{PKG}/server/fixture.py": SPINE_SRC},
+                          rules=["spine-host-fetch"])
+        assert vs == []
+
+    def test_retrace_fixture(self):
+        vs = lint_sources({f"{PKG}/models/fixture.py": RETRACE_SRC},
+                          rules=["retrace-hazard"])
+        assert len(vs) == 1 and "x" in vs[0].message
+
+
+# --- rule fixtures: registry drift -------------------------------------------
+
+CONSTANTS_FIXTURE = '''
+FOO_ENV = "DTPU_FOO"
+TRACE_ATTR_WHITELIST = frozenset({"job", "worker"})
+'''
+
+README_FIXTURE = """
+### env table
+| Variable | Default | Meaning |
+| `DTPU_FOO` | unset | test |
+"""
+
+
+class TestRegistryDriftRules:
+    def test_env_undeclared(self):
+        src = ('import os\n'
+               'a = os.environ.get("DTPU_FOO")\n'
+               'b = os.environ.get("DTPU_MYSTERY")\n')
+        vs = lint_sources({f"{PKG}/utils/constants.py": CONSTANTS_FIXTURE,
+                           f"{PKG}/runtime/x.py": src},
+                          rules=["env-undeclared"])
+        assert len(vs) == 1 and "DTPU_MYSTERY" in vs[0].message
+
+    def test_env_indirect_constant_resolved(self):
+        src = ('import os\n'
+               'K = "DTPU_INDIRECT"\n'
+               'v = os.environ.get(K)\n')
+        vs = lint_sources({f"{PKG}/utils/constants.py": CONSTANTS_FIXTURE,
+                           f"{PKG}/runtime/x.py": src},
+                          rules=["env-undeclared"])
+        assert len(vs) == 1 and "DTPU_INDIRECT" in vs[0].message
+
+    def test_readme_drift_both_directions(self):
+        consts = CONSTANTS_FIXTURE + 'BAR_ENV = "DTPU_BAR"\n'
+        readme = README_FIXTURE + "| `DTPU_GHOST` | unset | gone |\n"
+        vs = lint_sources({f"{PKG}/utils/constants.py": consts,
+                           "README.md": readme},
+                          rules=["env-readme-drift"])
+        msgs = " ".join(v.message for v in vs)
+        assert len(vs) == 2
+        assert "DTPU_BAR" in msgs and "DTPU_GHOST" in msgs
+
+    def test_metric_name_conventions(self):
+        src = ('fams = [\n'
+               '  ("dtpu_good_total", "counter", "ok.", []),\n'
+               '  ("dtpu_bad_count", "counter", "no suffix.", []),\n'
+               '  ("plain_gauge", "gauge", "no prefix.", []),\n'
+               ']\n')
+        vs = lint_sources({f"{PKG}/server/x.py": src},
+                          rules=["metric-name"])
+        assert len(vs) == 2
+
+    def test_span_attr_whitelist(self):
+        src = ('from x import trace_mod\n'
+               'def f(sp):\n'
+               '    sp.attrs["job"] = 1\n'
+               '    sp.attrs["rogue_attr"] = 2\n'
+               '    with trace_mod.span("collect", worker="w"):\n'
+               '        pass\n'
+               '    with trace_mod.span("collect", rogue_kw=1):\n'
+               '        pass\n')
+        vs = lint_sources({f"{PKG}/utils/constants.py": CONSTANTS_FIXTURE,
+                           f"{PKG}/ops/x.py": src},
+                          rules=["span-attr"])
+        assert sorted(v.message.split("'")[1] for v in vs) == [
+            "rogue_attr", "rogue_kw"]
+
+
+# --- baseline-delta semantics ------------------------------------------------
+
+class TestBaselineSemantics:
+    def _report(self, n_fsync, baseline):
+        body = "\n".join(["    os.fsync(3)"] * n_fsync) or "    pass"
+        src = f"import os\n\nasync def h(request):\n{body}\n"
+        project = engine.Project(
+            ROOT, {f"{PKG}/server/app.py":
+                   engine._parse_file(f"{PKG}/server/app.py", src)})
+        vs = engine.lint_project(project, rules=["async-blocking"])
+        return engine._split_new(vs, baseline), vs
+
+    def test_baselined_violation_not_new(self):
+        new, vs = self._report(1, {})
+        assert len(new) == 1
+        key = vs[0].key
+        new2, _ = self._report(1, {key: 1})
+        assert new2 == []
+
+    def test_count_increase_is_new(self):
+        _, vs = self._report(1, {})
+        key = vs[0].key
+        new, _ = self._report(3, {key: 1})
+        # two instances beyond the single grandfathered one
+        assert len(new) == 2
+
+    def test_keys_survive_line_moves(self):
+        _, vs = self._report(1, {})
+        src = ("import os\n\n# a new comment shifting lines\n\n"
+               "async def h(request):\n    os.fsync(3)\n")
+        project = engine.Project(
+            ROOT, {f"{PKG}/server/app.py":
+                   engine._parse_file(f"{PKG}/server/app.py", src)})
+        vs2 = engine.lint_project(project, rules=["async-blocking"])
+        assert vs2[0].key == vs[0].key
+
+
+# --- THE tier-1 gate ---------------------------------------------------------
+
+class TestLiveTreeGate:
+    def test_shipped_tree_is_clean(self):
+        report = engine.run_lint(root=ROOT)
+        assert report.new == [], "NEW dtpu-lint violations:\n" + "\n".join(
+            v.format() for v in report.new)
+
+    def test_baseline_exists_and_matches_schema(self):
+        with open(engine.baseline_path(ROOT)) as f:
+            data = json.load(f)
+        assert data["version"] == 1
+        assert all(isinstance(v, int) and v > 0
+                   for v in data["entries"].values())
+
+    def _mutated(self, relpath, anchor, inject):
+        full = os.path.join(ROOT, *relpath.split("/"))
+        with open(full, "r", encoding="utf-8") as f:
+            src = f.read()
+        assert anchor in src, f"mutation anchor missing in {relpath}"
+        return src.replace(anchor, anchor + inject, 1)
+
+    def test_seeded_async_fsync_caught(self):
+        src = self._mutated(
+            f"{PKG}/server/app.py",
+            '    async def interrupt(request):\n',
+            '        os.fsync(0)\n')
+        rep = engine.run_lint(root=ROOT,
+                              overrides={f"{PKG}/server/app.py": src})
+        assert any(v.rule == "async-blocking" and "os.fsync"
+                   in v.message and v.path.endswith("app.py")
+                   for v in rep.new)
+
+    def test_seeded_unlocked_guarded_write_caught(self):
+        src = self._mutated(
+            f"{PKG}/runtime/autoscale.py",
+            '    def stop(self) -> None:\n',
+            '        self.flaps = 0\n')
+        rep = engine.run_lint(
+            root=ROOT,
+            overrides={f"{PKG}/runtime/autoscale.py": src})
+        assert any(v.rule == "lockset" and "self.flaps" in v.message
+                   for v in rep.new)
+
+    def test_seeded_spine_asarray_caught(self):
+        src = self._mutated(
+            f"{PKG}/models/denoiser.py",
+            '        xin = x * c_in\n',
+            '        xin = np.asarray(xin)\n')
+        rep = engine.run_lint(
+            root=ROOT,
+            overrides={f"{PKG}/models/denoiser.py": src})
+        assert any(v.rule == "spine-host-fetch"
+                   and "np.asarray" in v.message
+                   and v.path.endswith("denoiser.py") for v in rep.new)
+
+    def test_seeded_undeclared_env_caught(self):
+        src = self._mutated(
+            f"{PKG}/runtime/interrupt.py",
+            'import numpy as np\n',
+            'UNDECLARED = __import__("os").environ.get('
+            '"DTPU_TOTALLY_NEW")\n')
+        rep = engine.run_lint(
+            root=ROOT,
+            overrides={f"{PKG}/runtime/interrupt.py": src})
+        assert any(v.rule == "env-undeclared"
+                   and "DTPU_TOTALLY_NEW" in v.message for v in rep.new)
+
+
+# --- cli lint ----------------------------------------------------------------
+
+class TestCliLint:
+    def test_clean_tree_exits_zero(self, capsys):
+        from comfyui_distributed_tpu import cli
+        rc = cli.main(["lint"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "clean" in out
+
+    def test_new_violation_exits_nonzero_with_file_line(self, tmp_path,
+                                                        capsys):
+        pkg = tmp_path / PKG
+        (pkg / "server").mkdir(parents=True)
+        (pkg / "analysis").mkdir()
+        (pkg / "server" / "app.py").write_text(
+            "import os\n\nasync def h(request):\n    os.fsync(1)\n")
+        from comfyui_distributed_tpu import cli
+        rc = cli.main(["lint", "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert f"{PKG}/server/app.py:4" in out
+        assert "[async-blocking]" in out
+
+    def test_write_baseline_then_clean(self, tmp_path, capsys):
+        pkg = tmp_path / PKG
+        (pkg / "server").mkdir(parents=True)
+        (pkg / "analysis").mkdir()
+        (pkg / "server" / "app.py").write_text(
+            "import os\n\nasync def h(request):\n    os.fsync(1)\n")
+        from comfyui_distributed_tpu import cli
+        assert cli.main(["lint", "--root", str(tmp_path),
+                         "--write-baseline"]) == 0
+        capsys.readouterr()
+        assert cli.main(["lint", "--root", str(tmp_path)]) == 0
+
+    def test_unknown_rule_exits_2(self, capsys):
+        from comfyui_distributed_tpu import cli
+        assert cli.main(["lint", "--rule", "locksets"]) == 2
+        assert "unknown rule id" in capsys.readouterr().err
+
+    def test_partial_write_baseline_refused(self, capsys):
+        # --rule + --write-baseline would overwrite the audited
+        # baseline with one rule's findings, destroying the rest
+        from comfyui_distributed_tpu import cli
+        assert cli.main(["lint", "--rule", "lockset",
+                         "--write-baseline"]) == 2
+        assert "full run" in capsys.readouterr().err
+
+    def test_lint_never_imports_jax(self):
+        # the "runs on CPU, no device" satellite: lint must stay
+        # importable and runnable without initializing any backend
+        import subprocess
+        code = ("import sys\n"
+                "from comfyui_distributed_tpu.analysis import run_lint\n"
+                "rep = run_lint()\n"
+                "assert 'jax' not in sys.modules, 'lint imported jax'\n"
+                "sys.exit(0 if rep.ok else 1)\n")
+        proc = subprocess.run([sys.executable, "-c", code], cwd=ROOT,
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+
+
+# --- regression tests for the REAL violations PR 10 fixed --------------------
+
+def _run_route(route_handler, request):
+    """Drive one aiohttp-style handler on a fresh loop, recording which
+    thread executes the (monkeypatched) blocking call."""
+    return asyncio.new_event_loop().run_until_complete(
+        route_handler(request))
+
+
+class TestAsyncOffloadRegressions:
+    """Each previously-blocking route now runs its blocking core on an
+    executor thread, not the event-loop thread (the dtpu-lint
+    async-blocking findings fixed in PR 10)."""
+
+    @pytest.fixture()
+    def app_state(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DTPU_RESOURCE", "0")
+        from comfyui_distributed_tpu.server.app import ServerState
+        state = ServerState(start_exec_thread=False,
+                            input_dir=str(tmp_path / "in"),
+                            output_dir=str(tmp_path / "out"))
+        return state
+
+    def _handler(self, state, name):
+        from comfyui_distributed_tpu.server.app import build_app
+        app = build_app(state)
+        for route in app.router.routes():
+            if route.handler.__name__ == name:
+                return route.handler
+        raise AssertionError(f"route handler {name} not found")
+
+    @staticmethod
+    def _record_thread(record):
+        def recorder(*a, **kw):
+            record.append(threading.current_thread())
+            return recorder.result
+        recorder.result = None
+        return recorder
+
+    def _assert_off_loop(self, record):
+        assert record, "blocking call never ran"
+        assert all(t is not threading.current_thread() for t in record), \
+            "blocking call executed on the event-loop thread"
+
+    class _Req:
+        def __init__(self, payload=None, query=None):
+            self._payload = payload or {}
+            self.query = query or {}
+            self.remote = "127.0.0.1"
+
+        async def json(self):
+            return self._payload
+
+    def test_stop_worker_offloaded(self, app_state):
+        record = []
+        rec = self._record_thread(record)
+        rec.result = True
+        app_state.manager.stop_worker = rec
+        handler = self._handler(app_state, "stop_worker")
+
+        async def drive():
+            return await handler(self._Req({"id": "w0"}))
+
+        resp = asyncio.new_event_loop().run_until_complete(drive())
+        assert resp.status == 200
+        self._assert_off_loop(record)
+
+    def test_worker_log_offloaded(self, app_state):
+        record = []
+        rec = self._record_thread(record)
+        rec.result = "log text"
+        app_state.manager.tail_log = rec
+        handler = self._handler(app_state, "worker_log")
+
+        async def drive():
+            return await handler(self._Req(query={"id": "w0"}))
+
+        resp = asyncio.new_event_loop().run_until_complete(drive())
+        assert resp.status == 200
+        self._assert_off_loop(record)
+
+    def test_launch_worker_offloaded(self, app_state, monkeypatch):
+        from comfyui_distributed_tpu.utils import config as cfg_mod
+        record = []
+
+        def fake_load(path=None):
+            record.append(threading.current_thread())
+            return {"workers": [{"id": "w0", "port": 1}],
+                    "settings": {}}
+        monkeypatch.setattr(cfg_mod, "load_config", fake_load)
+        rec = self._record_thread(record)
+        rec.result = {"id": "w0"}
+        app_state.manager.launch_worker = rec
+        handler = self._handler(app_state, "launch_worker")
+
+        async def drive():
+            return await handler(self._Req({"id": "w0"}))
+
+        resp = asyncio.new_event_loop().run_until_complete(drive())
+        assert resp.status == 200
+        assert len(record) == 2  # config load AND spawn, both off-loop
+        self._assert_off_loop(record)
+
+    def test_clear_memory_offloaded(self, app_state, monkeypatch):
+        from comfyui_distributed_tpu.utils import resource as res_mod
+        record = []
+
+        def fake_snap():
+            record.append(threading.current_thread())
+            return {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+                    "bytes_limit": None, "n_devices": 0,
+                    "source": "host_rss"}
+        monkeypatch.setattr(res_mod, "device_memory_snapshot", fake_snap)
+        handler = self._handler(app_state, "clear_memory")
+
+        async def drive():
+            return await handler(self._Req())
+
+        resp = asyncio.new_event_loop().run_until_complete(drive())
+        assert resp.status == 200
+        self._assert_off_loop(record)
+
+    def test_upload_image_offloaded(self, app_state, tmp_path):
+        record = []
+        handler = self._handler(app_state, "upload_image")
+
+        class _File:
+            def read(self):
+                record.append(threading.current_thread())
+                return b"png-bytes"
+
+        class _Img:
+            filename = "x.png"
+            file = _File()
+
+        class _Req:
+            remote = "127.0.0.1"
+
+            async def post(self):
+                return {"image": _Img()}
+
+        resp = asyncio.new_event_loop().run_until_complete(
+            handler(_Req()))
+        assert resp.status == 200
+        self._assert_off_loop(record)
+        with open(os.path.join(app_state.input_dir, "x.png"),
+                  "rb") as f:
+            assert f.read() == b"png-bytes"
+
+
+class TestLocksetFixRegressions:
+    def test_autoscaler_decision_state_consistent_under_races(self):
+        """sample_once (reconciliation thread) vs snapshot (HTTP
+        handlers): hammering both concurrently must leave consistent
+        counters — the PR 10 lockset fix."""
+        from comfyui_distributed_tpu.runtime.autoscale import (
+            FleetAutoscaler)
+        scaler = FleetAutoscaler(
+            registry=None, queue_depth_fn=lambda: 100,
+            spawner=lambda: "w", retirer=lambda wid: True,
+            min_workers=0, max_workers=10**9, up_queue=1.0,
+            down_queue=0.5, window=1, cooldown_s=0.0, interval_s=0.02,
+            drain_s=0.0, flap_window_s=10.0)
+        errors = []
+
+        def sampler():
+            t = 0.0
+            try:
+                for _ in range(200):
+                    t += 1.0
+                    scaler.sample_once(now=t)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def snapshotter():
+            try:
+                for _ in range(400):
+                    snap = scaler.snapshot()
+                    assert snap["scale_ups"] >= 0
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=sampler),
+                   threading.Thread(target=snapshotter),
+                   threading.Thread(target=snapshotter)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        snap = scaler.snapshot()
+        # every spawned id is tracked exactly once per scale-up
+        assert snap["scale_ups"] == len(snap["spawned"]) \
+            + snap["scale_downs"]
+
+    def test_ledger_recovered_job_consumed_exactly_once(self):
+        """create_job pops the recovered record under the ledger lock
+        (it used to race attach_wal / concurrent creates)."""
+        from comfyui_distributed_tpu.runtime.cluster import WorkLedger
+        for _ in range(20):
+            ledger = WorkLedger()
+            ledger.attach_wal(None, None, {
+                "j": {"kind": "tile",
+                      "units": {"0": {"owner": "w1", "done": False}}}})
+            seen = []
+
+            def create():
+                ledger.create_job("j", {0: "master"}, kind="tile")
+                with ledger._lock:
+                    seen.append("j" in ledger._recovered_jobs)
+            t1 = threading.Thread(target=create)
+            t2 = threading.Thread(target=create)
+            t1.start(); t2.start(); t1.join(); t2.join()
+            # recovered state fully consumed, never resurrected
+            with ledger._lock:
+                assert "j" not in ledger._recovered_jobs
+
+    def test_monitor_concurrent_sample_once_utilization_sane(self):
+        """_util_mark swaps under the lock now: concurrent sample_once
+        callers (monitor thread + heartbeat latest()) keep utilization
+        in [0, 1] and never crash."""
+        from comfyui_distributed_tpu.utils.resource import (
+            ResourceMonitor)
+        mon = ResourceMonitor(interval=60, ring=32,
+                              queue_depth_fn=lambda: 0)
+        errors = []
+
+        def hammer():
+            try:
+                for _ in range(30):
+                    snap = mon.sample_once()
+                    u = snap["utilization"]
+                    assert u is None or 0.0 <= u <= 1.0
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert mon.n_samples == 120
+
+
+class TestOpsDrainOffloadRegression:
+    """The WAL-appending ledger transitions the async drains used to
+    call inline (reassign/mark_hedged) are now executor-offloaded —
+    verified by source shape since driving a full drain needs a
+    cluster.  The lint gate enforces it structurally; this pins the
+    exact sites."""
+
+    def _src(self, rel):
+        with open(os.path.join(ROOT, *rel.split("/"))) as f:
+            return f.read()
+
+    def test_no_inline_wal_calls_left_in_async_bodies(self):
+        report = engine.run_lint(root=ROOT, rules=["async-blocking"])
+        assert report.new == []
+        # and the shipped baseline grandfathers NO async-blocking
+        # finding — the satellite was "fix, don't baseline"
+        baseline = engine.load_baseline(ROOT)
+        assert not any(k.startswith("async-blocking|")
+                       for k in baseline)
+
+    def test_hedge_mark_offloaded_in_tile_drain(self):
+        src = self._src(f"{PKG}/ops/tiled_upscale.py")
+        assert "lambda: ledger.mark_hedged(" in src
+        src2 = self._src(f"{PKG}/ops/distributed.py")
+        assert "ledger.mark_hedged(" in src2
+        assert "run_in_executor(\n                                None, lambda u=unit: ledger.mark_hedged(" in src2 \
+            or "lambda u=unit: ledger.mark_hedged(" in src2
+
+
+class TestBaselineHygiene:
+    def test_no_lockset_or_drift_grandfathered(self):
+        """Only the audited spine host-edge class is baselined; the
+        bug-class rules (async-blocking, lockset, env drift) ship
+        clean."""
+        baseline = engine.load_baseline(ROOT)
+        assert baseline, "shipped baseline missing"
+        bad = [k for k in baseline
+               if k.split("|", 1)[0] in ("async-blocking", "lockset",
+                                         "env-undeclared",
+                                         "env-readme-drift",
+                                         "metric-name", "span-attr",
+                                         "parse-error")]
+        assert bad == []
