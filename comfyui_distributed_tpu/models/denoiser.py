@@ -140,7 +140,13 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
                  objs: Optional[jax.Array] = None,
                  **_: Any) -> jax.Array:
         sigma = jnp.asarray(sigma, jnp.float32)
-        c_in = 1.0 / jnp.sqrt(sigma ** 2 + 1.0)
+        # per-sample sigma (continuous batching: each padded-batch slot
+        # at its own schedule position) broadcasts over the sample dims;
+        # the scalar path is untouched — ``sb`` IS ``sigma`` then, so
+        # every existing compiled program keeps its exact expressions
+        sb = sigma if sigma.ndim == 0 \
+            else jnp.reshape(sigma, (-1,) + (1,) * (x.ndim - 1))
+        c_in = 1.0 / jnp.sqrt(sb ** 2 + 1.0)
         t = t_from_sigma(sigma)
         ts = jnp.broadcast_to(t, (x.shape[0],))
         xin = x * c_in
@@ -183,15 +189,15 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
         eps_or_v, probs = out if capture else (out, None)
         if prediction_type == "v":
             # v-prediction: denoised = c_skip*x - c_out*v  (VP parameterization)
-            c_skip = 1.0 / (sigma ** 2 + 1.0)
-            c_out = sigma / jnp.sqrt(sigma ** 2 + 1.0)
+            c_skip = 1.0 / (sb ** 2 + 1.0)
+            c_out = sb / jnp.sqrt(sb ** 2 + 1.0)
             den = x * c_skip - eps_or_v * c_out
         elif prediction_type == "x0":
             # the model predicts the clean sample directly
             # (ModelSamplingDiscrete sampling="x0")
             den = eps_or_v
         else:
-            den = x - eps_or_v * sigma
+            den = x - eps_or_v * sb
         return (den, probs) if capture else den
 
     return denoiser

@@ -461,6 +461,64 @@ class DiffusionPipeline:
         return make_denoiser(self.raw_unet_apply, self.unet_params,
                              self.schedule, self.prediction_type)
 
+    def denoise_step_fn(self, sampler_name: str, cfg: float,
+                        rows: int, latent_hw: tuple,
+                        has_y: bool = False):
+        """One jitted denoise STEP over a padded ``rows``-sample batch —
+        the continuous-batching executor's per-bucket kernel
+        (workflow/batch_executor.py).  Signature:
+
+            step(unet_params, x, ctx, unc, y, keys, sigma, sigma_next,
+                 step_i, active) -> x'
+
+        where ``sigma``/``sigma_next``/``step_i`` are per-sample ``[rows]``
+        vectors (each slot at its own schedule position), ``keys`` the
+        per-sample PRNG keys, and ``active`` a ``[rows]`` bool mask —
+        inactive (padding / retired-slot) rows pass through unchanged.
+
+        The model construction mirrors :meth:`sample`'s ``make_core``
+        for the plain single-entry CFG case EXACTLY (same
+        ``make_denoiser`` + ``cfg_denoiser_multi`` wrapping, same y
+        stacking), and the per-step math is the SAME extracted step
+        callable the scan samplers run (samplers.SAMPLER_STEPS) — so a
+        slot stepped here is bit-identical to its serial run.  Cached in
+        the same LRU jit cache as the full-loop cores (one executable
+        per (sampler, cfg, padded shape): zero steady-state retraces);
+        ``x`` is donated, so the persistent batch updates in place."""
+        self._ensure_tp_sharded()
+        cfg_rescale = float(getattr(self, "cfg_rescale", 0.0) or 0.0)
+        static_key = ("cb_step", sampler_name, float(cfg), cfg_rescale,
+                      int(rows), tuple(latent_hw), bool(has_y),
+                      self.prediction_type)
+
+        def make_step():
+            step_impl = smp.get_sampler_step(sampler_name)
+            cfg_scale = float(cfg)
+            reps = 1 + (1 if cfg_scale != 1.0 else 0)
+
+            def step(unet_params, x, ctx, unc, y_in, keys, sigma,
+                     sigma_next, step_i, active):
+                den = make_denoiser(self.raw_unet_apply, unet_params,
+                                    self.schedule, self.prediction_type)
+                model = smp.cfg_denoiser_multi(
+                    den, [(ctx, None, 1.0, None)],
+                    [(unc, None, 1.0, None)], cfg_scale,
+                    cfg_rescale=cfg_rescale)
+                if not has_y:
+                    extra = {}
+                else:
+                    y2 = jnp.concatenate([y_in] * reps, axis=0) \
+                        if reps > 1 else y_in
+                    extra = {"y": y2}
+                x_new = step_impl(model, x, sigma, sigma_next, step_i,
+                                  keys, extra_args=extra)
+                act = jnp.reshape(active, (-1,) + (1,) * (x.ndim - 1))
+                return jnp.where(act, x_new, x)
+
+            return jax.jit(step, donate_argnums=(1,))
+
+        return self._cache_get_or_make(static_key, make_step)
+
     def sample(self, latents: jnp.ndarray, context: jnp.ndarray,
                uncond_context: jnp.ndarray, seeds,
                steps: int, cfg: float, sampler_name: str, scheduler: str,

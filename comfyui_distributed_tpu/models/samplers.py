@@ -68,6 +68,22 @@ def make_noise_fn(keys: jax.Array) -> Callable[[jax.Array, Tuple[int, ...]], jax
     return noise
 
 
+def make_noise_fn_rowwise(keys: jax.Array) -> Callable:
+    """Row-wise variant of :func:`make_noise_fn` for the continuous-
+    batching step executor: ``steps`` is a PER-SAMPLE ``[B]`` vector (a
+    padded batch's slots sit at different iteration indices), each row
+    drawing from ``fold_in(keys[b], steps[b])``.  With a broadcast
+    scalar step this is bit-identical to ``make_noise_fn`` — the same
+    fold-in, vmapped over the same keys."""
+    def noise(steps: jax.Array, sample_shape: Tuple[int, ...]) -> jax.Array:
+        def one(k, st):
+            return jax.random.normal(jax.random.fold_in(k, st),
+                                     sample_shape)
+        return jax.vmap(one)(keys, jnp.broadcast_to(
+            jnp.asarray(steps), (keys.shape[0],)))
+    return noise
+
+
 def _broadcast_sigma(sigma: jax.Array, x: jax.Array) -> jax.Array:
     return jnp.reshape(sigma, (-1,) + (1,) * (x.ndim - 1))
 
@@ -150,6 +166,72 @@ def _scan_sampler(step_fn, x, sigmas, carry_init=None):
     return x_final
 
 
+# --- extracted single-step callables (continuous batching) -------------------
+#
+# The step-granular batch executor (workflow/batch_executor.py) advances
+# a padded batch ONE sigma pair at a time, with every slot at its own
+# iteration index — so these samplers' per-step math is extracted into
+# standalone ``<name>_step(model, x, sigma, sigma_next, step_i, keys)``
+# callables that accept PER-SAMPLE ``[B]`` sigma/step vectors (scalars
+# still work: ``_broadcast_sigma`` reshapes either form identically).
+# The scan samplers below are expressed THROUGH these callables, so the
+# serial loop and the continuous-batching loop execute literally the
+# same per-step expressions — the bit-exactness guarantee is structural,
+# not a parallel implementation kept in sync by hand.  Only samplers
+# whose step is stateless across iterations (no multistep history
+# carry) are extracted; SAMPLER_STEPS is the executor's whitelist.
+
+def euler_step(model: Model, x: jax.Array, sigma: jax.Array,
+               sigma_next: jax.Array, step_i: jax.Array = 0,
+               keys: Optional[jax.Array] = None,
+               extra_args: Optional[Dict[str, Any]] = None) -> jax.Array:
+    """One Euler (== deterministic DDIM) step; ``keys``/``step_i`` are
+    accepted for signature uniformity and unused (no step noise)."""
+    extra = extra_args or {}
+    denoised = model(x, sigma, **extra)
+    d = _to_d(x, _broadcast_sigma(jnp.asarray(sigma, jnp.float32), x),
+              denoised)
+    return x + d * _broadcast_sigma(
+        jnp.asarray(sigma_next, jnp.float32)
+        - jnp.asarray(sigma, jnp.float32), x)
+
+
+def euler_ancestral_step(model: Model, x: jax.Array, sigma: jax.Array,
+                         sigma_next: jax.Array, step_i: jax.Array,
+                         keys: jax.Array,
+                         extra_args: Optional[Dict[str, Any]] = None,
+                         eta: float = 1.0) -> jax.Array:
+    """One ancestral Euler step: deterministic move to sigma_down, then
+    per-sample ``fold_in(keys[b], step_i[b])`` noise at sigma_up."""
+    extra = extra_args or {}
+    s = jnp.asarray(sigma, jnp.float32)
+    s_next = jnp.asarray(sigma_next, jnp.float32)
+    denoised = model(x, s, **extra)
+    sd, su = _ancestral_sigmas(s, s_next, eta)
+    d = _to_d(x, _broadcast_sigma(s, x), denoised)
+    x = x + d * _broadcast_sigma(sd - s, x)
+    noise = make_noise_fn_rowwise(keys)(step_i, x.shape[1:])
+    return x + noise * _broadcast_sigma(su, x)
+
+
+# sampler name -> extracted step callable; THE eligibility surface for
+# the continuous-batching executor (constants.CB_SAFE_SAMPLERS mirrors
+# the keys so the registry-drift story stays in one obvious place)
+SAMPLER_STEPS: Dict[str, Callable] = {
+    "euler": euler_step,
+    "ddim": euler_step,
+    "euler_ancestral": euler_ancestral_step,
+}
+
+
+def get_sampler_step(name: str) -> Callable:
+    if name not in SAMPLER_STEPS:
+        raise ValueError(
+            f"sampler {name!r} has no extracted step callable; "
+            f"continuous batching supports: {sorted(SAMPLER_STEPS)}")
+    return SAMPLER_STEPS[name]
+
+
 # --- samplers ---------------------------------------------------------------
 
 def sample_euler(model: Model, x: jax.Array, sigmas: jax.Array,
@@ -161,9 +243,8 @@ def sample_euler(model: Model, x: jax.Array, sigmas: jax.Array,
 
     def step(carry, step_i, s, s_next):
         x, _ = carry
-        denoised = model(x, s, **extra)
-        d = _to_d(x, s, denoised)
-        x = x + d * (s_next - s)
+        x = euler_step(model, x, s, s_next, step_i, keys,
+                       extra_args=extra)
         return (x, None), None
 
     return _scan_sampler(step, x, sigmas)
@@ -229,16 +310,11 @@ def sample_euler_ancestral(model: Model, x: jax.Array, sigmas: jax.Array,
     extra = extra_args or {}
     if keys is None:
         raise ValueError("euler_ancestral requires per-sample keys")
-    noise_fn = make_noise_fn(keys)
-    sample_shape = x.shape[1:]
 
     def step(carry, step_i, s, s_next):
         x, _ = carry
-        denoised = model(x, s, **extra)
-        sd, su = _ancestral_sigmas(s, s_next, eta)
-        d = _to_d(x, s, denoised)
-        x = x + d * (sd - s)
-        x = x + noise_fn(step_i, sample_shape) * su
+        x = euler_ancestral_step(model, x, s, s_next, step_i, keys,
+                                 extra_args=extra, eta=eta)
         return (x, None), None
 
     return _scan_sampler(step, x, sigmas)
@@ -1646,7 +1722,14 @@ def cfg_denoiser_multi(model: Model, conds, uncond: Any,
             [c for c, _, _, _ in conds]
             + ([c for c, _, _, _ in unconds] if use_uncond else []),
             axis=0)
-        out = model(x_rep, sigma, context=ctx, **extra)
+        # per-sample sigma (continuous batching: a padded batch's slots
+        # sit at different sigmas) tiles in lockstep with the CFG-stacked
+        # rows; scalar sigma broadcasts exactly as before
+        sigma_rep = sigma
+        if getattr(sigma, "ndim", 0):
+            sigma_rep = jnp.concatenate([jnp.asarray(sigma)] * reps,
+                                        axis=0)
+        out = model(x_rep, sigma_rep, context=ctx, **extra)
         parts = jnp.split(out, reps, axis=0)
         den_cond = _mask_blend(conds, parts[:n], sigma)
         if not use_uncond:

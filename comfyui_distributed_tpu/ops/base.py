@@ -24,6 +24,15 @@ from comfyui_distributed_tpu.utils.trace import record_transfer
 CONTROL = "__control__"
 
 
+class CBCapture(Exception):
+    """Control-flow signal for the continuous-batching executor's bucket
+    build (workflow/batch_executor.py): with ``OpContext.cb_capture``
+    set, the KSampler records its resolved inputs (model, conditionings,
+    latent, widget config) into the dict and raises this instead of
+    sampling — the prefix run supplied everything the step executor
+    needs, so the graph tail (decode/save) must NOT run yet."""
+
+
 @dataclasses.dataclass
 class Conditioning:
     """CLIP encoding result (comfy CONDITIONING)."""
@@ -138,6 +147,10 @@ class OpContext:
     # out of this to embed per-prompt metadata
     hidden_overrides: Dict[str, Dict[str, Any]] = \
         dataclasses.field(default_factory=dict)
+    # continuous batching (workflow/batch_executor.py): a dict arms the
+    # KSampler's capture mode — it records its resolved inputs here and
+    # raises CBCapture instead of sampling (bucket-build prefix run)
+    cb_capture: Optional[Dict[str, Any]] = None
 
     def check_interrupt(self):
         if self.interrupt_event is not None and self.interrupt_event.is_set():

@@ -18,6 +18,7 @@ import numpy as np
 
 from comfyui_distributed_tpu.models import registry
 from comfyui_distributed_tpu.ops.base import (
+    CBCapture,
     CONTROL,
     Conditioning,
     DeviceImage,
@@ -1566,13 +1567,39 @@ class KSampler(Op):
     DEFAULTS = {"denoise": 1.0}
     # coalesced_seeds: per-prompt seed list injected by the batch-
     # coalescing scheduler (workflow/scheduler.py) as a hidden override —
-    # JSON-safe ints, so the merged graph's PNG metadata stays clean
-    HIDDEN = ["coalesced_seeds"]
+    # JSON-safe ints, so the merged graph's PNG metadata stays clean.
+    # cb_latent: a finished continuous-batching slot's latent rows
+    # (workflow/batch_executor.py tail run) — the sampler returns them
+    # directly so the graph tail (VAE decode, save) runs unchanged.
+    HIDDEN = ["coalesced_seeds", "cb_latent"]
 
-    def execute(self, ctx: OpContext, model, seed, steps, cfg, sampler_name,
-                scheduler, positive: Conditioning, negative: Conditioning,
-                latent_image, denoise: float = 1.0, coalesced_seeds=None):
+    # model/positive/negative/latent_image default None ONLY for the
+    # continuous-batching tail (cb_latent short-circuits before any of
+    # them is touched; the pruned tail graph drops the encode subtree) —
+    # the parameter ORDER is unchanged, so positional callers keep
+    # working, and the widget defaults only matter to pruned graphs
+    def execute(self, ctx: OpContext, model=None, seed=0, steps=20,
+                cfg=8.0, sampler_name="euler", scheduler="normal",
+                positive: Conditioning = None,
+                negative: Conditioning = None,
+                latent_image=None, denoise: float = 1.0,
+                coalesced_seeds=None, cb_latent=None):
         ctx.check_interrupt()
+        if ctx.cb_capture is not None:
+            # bucket-build prefix run: hand the resolved inputs to the
+            # step executor instead of sampling (it owns the loop)
+            ctx.cb_capture.update(
+                model=model, seed=seed, steps=steps, cfg=cfg,
+                sampler_name=str(sampler_name), scheduler=str(scheduler),
+                denoise=denoise, positive=positive, negative=negative,
+                latent_image=latent_image)
+            raise CBCapture("KSampler inputs captured")
+        if cb_latent is not None:
+            lat = cb_latent if isinstance(cb_latent, DeviceLatent) \
+                else DeviceLatent(as_device_array(cb_latent))
+            out_d = {"samples": lat, "local_batch": int(lat.shape[0]),
+                     "fanout": 1}
+            return (out_d,)
         if coalesced_seeds is not None and not isinstance(seed, SeedValue):
             seed = SeedValue(int(seed),
                              per_prompt=np.asarray(coalesced_seeds,

@@ -123,7 +123,8 @@ class ServerState:
                  models_dir: Optional[str] = None,
                  start_exec_thread: bool = True,
                  overlap: Optional[bool] = None,
-                 coalesce: Optional[bool] = None):
+                 coalesce: Optional[bool] = None,
+                 cb: Optional[bool] = None):
         self.config_path = config_path
         self.is_worker = is_worker
         self.port: Optional[int] = None  # set by serve()
@@ -190,6 +191,16 @@ class ServerState:
             if coalesce is None else bool(coalesce)
         self.coalesce_max = max(1, int(os.environ.get(
             C.COALESCE_MAX_ENV, C.COALESCE_MAX_DEFAULT)))
+        # iteration-level continuous batching (ISSUE 12): DTPU_CB=1
+        # replaces the pop-a-group exec loop with the step-granular
+        # batch executor (workflow/batch_executor.py) — eligible prompts
+        # join a RUNNING padded batch at step boundaries; everything
+        # else rides its fallback thread through _execute_group.  Off
+        # by default: the legacy dispatch model is untouched without
+        # the flag.
+        self.cb_enabled = _env_flag(C.CB_ENV, "0") \
+            if cb is None else bool(cb)
+        self.cb: Optional[Any] = None
         self.host_pool = net_mod.HostIOPool() if self.overlap_enabled \
             else None
         self._queue: List[Dict[str, Any]] = []
@@ -225,9 +236,15 @@ class ServerState:
             raise RuntimeError(f"durable master startup refused: {e}")
         self._exec_started = bool(start_exec_thread)
         if start_exec_thread:
-            t = threading.Thread(target=self._exec_loop, daemon=True,
-                                 name="dtpu-exec")
-            t.start()
+            if self.cb_enabled:
+                from comfyui_distributed_tpu.workflow import \
+                    batch_executor as cb_mod
+                self.cb = cb_mod.ContinuousBatchExecutor(self)
+                self.cb.start()
+            else:
+                t = threading.Thread(target=self._exec_loop, daemon=True,
+                                     name="dtpu-exec")
+                t.start()
             if self.overlap_enabled:
                 f = threading.Thread(target=self._finalize_loop,
                                      daemon=True, name="dtpu-finalize")
@@ -260,7 +277,13 @@ class ServerState:
 
     def queue_remaining(self) -> int:
         with self._queue_lock:
-            return len(self._queue) + (1 if self._running else 0)
+            n = len(self._queue) + (1 if self._running else 0)
+        if self.cb is not None:
+            # continuous batching: in-flight slots + decoding tails are
+            # queued-or-executing work exactly like the legacy in-flight
+            # group (backpressure, autoscale signal, Retry-After hints)
+            n += self.cb.active_prompts()
+        return n
 
     def queued_by_class(self) -> Dict[str, int]:
         """Queued (not yet running) prompts per tenant class — the
@@ -310,9 +333,17 @@ class ServerState:
             sp.attrs.setdefault("prompt_id", pid)
             sp.attrs.setdefault("tenant", tenant)
         # signature hashed OUTSIDE the lock (it walks the whole graph):
-        # _pop_group then only compares strings under the lock
+        # _pop_group then only compares strings under the lock.  The
+        # continuous-batching flag rides along the same way: a cheap
+        # whole-graph screen now, so the step executor's pop decisions
+        # are string/int compares under the lock.
         sig = sched_mod.coalesce_signature(prompt) \
-            if self.coalesce_enabled else None
+            if (self.coalesce_enabled or self.cb_enabled) else None
+        cb_ok = False
+        if self.cb_enabled and sig is not None:
+            from comfyui_distributed_tpu.workflow import \
+                batch_executor as cb_mod
+            cb_ok = cb_mod.quick_eligible(prompt)
         with self._queue_lock:
             if self._draining:
                 self._abandon_span(sp, pid, "rejected: draining")
@@ -338,6 +369,7 @@ class ServerState:
                                 "client_id": client_id,
                                 "extra_data": extra_data or {},
                                 "sig": sig,
+                                "cb": cb_ok,
                                 "tenant": tenant,
                                 "span": sp,
                                 "t_enq": time.perf_counter()})
@@ -394,76 +426,96 @@ class ServerState:
         return group
 
     def _exec_loop(self) -> None:
-        from comfyui_distributed_tpu.parallel.mesh import get_runtime
         while True:
             self._queue_event.wait()
             self._exec_gate.wait()
             group = self._pop_group()
             if group is None:
                 continue
-            self.interrupt_event.clear()
-            t0 = time.perf_counter()
-            res, err = None, None
-            try:
-                ctx = OpContext(
-                    runtime=get_runtime(),
-                    models_dir=self.models_dir,
-                    input_dir=self.input_dir,
-                    output_dir=self.output_dir,
-                    is_worker=self.is_worker,
-                    job_store=self.jobs,
-                    server_loop=self.loop,
-                    interrupt_event=self.interrupt_event,
-                    host_pool=self.host_pool,
-                    cluster=self.cluster,
-                    ledger=self.ledger,
-                    fault_inject=self.fault_inject,
-                )
-                first = group[0]
-                trace_mod.GLOBAL_COUNTERS.bump("exec_runs")
-                # the run executes under the HEAD prompt's job span
-                # (coalesced followers' traces stay thin — job +
-                # queue_wait — and name their leader); per-node and
-                # stage spans created inside attach to this trace
-                with trace_mod.use_span(first.get("span")), \
-                        trace_mod.span("execute",
-                                       coalesced=len(group)):
-                    if len(group) > 1:
-                        graph, hidden = sched_mod.build_coalesced(
-                            [it["prompt"] for it in group])
-                        ctx.coalesce = len(group)
-                        trace_mod.GLOBAL_COUNTERS.bump("coalesced_batches")
-                        trace_mod.GLOBAL_COUNTERS.bump("coalesced_prompts",
-                                                       len(group))
-                        debug_log(f"coalesced {len(group)} prompts into "
-                                  f"one dispatch ({first['id']}..)")
-                        for item in group[1:]:
-                            if item.get("span") is not None:
-                                item["span"].attrs["coalesced_into"] = \
-                                    first["id"]
-                        with trace_mod.stage("coalesced_batch"):
-                            res = WorkflowExecutor(ctx).execute(
-                                graph, hidden=hidden,
-                                extra_pnginfo=first.get(
-                                    "extra_data", {}).get("extra_pnginfo"))
-                    else:
+            self._execute_group(group)
+
+    def _execute_group(self, group: List[Dict[str, Any]]) -> None:
+        """Run one popped dispatch group end to end (the legacy
+        whole-graph model): coalesced build, executor run, finalize
+        hand-off.  Shared by the classic exec loop and the continuous-
+        batching executor's fallback thread — non-step-batchable
+        prompts keep every PR 2/9 behavior bit for bit."""
+        from comfyui_distributed_tpu.parallel.mesh import get_runtime
+        self.interrupt_event.clear()
+        t0 = time.perf_counter()
+        res, err = None, None
+        try:
+            ctx = OpContext(
+                runtime=get_runtime(),
+                models_dir=self.models_dir,
+                input_dir=self.input_dir,
+                output_dir=self.output_dir,
+                is_worker=self.is_worker,
+                job_store=self.jobs,
+                server_loop=self.loop,
+                interrupt_event=self.interrupt_event,
+                host_pool=self.host_pool,
+                cluster=self.cluster,
+                ledger=self.ledger,
+                fault_inject=self.fault_inject,
+            )
+            first = group[0]
+            trace_mod.GLOBAL_COUNTERS.bump("exec_runs")
+            # the run executes under the HEAD prompt's job span
+            # (coalesced followers' traces stay thin — job +
+            # queue_wait — and name their leader); per-node and
+            # stage spans created inside attach to this trace
+            with trace_mod.use_span(first.get("span")), \
+                    trace_mod.span("execute",
+                                   coalesced=len(group)):
+                if len(group) > 1:
+                    graph, hidden = sched_mod.build_coalesced(
+                        [it["prompt"] for it in group])
+                    ctx.coalesce = len(group)
+                    trace_mod.GLOBAL_COUNTERS.bump("coalesced_batches")
+                    trace_mod.GLOBAL_COUNTERS.bump("coalesced_prompts",
+                                                   len(group))
+                    debug_log(f"coalesced {len(group)} prompts into "
+                              f"one dispatch ({first['id']}..)")
+                    for item in group[1:]:
+                        if item.get("span") is not None:
+                            item["span"].attrs["coalesced_into"] = \
+                                first["id"]
+                    with trace_mod.stage("coalesced_batch"):
                         res = WorkflowExecutor(ctx).execute(
-                            first["prompt"],
-                            extra_pnginfo=first.get("extra_data", {}).get(
-                                "extra_pnginfo"))
-                trace_mod.GLOBAL_STAGES.record("compute", res.total_s)
-            except Exception as e:  # noqa: BLE001 - survive bad prompts
-                err = e
-            finally:
-                with self._queue_lock:
-                    self._running = False
-                    self._finalize_pending += 1
-            if self.overlap_enabled:
-                # hand host-side joining to the finalizer so the next
-                # group's compute starts NOW — this is the overlap
-                self._finalize_q.put((group, res, err, t0))
-            else:
-                self._finalize_group(group, res, err, t0)
+                            graph, hidden=hidden,
+                            extra_pnginfo=first.get(
+                                "extra_data", {}).get("extra_pnginfo"))
+                else:
+                    res = WorkflowExecutor(ctx).execute(
+                        first["prompt"],
+                        extra_pnginfo=first.get("extra_data", {}).get(
+                            "extra_pnginfo"))
+            trace_mod.GLOBAL_STAGES.record("compute", res.total_s)
+        except Exception as e:  # noqa: BLE001 - survive bad prompts
+            err = e
+        finally:
+            with self._queue_lock:
+                self._running = False
+                self._finalize_pending += 1
+        if self.overlap_enabled:
+            # hand host-side joining to the finalizer so the next
+            # group's compute starts NOW — this is the overlap
+            self._finalize_q.put((group, res, err, t0))
+        else:
+            self._finalize_group(group, res, err, t0)
+
+    def _finalize_hand(self, group, res, err, t0) -> None:
+        """Finalize entry point for the continuous-batching executor
+        (tail decodes, slot aborts): books the pending finalize and
+        routes through the same overlap/inline split as
+        _execute_group."""
+        with self._queue_lock:
+            self._finalize_pending += 1
+        if self.overlap_enabled:
+            self._finalize_q.put((group, res, err, t0))
+        else:
+            self._finalize_group(group, res, err, t0)
 
     def _finalize_loop(self) -> None:
         while True:
@@ -659,6 +711,11 @@ class ServerState:
                 # — only in-flight/host work is drainable
                 idle = (not self._running and self._finalize_pending == 0
                         and (not self._queue or not self._exec_started))
+            if idle and self.cb is not None:
+                # continuous batching: in-flight slots / decoding tails /
+                # fallback groups are in-flight work like the legacy
+                # running group
+                idle = self.cb.idle()
             if idle and (self.host_pool is None
                          or self.host_pool.pending == 0):
                 return True
@@ -836,6 +893,13 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                       "coalesce": state.coalesce_enabled,
                                       "max_queue": state.max_queue,
                                   },
+                                  # iteration-level continuous batching:
+                                  # slot occupancy, per-bucket admit/
+                                  # retire/step/retrace counters, pad set
+                                  "batching": (
+                                      state.cb.snapshot()
+                                      if state.cb is not None
+                                      else {"enabled": False}),
                                   # cluster control plane: lease states,
                                   # ledger activity, recovery counters
                                   "cluster": {
@@ -989,6 +1053,38 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
              "Prompts finalized per second (recent window).",
              [({}, round(state.drain_rate(), 4))]),
         ])
+        if state.cb is not None:
+            # continuous batching: slot occupancy + admit/retire/step
+            # counters and the per-bucket steady-state retrace counter
+            # (the zero-retrace invariant, scrapeable per shape bucket)
+            bsnap = state.cb.snapshot()
+            extra.extend([
+                ("dtpu_batch_slots", "gauge",
+                 "Continuous-batching slots by state (all shape "
+                 "buckets).",
+                 [({"state": "active"}, bsnap["slots_active"]),
+                  ({"state": "free"}, bsnap["slots_free"])]),
+                ("dtpu_cb_admits_total", "counter",
+                 "Prompts admitted into a running batch at a step "
+                 "boundary.",
+                 [({}, bsnap["admits"])]),
+                ("dtpu_cb_retires_total", "counter",
+                 "Slots retired (prompt finished its steps and moved "
+                 "to decode).",
+                 [({}, bsnap["retires"])]),
+                ("dtpu_cb_steps_total", "counter",
+                 "Batched denoise steps executed.",
+                 [({}, bsnap["steps"])]),
+                ("dtpu_cb_fallback_total", "counter",
+                 "Prompts dispatched through the legacy fallback "
+                 "executor.",
+                 [({}, bsnap["fallbacks"])]),
+                ("dtpu_cb_bucket_retraces_total", "counter",
+                 "Retraces observed during bucket steps (want 0 in "
+                 "steady state).",
+                 [({"bucket": b["sig"]}, b["retraces"])
+                  for b in bsnap["buckets"]]),
+            ])
         if state.autoscaler is not None:
             asnap = state.autoscaler.snapshot()
             extra.extend([
@@ -1724,20 +1820,13 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             {"exec_info": {"queue_remaining": state.queue_remaining()}})
 
     def _is_dispatched_share(prompt: Dict[str, Any]) -> bool:
-        """A graph some orchestrator already prepared (hidden
-        multi_job_id on a distributed node): mandatory work for a job
-        that passed admission AT ITS MASTER.  Re-shedding it here would
-        silently amputate an admitted job's worker shares, so these
-        bypass this server's own admission (the hard queue-full cap
-        still applies)."""
-        for node in prompt.values():
-            if not isinstance(node, dict) or node.get("class_type") \
-                    not in C.DISTRIBUTED_NODE_TYPES:
-                continue
-            h = {**node.get("inputs", {}), **node.get("hidden", {})}
-            if h.get("multi_job_id"):
-                return True
-        return False
+        """Orchestrated-share predicate (one copy: workflow/orchestrate
+        .is_dispatched_share).  Shares bypass this server's own
+        admission — re-shedding would silently amputate an admitted
+        job's worker shares; the hard queue-full cap still applies."""
+        from comfyui_distributed_tpu.workflow.orchestrate import \
+            is_dispatched_share
+        return is_dispatched_share(prompt)
 
     async def post_prompt(request):
         data = await request.json()

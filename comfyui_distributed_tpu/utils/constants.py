@@ -105,6 +105,41 @@ COALESCE_SAFE_NODE_TYPES = frozenset({
     "VAEDecode", "VAEDecodeTiled", "SaveImage", "PreviewImage",
 })
 
+# --- iteration-level continuous batching (workflow/batch_executor.py) --------
+# Orca-style step-granular denoise executor: a persistent, padded,
+# shape-bucketed device batch (bucket key = the PR 2 structural
+# signature) where each slot carries one prompt's iteration state —
+# remaining-steps counter, sigma index and its exact (seed, fold-idx)
+# noise-stream keys, so a continuously-batched image stays bit-identical
+# to its serial run.  New prompts JOIN the running batch at the next
+# step boundary (non-contiguous same-signature merging); finished
+# prompts exit their slot immediately and proceed to VAE decode on the
+# tail thread without draining the batch.  Off by default (DTPU_CB=1
+# opts in): the legacy head-run coalescing dispatch stays the default
+# path, so existing deployments see no behavior change.
+CB_ENV = "DTPU_CB"                       # "1" arms the step executor
+CB_SLOTS_ENV = "DTPU_CB_SLOTS"           # slots per bucket (max batch)
+CB_SLOTS_DEFAULT = 4
+# padded slot-count bucket set: each step runs at the smallest declared
+# pad >= the active slot count, so the per-step executable comes from a
+# FIXED shape set (zero steady-state retraces once each pad compiled);
+# sizes above DTPU_CB_SLOTS are ignored, and the max is always included
+CB_PAD_BUCKETS_ENV = "DTPU_CB_PAD_BUCKETS"
+CB_PAD_BUCKETS_DEFAULT = "1,2,4,8"
+CB_MAX_BUCKETS_ENV = "DTPU_CB_MAX_BUCKETS"  # concurrent shape buckets
+CB_MAX_BUCKETS_DEFAULT = 4
+# admission window: how long the driver lingers at an idle boundary
+# waiting for arrivals to accumulate before dispatching the first step
+# (0 = dispatch immediately; a small value trades first-step latency
+# for fuller initial batches under bursty arrivals)
+CB_ADMIT_WINDOW_ENV = "DTPU_CB_ADMIT_WINDOW_S"
+CB_ADMIT_WINDOW_DEFAULT = 0.0
+# samplers with an extracted single-step callable (models/samplers.py
+# SAMPLER_STEPS): the ONLY samplers the step executor admits — every
+# entry is stateless across steps (no multistep history carry), so a
+# slot's step N is a pure function of (x, sigma_N, sigma_N+1, keys)
+CB_SAFE_SAMPLERS = frozenset({"euler", "ddim", "euler_ancestral"})
+
 # --- observability (request-scoped tracing + telemetry) ----------------------
 # Dapper-style always-on request tracing (utils/trace.py spans): every job
 # gets a trace; spans propagate over the distributed HTTP edges via
@@ -350,8 +385,8 @@ TRACE_ATTR_WHITELIST = frozenset({
     # job identity / topology
     "prompt_id", "client_id", "tenant", "role", "fanout", "job",
     "worker", "node", "target",
-    # coalescing
-    "coalesced", "coalesced_into",
+    # coalescing / continuous batching
+    "coalesced", "coalesced_into", "bucket", "slot",
     # recovery / hedging
     "lost", "to", "units", "tile_idx", "n_workers",
     # resource attribution (ISSUE 5)

@@ -16,7 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from comfyui_distributed_tpu.ops.base import OpContext, get_op
+from comfyui_distributed_tpu.ops.base import CBCapture, OpContext, get_op
 from comfyui_distributed_tpu.utils import resource as resource_mod
 from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.constants import \
@@ -110,13 +110,27 @@ class WorkflowExecutor:
 
     def execute(self, workflow: Any,
                 hidden: Optional[Dict[str, Dict[str, Any]]] = None,
-                extra_pnginfo: Optional[Dict[str, Any]] = None
+                extra_pnginfo: Optional[Dict[str, Any]] = None,
+                cb_capture: Optional[Dict[str, Any]] = None,
+                prompt_json: Optional[Any] = None
                 ) -> ExecutionResult:
         """Run a workflow (path/JSON/dict/Graph).  ``hidden`` optionally maps
         node id -> hidden-input overrides (the dispatcher's injections).
         ``extra_pnginfo`` (ComfyUI contract, typically
         ``{"workflow": <UI-format doc>}``) is embedded by SaveImage into
-        every saved PNG alongside the API-format prompt."""
+        every saved PNG alongside the API-format prompt.
+
+        ``cb_capture`` (continuous batching, workflow/batch_executor.py):
+        a dict arms the prefix-capture run — the graph executes UP TO
+        its KSampler, which records its resolved inputs into the dict
+        and stops the walk (ops.base.CBCapture); the returned result
+        then holds only the prefix outputs, and nothing downstream of
+        the sampler has run.
+
+        ``prompt_json`` overrides the API-format document SaveImage
+        embeds in PNG metadata (default: this graph's own) — the
+        continuous-batching tail executes a PRUNED decode graph but
+        must embed the client's FULL prompt for provenance."""
         graph = workflow if isinstance(workflow, Graph) \
             else parse_workflow(workflow)
         hidden = hidden or {}
@@ -124,12 +138,14 @@ class WorkflowExecutor:
         # ExecutionResults keep their own lists)
         self.ctx.saved_images = []
         self.ctx.image_futures = []
-        self.ctx.prompt_json = graph.to_api_format()
+        self.ctx.prompt_json = prompt_json if prompt_json is not None \
+            else graph.to_api_format()
         # coalesced runs: SaveImage rebuilds per-prompt metadata from the
         # per-prompt widget overrides (coalesced_seeds etc.), so every
         # saved PNG embeds ITS prompt's values, not prompt 0's
         self.ctx.hidden_overrides = dict(hidden)
         self.ctx.extra_pnginfo = extra_pnginfo
+        self.ctx.cb_capture = cb_capture
         fanout = self._decide_fanout(graph)
         fan_nodes = None
         if fanout > 1:
@@ -191,26 +207,37 @@ class WorkflowExecutor:
                 # the first node) IS this node's start snapshot — one
                 # probe per boundary, not two
                 node_mem0 = prev_node_mem
-                # node-scoped telemetry: transfer attribution + a child
-                # span in the active request trace (no-op outside a job)
-                with trace_mod.node_scope(nid), \
-                        trace_mod.span(node.class_type, node=nid) as nsp:
-                    outputs[nid] = op.execute(self.ctx, **kwargs)
-                    if res_on:
-                        node_mem1 = resource_mod.device_memory_snapshot()
-                        mem_delta = {
-                            "peak_delta_bytes": max(
-                                node_mem1["peak_bytes_in_use"]
-                                - node_mem0["peak_bytes_in_use"], 0),
-                            "in_use_delta_bytes":
-                                node_mem1["bytes_in_use"]
-                                - node_mem0["bytes_in_use"],
-                        }
-                        prev_node_mem = node_mem1
-                        node_memory[nid] = mem_delta
-                        if nsp is not None and mem_delta["peak_delta_bytes"]:
-                            nsp.attrs["mem_peak_mb"] = round(
-                                mem_delta["peak_delta_bytes"] / 1e6, 2)
+                try:
+                    # node-scoped telemetry: transfer attribution + a
+                    # child span in the active request trace (no-op
+                    # outside a job)
+                    with trace_mod.node_scope(nid), \
+                            trace_mod.span(node.class_type,
+                                           node=nid) as nsp:
+                        outputs[nid] = op.execute(self.ctx, **kwargs)
+                        if res_on:
+                            node_mem1 = \
+                                resource_mod.device_memory_snapshot()
+                            mem_delta = {
+                                "peak_delta_bytes": max(
+                                    node_mem1["peak_bytes_in_use"]
+                                    - node_mem0["peak_bytes_in_use"], 0),
+                                "in_use_delta_bytes":
+                                    node_mem1["bytes_in_use"]
+                                    - node_mem0["bytes_in_use"],
+                            }
+                            prev_node_mem = node_mem1
+                            node_memory[nid] = mem_delta
+                            if nsp is not None \
+                                    and mem_delta["peak_delta_bytes"]:
+                                nsp.attrs["mem_peak_mb"] = round(
+                                    mem_delta["peak_delta_bytes"] / 1e6,
+                                    2)
+                except CBCapture:
+                    # bucket-build prefix run: the sampler recorded its
+                    # inputs into ctx.cb_capture — stop the walk here so
+                    # the graph tail (decode/save) does NOT run
+                    break
                 timings[nid] = time.perf_counter() - t0
                 # per-node-type latency histogram (p50/p95/p99 on
                 # /distributed/metrics and the dtpu_node_seconds family)

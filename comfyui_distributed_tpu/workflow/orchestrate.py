@@ -44,6 +44,25 @@ _IMAGE_REF = re.compile(
     re.IGNORECASE)
 
 
+def is_dispatched_share(prompt: Dict[str, Any]) -> bool:
+    """A graph some orchestrator already prepared (hidden multi_job_id
+    on a distributed node): mandatory work for a job that passed
+    admission AT ITS MASTER.  The one copy of the predicate — the server
+    uses it to bypass local admission (re-shedding would silently
+    amputate an admitted job's worker shares), and the continuous-
+    batching executor uses it to keep orchestrated shares off the step
+    batch (their collector drains and hidden per-participant state need
+    the classic whole-graph dispatch)."""
+    for node in prompt.values():
+        if not isinstance(node, dict) or node.get("class_type") \
+                not in C.DISTRIBUTED_NODE_TYPES:
+            continue
+        h = {**node.get("inputs", {}), **node.get("hidden", {})}
+        if h.get("multi_job_id"):
+            return True
+    return False
+
+
 def find_image_references(graph: Graph) -> List[str]:
     """Filename-valued ``image`` inputs that must be staged onto remote
     workers before dispatch (reference ``findImageReferences``)."""

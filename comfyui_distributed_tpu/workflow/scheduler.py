@@ -286,6 +286,31 @@ class AdmissionController:
 
     # -- weighted fair dequeue ------------------------------------------------
 
+    def peek_class(self, queued: Dict[str, int]) -> Optional[str]:
+        """The class :meth:`next_class` WOULD pick, without charging its
+        virtual pass or touching the idle-return state — the
+        continuous-batching pop peeks first and only commits the stride
+        charge when it actually dequeues (a deferred boundary must not
+        debit the blocked class, or a full bucket would starve its own
+        tenant once capacity frees)."""
+        with self._lock:
+            active = [cls for cls in self.classes if queued.get(cls)]
+            if not active:
+                return None
+            carried = [cls for cls in active
+                       if cls in self._active_prev]
+            base = min(self._pass[cls] for cls in carried) \
+                if carried else None
+            best, best_key = None, None
+            for cls in active:
+                p = self._pass[cls]
+                if base is not None and cls not in self._active_prev:
+                    p = max(p, base)
+                key = (p, self.classes.index(cls))
+                if best_key is None or key < best_key:
+                    best, best_key = cls, key
+            return best
+
     def next_class(self, queued: Dict[str, int]) -> Optional[str]:
         """Stride scheduling over the classes with queued work: pick the
         smallest virtual finish time, advance it by 1/weight.  A class
@@ -360,6 +385,86 @@ def pop_fair_group(queue: List[Dict[str, Any]],
             break
         group.append(queue.pop(j))
     return group
+
+
+def pop_cb_admit(queue: List[Dict[str, Any]],
+                 admission: AdmissionController,
+                 room_for,
+                 fallback_ok: bool = True,
+                 legacy_max: int = 1) -> Tuple[str, List[Dict[str, Any]]]:
+    """Continuous-batching admission pop (workflow/batch_executor.py):
+    the SAME stride scheduling as :func:`pop_fair_group` — one
+    ``next_class`` decision per pop, so paid/free/batch dequeue ratios
+    are identical whichever dispatch model consumes the queue — but the
+    scheduled class's head prompt may now join a RUNNING batch.
+
+    ``room_for(item) -> int`` is the executor's capacity oracle: >0 =
+    step-batchable with that many free slots, 0 = not batchable (legacy
+    dispatch is correct for it), <0 = batchable but FULL — the item must
+    wait for a slot exit rather than burn the mesh through the fallback
+    path.  Outcomes:
+
+    - ``("cb", items)``: the head is batchable — pop it plus up to
+      ``room-1`` MORE items of the same class AND signature from
+      anywhere behind it (the non-contiguous merge the head-run-only
+      coalescer could never do; passed-over items keep their queue
+      positions, so within the class nothing is lost, merely joined
+      later at another step boundary);
+    - ``("fallback", group)``: the head is not batchable — the exact
+      legacy contiguous-within-class group pop, for the classic
+      one-dispatch executor path;
+    - ``("defer", [])``: batchable-but-full, or not batchable while
+      ``fallback_ok`` is False (the fallback executor is mid-group) —
+      nothing popped, and the stride pass is NOT charged (the class is
+      blocked on capacity, not skipping its turn).
+
+    Caller holds the queue lock."""
+    if not queue:
+        return "defer", []
+    counts: Dict[str, int] = {}
+    for item in queue:
+        c = item.get("tenant") or admission.default_class
+        counts[c] = counts.get(c, 0) + 1
+    # peek first, commit the stride charge only on an actual dequeue —
+    # next_class() on the same counts deterministically re-picks the
+    # peeked class
+    cls = admission.peek_class(counts) or admission.default_class
+    idx = next((i for i, item in enumerate(queue)
+                if (item.get("tenant") or admission.default_class)
+                == cls), 0)
+    head = queue[idx]
+    room = int(room_for(head) or 0)
+    if room > 0:
+        admission.next_class(counts)
+        sig = head.get("sig")
+        take = [idx]
+        j = idx + 1
+        while sig is not None and len(take) < room and j < len(queue):
+            it = queue[j]
+            if (it.get("tenant") or admission.default_class) == cls \
+                    and it.get("sig") == sig:
+                take.append(j)
+            j += 1
+        items = [queue[i] for i in take]
+        for i in reversed(take):
+            queue.pop(i)
+        return "cb", items
+    if room < 0 or not fallback_ok:
+        return "defer", []
+    admission.next_class(counts)
+    # legacy group semantics for the non-batchable head: contiguous
+    # same-signature run WITHIN the class (pop_fair_group's tail logic)
+    group = [queue.pop(idx)]
+    sig = group[0].get("sig")
+    j = idx
+    while sig is not None and len(group) < max(legacy_max, 1):
+        while j < len(queue) and (queue[j].get("tenant")
+                                  or admission.default_class) != cls:
+            j += 1
+        if j >= len(queue) or queue[j].get("sig") != sig:
+            break
+        group.append(queue.pop(j))
+    return "fallback", group
 
 
 def split_images(images: List[Any], k: int) -> List[List[Any]]:

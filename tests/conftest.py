@@ -81,6 +81,10 @@ _MODULE_COST_S = {
     # pure-AST static analysis (dtpu-lint): parses the package ~10x
     # (fixtures + live-tree gate + seeded mutations), no device work
     "test_analysis.py": 13,
+    # continuous batching (PR 12): bucket-level exactness + a few real
+    # CB ServerStates on the tiny model (~30s warm-cache; the late-join
+    # bit-exactness proof is the priciest call at ~8s warm)
+    "test_batching.py": 30,
     "test_tiling.py": 10,
 }
 
@@ -211,6 +215,15 @@ _SLOW_TESTS = {
     "test_checkpoints.py::test_roundtrip_exact[tiny]",
     "test_controlnet.py::TestControlNetChaining::"
     "test_two_live_nets_accumulate",
+    # PR 12: the continuous-batching late-join bit-exactness proof
+    # (~14s warm, ~27s cold — two samplers x serial references), the
+    # same precedent as PR 2's coalesced==serial proof; the cheap
+    # behavioral tests of the same module (non-contiguous merge,
+    # slot-exit provenance, fallback, zero-retrace churn) stay in the
+    # gate, and `bench.py --phase batching` re-proves exactness on
+    # every watchdog run
+    "test_batching.py::TestBucketExactness::"
+    "test_late_join_bit_identical_to_serial",
 }
 
 

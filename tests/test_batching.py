@@ -1,0 +1,370 @@
+"""Iteration-level continuous batching (ISSUE 12): step-granular denoise
+executor with persistent shape-bucketed batches — non-contiguous
+same-signature merging, per-slot (seed, fold-idx) bit-exactness vs the
+serial run, tenant stride fairness through the CB pop, slot-exit-order
+PNG/history provenance, and the metrics surfaces."""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from comfyui_distributed_tpu.models import registry
+from comfyui_distributed_tpu.models import samplers as smp
+from comfyui_distributed_tpu.ops.base import OpContext
+from comfyui_distributed_tpu.server.app import ServerState, build_app
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.workflow import batch_executor as cb_mod
+from comfyui_distributed_tpu.workflow import scheduler as sched
+from comfyui_distributed_tpu.workflow.executor import WorkflowExecutor
+
+
+@pytest.fixture(autouse=True)
+def tiny_family(monkeypatch):
+    monkeypatch.setenv(registry.FAMILY_ENV, "tiny")
+    yield
+
+
+def make_prompt(seed, steps=2, size=32, text="cat", batch=1,
+                sampler="euler", save=False):
+    p = {
+        "7": {"class_type": "CheckpointLoaderSimple",
+              "inputs": {"ckpt_name": "tiny.safetensors"}},
+        "5": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": text, "clip": ["7", 1]}},
+        "6": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["7", 1]}},
+        "9": {"class_type": "EmptyLatentImage",
+              "inputs": {"width": size, "height": size,
+                         "batch_size": batch}},
+        "8": {"class_type": "KSampler",
+              "inputs": {"model": ["7", 0], "positive": ["5", 0],
+                         "negative": ["6", 0], "latent_image": ["9", 0],
+                         "seed": seed, "steps": steps, "cfg": 2.0,
+                         "sampler_name": sampler, "scheduler": "normal",
+                         "denoise": 1.0}},
+        "1": {"class_type": "VAEDecode",
+              "inputs": {"samples": ["8", 0], "vae": ["7", 2]}},
+        "3": {"class_type": "PreviewImage", "inputs": {"images": ["1", 0]}},
+    }
+    if save:
+        p["3"] = {"class_type": "SaveImage",
+                  "inputs": {"images": ["1", 0],
+                             "filename_prefix": f"cb_{seed}"}}
+    return p
+
+
+def make_state(tmp_path, **kw):
+    kw.setdefault("cb", True)
+    return ServerState(config_path=str(tmp_path / "cfg.json"),
+                       input_dir=str(tmp_path / "in"),
+                       output_dir=str(tmp_path / "out"), **kw)
+
+
+def wait_history(state, pids, timeout=180):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(p in state._history for p in pids):
+            return {p: state._history[p] for p in pids}
+        time.sleep(0.01)
+    raise AssertionError(f"prompts never finished: "
+                         f"{[p for p in pids if p not in state._history]}")
+
+
+def item(seed, cls="paid", steps=2, sampler="euler", cb=True):
+    p = make_prompt(seed, steps=steps, sampler=sampler)
+    return {"id": f"i{seed}", "prompt": p,
+            "sig": sched.coalesce_signature(p),
+            "cb": cb and cb_mod.quick_eligible(p),
+            "tenant": cls, "t_enq": time.perf_counter()}
+
+
+class TestEligibility:
+    def test_safe_sampler_registry_matches_extracted_steps(self):
+        """The declared product surface (constants.CB_SAFE_SAMPLERS) and
+        the actual extracted step callables must never drift."""
+        assert frozenset(C.CB_SAFE_SAMPLERS) \
+            == frozenset(smp.SAMPLER_STEPS)
+
+    def test_quick_eligible_plain_txt2img(self):
+        assert cb_mod.quick_eligible(make_prompt(1))
+        assert cb_mod.quick_eligible(make_prompt(1,
+                                                 sampler="euler_ancestral"))
+
+    def test_quick_rejects_non_step_sampler(self):
+        assert not cb_mod.quick_eligible(make_prompt(1, sampler="heun"))
+
+    def test_quick_rejects_multi_sampler_graphs(self):
+        p = make_prompt(1)
+        p["80"] = dict(p["8"])
+        assert not cb_mod.quick_eligible(p)
+
+    def test_quick_rejects_dispatched_shares(self):
+        p = make_prompt(1)
+        p["99"] = {"class_type": "DistributedCollector",
+                   "inputs": {"images": ["1", 0],
+                              "multi_job_id": "job"}}
+        assert not cb_mod.quick_eligible(p)
+
+    def test_quick_rejects_degenerate_steps(self):
+        p = make_prompt(1)
+        p["8"]["inputs"]["steps"] = 0
+        assert not cb_mod.quick_eligible(p)
+
+
+class TestCbPop:
+    def test_non_contiguous_same_signature_merge(self):
+        """A/B/A queue: the CB pop takes BOTH A prompts past the B in
+        the middle — the head-run-only limitation is gone; B keeps its
+        position for the next boundary."""
+        adm = sched.AdmissionController()
+        a1, b, a2 = item(1, steps=3), item(2, steps=1), item(3, steps=3)
+        assert a1["sig"] == a2["sig"] != b["sig"]
+        queue = [a1, b, a2]
+        kind, items = sched.pop_cb_admit(queue, adm, lambda it: 4)
+        assert kind == "cb"
+        assert [it["id"] for it in items] == ["i1", "i3"]
+        assert [it["id"] for it in queue] == ["i2"]
+
+    def test_room_caps_the_sweep(self):
+        adm = sched.AdmissionController()
+        queue = [item(i) for i in range(5)]
+        kind, items = sched.pop_cb_admit(queue, adm, lambda it: 2)
+        assert kind == "cb" and len(items) == 2 and len(queue) == 3
+
+    def test_ineligible_head_pops_legacy_group(self):
+        adm = sched.AdmissionController()
+        queue = [item(1, cb=False), item(2, cb=False), item(3, cb=False)]
+        kind, group = sched.pop_cb_admit(queue, adm, lambda it: 0,
+                                         legacy_max=8)
+        assert kind == "fallback"
+        # contiguous same-signature run merged, exactly like
+        # pop_fair_group would
+        assert [it["id"] for it in group] == ["i1", "i2", "i3"]
+
+    def test_batchable_but_full_defers(self):
+        """An eligible prompt whose bucket is full must WAIT for a slot
+        exit (defer), never burn the mesh through the fallback path."""
+        adm = sched.AdmissionController()
+        queue = [item(1)]
+        kind, items = sched.pop_cb_admit(queue, adm, lambda it: -1)
+        assert kind == "defer" and not items and len(queue) == 1
+
+    def test_fallback_busy_defers(self):
+        adm = sched.AdmissionController()
+        queue = [item(1, cb=False)]
+        kind, items = sched.pop_cb_admit(queue, adm, lambda it: 0,
+                                         fallback_ok=False)
+        assert kind == "defer" and len(queue) == 1
+
+    def test_tenant_stride_ratios_survive_cb_pop(self):
+        """paid/free/batch dequeue ratios through pop_cb_admit match the
+        6/3/1 stride weights — fairness survives the new dispatch
+        model (the pop shares next_class with pop_fair_group)."""
+        adm = sched.AdmissionController(
+            weights={"paid": 6.0, "free": 3.0, "batch": 1.0},
+            rate={}, burst={}, shed={})
+        queue = []
+        for i in range(40):
+            for cls in ("paid", "free", "batch"):
+                queue.append(item(1000 + i * 3, cls=cls))
+        order = []
+        for _ in range(60):
+            kind, items = sched.pop_cb_admit(queue, adm,
+                                             lambda it: 1)
+            assert kind == "cb" and len(items) == 1
+            order.append(items[0]["tenant"])
+        counts = {cls: order.count(cls) for cls in
+                  ("paid", "free", "batch")}
+        assert counts["paid"] == 36 and counts["free"] == 18 \
+            and counts["batch"] == 6
+
+
+class TestBucketExactness:
+    def test_late_join_bit_identical_to_serial(self):
+        """THE exactness guarantee: a prompt that joins a RUNNING batch
+        mid-flight produces a latent bit-identical to its own serial
+        run — per-slot (seed, fold-idx) keys + the shared extracted
+        step callable, for both a deterministic and an ancestral
+        (per-step noise) sampler."""
+        for sampler in ("euler", "euler_ancestral"):
+            p1 = make_prompt(11, steps=3, sampler=sampler)
+            p2 = make_prompt(22, steps=3, sampler=sampler)
+            sig = sched.coalesce_signature(p1)
+            serial = {}
+            for s, p in ((11, p1), (22, p2)):
+                res = WorkflowExecutor(OpContext()).execute(p)
+                serial[s] = np.asarray(res.outputs["8"][0]["samples"]
+                                       .data)
+            i1 = {"id": "a", "prompt": p1, "sig": sig, "cb": True}
+            i2 = {"id": "b", "prompt": p2, "sig": sig, "cb": True}
+            bkt = cb_mod._Bucket(sig, i1, OpContext(), max_slots=4)
+            bkt.admit(i1)
+            bkt.step_once()          # a is mid-flight...
+            bkt.admit(i2)            # ...when b joins at the boundary
+            done = {}
+            for _ in range(10):
+                bkt.step_once()
+                for its, rows, _t in bkt.take_finished():
+                    arr = np.asarray(rows)
+                    for j, it in enumerate(its):
+                        done[it["id"]] = arr[j * bkt.b:(j + 1) * bkt.b]
+                if len(done) == 2:
+                    break
+            assert (done["a"] == serial[11]).all(), sampler
+            assert (done["b"] == serial[22]).all(), sampler
+
+    def test_pad_grows_and_shrinks_along_the_set(self):
+        p = make_prompt(1, steps=4)
+        sig = sched.coalesce_signature(p)
+        it0 = {"id": "x0", "prompt": p, "sig": sig, "cb": True}
+        bkt = cb_mod._Bucket(sig, it0, OpContext(), max_slots=4)
+        assert bkt.pads == [1, 2, 4]
+        bkt.admit(it0)
+        assert bkt.pad == 1
+        for i in range(2):
+            pi = make_prompt(10 + i, steps=4)
+            bkt.admit({"id": f"x{i + 1}", "prompt": pi, "sig": sig,
+                       "cb": True})
+        assert bkt.pad == 4 and bkt.n_active == 3
+        while bkt.n_active:
+            bkt.step_once()
+            bkt.take_finished()
+        # all slots exited together -> pad falls back to the smallest
+        assert bkt.pad == 1 and bkt.retires == 3
+
+    def test_zero_steady_state_retraces_across_occupancy_churn(self):
+        """After one warm pass over a pad size, steps at that size and
+        admit/retire churn within it must not retrace — the per-bucket
+        jitted step + slot plumbing all come from caches keyed on the
+        declared shape set."""
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        p = make_prompt(5, steps=2)
+        sig = sched.coalesce_signature(p)
+        it0 = {"id": "w", "prompt": p, "sig": sig, "cb": True}
+        bkt = cb_mod._Bucket(sig, it0, OpContext(), max_slots=2)
+        # warm: one full admit->step->retire cycle at EACH pad size —
+        # steady state is defined over the declared shape set, so every
+        # pad must have compiled once (exactly what a serving warmup or
+        # the bench's warm pass does)
+        bkt.admit(it0)
+        while bkt.n_active:
+            bkt.step_once()
+            bkt.take_finished()
+        bkt.admit({"id": "w1", "prompt": make_prompt(4, steps=2),
+                   "sig": sig, "cb": True})
+        bkt.admit({"id": "w2", "prompt": make_prompt(6, steps=2),
+                   "sig": sig, "cb": True})
+        while bkt.n_active:
+            bkt.step_once()
+            bkt.take_finished()
+        mark = trace_mod.GLOBAL_RETRACES.mark()
+        for i in range(3):
+            bkt.admit({"id": f"s{i}", "prompt":
+                       make_prompt(100 + i, steps=2), "sig": sig,
+                       "cb": True})
+            bkt.step_once()
+            bkt.take_finished()
+        while bkt.n_active:
+            bkt.step_once()
+            bkt.take_finished()
+        assert trace_mod.GLOBAL_RETRACES.since(mark)["traces"] == 0
+
+
+class TestServerContinuousBatching:
+    def test_interleaved_signatures_all_complete_and_merge(self,
+                                                           tmp_path):
+        """A/B/A interleaved queue through a real CB ServerState: all
+        succeed, the two A prompts share ONE bucket (non-contiguous
+        merge), and the 1-step B exits without waiting for the 3-step
+        A batch to drain (slot-exit order != queue order)."""
+        st = make_state(tmp_path)
+        st._exec_gate.clear()
+        pids = [st.enqueue_prompt(make_prompt(1, steps=3), "c"),
+                st.enqueue_prompt(make_prompt(2, steps=1), "c"),
+                st.enqueue_prompt(make_prompt(3, steps=3), "c")]
+        st._exec_gate.set()
+        hist = wait_history(st, pids)
+        assert all(h["status"] == "success" for h in hist.values())
+        snap = st.cb.snapshot()
+        assert snap["admits"] == 3 and snap["retires"] == 3
+        assert snap["fallbacks"] == 0
+        by_admits = sorted(b["admits"] for b in snap["buckets"])
+        assert by_admits == [1, 2]
+        assert st.drain(20) is True
+
+    def test_slot_exit_order_keeps_png_and_history_provenance(
+            self, tmp_path):
+        """Satellite: images may now finish out of queue order — each
+        saved PNG must still embed ITS OWN prompt's seed and land in
+        its own history entry."""
+        from PIL import Image
+        st = make_state(tmp_path)
+        st._exec_gate.clear()
+        # enqueue the slow prompt FIRST so the fast one overtakes it
+        pids = [st.enqueue_prompt(make_prompt(71, steps=4, save=True),
+                                  "c"),
+                st.enqueue_prompt(make_prompt(72, steps=1, save=True),
+                                  "c")]
+        st._exec_gate.set()
+        hist = wait_history(st, pids)
+        assert all(h["status"] == "success" for h in hist.values())
+        assert all(h["images"] == 1 for h in hist.values())
+        out = tmp_path / "out"
+        embedded = {}
+        for n in os.listdir(out):
+            meta = json.loads(Image.open(out / n).info["prompt"])
+            embedded[meta["8"]["inputs"]["seed"]] = n
+        assert set(embedded) == {71, 72}
+        assert st.drain(20) is True
+
+    def test_ineligible_prompts_ride_the_fallback(self, tmp_path):
+        st = make_state(tmp_path)
+        st._exec_gate.clear()
+        pids = [st.enqueue_prompt(make_prompt(7, steps=1), "c"),
+                st.enqueue_prompt(make_prompt(8, steps=1,
+                                              sampler="heun"), "c")]
+        st._exec_gate.set()
+        hist = wait_history(st, pids)
+        assert all(h["status"] == "success" for h in hist.values())
+        assert st.cb.snapshot()["fallbacks"] >= 1
+        assert st.drain(20) is True
+
+    def test_metrics_surfaces_expose_batching(self, tmp_path):
+        async def body():
+            st = make_state(tmp_path)
+            client = TestClient(TestServer(build_app(st)))
+            await client.start_server()
+            try:
+                m = await (await client.get(
+                    "/distributed/metrics")).json()
+                b = m["batching"]
+                assert b["enabled"] is True
+                assert {"max_slots", "pad_buckets", "slots_active",
+                        "slots_free", "admits", "retires", "steps",
+                        "fallbacks", "buckets"} <= set(b)
+                text = await (await client.get(
+                    "/distributed/metrics.prom")).text()
+                assert 'dtpu_batch_slots{state="active"}' in text
+                assert 'dtpu_batch_slots{state="free"}' in text
+                assert "dtpu_cb_admits_total" in text
+                assert "dtpu_cb_retires_total" in text
+                assert "dtpu_cb_steps_total" in text
+            finally:
+                await client.close()
+                st.drain(5)
+        asyncio.run(body())
+
+    def test_cb_off_keeps_legacy_dispatch(self, tmp_path):
+        """DTPU_CB unset: no executor is constructed and the classic
+        exec loop serves the queue — the default path is untouched."""
+        st = make_state(tmp_path, cb=False)
+        assert st.cb is None
+        pid = st.enqueue_prompt(make_prompt(9, steps=1), "c")
+        hist = wait_history(st, [pid])
+        assert hist[pid]["status"] == "success"
+        assert st.drain(20) is True
