@@ -121,7 +121,7 @@ def parse_args(argv=None):
     p.add_argument("--phase", default=None,
                    choices=["tensor_plane", "pipeline", "observability",
                             "fault", "telemetry", "failover", "overload",
-                            "batching"],
+                            "batching", "reuse"],
                    help="run ONE named software-proxy phase. "
                         "'tensor_plane': repeated 2-image SPMD txt2img on "
                         "the CPU backend reporting host_transfer_mb_per_"
@@ -177,7 +177,15 @@ def parse_args(argv=None):
                         "executor: >=2x imgs/s at equal-or-better p95, "
                         "zero steady-state retraces after the warm "
                         "pass, and a bucket-level late-join "
-                        "continuous==serial bit-exactness check")
+                        "continuous==serial bit-exactness check. "
+                        "'reuse': cross-request compute-reuse proof — a "
+                        "seeded retry/variant storm (exact-hit replay "
+                        ">=10x p50, cached arm >=1.3x imgs/s at equal "
+                        "p95 with shared encodes, zero retraces), a "
+                        "10%%-changed-image re-upscale refining only "
+                        "the dirty tiles with a PNG-identical blend, "
+                        "and an SSE preview client disconnect freeing "
+                        "its CB slot at the next step boundary")
     p.add_argument("--check", action="store_true",
                    help="perf-regression watchdog: after the run, compare "
                         "the fresh result against the most recent prior "
@@ -312,6 +320,8 @@ def metric_name(args):
         return "overload_paid_completion_rate"
     if getattr(args, "phase", None) == "batching":
         return "batching_cb_speedup_poisson"
+    if getattr(args, "phase", None) == "reuse":
+        return "reuse_storm_speedup_retry_variant"
     if args.real_ckpt:
         return (f"real_ckpt_{args.family}_{args.width}x{args.height}_"
                 f"{args.steps}step_sec_per_image")
@@ -332,7 +342,7 @@ def metric_name(args):
 
 
 def metric_unit(args):
-    if getattr(args, "phase", None) in ("pipeline", "batching"):
+    if getattr(args, "phase", None) in ("pipeline", "batching", "reuse"):
         return "x"
     if getattr(args, "phase", None) == "tensor_plane":
         return "sec/run"
@@ -814,6 +824,7 @@ CHECK_TOLERANCE_PCT = {
     "observability_traced_imgs_per_s_4prompt": 15.0,
     "resource_telemetry_imgs_per_s_4prompt": 15.0,
     "batching_cb_speedup_poisson": 15.0,
+    "reuse_storm_speedup_retry_variant": 15.0,
 }
 
 
@@ -1091,6 +1102,26 @@ def _staged_burst(st, n_prompts, steps, seed0=100):
     return pids
 
 
+def _cache_pinned_off():
+    """Pin the cross-request reuse plane OFF (ISSUE 13) for an
+    arm-comparison harness: these measure the COMPUTE pipeline, and the
+    exact-hit result tier would otherwise replay arm 2's identical
+    re-submissions instead of dispatching them.  Returns the previous
+    env value for :func:`_cache_restore`."""
+    from comfyui_distributed_tpu.utils import constants as C
+    prev = os.environ.get(C.CACHE_ENV)
+    os.environ[C.CACHE_ENV] = "0"
+    return prev
+
+
+def _cache_restore(prev):
+    from comfyui_distributed_tpu.utils import constants as C
+    if prev is None:
+        os.environ.pop(C.CACHE_ENV, None)
+    else:
+        os.environ[C.CACHE_ENV] = prev
+
+
 def measure_pipeline(n_prompts: int = 4, steps: int = 2,
                      wait_s: float = 300.0):
     """Serial-vs-overlapped serving comparison on the CPU tiny model —
@@ -1135,36 +1166,44 @@ def measure_pipeline(n_prompts: int = 4, steps: int = 2,
                 busy -= after.get(k, 0.0) - before.get(k, 0.0)
         return max(0.0, min(1.0, 1.0 - busy / max(wall, 1e-9)))
 
-    # --- serial baseline ---------------------------------------------------
-    st = state(overlap=False, coalesce=False)
-    wait_all(st, [st.enqueue_prompt(_pipeline_prompt(1, steps=steps),
-                                    "warm")])       # compile batch-1
-    runs0 = tr.GLOBAL_COUNTERS.get("exec_runs")
-    s0 = stage_totals()
-    t0 = time.perf_counter()
-    wait_all(st, staged_burst(st))
-    serial_s = time.perf_counter() - t0
-    serial_runs = tr.GLOBAL_COUNTERS.get("exec_runs") - runs0
-    serial_idle = idle_fraction(s0, stage_totals(), serial_s,
-                                host_inline=True)
-    st.drain(10)
+    # the exact-hit result cache would replay the overlapped arm's
+    # identical re-submissions (this harness measures the dispatch
+    # pipeline, not the cache) — pin it off for both arms
+    cache_prev = _cache_pinned_off()
+    try:
+        # --- serial baseline -----------------------------------------------
+        st = state(overlap=False, coalesce=False)
+        wait_all(st, [st.enqueue_prompt(_pipeline_prompt(1, steps=steps),
+                                        "warm")])       # compile batch-1
+        runs0 = tr.GLOBAL_COUNTERS.get("exec_runs")
+        s0 = stage_totals()
+        t0 = time.perf_counter()
+        wait_all(st, staged_burst(st))
+        serial_s = time.perf_counter() - t0
+        serial_runs = tr.GLOBAL_COUNTERS.get("exec_runs") - runs0
+        serial_idle = idle_fraction(s0, stage_totals(), serial_s,
+                                    host_inline=True)
+        st.drain(10)
 
-    # --- overlapped + coalesced --------------------------------------------
-    st = state(overlap=True, coalesce=True)
-    wait_all(st, staged_burst(st))                  # compile batch-N
-    runs0 = tr.GLOBAL_COUNTERS.get("exec_runs")
-    batches0 = tr.GLOBAL_COUNTERS.get("coalesced_batches")
-    retrace_mark = tr.GLOBAL_RETRACES.mark()
-    s0 = stage_totals()
-    t0 = time.perf_counter()
-    wait_all(st, staged_burst(st))
-    overlap_s = time.perf_counter() - t0
-    overlap_runs = tr.GLOBAL_COUNTERS.get("exec_runs") - runs0
-    overlap_batches = tr.GLOBAL_COUNTERS.get("coalesced_batches") - batches0
-    retraces = tr.GLOBAL_RETRACES.since(retrace_mark)
-    overlap_idle = idle_fraction(s0, stage_totals(), overlap_s,
-                                 host_inline=False)
-    st.drain(10)
+        # --- overlapped + coalesced ----------------------------------------
+        st = state(overlap=True, coalesce=True)
+        wait_all(st, staged_burst(st))                  # compile batch-N
+        runs0 = tr.GLOBAL_COUNTERS.get("exec_runs")
+        batches0 = tr.GLOBAL_COUNTERS.get("coalesced_batches")
+        retrace_mark = tr.GLOBAL_RETRACES.mark()
+        s0 = stage_totals()
+        t0 = time.perf_counter()
+        wait_all(st, staged_burst(st))
+        overlap_s = time.perf_counter() - t0
+        overlap_runs = tr.GLOBAL_COUNTERS.get("exec_runs") - runs0
+        overlap_batches = tr.GLOBAL_COUNTERS.get("coalesced_batches") \
+            - batches0
+        retraces = tr.GLOBAL_RETRACES.since(retrace_mark)
+        overlap_idle = idle_fraction(s0, stage_totals(), overlap_s,
+                                     host_inline=False)
+        st.drain(10)
+    finally:
+        _cache_restore(cache_prev)
 
     return {
         "n_prompts": n_prompts,
@@ -1535,7 +1574,12 @@ def measure_fault(kill_fraction: float = 0.34, repeats: int = 3,
     os.environ.setdefault("DTPU_DEFAULT_FAMILY", "tiny")
     saved_env = {k: os.environ.get(k)
                  for k in (C.FAULT_POLICY_ENV, C.HEDGE_ENV, C.LEASE_ENV,
-                           C.SUSPECT_PROBES_ENV)}
+                           C.SUSPECT_PROBES_ENV, C.CACHE_ENV)}
+    # same seeded upscale job every round in ONE process: the tile
+    # cache (ISSUE 13) would settle later rounds' units as owner
+    # "cache" before any worker refines — this harness measures the
+    # recovery path, so pin the reuse plane off
+    os.environ[C.CACHE_ENV] = "0"
     # lease/probe tuning for a single-process CPU proxy: jax compute
     # holds the GIL in long stretches, starving the shared event loop —
     # a too-tight lease would declare LIVE workers dead from probe
@@ -1860,7 +1904,13 @@ def measure_failover(steps: int = 1, wait_s: float = 300.0):
     saved_env = {k: os.environ.get(k)
                  for k in (C.WAL_DIR_ENV, C.MASTER_LEASE_ENV, C.LEASE_ENV,
                            C.FAULT_POLICY_ENV, C.HEDGE_ENV,
-                           C.STANDBY_ENV, C.DRAIN_TIMEOUT_ENV)}
+                           C.STANDBY_ENV, C.DRAIN_TIMEOUT_ENV,
+                           C.CACHE_ENV)}
+    # the baseline and kill episodes share one seeded job in one
+    # process: the tile cache (ISSUE 13) would check every unit in as
+    # "cache" at job creation, so the mid-job kill would fire on an
+    # already-complete job — pin the reuse plane off
+    os.environ[C.CACHE_ENV] = "0"
     os.environ[C.MASTER_LEASE_ENV] = "2.0"
     os.environ[C.LEASE_ENV] = "4.0"
     os.environ[C.FAULT_POLICY_ENV] = "reassign"
@@ -2183,7 +2233,12 @@ def measure_overload(duration_s: float = 10.0, wait_s: float = 300.0,
     saved_env = {k: os.environ.get(k)
                  for k in (C.FAULT_POLICY_ENV, C.HEDGE_ENV, C.LEASE_ENV,
                            C.SUSPECT_PROBES_ENV, C.MAX_QUEUE_ENV,
-                           C.TENANT_SHED_ENV, C.HEDGE_MIN_WAIT_ENV)}
+                           C.TENANT_SHED_ENV, C.HEDGE_MIN_WAIT_ENV,
+                           C.CACHE_ENV)}
+    # repeated seeded fan-out jobs in one process: result/tile cache
+    # hits would settle later paid jobs without dispatching — this
+    # harness measures admission + recovery under load, pin reuse off
+    os.environ[C.CACHE_ENV] = "0"
     os.environ[C.FAULT_POLICY_ENV] = "reassign"
     os.environ[C.HEDGE_ENV] = "1"
     # single-process CPU proxy: jax compute starves the shared loop, so
@@ -2680,7 +2735,11 @@ def measure_batching(duration_s: float = 6.0, rates=None, seed: int = 7,
     sigs = ((16, 4), (16, 6))     # (size, steps): two shape buckets
     saved_env = {k: os.environ.get(k)
                  for k in (C.CB_SLOTS_ENV, C.CB_PAD_BUCKETS_ENV,
-                           C.MAX_QUEUE_ENV)}
+                           C.MAX_QUEUE_ENV, C.CACHE_ENV)}
+    # the SAME schedule replays against every arm: the exact-hit result
+    # cache (ISSUE 13) would settle arms 2-3 without dispatching — this
+    # harness measures the dispatch models, so pin the cache off
+    os.environ[C.CACHE_ENV] = "0"
     os.environ[C.CB_SLOTS_ENV] = "8"
     # single pad size: the declared shape set collapses to one entry,
     # making zero-steady-state-retraces a closed-world argument after
@@ -2896,6 +2955,393 @@ def run_batching(args):
     emit(args, payload)
 
 
+def _reuse_img2img_prompt(seed, steps=2, name="cond.png"):
+    """Seeded img2img storm unit: LoadImage -> VAEEncode conditioning +
+    two text encodes feed the sampler — the sub-graph tiers' shape."""
+    return {
+        "7": {"class_type": "CheckpointLoaderSimple",
+              "inputs": {"ckpt_name": "tiny.safetensors"}},
+        "5": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "storm", "clip": ["7", 1]}},
+        "6": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["7", 1]}},
+        "10": {"class_type": "LoadImage", "inputs": {"image": name}},
+        "11": {"class_type": "VAEEncode",
+               "inputs": {"pixels": ["10", 0], "vae": ["7", 2]}},
+        "8": {"class_type": "KSampler",
+              "inputs": {"model": ["7", 0], "positive": ["5", 0],
+                         "negative": ["6", 0], "latent_image": ["11", 0],
+                         "seed": seed, "steps": steps, "cfg": 2.0,
+                         "sampler_name": "euler", "scheduler": "normal",
+                         "denoise": 0.6}},
+        "1": {"class_type": "VAEDecode",
+              "inputs": {"samples": ["8", 0], "vae": ["7", 2]}},
+        "3": {"class_type": "PreviewImage", "inputs": {"images": ["1", 0]}},
+    }
+
+
+def _reuse_upscale_prompt(seed=7, name="src.png"):
+    return {
+        "7": {"class_type": "CheckpointLoaderSimple",
+              "inputs": {"ckpt_name": "tiny.safetensors"}},
+        "5": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "a map", "clip": ["7", 1]}},
+        "6": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["7", 1]}},
+        "10": {"class_type": "LoadImage", "inputs": {"image": name}},
+        "2": {"class_type": "UltimateSDUpscaleDistributed",
+              "inputs": {"upscaled_image": ["10", 0], "model": ["7", 0],
+                         "positive": ["5", 0], "negative": ["6", 0],
+                         "vae": ["7", 2], "seed": seed, "steps": 1,
+                         "cfg": 2.0, "sampler_name": "euler",
+                         "scheduler": "normal", "denoise": 0.4,
+                         "tile_width": 32, "tile_height": 32,
+                         "padding": 8, "mask_blur": 2,
+                         "force_uniform_tiles": True}},
+        "3": {"class_type": "PreviewImage", "inputs": {"images": ["2", 0]}},
+    }
+
+
+def measure_reuse_storm(wait_s: float = 300.0):
+    """Retry/variant-storm arms (ISSUE 13 tiers a+b) on one legacy
+    (coalesce-off — every variant is its own dispatch) serving state.
+
+    The seeded schedule is 3 waves of the same 4 seed-variants: wave 1
+    is first-sight traffic, waves 2-3 are the retry storm.  Cache-off
+    executes all 12; cache-on executes 4 (variants share the text/VAE
+    encodes through the sub-graph tier — proven by the embed-hit
+    counter and the PR 2 determinism making outputs bit-identical
+    either way, covered in tests/test_reuse.py) and replays 8 through
+    the exact-hit tier.  Reported: imgs/s + per-request p50/p95 both
+    arms, the replay-vs-recompute p50 ratio, embed hits, and the
+    cache-on arm's retrace count (0 = the cache never perturbs
+    compiled code)."""
+    import numpy as np
+
+    from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+    from comfyui_distributed_tpu.utils import trace as tr
+    from comfyui_distributed_tpu.utils.image import encode_png
+
+    os.environ.setdefault("DTPU_DEFAULT_FAMILY", "tiny")
+    cache_env_before = os.environ.get("DTPU_CACHE")
+    st = _serving_state(overlap=True, coalesce=False,
+                        prefix="bench_reuse_")
+    rng = np.random.default_rng(13)
+    with open(os.path.join(st.input_dir, "cond.png"), "wb") as f:
+        f.write(encode_png(rng.random((1, 64, 64, 3)).astype("float32")))
+    variants = 4
+    waves = 3
+
+    def submit_wave(seed_base, wave):
+        t_sub = {}
+        st._exec_gate.clear()
+        for v in range(variants):
+            t0 = time.time()
+            pid = st.enqueue_prompt(
+                _reuse_img2img_prompt(seed_base + v), f"storm_w{wave}")
+            t_sub[pid] = t0
+        st._exec_gate.set()
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            if all(p in st._history for p in t_sub):
+                break
+            time.sleep(0.005)
+        lats, replayed = [], 0
+        for pid, t0 in t_sub.items():
+            h = st._history[pid]
+            assert h["status"] == "success", h
+            lats.append(h["finished_at"] - t0)
+            replayed += 1 if h.get("cache_hit") else 0
+        return lats, replayed
+
+    def run_arm(cache_on, seed_base):
+        os.environ["DTPU_CACHE"] = "1" if cache_on else "0"
+        if cache_on:
+            reuse_mod.reset_reuse()
+        lats, exec_lats, replay_lats = [], [], []
+        t0 = time.perf_counter()
+        for wave in range(waves):
+            wl, replayed = submit_wave(seed_base, wave)
+            lats.extend(wl)
+            (replay_lats if wave and cache_on else exec_lats).extend(wl)
+        wall = time.perf_counter() - t0
+        lats.sort()
+        n = variants * waves
+        return {
+            "imgs_per_s": round(n / wall, 4),
+            "wall_s": round(wall, 4),
+            "p50_s": round(lats[n // 2], 4),
+            "p95_s": round(lats[int(0.95 * (n - 1))], 4),
+            "_exec_lats": exec_lats,
+            "_replay_lats": replay_lats,
+        }
+
+    try:
+        # warm the shapes out of the timed path (both arms share them)
+        os.environ["DTPU_CACHE"] = "0"
+        submit_wave(900, 0)
+        off = run_arm(False, seed_base=100)
+        mark = tr.GLOBAL_RETRACES.mark()
+        on = run_arm(True, seed_base=200)
+        on_retraces = tr.GLOBAL_RETRACES.since(mark)["traces"]
+        embed = reuse_mod.get_reuse().subgraph.snapshot()
+        result = reuse_mod.get_reuse().result.snapshot()
+        st.drain(10)
+    finally:
+        if cache_env_before is None:
+            os.environ.pop("DTPU_CACHE", None)
+        else:
+            os.environ["DTPU_CACHE"] = cache_env_before
+    exec_l = sorted(off["_exec_lats"])
+    repl_l = sorted(on["_replay_lats"])
+    p50_exec = exec_l[len(exec_l) // 2]
+    p50_replay = repl_l[len(repl_l) // 2] if repl_l else None
+    for d in (off, on):
+        d.pop("_exec_lats"), d.pop("_replay_lats")
+    return {
+        "schedule": {"variants": variants, "waves": waves,
+                     "requests": variants * waves, "seed": 13},
+        "cache_off": off,
+        "cache_on": on,
+        "storm_speedup": round(on["imgs_per_s"] / off["imgs_per_s"], 3),
+        "replay_p50_s": round(p50_replay, 5) if p50_replay else None,
+        "recompute_p50_s": round(p50_exec, 4),
+        "replay_p50_speedup": round(p50_exec / p50_replay, 1)
+        if p50_replay else 0.0,
+        "replays": result["hits"],
+        "embed_hits": embed["hits"],
+        "cache_on_retraces": int(on_retraces),
+    }
+
+
+def measure_reuse_tiles(wait_s: float = 300.0):
+    """Changed-tile skipping proof (tier c): refine a 4-tile upscale,
+    dirty ONE tile (~10% of the image), re-run — only the dirty tile
+    refines (skip counter == clean count) and the partial blend matches
+    a cache-cleared full re-run bit-identically at the PNG (uint8 wire)
+    level, the same oracle the cluster recovery tests use."""
+    import tempfile
+
+    import numpy as np
+
+    from comfyui_distributed_tpu.ops.base import OpContext
+    from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+    from comfyui_distributed_tpu.utils import trace as tr
+    from comfyui_distributed_tpu.utils.image import encode_png
+    from comfyui_distributed_tpu.workflow.executor import WorkflowExecutor
+
+    reuse_mod.reset_reuse()
+    tmp = tempfile.mkdtemp(prefix="bench_reuse_tile_")
+    rng = np.random.default_rng(13)
+    base = rng.random((1, 64, 64, 3)).astype(np.float32)
+
+    def write(img):
+        with open(os.path.join(tmp, "src.png"), "wb") as f:
+            f.write(encode_png(img))
+
+    ctx = lambda: OpContext(input_dir=tmp, output_dir=tmp)  # noqa: E731
+    write(base)
+    t0 = time.perf_counter()
+    WorkflowExecutor(ctx()).execute(_reuse_upscale_prompt())
+    full_s = time.perf_counter() - t0
+    # clean re-run: every tile skips
+    sk0 = tr.GLOBAL_COUNTERS.get("tiles_skipped")
+    t0 = time.perf_counter()
+    WorkflowExecutor(ctx()).execute(_reuse_upscale_prompt())
+    clean_s = time.perf_counter() - t0
+    clean_skips = tr.GLOBAL_COUNTERS.get("tiles_skipped") - sk0
+    # dirty ONE of the 4 tiles (a ~10% region of the image)
+    dirty = base.copy()
+    dirty[0, :16, :16, :] = 0.5
+    write(dirty)
+    sk1 = tr.GLOBAL_COUNTERS.get("tiles_skipped")
+    t0 = time.perf_counter()
+    partial = WorkflowExecutor(ctx()).execute(_reuse_upscale_prompt())
+    partial_s = time.perf_counter() - t0
+    dirty_skips = tr.GLOBAL_COUNTERS.get("tiles_skipped") - sk1
+    # full-recompute oracle for the dirtied source
+    reuse_mod.get_reuse().clear()
+    oracle = WorkflowExecutor(ctx()).execute(_reuse_upscale_prompt())
+
+    def q(a):
+        return np.clip(a * 255.0 + 0.5, 0, 255).astype(np.uint8)
+
+    return {
+        "tiles_total": 4,
+        "clean_rerun_skips": int(clean_skips),
+        "dirty_rerun_skips": int(dirty_skips),
+        "dirty_tiles_refined": 4 - int(dirty_skips),
+        "full_refine_s": round(full_s, 3),
+        "clean_rerun_s": round(clean_s, 4),
+        "dirty_rerun_s": round(partial_s, 3),
+        "blend_png_identical": bool(np.array_equal(
+            q(partial.images[0]), q(oracle.images[0]))),
+    }
+
+
+def measure_reuse_preview(wait_s: float = 240.0):
+    """Preview/cancellation proof over real HTTP: an SSE subscriber
+    receives step-wise frames from the CB denoise loop; dropping the
+    connection mid-stream abandons the job — the slot exits at the next
+    step boundary (cb_exit span in the flight recorder), the surviving
+    prompts complete 1.0, and both metrics surfaces carry the
+    dtpu_cache_*/dtpu_preview_* counters."""
+    import asyncio
+    import tempfile
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from comfyui_distributed_tpu.server.app import ServerState, build_app
+    from comfyui_distributed_tpu.utils import trace as tr
+
+    tmp = tempfile.mkdtemp(prefix="bench_reuse_prev_")
+
+    async def go():
+        state = ServerState(config_path=os.path.join(tmp, "cfg.json"),
+                            input_dir=tmp, output_dir=tmp, cb=True)
+        client = TestClient(TestServer(build_app(state)))
+        await client.start_server()
+        try:
+            loop = asyncio.get_running_loop()
+            pid_long = await loop.run_in_executor(
+                None, lambda: state.enqueue_prompt(
+                    _pipeline_prompt(1, steps=90), "watcher"))
+            resp = await client.get(f"/distributed/preview/{pid_long}")
+            assert resp.status == 200, resp.status
+            buf = b""
+            frames = 0
+            deadline = time.monotonic() + wait_s
+            while frames < 2 and time.monotonic() < deadline:
+                buf += await resp.content.read(256)
+                frames = buf.count(b"event: preview")
+            resp.close()   # the mid-stream client disconnect
+            survivors = []
+            for i in range(2):
+                survivors.append(await loop.run_in_executor(
+                    None, lambda i=i: state.enqueue_prompt(
+                        _pipeline_prompt(40 + i, steps=2), "other")))
+            deadline = time.monotonic() + wait_s
+            want = [pid_long] + survivors
+            while time.monotonic() < deadline:
+                if all(p in state._history for p in want):
+                    break
+                await asyncio.sleep(0.05)
+            hist = {p: state._history.get(p) for p in want}
+            snap = state.cb.snapshot()
+            rec = tr.GLOBAL_TRACES.get(pid_long)
+            exit_span = bool(rec) and any(
+                s["name"] == "cb_exit" for s in rec["spans"])
+            m = await (await client.get("/distributed/metrics")).json()
+            prom = await (await client.get(
+                "/distributed/metrics.prom")).text()
+            return {
+                "preview_frames_received": frames,
+                "abandoned_status": (hist[pid_long] or {}).get("status"),
+                "survivor_completion": sum(
+                    1 for p in survivors
+                    if (hist[p] or {}).get("status") == "success")
+                / len(survivors),
+                "slots_active_after": snap["slots_active"],
+                "cb_abandoned": snap["abandoned"],
+                "slot_exit_span_in_trace": exit_span,
+                "json_surface_ok": bool(
+                    m.get("reuse", {}).get("previews") is not None
+                    and m.get("prompts_abandoned") == 1),
+                "prom_surface_ok": (
+                    "dtpu_jobs_abandoned_total 1" in prom
+                    and "dtpu_preview_events_total" in prom
+                    and "dtpu_cache_hits_total" in prom),
+            }
+        finally:
+            await client.close()
+
+    return asyncio.run(go())
+
+
+def run_reuse(args):
+    """``--phase reuse``: the cross-request compute-reuse proof
+    (ISSUE 13) — on a seeded retry/variant-storm schedule the exact-hit
+    replay p50 must be >=10x faster than recompute and the cached arm
+    >=1.3x imgs/s over cache-off at equal-or-better p95 with the
+    embeddings demonstrably shared; a 10%-changed re-upscale refines
+    ONLY the dirty tiles with a PNG-identical blend; zero retraces in
+    the cached arm; and a mid-stream SSE disconnect frees its CB slot
+    at the next step boundary with completion 1.0 for the survivors."""
+    from comfyui_distributed_tpu.parallel.mesh import force_cpu_platform
+    force_cpu_platform(1)
+    enable_compile_cache()
+    storm = measure_reuse_storm()
+    tiles = measure_reuse_tiles()
+    preview = measure_reuse_preview()
+    log(f"reuse storm: on {storm['cache_on']['imgs_per_s']} imgs/s vs "
+        f"off {storm['cache_off']['imgs_per_s']} "
+        f"({storm['storm_speedup']}x); replay p50 "
+        f"{storm['replay_p50_s']}s vs recompute "
+        f"{storm['recompute_p50_s']}s ({storm['replay_p50_speedup']}x); "
+        f"embed hits {storm['embed_hits']}; tiles: "
+        f"{tiles['dirty_rerun_skips']}/{tiles['tiles_total']} skipped, "
+        f"png_identical {tiles['blend_png_identical']}; preview: "
+        f"{preview['preview_frames_received']} frames, abandoned -> "
+        f"{preview['abandoned_status']}, survivors "
+        f"{preview['survivor_completion']}")
+    payload = {
+        "metric": metric_name(args),
+        "value": storm["storm_speedup"],
+        "unit": metric_unit(args),
+        "vs_baseline": storm["storm_speedup"],
+        "storm": storm,
+        "tiles": tiles,
+        "preview": preview,
+    }
+    problems = []
+    if storm["replay_p50_speedup"] < 10.0:
+        problems.append(f"exact-hit replay p50 only "
+                        f"{storm['replay_p50_speedup']}x faster than "
+                        "recompute (bar: 10x)")
+    if storm["storm_speedup"] < 1.3:
+        problems.append(f"storm speedup {storm['storm_speedup']}x < "
+                        "1.3x over cache-off")
+    if storm["cache_on"]["p95_s"] > storm["cache_off"]["p95_s"] * 1.10:
+        problems.append(
+            f"cache-on p95 {storm['cache_on']['p95_s']}s worse than "
+            f"cache-off {storm['cache_off']['p95_s']}s")
+    if storm["embed_hits"] < 2 * (storm["schedule"]["variants"] - 1):
+        problems.append(f"embed hits {storm['embed_hits']} — the "
+                        "variants did not share their encodes")
+    if storm["cache_on_retraces"] != 0:
+        problems.append(f"{storm['cache_on_retraces']} retraces in the "
+                        "cached arm (must be 0)")
+    if tiles["dirty_rerun_skips"] != tiles["tiles_total"] - 1:
+        problems.append(
+            f"dirty re-run skipped {tiles['dirty_rerun_skips']} of "
+            f"{tiles['tiles_total']} tiles (want clean count "
+            f"{tiles['tiles_total'] - 1})")
+    if not tiles["blend_png_identical"]:
+        problems.append("changed-tile blend differs from the full "
+                        "re-run oracle")
+    if preview["preview_frames_received"] < 1:
+        problems.append("no SSE preview frames arrived")
+    if preview["abandoned_status"] != "abandoned":
+        problems.append(f"disconnected job finished as "
+                        f"{preview['abandoned_status']!r}, not "
+                        "abandoned")
+    if preview["survivor_completion"] != 1.0:
+        problems.append(f"survivor completion "
+                        f"{preview['survivor_completion']} != 1.0")
+    if preview["slots_active_after"] != 0:
+        problems.append("abandoned slot never freed")
+    if not preview["slot_exit_span_in_trace"]:
+        problems.append("no cb_exit slot-exit span in the abandoned "
+                        "job's trace")
+    if not (preview["json_surface_ok"] and preview["prom_surface_ok"]):
+        problems.append("dtpu_cache_*/dtpu_preview_* counters missing "
+                        "from a metrics surface")
+    if problems:
+        payload["error"] = {"stage": "reuse_invariants",
+                            "detail": "; ".join(problems)}
+    emit(args, payload)
+
+
 def run_suite(args):
     """The driver's default invocation: budget-capped backend escape
     (ladder_budget — ≤~20% of the claim window), then cheapest-first
@@ -2973,6 +3419,14 @@ def run_suite(args):
         cbp = _phase_subprocess("batching", extra=("--check",))
         if cbp is not None:
             payload_b["stages"]["batching"] = cbp
+        # reuse watchdog stage: the CPU proxy re-proves the cross-
+        # request compute-reuse contract (exact-hit replay, storm
+        # speedup at equal p95, changed-tile-only upscaling, client-
+        # gone slot free) and --check flags a storm-speedup regression
+        # against the prior BENCH artifact
+        ru = _phase_subprocess("reuse", extra=("--check",))
+        if ru is not None:
+            payload_b["stages"]["reuse"] = ru
         emit(args, payload_b)
     finally:
         try:
@@ -3407,6 +3861,8 @@ def main():
             run_overload(args)
         elif args.phase == "batching":
             run_batching(args)
+        elif args.phase == "reuse":
+            run_reuse(args)
         elif args.real_ckpt:
             run_real_ckpt(args)
         elif args.multiproc_sweep:

@@ -367,6 +367,45 @@ def cmd_fleet(args) -> int:
     return 0
 
 
+def cmd_reuse(args) -> int:
+    """Cross-request reuse reader (ISSUE 13): per-tier cache
+    hits/misses/evictions and byte residency against their budgets,
+    the exact-hit replay count, tile skips, and the preview channel's
+    client/abandonment gauges — the headless answer to "is the fleet
+    actually reusing work"."""
+    import urllib.request
+    with urllib.request.urlopen(f"{args.url}/distributed/metrics",
+                                timeout=10) as r:
+        data = json.loads(r.read())
+    reuse = data.get("reuse") or {}
+    if args.json:
+        print(json.dumps(reuse, indent=2))
+        return 0
+    if not reuse:
+        print("(no reuse block reported — older server?)")
+        return 1
+    print(f"reuse plane: enabled={reuse.get('enabled')} "
+          f"total={reuse.get('bytes_total', 0) / 1e6:.1f}MB "
+          f"generation={reuse.get('generation', 0)}")
+    print(f"{'tier':8s} {'entries':>7s} {'mb':>9s} {'budget_mb':>9s} "
+          f"{'hits':>7s} {'misses':>7s} {'evict':>6s}")
+    for tier in ("result", "embed", "tile"):
+        t = reuse.get(tier) or {}
+        print(f"{tier:8s} {t.get('entries', 0):>7d} "
+              f"{t.get('bytes', 0) / 1e6:>9.1f} "
+              f"{t.get('max_bytes', 0) / 1e6:>9.1f} "
+              f"{t.get('hits', 0):>7d} {t.get('misses', 0):>7d} "
+              f"{t.get('evictions', 0):>6d}")
+    print(f"replays={data.get('prompts_replayed', 0)} "
+          f"abandoned={data.get('prompts_abandoned', 0)}")
+    pv = reuse.get("previews") or {}
+    print(f"previews: enabled={pv.get('enabled')} "
+          f"clients={pv.get('clients', 0)} "
+          f"watched={pv.get('watched_prompts', 0)} "
+          f"abandon_pending={pv.get('abandoned_pending', 0)}")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Flight-recorder reader: no id lists recent job traces; with an id,
     pretty-prints the job's span tree (indent = parent/child, one line
@@ -604,6 +643,15 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the pretty report")
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser("reuse", help="cross-request reuse status: "
+                                     "per-tier cache counters/residency, "
+                                     "exact-hit replays, tile skips, "
+                                     "preview clients")
+    p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the table")
+    p.set_defaults(fn=cmd_reuse)
 
     p = sub.add_parser("wal", help="dump/verify a write-ahead job log: "
                                    "segments, checksums, lease, per-job "

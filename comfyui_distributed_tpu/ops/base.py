@@ -151,6 +151,12 @@ class OpContext:
     # KSampler's capture mode — it records its resolved inputs here and
     # raises CBCapture instead of sampling (bucket-build prefix run)
     cb_capture: Optional[Dict[str, Any]] = None
+    # cross-request compute reuse (runtime/reuse.py): the EXECUTING
+    # node's input-sub-graph content hash, set per node by the executor
+    # when the subtree is content-addressable (else None) — the
+    # sub-graph memo tiers (CLIPTextEncode embeddings, VAEEncode
+    # conditioning latents) key their device caches on it
+    content_key: Optional[str] = None
 
     def check_interrupt(self):
         if self.interrupt_event is not None and self.interrupt_event.is_set():

@@ -953,14 +953,50 @@ class DiffControlNetLoader(Op):
         return ((module, summed),)
 
 
+def _embed_cache_get(ctx: OpContext, kind: str):
+    """Sub-graph memo lookup for an encode op (runtime/reuse.py): the
+    key is the executor-computed input-sub-graph content hash, so a
+    retry/variant storm pays text-encode once.  Returns (key, hit);
+    key None = not addressable or caching off.  A hit stamps the node's
+    span ``cache_hit``/``cache_tier`` so `cli trace` shows the skip."""
+    from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+    from comfyui_distributed_tpu.utils import trace as trace_mod
+    if not reuse_mod.reuse_enabled() or not ctx.content_key:
+        return None, None
+    key = f"{kind}:{ctx.content_key}"
+    hit = reuse_mod.get_reuse().subgraph.get(key)
+    if hit is not None:
+        sp = trace_mod.current_span()
+        if sp is not None:
+            sp.attrs["cache_hit"] = True
+            sp.attrs["cache_tier"] = "embed"
+    return key, hit
+
+
+def _embed_cache_put(key, value, nbytes: int) -> None:
+    from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+    if key is not None:
+        reuse_mod.get_reuse().subgraph.put(key, value, nbytes)
+
+
+def _cond_nbytes(cond: "Conditioning") -> int:
+    from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+    return reuse_mod.conditioning_nbytes(cond)
+
+
 @register_op
 class CLIPTextEncode(Op):
     TYPE = "CLIPTextEncode"
     WIDGETS = ["text"]
 
     def execute(self, ctx: OpContext, clip, text: str):
+        key, hit = _embed_cache_get(ctx, "embed")
+        if hit is not None:
+            return (hit,)
         context, pooled = clip.encode_prompt([text])
-        return (Conditioning(context=context, pooled=pooled),)
+        cond = Conditioning(context=context, pooled=pooled)
+        _embed_cache_put(key, cond, _cond_nbytes(cond))
+        return (cond,)
 
 
 @register_op
@@ -1101,14 +1137,19 @@ class CLIPTextEncodeSDXL(Op):
                 crop_w: int = 0, crop_h: int = 0,
                 target_width: int = 0, target_height: int = 0,
                 text_g: str = "", text_l: str = ""):
+        key, hit = _embed_cache_get(ctx, "embed_sdxl")
+        if hit is not None:
+            return (hit,)
         tw = int(target_width) or int(width)
         th = int(target_height) or int(height)
         context, pooled = clip.encode_prompt([str(text_l)],
                                              texts_alt=[str(text_g)])
-        return (Conditioning(
+        cond = Conditioning(
             context=context, pooled=pooled,
             size_cond=(int(height), int(width), int(crop_h), int(crop_w),
-                       th, tw)),)
+                       th, tw))
+        _embed_cache_put(key, cond, _cond_nbytes(cond))
+        return (cond,)
 
 
 @register_op
@@ -2376,11 +2417,23 @@ class VAEEncode(Op):
     TYPE = "VAEEncode"
 
     def execute(self, ctx: OpContext, pixels, vae):
+        # sub-graph memo (runtime/reuse.py): the PRE-expansion encoded
+        # latent is cached on device keyed by the input sub-graph's
+        # content hash — a retry/variant storm over the same
+        # conditioning image pays VAE-encode once.  Donation-safe: a
+        # cached device array reaches the sampler un-fresh, and
+        # _prepare_sample_inputs only donates freshly-materialized
+        # buffers.
+        from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+        key, hit = _embed_cache_get(ctx, "vaeenc")
+        if hit is not None:
+            return _expand_encoded_latent(ctx, pixels, hit)
         # device path: a DeviceImage source (hires-fix chain) never
         # bounces through host on its way into the encoder
         img = as_device_image(pixels)
         with Timer("vae_encode"):
             lat = vae.vae_encode(img)
+        _embed_cache_put(key, lat, reuse_mod.nbytes_of(lat))
         return _expand_encoded_latent(ctx, pixels, lat)
 
 

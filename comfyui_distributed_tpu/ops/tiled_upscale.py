@@ -51,6 +51,31 @@ from comfyui_distributed_tpu.utils.logging import Timer, debug_log, log
 from comfyui_distributed_tpu.utils.net import post_form_with_retry, run_async_in_loop
 
 
+def _tile_cache_eligible(pipe, positive: Conditioning,
+                         negative: Conditioning) -> bool:
+    """Changed-tile skipping is armed only for the plain refine case:
+    canvas-global single-entry conditioning and an unpatched model.
+    Regional masks resolve per tile POSITION (content identity is not
+    enough), and model patches change the refine function in ways the
+    key does not capture — those runs skip the tier, never mis-hit."""
+    for c in (positive, negative):
+        if getattr(c, "siblings", ()) \
+                or getattr(c, "area_mask", None) is not None \
+                or getattr(c, "timestep_range", None) is not None \
+                or getattr(c, "control", None) is not None \
+                or getattr(c, "concat_latent", None) is not None \
+                or getattr(c, "unclip", None) is not None \
+                or getattr(c, "gligen", None) is not None:
+            return False
+    if getattr(pipe, "perp_neg_cond", None) is not None:
+        return False
+    for attr in ("sag_params", "hypernets", "deep_shrink_spec",
+                 "cfg_rescale"):
+        if getattr(pipe, attr, None):
+            return False
+    return True
+
+
 @register_op
 class UltimateSDUpscaleDistributed(Op):
     TYPE = "UltimateSDUpscaleDistributed"
@@ -345,6 +370,69 @@ class UltimateSDUpscaleDistributed(Op):
                 (x2 - x1, y2 - y1), p["mask_blur"])
         return np.clip(canvas, 0.0, 1.0)[None]
 
+    # --- changed-tile skipping (ISSUE 13 tier c) ----------------------------
+
+    def _tile_cache_probe(self, pipe, positive, negative, p,
+                          tiles: np.ndarray, indices: Sequence[int],
+                          refined: Dict[int, np.ndarray]):
+        """Per-tile content-addressed lookup (runtime/reuse.py): key =
+        model identity + conditioning fingerprint + refine params +
+        tile index (its seed is ``seed + idx``) + the extracted
+        window's bytes.  Hits land in ``refined`` (the stored refined
+        window, bit-identical to what the producing run blended) and
+        bump the ``tiles_skipped`` counter + span attr; returns
+        ``{tile_idx: key}`` for storing misses, or None when the tier
+        is off or this refine is ineligible."""
+        import jax
+
+        from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        if not reuse_mod.reuse_enabled() \
+                or not _tile_cache_eligible(pipe, positive, negative):
+            return None
+        if jax.process_count() > 1:
+            # multihost SPMD: every process must execute the SAME
+            # program, but the caches are per-process — divergent dirty
+            # sets would enter the sharded refine with different batch
+            # shapes and hang the collectives
+            return None
+        plane = reuse_mod.get_reuse()
+        salt = plane.model_salt(pipe)
+        if salt is None:
+            return None
+        key_list = reuse_mod.tile_keys(
+            salt,
+            reuse_mod.conditioning_fingerprint(positive, negative),
+            p, tiles, [int(i) for i in indices])
+        keys = dict(zip((int(i) for i in indices), key_list))
+        hits = 0
+        for i in keys:
+            win = plane.tiles.get(keys[i])
+            if win is not None:
+                refined[i] = win
+                hits += 1
+        if hits:
+            trace_mod.GLOBAL_COUNTERS.bump("tiles_skipped", hits)
+            sp = trace_mod.current_span()
+            if sp is not None:
+                sp.attrs["tiles_skipped"] = \
+                    int(sp.attrs.get("tiles_skipped", 0)) + hits
+        return keys
+
+    @staticmethod
+    def _tile_cache_store(keys, refined: Dict[int, np.ndarray],
+                          only=None) -> None:
+        if keys is None:
+            return
+        from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+        plane = reuse_mod.get_reuse()
+        for i, win in refined.items():
+            if only is not None and i not in only:
+                continue
+            key = keys.get(int(i))
+            if key is not None:
+                plane.tiles.put(key, win, reuse_mod.tile_nbytes(win))
+
     # --- SPMD path ----------------------------------------------------------
 
     def _run_spmd(self, ctx: OpContext, image: np.ndarray, pipe,
@@ -353,26 +441,41 @@ class UltimateSDUpscaleDistributed(Op):
         all_tiles = tiling.calculate_tiles(w, h, p["tile_w"], p["tile_h"])
         total = len(all_tiles)
         d = max(ctx.fanout, 1)
-        padded_total = coll.pad_to_multiple(total, d) if d > 1 else total
-        positions = list(all_tiles) + [all_tiles[0]] * (padded_total - total)
-        indices = list(range(total)) + [0] * (padded_total - total)
-
-        log(f"tiled upscale: {total} tiles ({w}x{h}, {p['tile_w']}x"
-            f"{p['tile_h']}+{p['padding']}) over {d} mesh slot(s)"
-            + (f", padded to {padded_total}" if padded_total != total else ""))
         with Timer("tile_extract"):
-            tiles = tiling.extract_tiles(image, positions, p["tile_w"],
+            tiles = tiling.extract_tiles(image, all_tiles, p["tile_w"],
                                          p["tile_h"], p["padding"])
-        with Timer("tile_refine"):
-            refined = self._refine_batch(ctx, pipe, tiles, indices,
-                                         positive, negative, p,
-                                         positions=positions,
-                                         img_size=(w, h),
-                                         shard=(d > 1))
+        # changed-tile skipping: unchanged windows replay their stored
+        # refined tiles; only the dirty set reaches the mesh
+        refined: Dict[int, np.ndarray] = {}
+        keys = self._tile_cache_probe(pipe, positive, negative, p,
+                                      tiles, range(total), refined)
+        dirty = [i for i in range(total) if i not in refined]
+        if refined:
+            log(f"tiled upscale: {len(refined)}/{total} tiles unchanged "
+                f"(cache hits); refining {len(dirty)}")
+        if dirty:
+            padded_n = coll.pad_to_multiple(len(dirty), d) if d > 1 \
+                else len(dirty)
+            indices = list(dirty) + [dirty[0]] * (padded_n - len(dirty))
+            positions = [all_tiles[i] for i in indices]
+            log(f"tiled upscale: {len(dirty)} tiles ({w}x{h}, "
+                f"{p['tile_w']}x{p['tile_h']}+{p['padding']}) over {d} "
+                f"mesh slot(s)"
+                + (f", padded to {padded_n}" if padded_n != len(dirty)
+                   else ""))
+            rows = tiles[indices]
+            with Timer("tile_refine"):
+                out_rows = self._refine_batch(ctx, pipe, rows, indices,
+                                              positive, negative, p,
+                                              positions=positions,
+                                              img_size=(w, h),
+                                              shard=(d > 1))
+            fresh = {i: out_rows[k] for k, i in enumerate(indices)
+                     if k < len(dirty)}
+            self._tile_cache_store(keys, fresh)
+            refined.update(fresh)
         with Timer("tile_blend"):
-            out = self._blend_all(
-                image, {i: refined[k] for k, i in enumerate(indices)
-                        if k < total}, all_tiles, p)
+            out = self._blend_all(image, refined, all_tiles, p)
         return (out,)
 
     # --- worker HTTP path ---------------------------------------------------
@@ -564,6 +667,29 @@ class UltimateSDUpscaleDistributed(Op):
         mine = parts[0]
         active_workers = sum(1 for part in parts[1:] if part)
 
+        # changed-tile skipping (ISSUE 13 tier c): hash every extracted
+        # window BEFORE the ledger plans the job — cached units check in
+        # immediately (owner "cache", exactly-once like any other
+        # completion), so the pending set the drain waits on is ONLY the
+        # dirty tiles, and duplicate sends from workers that still
+        # refined their full partition lose the first-wins race
+        from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+        cached: Dict[int, np.ndarray] = {}
+        tile_keys = None
+        windows_all = None
+        if reuse_mod.reuse_enabled() \
+                and _tile_cache_eligible(pipe, positive, negative):
+            with Timer("tile_extract"):
+                windows_all = tiling.extract_tiles(
+                    image, all_tiles, p["tile_w"], p["tile_h"],
+                    p["padding"])
+            tile_keys = self._tile_cache_probe(
+                pipe, positive, negative, p, windows_all,
+                range(len(all_tiles)), cached)
+        if cached:
+            log(f"tiled upscale master: {len(cached)}/{len(all_tiles)} "
+                f"tiles unchanged (cache hits)")
+
         # work ledger (cluster control plane): record which participant
         # owns which tile indices BEFORE any work happens — completions
         # check in through it (exactly-once at the blend) and whatever is
@@ -575,6 +701,9 @@ class UltimateSDUpscaleDistributed(Op):
                 for i in part:
                     owners[int(i)] = workers[wi]
             ledger.create_job(multi_job_id, owners, kind="tile")
+            for i, win in cached.items():
+                ledger.check_in(multi_job_id, i, "cache",
+                                payload=([win], {"form": "window"}))
 
         def refine_units(units: Sequence[int]) -> Dict[int, np.ndarray]:
             """Master-local refine of arbitrary units (the recovery and
@@ -582,13 +711,20 @@ class UltimateSDUpscaleDistributed(Op):
             is bit-identical to what the lost/straggling owner would
             have produced."""
             units = [int(u) for u in units]
-            t = tiling.extract_tiles(image, [all_tiles[i] for i in units],
-                                     p["tile_w"], p["tile_h"],
-                                     p["padding"])
+            if windows_all is not None:
+                # the cache probe already extracted every window —
+                # reuse its rows instead of re-slicing the image
+                t = windows_all[units]
+            else:
+                t = tiling.extract_tiles(
+                    image, [all_tiles[i] for i in units],
+                    p["tile_w"], p["tile_h"], p["padding"])
             out = self._refine_batch(
                 ctx, pipe, t, units, positive, negative, p,
                 positions=[all_tiles[i] for i in units], img_size=(w, h))
-            return {i: out[k] for k, i in enumerate(units)}
+            out = {i: out[k] for k, i in enumerate(units)}
+            self._tile_cache_store(tile_keys, out)
+            return out
 
         # pre-create the tile queue BEFORE refining our own range: workers
         # may finish first, and put_tile requires an existing queue (the
@@ -600,7 +736,11 @@ class UltimateSDUpscaleDistributed(Op):
                               ctx.server_loop, timeout=C.QUEUE_INIT_TIMEOUT)
 
         try:
-            refined: Dict[int, np.ndarray] = {}
+            refined: Dict[int, np.ndarray] = dict(cached)
+            if ledger is None:
+                # no ledger to shrink the pending set through: the
+                # cached units simply leave the master's own range
+                mine = [i for i in mine if int(i) not in cached]
             if ledger is not None:
                 # crash recovery (durability plane): units completed
                 # before the old master died blend straight from their
@@ -632,6 +772,12 @@ class UltimateSDUpscaleDistributed(Op):
                     ctx, multi_job_id, active_workers,
                     refine_window=refine_units)
                 for tile_idx, item in collected.items():
+                    if int(tile_idx) in cached:
+                        # ledger-less dedupe: a worker's send for a tile
+                        # the cache already settled must not displace
+                        # the stored window (with a ledger the
+                        # first-wins check-in already dropped it)
+                        continue
                     if "window_tensor" in item:
                         # master-local recovery/hedge result: already at
                         # window size
@@ -641,6 +787,9 @@ class UltimateSDUpscaleDistributed(Op):
                         # window size
                         refined[int(tile_idx)] = self._worker_tile_to_window(
                             item, all_tiles[int(tile_idx)], p, (w, h))
+                        self._tile_cache_store(
+                            tile_keys, {int(tile_idx):
+                                        refined[int(tile_idx)]})
 
             # post-drain recovery: units still pending (collection
             # deadline fired, or an in-drain recovery failed) are

@@ -61,6 +61,7 @@ from aiohttp import web
 from comfyui_distributed_tpu.ops.base import OpContext
 from comfyui_distributed_tpu.runtime import autoscale as autoscale_mod
 from comfyui_distributed_tpu.runtime import cluster as cluster_mod
+from comfyui_distributed_tpu.runtime import reuse as reuse_mod
 from comfyui_distributed_tpu.runtime.jobs import JobStore
 from comfyui_distributed_tpu.utils import chaos as chaos_mod
 from comfyui_distributed_tpu.runtime.manager import (
@@ -162,6 +163,9 @@ class ServerState:
         self.metrics: Dict[str, Any] = {
             "prompts_executed": 0, "prompts_failed": 0,
             "images_received": 0, "tiles_received": 0,
+            # cross-request reuse (ISSUE 13): exact-hit replays and
+            # client-gone abandonments are neither executed nor failed
+            "prompts_replayed": 0, "prompts_abandoned": 0,
             "last_execution_s": None,
         }
         self.max_queue = int(os.environ.get(C.MAX_QUEUE_ENV,
@@ -204,6 +208,12 @@ class ServerState:
         self.host_pool = net_mod.HostIOPool() if self.overlap_enabled \
             else None
         self._queue: List[Dict[str, Any]] = []
+        # every admitted-but-not-finalized prompt id (queued, in a CB
+        # slot, mid-decode, or in a fallback group): the preview
+        # route's authoritative liveness check — the queue/CB-slot
+        # views individually have handoff windows where a live prompt
+        # is in neither
+        self._inflight: set = set()        # guarded-by: self._queue_lock
         self._queue_lock = threading.Lock()
         self._queue_event = threading.Event()
         # bench/test hook: the exec loop waits here before popping, so a
@@ -344,6 +354,23 @@ class ServerState:
             from comfyui_distributed_tpu.workflow import \
                 batch_executor as cb_mod
             cb_ok = cb_mod.quick_eligible(prompt)
+        # exact-hit result cache (ISSUE 13 tier a): a byte-identical
+        # re-submission (same signature AND same full widget values,
+        # seed included) replays the stored outputs without ever
+        # touching the queue — history/metrics/span stamped cache_hit.
+        # DTPU_CACHE=0 skips the key computation entirely; recovery
+        # re-enqueues always re-execute (their first run may not have
+        # finished storing).
+        rkey = None
+        if not self.is_worker and not _recovered \
+                and reuse_mod.reuse_enabled():
+            rkey = reuse_mod.result_key(prompt, input_dir=self.input_dir,
+                                        models_dir=self.models_dir)
+            if rkey is not None:
+                entry = reuse_mod.get_reuse().result.get(rkey)
+                if entry is not None:
+                    self._replay_cached(pid, sp, entry)
+                    return pid
         with self._queue_lock:
             if self._draining:
                 self._abandon_span(sp, pid, "rejected: draining")
@@ -370,9 +397,11 @@ class ServerState:
                                 "extra_data": extra_data or {},
                                 "sig": sig,
                                 "cb": cb_ok,
+                                "rkey": rkey,
                                 "tenant": tenant,
                                 "span": sp,
                                 "t_enq": time.perf_counter()})
+            self._inflight.add(pid)
         # write-ahead: the admission record is durable BEFORE the
         # prompt_id reaches the client (a crash after the append but
         # before the response re-runs the prompt — at-least-once at the
@@ -383,6 +412,59 @@ class ServerState:
             self.durable.log_enqueue(pid, prompt, client_id, extra_data)
         self._queue_event.set()
         return pid
+
+    def _replay_cached(self, pid: str, sp,
+                       entry: Dict[str, Any]) -> None:
+        """Exact-hit replay: settle the prompt NOW from the stored
+        outputs.  The history entry and the committed job span look
+        like a normal success, distinguished by ``cache_hit`` — a
+        client polling /history cannot tell the difference except by
+        latency.  Counted ONLY as ``prompts_replayed``: nothing
+        executed (prompts_executed stays honest), nothing was admitted
+        (the per-class completed counter would break
+        admitted >= completed), and no queue slot freed (the
+        drain-rate ring feeds the Retry-After estimate)."""
+        done_t = time.time()
+        self.metrics["prompts_replayed"] += 1
+        trace_mod.GLOBAL_COUNTERS.bump("cache_result_replays")
+        trace_mod.GLOBAL_STAGES.record("cache_replay", 0.0)
+        self._history[pid] = {
+            "status": "success",
+            "images": len(entry.get("images", ())),
+            "duration_s": 0.0,
+            "cache_hit": True,
+            "finished_at": done_t,
+        }
+        if sp is not None:
+            sp.attrs["cache_hit"] = True
+            sp.attrs["cache_tier"] = "result"
+            sp.end()
+            trace_mod.GLOBAL_TRACES.commit(
+                pid, sp.trace_id, status="ok", root_span_id=sp.span_id,
+                duration_s=round(done_t - sp.start_s, 6))
+
+    def _purge_abandoned(self) -> int:
+        """Client-gone cancellation for prompts still IN the queue: the
+        exec/CB driver calls this before popping, so an abandoned job
+        never starts executing.  Each purged prompt finalizes as
+        ``abandoned`` through the normal finalize path (history, WAL
+        record, sealed span)."""
+        bus = reuse_mod.PREVIEWS
+        with self._queue_lock:
+            if not self._queue:
+                return 0
+            doomed = [it for it in self._queue
+                      if bus.is_abandoned(it["id"])]
+            if not doomed:
+                return 0
+            gone = {id(it) for it in doomed}
+            self._queue = [it for it in self._queue
+                           if id(it) not in gone]
+        err = reuse_mod.AbandonedError(
+            "client disconnected before execution")
+        for item in doomed:
+            self._finalize_hand([item], None, err, time.perf_counter())
+        return len(doomed)
 
     @staticmethod
     def _abandon_span(sp, pid: str, reason: str) -> None:
@@ -429,6 +511,7 @@ class ServerState:
         while True:
             self._queue_event.wait()
             self._exec_gate.wait()
+            self._purge_abandoned()
             group = self._pop_group()
             if group is None:
                 continue
@@ -537,6 +620,7 @@ class ServerState:
                 err = e
         k = len(group)
         done_t = time.time()
+        abandoned = isinstance(err, reuse_mod.AbandonedError)
         if err is None:
             per_prompt = sched_mod.split_images(res.images, k)
             # metrics BEFORE history: clients poll history for
@@ -544,9 +628,28 @@ class ServerState:
             # them a window where the prompt is "done" but uncounted
             self.metrics["prompts_executed"] += k
             self.metrics["last_execution_s"] = res.total_s
+            reuse_on = reuse_mod.reuse_enabled()
             for item, imgs in zip(group, per_prompt):
                 entry = {"status": "success", "images": len(imgs),
                          "duration_s": res.total_s,
+                         "finished_at": done_t}
+                if k > 1:
+                    entry["coalesced"] = k
+                self._history[item["id"]] = entry
+                # exact-hit result tier: store the per-prompt outputs
+                # so a byte-identical re-submission replays instead of
+                # recomputing (LRU-bounded by DTPU_CACHE_BYTES)
+                if reuse_on and item.get("rkey") and imgs:
+                    reuse_mod.store_result(item["rkey"], imgs,
+                                           res.total_s)
+        elif abandoned:
+            # client-gone cancellation: settled, not failed — the WAL
+            # completion record below closes the admission record so a
+            # crash-recovery never resurrects an abandoned job
+            log(f"prompt group {group[0]['id']} (x{k}) abandoned: {err}")
+            self.metrics["prompts_abandoned"] += k
+            for item in group:
+                entry = {"status": "abandoned", "error": str(err),
                          "finished_at": done_t}
                 if k > 1:
                     entry["coalesced"] = k
@@ -561,20 +664,20 @@ class ServerState:
                 if k > 1:
                     entry["coalesced"] = k
                 self._history[item["id"]] = entry
+        # seal each prompt's trace: end the job span, commit to the
+        # flight recorder under the prompt id, and emit the always-on
+        # slow-job line when the end-to-end span exceeds DTPU_SLOW_JOB_S
+        status = "ok" if err is None \
+            else ("abandoned" if abandoned else "error")
         if self.durable is not None:
             # the completion record closes the admission record: a
             # crash BEFORE this point re-runs the prompt on recovery
             # (deterministic seeds make the redo bit-identical), after
             # it the prompt is settled history
             for item in group:
-                self.durable.log_exec_done(
-                    item["id"], "ok" if err is None else "error")
+                self.durable.log_exec_done(item["id"], status)
         for item in group:
             self._drop_tile_queues(item["prompt"])
-        # seal each prompt's trace: end the job span, commit to the
-        # flight recorder under the prompt id, and emit the always-on
-        # slow-job line when the end-to-end span exceeds DTPU_SLOW_JOB_S
-        status = "ok" if err is None else "error"
         slow_thr = 0.0
         try:
             slow_thr = float(os.environ.get(C.SLOW_JOB_ENV, "0") or 0)
@@ -613,7 +716,7 @@ class ServerState:
             if sp is None:
                 continue
             if err is not None:
-                sp.set_status("error", str(err))
+                sp.set_status(status, str(err))
                 # the job never set its execute-span mem attrs (the
                 # exception aborted the executor) — stamp the root so
                 # the error trace still answers "how much memory"
@@ -644,8 +747,15 @@ class ServerState:
             for item in group:
                 self.admission.on_complete(
                     item.get("tenant") or self.admission.default_class)
+        # preview channel: terminal SSE event for any attached client,
+        # and the abandonment flag (if set) is consumed — the job is
+        # settled either way
+        for item in group:
+            reuse_mod.PREVIEWS.finish(item["id"], status)
         with self._queue_lock:
             self._finalize_pending -= 1
+            for item in group:
+                self._inflight.discard(item["id"])
         debug_log(f"group {group[0]['id']} (x{k}) done in "
                   f"{time.perf_counter() - t0:.2f}s")
 
@@ -718,6 +828,12 @@ class ServerState:
                 idle = self.cb.idle()
             if idle and (self.host_pool is None
                          or self.host_pool.pending == 0):
+                if self.cb is not None:
+                    # drained for shutdown: stop the step driver so a
+                    # dead ServerState's threads don't keep polling the
+                    # process-global interrupt/queue state (loopback
+                    # tests and benches run many states per process)
+                    self.cb.stop()
                 return True
             if time.monotonic() >= deadline:
                 break
@@ -729,6 +845,8 @@ class ServerState:
         # samplers poll the flag per step)
         with self._queue_lock:
             purged, self._queue = self._queue, []
+            for item in purged:
+                self._inflight.discard(item["id"])
         done_t = time.time()
         for item in purged:
             self._abandon_span(item.get("span"), item["id"],
@@ -741,6 +859,15 @@ class ServerState:
         log(f"drain timeout after {timeout:.1f}s; cancelled "
             f"{len(purged)} queued prompt(s), interrupting in-flight work")
         self.interrupt_event.set()
+        if self.cb is not None:
+            # give the driver a beat to consume the interrupt (aborting
+            # its slots), then stop its threads — a timed-out drain must
+            # not leak a live driver polling process-global state any
+            # more than a clean one does
+            stop_by = time.monotonic() + 2.0
+            while time.monotonic() < stop_by and not self.cb.idle():
+                time.sleep(0.02)
+            self.cb.stop()
         if self.host_pool is not None:
             self.host_pool.shutdown(wait=False)
         return False
@@ -935,6 +1062,15 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                   # fault counters (all zero unarmed)
                                   "chaos": chaos_mod.get_chaos()
                                   .snapshot(),
+                                  # cross-request compute reuse: per-tier
+                                  # hit/miss/eviction counters + byte
+                                  # residency, and the preview channel's
+                                  # client/abandonment gauges
+                                  "reuse": {
+                                      **reuse_mod.get_reuse().snapshot(),
+                                      "previews":
+                                          reuse_mod.PREVIEWS.snapshot(),
+                                  },
                                   # resource telemetry: current gauges +
                                   # bounded ring-series stats (device
                                   # memory, RSS, utilization, queue)
@@ -1085,6 +1221,43 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                  [({"bucket": b["sig"]}, b["retraces"])
                   for b in bsnap["buckets"]]),
             ])
+        # cross-request reuse + preview channel (ISSUE 13): per-tier
+        # cache counters and byte gauges, tile-skip and abandonment
+        # counters — the acceptance's dtpu_cache_*/dtpu_preview_*
+        # families on the scrapeable surface
+        rs = reuse_mod.get_reuse().snapshot()
+        pv = reuse_mod.PREVIEWS.snapshot()
+        tiers = ("result", "embed", "tile")
+        extra.extend([
+            ("dtpu_cache_hits_total", "counter",
+             "Reuse-cache hits by tier.",
+             [({"tier": t}, rs[t]["hits"]) for t in tiers]),
+            ("dtpu_cache_misses_total", "counter",
+             "Reuse-cache misses by tier.",
+             [({"tier": t}, rs[t]["misses"]) for t in tiers]),
+            ("dtpu_cache_evictions_total", "counter",
+             "Reuse-cache LRU evictions by tier.",
+             [({"tier": t}, rs[t]["evictions"]) for t in tiers]),
+            ("dtpu_cache_bytes", "gauge",
+             "Bytes resident in the reuse cache by tier.",
+             [({"tier": t}, rs[t]["bytes"]) for t in tiers]),
+            ("dtpu_cache_replays_total", "counter",
+             "Prompts settled by exact-hit replay.",
+             [({}, state.metrics["prompts_replayed"])]),
+            ("dtpu_cache_tiles_skipped_total", "counter",
+             "Upscale tiles skipped via per-tile content hashes.",
+             [({}, trace_mod.GLOBAL_COUNTERS.get("tiles_skipped"))]),
+            ("dtpu_preview_clients", "gauge",
+             "Attached SSE preview clients.",
+             [({}, pv["clients"])]),
+            ("dtpu_preview_events_total", "counter",
+             "Progressive preview frames published.",
+             [({}, trace_mod.GLOBAL_COUNTERS.get("preview_events"))]),
+            ("dtpu_jobs_abandoned_total", "counter",
+             "Jobs abandoned by client disconnect (queue purges + "
+             "freed CB slots).",
+             [({}, state.metrics["prompts_abandoned"])]),
+        ])
         if state.autoscaler is not None:
             asnap = state.autoscaler.snapshot()
             extra.extend([
@@ -1250,20 +1423,27 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             before = resource_mod.device_memory_snapshot()
             rss_before = resource_mod.host_rss_bytes()
             registry.clear_pipeline_cache()
+            # invalidate the cross-request reuse plane (ISSUE 13): a
+            # reloaded checkpoint must never replay a stale entry, and
+            # the freed residency belongs in this route's before/after
+            # snapshot like every other cache it drops
+            cache_freed = reuse_mod.get_reuse().clear()
             jax.clear_caches()
             for _ in range(3):
                 gc.collect()
             after = resource_mod.device_memory_snapshot()
             rss_after = resource_mod.host_rss_bytes()
-            return before, rss_before, after, rss_after
+            return before, rss_before, after, rss_after, cache_freed
 
-        before, rss_before, after, rss_after = await asyncio \
+        before, rss_before, after, rss_after, cache_freed = await asyncio \
             .get_running_loop().run_in_executor(None, clear)
         freed = max(before["bytes_in_use"] - after["bytes_in_use"], 0)
         log(f"cleared model/jit caches (freed {freed / 1e6:.1f} MB "
-            f"device, source={after['source']})")
+            f"device, {cache_freed / 1e6:.1f} MB reuse cache, "
+            f"source={after['source']})")
         return ok({
             "freed_bytes": freed,
+            "cache_freed_bytes": cache_freed,
             "device_bytes_before": before["bytes_in_use"],
             "device_bytes_after": after["bytes_in_use"],
             "host_rss_before": rss_before,
@@ -2044,6 +2224,96 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     async def history(request):
         return web.json_response(state._history)
 
+    def _prompt_live(pid: str) -> bool:
+        """Whether the prompt is admitted and not yet finalized (the
+        authoritative _inflight set — the queue/CB-slot views have
+        handoff windows).  An unknown id must never arm a dangling
+        abandonment flag or pin a preview-client slot."""
+        with state._queue_lock:
+            return pid in state._inflight
+
+    async def preview_stream(request):
+        """Server-sent events: step-wise progressive previews for one
+        prompt (``event: preview`` frames with a base64 PNG of the
+        denoising latent, then one ``event: done``).  The stream is
+        ALSO the cancellation channel: when the last subscriber
+        disconnects before the job finishes, the job is abandoned — a
+        queued prompt is purged, a CB slot exits at the next step
+        boundary, and the WAL records the abandonment."""
+        if not reuse_mod.previews_enabled():
+            return web.json_response(
+                {"error": f"previews disabled ({C.PREVIEW_ENV}=0)"},
+                status=403)
+        pid = request.match_info["prompt_id"]
+        if pid not in state._history and not _prompt_live(pid):
+            # unknown id: refuse BEFORE subscribing — an endless-ping
+            # stream per garbage id would otherwise pin slots under the
+            # DTPU_PREVIEW_MAX_CLIENTS cap indefinitely
+            return web.json_response(
+                {"error": f"unknown prompt {pid!r} (not queued, not "
+                          "executing, not in history)"}, status=404)
+        bus = reuse_mod.PREVIEWS
+        q = bus.subscribe(pid)
+        if q is None:
+            return web.json_response(
+                {"error": "too many preview clients"}, status=429)
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache",
+                     "X-Accel-Buffering": "no"})
+        disconnected = False
+        try:
+            await resp.prepare(request)
+            last_beat = time.monotonic()
+            while True:
+                ev = None
+                try:
+                    ev = q.get_nowait()
+                except queue.Empty:
+                    pass
+                if ev is None:
+                    hist = state._history.get(pid)
+                    if hist is not None:
+                        ev = {"type": "done", "prompt_id": pid,
+                              "status": hist.get("status", "done")}
+                    else:
+                        now = time.monotonic()
+                        if now - last_beat >= 1.0:
+                            # heartbeat comment: disconnect detection
+                            # between preview frames (a write to a
+                            # closed transport raises)
+                            await resp.write(b": ping\n\n")
+                            last_beat = now
+                        await asyncio.sleep(0.05)
+                        continue
+                await resp.write(
+                    f"event: {ev['type']}\n"
+                    f"data: {json.dumps(ev)}\n\n".encode())
+                if ev.get("type") == "done":
+                    break
+            await resp.write_eof()
+        except asyncio.CancelledError:
+            # aiohttp cancels the handler when the client disconnects
+            disconnected = True
+            raise
+        except (ConnectionResetError, ConnectionError):
+            disconnected = True
+        finally:
+            remaining = bus.unsubscribe(pid, q)
+            if disconnected and remaining == 0 \
+                    and pid not in state._history and _prompt_live(pid):
+                # client gone = cancellation signal: flag the job; the
+                # CB driver's boundary scan / queue purge finalizes it
+                bus.abandon(pid)
+                state._queue_event.set()
+                if pid in state._history:
+                    # finalize raced the disconnect: the job settled
+                    # between our liveness check and the flag — consume
+                    # the stale flag (finish() already ran; nothing
+                    # else ever would, and the set must not leak)
+                    bus.clear_abandoned(pid)
+        return resp
+
     r.add_get("/distributed/config", get_config)
     r.add_post("/distributed/config/update_worker", update_worker)
     r.add_post("/distributed/config/delete_worker", delete_worker)
@@ -2085,6 +2355,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_post("/distributed/job_complete", job_complete)
     r.add_post("/distributed/tile_complete", tile_complete)
     r.add_post("/distributed/load_image", load_image)
+    r.add_get("/distributed/preview/{prompt_id}", preview_stream)
     r.add_get("/prompt", get_prompt)
     r.add_post("/prompt", post_prompt)
     r.add_post("/interrupt", interrupt)

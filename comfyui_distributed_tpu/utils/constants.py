@@ -140,6 +140,63 @@ CB_ADMIT_WINDOW_DEFAULT = 0.0
 # slot's step N is a pure function of (x, sigma_N, sigma_N+1, keys)
 CB_SAFE_SAMPLERS = frozenset({"euler", "ddim", "euler_ancestral"})
 
+# --- cross-request compute reuse (runtime/reuse.py) ---------------------------
+# Three content-addressed cache tiers + the SSE preview/cancellation
+# channel.  DTPU_CACHE=0 is a TRUE kill switch (no key computed, no
+# cache touched on any hot path — the DTPU_RESOURCE=0 pattern); each
+# tier has its own LRU byte budget, and the resource monitor samples
+# the total into a bounded ``cache_bytes`` ring so residency is
+# observable next to RSS/HBM.
+CACHE_ENV = "DTPU_CACHE"                 # "0" disables every tier
+CACHE_BYTES_ENV = "DTPU_CACHE_BYTES"     # exact-hit result tier budget
+CACHE_BYTES_DEFAULT = 256 << 20
+CACHE_DEVICE_BYTES_ENV = "DTPU_CACHE_DEVICE_BYTES"  # on-device sub-graph tier
+CACHE_DEVICE_BYTES_DEFAULT = 128 << 20
+CACHE_TILE_BYTES_ENV = "DTPU_CACHE_TILE_BYTES"      # refined-tile tier
+CACHE_TILE_BYTES_DEFAULT = 256 << 20
+CACHE_ENTRIES_ENV = "DTPU_CACHE_ENTRIES"  # per-tier entry cap
+CACHE_ENTRIES_DEFAULT = 256
+# progressive previews over SSE (GET /distributed/preview/<prompt_id>):
+# the continuous-batching denoise driver publishes a cheap latent->RGB
+# frame at step boundaries WHILE a subscriber is attached; a client
+# that disconnects mid-stream abandons the job (its CB slot exits at
+# the next step boundary; queued copies are purged).
+PREVIEW_ENV = "DTPU_PREVIEW"             # "0" disables the SSE route
+PREVIEW_EVERY_ENV = "DTPU_PREVIEW_EVERY"  # publish every N steps
+PREVIEW_EVERY_DEFAULT = 1
+PREVIEW_MAX_CLIENTS_ENV = "DTPU_PREVIEW_MAX_CLIENTS"
+PREVIEW_MAX_CLIENTS_DEFAULT = 64
+
+# Node types whose output is a pure function of (widgets, upstream
+# content keys) — the sub-graph memoization's addressable set
+# (runtime/reuse.subgraph_keys).  Deliberately conservative: these feed
+# the two cached producers (text-encoder embeddings via CLIPTextEncode,
+# VAE-encoded conditioning via VAEEncode).  LoadImage is addressable
+# through a file-stat salt (name + mtime + size), so a re-upload under
+# the same name misses instead of aliasing.
+REUSE_KEY_NODE_TYPES = frozenset({
+    "CheckpointLoaderSimple", "CLIPSetLastLayer", "LoraLoader",
+    "LoraLoaderModelOnly", "CLIPTextEncode", "CLIPTextEncodeSDXL",
+    "CLIPTextEncodeSDXLRefiner", "LoadImage", "VAEEncode",
+    "ImageScale", "EmptyLatentImage",
+})
+
+# Node types a whole graph may consist of and still be EXACT-HIT result
+# cacheable (tier a): every type is a deterministic pure function of
+# its widgets/inputs (seeded samplers included), with the only
+# out-of-graph state — LoadImage's file — folded into the key as a
+# stat salt.  Distributed nodes never qualify (their outputs depend on
+# fleet topology and per-dispatch hidden state), and neither does
+# SaveImage: its contract is a NEW counter-numbered file on disk per
+# queue, a side effect a replay cannot honor from stored arrays —
+# SaveImage graphs execute every time, only collect-in-memory graphs
+# (PreviewImage) replay.
+RESULT_CACHE_SAFE_NODE_TYPES = (COALESCE_SAFE_NODE_TYPES | frozenset({
+    "LoadImage", "VAEEncode", "VAEEncodeTiled", "ImageScale",
+    "CLIPTextEncodeSDXL", "CLIPTextEncodeSDXLRefiner",
+    "KSamplerAdvanced",
+})) - frozenset({"SaveImage"})
+
 # --- observability (request-scoped tracing + telemetry) ----------------------
 # Dapper-style always-on request tracing (utils/trace.py spans): every job
 # gets a trace; spans propagate over the distributed HTTP edges via
@@ -392,6 +449,8 @@ TRACE_ATTR_WHITELIST = frozenset({
     # resource attribution (ISSUE 5)
     "device_peak_mb", "rss_mb", "mem_peak_mb", "mem_peak_delta_mb",
     "mem_source",
+    # cross-request compute reuse (ISSUE 13)
+    "cache_hit", "cache_tier", "tiles_skipped",
 })
 
 # --- persistent compilation cache -------------------------------------------

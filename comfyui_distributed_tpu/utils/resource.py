@@ -49,7 +49,7 @@ from comfyui_distributed_tpu.utils.logging import debug_log
 
 # series names every monitor samples (rings + gauges + prom families)
 SERIES = ("device_bytes_in_use", "device_peak_bytes", "host_rss_bytes",
-          "utilization", "queue_depth")
+          "utilization", "queue_depth", "cache_bytes")
 
 
 # --- probes ------------------------------------------------------------------
@@ -122,6 +122,18 @@ def device_memory_snapshot() -> Dict[str, Any]:
             "bytes_limit": None, "n_devices": 0, "source": "host_rss"}
 
 
+def _cache_bytes() -> int:
+    """Reuse-plane residency (ISSUE 13): the caches are LRU-bounded by
+    DTPU_CACHE_* budgets, and sampling their total into a ring puts the
+    residency next to RSS/HBM on every surface the monitor feeds.
+    Never constructs the plane just to measure it."""
+    try:
+        from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+        return reuse_mod.cache_bytes_total()
+    except Exception:  # noqa: BLE001 - telemetry must never fail a sample
+        return 0
+
+
 def snapshot_now(queue_depth: Optional[int] = None,
                  utilization: Optional[float] = None) -> Dict[str, Any]:
     """One full resource sample (the heartbeat/federation wire shape)."""
@@ -134,6 +146,7 @@ def snapshot_now(queue_depth: Optional[int] = None,
         "host_rss_bytes": host_rss_bytes(),
         "utilization": utilization,
         "queue_depth": queue_depth,
+        "cache_bytes": _cache_bytes(),
         "source": mem["source"],
     }
 
@@ -264,6 +277,7 @@ class ResourceMonitor:
             t, snap["device_bytes_in_use"])
         self.series["device_peak_bytes"].append(t, snap["device_peak_bytes"])
         self.series["host_rss_bytes"].append(t, snap["host_rss_bytes"])
+        self.series["cache_bytes"].append(t, snap["cache_bytes"])
         if snap["utilization"] is not None:
             self.series["utilization"].append(t, snap["utilization"])
         if qd is not None:
@@ -425,6 +439,7 @@ def _host_only_snapshot() -> Dict[str, Any]:
         "host_rss_bytes": rss,
         "utilization": None,
         "queue_depth": None,
+        "cache_bytes": _cache_bytes(),
         "source": "host_rss",
     }
 
@@ -475,6 +490,9 @@ def resource_prom_families(
          "timeline.", "utilization"),
         ("dtpu_res_queue_depth",
          "Prompts queued or executing at sample time.", "queue_depth"),
+        ("dtpu_res_cache_bytes",
+         "Bytes resident in the cross-request reuse caches.",
+         "cache_bytes"),
     ]
     fams = []
     for fam, help_text, key in gauges:

@@ -59,6 +59,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from comfyui_distributed_tpu.ops.base import DeviceLatent, OpContext
+from comfyui_distributed_tpu.runtime import reuse as reuse_mod
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.logging import debug_log, log
@@ -499,6 +500,18 @@ class _Bucket:
         self.retires += len(done)
         return out
 
+    def drop_slots(self, drop: List[int]) -> List[Dict[str, Any]]:
+        """Slice out specific slots at a step boundary (client-gone
+        cancellation): their rows leave the batch, the pad compacts
+        along the pad set, the rest keep stepping.  Returns the dropped
+        items."""
+        doomed = set(drop)
+        items = [self.slots[i].item for i in sorted(doomed)]
+        keep = [i for i in range(len(self.slots)) if i not in doomed]
+        self.slots = [self.slots[i] for i in keep]
+        self._repad(keep)
+        return items
+
     def abort_all(self) -> List[Dict[str, Any]]:
         items = [s.item for s in self.slots]
         self.slots = []
@@ -532,7 +545,8 @@ class ContinuousBatchExecutor:
         self._lock = threading.Lock()
         self._stats = {"admits": 0, "retires": 0, "steps": 0,
                        "fallbacks": 0, "retraces": 0,
-                       "pad_transitions": 0}       # guarded-by: self._lock
+                       "pad_transitions": 0,
+                       "abandoned": 0}             # guarded-by: self._lock
         self._bucket_stats: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
         self._active = 0                           # guarded-by: self._lock
         self._tailing = 0                          # guarded-by: self._lock
@@ -650,6 +664,7 @@ class ContinuousBatchExecutor:
         fairness says stop.  Returns True when anything was dispatched
         (admitted or handed to the fallback)."""
         st = self.state
+        st._purge_abandoned()
         got = False
         while not self._stop:
             if not st._exec_gate.is_set():
@@ -752,7 +767,52 @@ class ContinuousBatchExecutor:
         self._rr = (self._rr + 1) % len(live)
         return live[self._rr]
 
+    def _drop_abandoned(self, bkt: _Bucket) -> None:
+        """Client-gone cancellation (runtime/reuse.PreviewBus): slots
+        whose last preview subscriber disconnected exit HERE, at the
+        step boundary — their rows leave the batch immediately (freeing
+        the slot for the next admit), and the job finalizes as
+        ``abandoned`` (history/WAL/span all record it)."""
+        bus = reuse_mod.PREVIEWS
+        doomed = [i for i, s in enumerate(bkt.slots)
+                  if bus.is_abandoned(s.item["id"])]
+        if not doomed:
+            return
+        items = bkt.drop_slots(doomed)
+        err = reuse_mod.AbandonedError(
+            "client disconnected mid-denoise")
+        now_wall = time.time()
+        trace_mod.GLOBAL_COUNTERS.bump("cb_abandoned", len(items))
+        with self._lock:
+            self._stats["abandoned"] += len(items)
+        for item in items:
+            if item.get("span") is not None:
+                trace_mod.event_span("cb_exit", now_wall, now_wall,
+                                     parent=item["span"],
+                                     attrs={"bucket": bkt.sig[:8]})
+            debug_log(f"cb: {item['id']} abandoned (client gone); "
+                      f"slot freed at step boundary")
+            self.state._finalize_hand([item], None, err,
+                                      time.perf_counter())
+        self._mirror_stats()
+
+    def _publish_previews(self, bkt: _Bucket) -> None:
+        """Step-wise progressive previews: one cheap latent->RGB frame
+        per WATCHED slot every DTPU_PREVIEW_EVERY boundaries.  The
+        wants() screen keeps the unwatched steady state at one dict
+        lookup per active slot."""
+        bus = reuse_mod.PREVIEWS
+        every = reuse_mod.preview_every()
+        for i, slot in enumerate(bkt.slots):
+            pid = slot.item["id"]
+            if slot.step % every == 0 and bus.wants(pid):
+                bus.publish_latent(pid, slot.step, bkt.n_steps,
+                                   bkt.x[i * bkt.b])
+
     def _step_and_retire(self, bkt: _Bucket) -> None:
+        self._drop_abandoned(bkt)
+        if not bkt.slots:
+            return
         mark = trace_mod.GLOBAL_RETRACES.mark()
         t0 = time.perf_counter()
         try:
@@ -786,6 +846,8 @@ class ContinuousBatchExecutor:
         with self._lock:
             self._stats["steps"] += 1
             self._stats["retraces"] += traced
+        if reuse_mod.previews_enabled():
+            self._publish_previews(bkt)
         finished = bkt.take_finished()
         now_wall = time.time()
         for items, rows, t_admit in finished:
@@ -819,16 +881,35 @@ class ContinuousBatchExecutor:
                     st._exec_gate.wait(0.05)
                     continue
                 if st.interrupt_event.is_set():
-                    # abort active slots; only CONSUME the flag when the
-                    # fallback executor is idle — a mid-group fallback
-                    # job must still see its interrupt (its per-step
-                    # poll / op-boundary checks read the same event)
-                    if not self._fallback_busy:
+                    active = any(b.n_active
+                                 for b in self._buckets.values())
+                    if active or self._fallback_busy:
+                        # abort active slots; only CONSUME the flag when
+                        # the fallback executor is idle — a mid-group
+                        # fallback job must still see its interrupt (its
+                        # per-step poll / op-boundary checks read the
+                        # same event)
+                        if not self._fallback_busy:
+                            st.interrupt_event.clear()
+                        self._abort_active(
+                            InterruptedError("execution interrupted"))
+                        time.sleep(0.005)
+                        continue
+                    if st._queue_event.is_set():
+                        # stale flag with fresh work queued: consume it
+                        # at the dispatch boundary exactly like the
+                        # legacy exec loop's group start
                         st.interrupt_event.clear()
-                    self._abort_active(
-                        InterruptedError("execution interrupted"))
-                    time.sleep(0.005)
-                    continue
+                    else:
+                        # nothing here to interrupt: the process-global
+                        # flag is NOT ours to consume — another
+                        # ServerState in this process (or a directly
+                        # driven sampler) may be its target, and an
+                        # idle driver eating it would make /interrupt
+                        # a no-op for them (the leaked-driver bug the
+                        # per-step-interrupt tests caught)
+                        st._queue_event.wait(timeout=0.05)
+                        continue
                 admitted = self._admit_boundary()
                 bkt = self._next_bucket()
                 if bkt is None:

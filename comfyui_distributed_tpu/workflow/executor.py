@@ -134,6 +134,16 @@ class WorkflowExecutor:
         graph = workflow if isinstance(workflow, Graph) \
             else parse_workflow(workflow)
         hidden = hidden or {}
+        # cross-request compute reuse (runtime/reuse.py): one pass over
+        # the graph computes each addressable node's input-sub-graph
+        # content hash; the encode ops key their device memo caches on
+        # it.  DTPU_CACHE=0 skips the pass entirely (kill switch).
+        from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+        reuse_keys: Dict[str, str] = {}
+        if reuse_mod.reuse_enabled():
+            reuse_keys = reuse_mod.subgraph_keys(
+                graph, hidden, input_dir=self.ctx.input_dir,
+                models_dir=self.ctx.models_dir)
         # fresh per-run collection state (assign, don't clear — prior
         # ExecutionResults keep their own lists)
         self.ctx.saved_images = []
@@ -183,6 +193,7 @@ class WorkflowExecutor:
                                              or nid in fan_nodes) else 1
                 node = graph.nodes[nid]
                 op = get_op(node.class_type)
+                self.ctx.content_key = reuse_keys.get(nid)
                 kwargs: Dict[str, Any] = {}
                 for name, value in node.inputs.items():
                     if name == "__widgets__":

@@ -86,6 +86,10 @@ _MODULE_COST_S = {
     # bit-exactness proof is the priciest call at ~8s warm)
     "test_batching.py": 30,
     "test_tiling.py": 10,
+    # cross-request compute reuse (PR 13): non-slow share only (the
+    # tile-tier bit-exactness proofs and the SSE client-gone acceptance
+    # are slow-marked in-file, ~25s together with real refine runs)
+    "test_reuse.py": 25,
 }
 
 
