@@ -67,6 +67,30 @@ def cmd_worker(args) -> int:
     return 0
 
 
+def cmd_router(args) -> int:
+    """Stateless admission router for the multi-master control plane
+    (ISSUE 14): spreads /prompt by prompt-id hash over the consistent-
+    hash ring (pulled from the masters, refreshed on failure) and
+    serves the merged multi-shard read views `cli fleet`/`cli top`/
+    `cli cluster` render.  Holds no queue, no WAL, no leases — run as
+    many replicas as you like."""
+    from aiohttp import web
+
+    from comfyui_distributed_tpu.runtime.shard import build_router_app
+    from comfyui_distributed_tpu.utils import constants as C
+    masters = [u for u in (args.masters or os.environ.get(
+        C.ROUTER_MASTERS_ENV, "")).split(",") if u.strip()]
+    if not masters:
+        print(f"no masters: pass --masters or set "
+              f"{C.ROUTER_MASTERS_ENV}", file=sys.stderr)
+        return 2
+    app = build_router_app(masters)
+    print(f"router listening on {args.host}:{args.port} over "
+          f"{len(masters)} seed master(s)", file=sys.stderr)
+    web.run_app(app, host=args.host, port=args.port, print=None)
+    return 0
+
+
 def cmd_run(args) -> int:
     if args.via:
         return _run_via_server(args)
@@ -621,9 +645,20 @@ def main(argv=None) -> int:
     p.add_argument("--url", default="http://127.0.0.1:8288")
     p.set_defaults(fn=cmd_status)
 
+    def master_alias(p):
+        # multi-master (ISSUE 14): `--master <url>` names one master OR
+        # a router — a router URL renders the merged multi-shard view
+        # from its federated endpoints
+        p.add_argument("--master", dest="url", default=argparse.SUPPRESS,
+                       metavar="URL",
+                       help="master (or router) base URL; a router URL "
+                            "renders the merged multi-shard view "
+                            "(alias of --url)")
+
     p = sub.add_parser("cluster", help="worker lease states + work-ledger "
                                        "jobs from a running master")
     p.add_argument("--url", default="http://127.0.0.1:8288")
+    master_alias(p)
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the pretty table")
     p.set_defaults(fn=cmd_cluster)
@@ -632,6 +667,7 @@ def main(argv=None) -> int:
                                    "utilization per participant from the "
                                    "master's federated metrics")
     p.add_argument("--url", default="http://127.0.0.1:8288")
+    master_alias(p)
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the table")
     p.set_defaults(fn=cmd_top)
@@ -640,9 +676,21 @@ def main(argv=None) -> int:
                                      "decisions + signal, per-tenant "
                                      "admission counters, chaos spec")
     p.add_argument("--url", default="http://127.0.0.1:8288")
+    master_alias(p)
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the pretty report")
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser("router", help="stateless multi-master admission "
+                                      "router: /prompt spread by "
+                                      "prompt-id hash over the ring, "
+                                      "merged multi-shard read views")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8290)
+    p.add_argument("--masters", default=None,
+                   help="comma-separated master URLs (default "
+                        "$DTPU_ROUTER_MASTERS)")
+    p.set_defaults(fn=cmd_router)
 
     p = sub.add_parser("reuse", help="cross-request reuse status: "
                                      "per-tier cache counters/residency, "

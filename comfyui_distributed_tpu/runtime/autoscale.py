@@ -100,13 +100,19 @@ class FleetAutoscaler:
                  cooldown_s: Optional[float] = None,
                  interval_s: Optional[float] = None,
                  drain_s: Optional[float] = None,
-                 flap_window_s: Optional[float] = None):
+                 flap_window_s: Optional[float] = None,
+                 shard: Optional[Any] = None):
         self.registry = registry
         self.queue_depth_fn = queue_depth_fn
         self.util_fn = util_fn
         self.spawner = spawner
         self.retirer = retirer
         self.worker_queue_fn = worker_queue_fn
+        # multi-master federation (ISSUE 14): the ShardManager (or None)
+        # — its gossiped peer queue depths fold into the signal, so each
+        # shard's reconciliation sees the MERGED fleet pressure instead
+        # of only its own slice
+        self.shard = shard
         self.min_workers = _env_int(C.AUTOSCALE_MIN_ENV,
                                     C.AUTOSCALE_MIN_DEFAULT) \
             if min_workers is None else int(min_workers)
@@ -193,15 +199,31 @@ class FleetAutoscaler:
                 util = self.util_fn()
             except Exception as e:  # noqa: BLE001
                 debug_log(f"autoscale: util probe failed: {e}")
-        participants = 1 + live          # master serves too
-        depth = master_q + worker_q
-        return {
+        # multi-master federation: peer masters' gossiped queue depths
+        # (each already includes THAT shard's worker backlog view only
+        # for its own queue — workers are shared, so their heartbeat
+        # backlog is counted once, here) merge into one fleet signal
+        peer_q = 0
+        peer_masters = 0
+        if self.shard is not None:
+            try:
+                peer_q = int(self.shard.peer_queue_depth())
+                peer_masters = int(self.shard.live_peer_masters())
+            except Exception as e:  # noqa: BLE001 - signal survives
+                debug_log(f"autoscale: shard signal failed: {e}")
+        participants = 1 + live + peer_masters   # masters serve too
+        depth = master_q + worker_q + peer_q
+        out = {
             "queue_depth": depth,
             "queue_per_participant": depth / participants,
             "utilization": util,
             "live_workers": live,
             "participants": participants,
         }
+        if self.shard is not None:
+            out["peer_masters"] = peer_masters
+            out["peer_queue_depth"] = peer_q
+        return out
 
     # -- decision -------------------------------------------------------------
 
@@ -269,6 +291,21 @@ class FleetAutoscaler:
             under_ready = under and self._under_streak >= self.window
         if self._in_cooldown(now):
             return {**signal, "action": action, "cooldown": True}
+        # federated actuation (ISSUE 14): every sharded master folds
+        # the same gossiped backlog into its signal, so N independent
+        # actuators would spawn/retire N times for ONE backlog (and
+        # amplify the very flap the hysteresis damps).  The ring
+        # designates exactly one actuator; the others keep sampling —
+        # and reaping their own in-flight retirements, above — but
+        # defer new scale actions to the designated shard.
+        if self.shard is not None:
+            try:
+                actuator = bool(self.shard.is_autoscale_actuator())
+            except Exception:  # noqa: BLE001 - fail open: act alone
+                actuator = True
+            if not actuator:
+                return {**signal, "action": action, "cooldown": False,
+                        "actuator": False}
         live = signal["live_workers"]
         if over_ready and live < self.max_workers \
                 and self.spawner is not None:
@@ -495,6 +532,7 @@ def install(state) -> Optional[FleetAutoscaler]:
         util_fn=util,
         spawner=default_spawner(state),
         retirer=default_retirer(state),
+        shard=getattr(state, "shard", None),
     )
     scaler.start()
     return scaler

@@ -395,6 +395,11 @@ class WorkLedger:
         self._wal = None
         self._unit_store = None
         self._recovered_jobs: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
+        # multi-master takeover (ISSUE 14): an ABSORBED shard's
+        # recovered jobs carry their own UnitStore (the dead shard's
+        # spill dir) — preload/blend reads come from THERE, while new
+        # check-ins spill into this master's own store/WAL
+        self._recovered_stores: Dict[str, Any] = {}  # guarded-by: self._lock
 
     def attach_wal(self, wal, unit_store,
                    recovered_jobs: Optional[Dict[str, Any]] = None) -> None:
@@ -408,6 +413,19 @@ class WorkLedger:
             self._unit_store = unit_store
             if recovered_jobs is not None:
                 self._recovered_jobs = dict(recovered_jobs)
+
+    def merge_recovered(self, recovered_jobs: Dict[str, Any],
+                        unit_store: Any = None) -> None:
+        """ADD a peer shard's replayed jobs (multi-master absorb) —
+        unlike :meth:`attach_wal` this never replaces the existing
+        recovered set, and each merged job remembers the DEAD shard's
+        unit store so its preloaded payloads blend from the right
+        disk."""
+        with self._lock:
+            for jid, job in (recovered_jobs or {}).items():
+                self._recovered_jobs[str(jid)] = job
+                if unit_store is not None:
+                    self._recovered_stores[str(jid)] = unit_store
 
     def _wal_append(self, rtype: str, **fields) -> None:
         """Append an ownership-transition record; fencing errors
@@ -435,14 +453,18 @@ class WorkLedger:
             # popped outside — racing a concurrent takeover's attach_wal
             # could drop or double-apply a recovered job)
             recovered = self._recovered_jobs.pop(jid, None)
+            # an absorbed job reads its preloaded payloads from the
+            # DEAD shard's store; everything else uses our own
+            job_store = self._recovered_stores.pop(jid, None) \
+                or self._unit_store
             rec_units = (recovered or {}).get("units", {})
             units = {}
             for u, o in owners.items():
                 ru = rec_units.get(str(u))
                 if ru is not None and ru.get("done") \
-                        and self._unit_store is not None \
+                        and job_store is not None \
                         and ru.get("spilled") \
-                        and self._unit_store.has(jid, u):
+                        and job_store.has(jid, u):
                     # completed before the crash AND its payload
                     # survived: never re-refined, blended from the spill
                     units[u] = {"owner": str(ru.get("by") or o),
@@ -473,6 +495,9 @@ class WorkLedger:
                 "recovered": recovered is not None,
                 "recovered_handled": False,
                 "preloaded": list(preloaded),
+                # where THIS job's preloaded payloads live (differs
+                # from self._unit_store only for absorbed jobs)
+                "store": job_store,
             }
         if preloaded:
             log(f"ledger: job {jid} recovered with {len(preloaded)}/"
@@ -521,6 +546,10 @@ class WorkLedger:
             # this job's idempotency keys, dropped by the tracker) are
             # no longer needed for recovery
             self._unit_store.drop_job(jid)
+        store = job.get("store")
+        if store is not None and store is not self._unit_store:
+            # absorbed job: its preloads lived in the dead shard's dir
+            store.drop_job(jid)
         return summary
 
     # -- check-in (exactly-once) ----------------------------------------------
@@ -820,12 +849,14 @@ class WorkLedger:
         with self._lock:
             job = self._jobs.get(jid)
             preloaded = list(job.get("preloaded") or ()) if job else []
-        if not preloaded or self._unit_store is None:
+            store = (job.get("store") if job else None) \
+                or self._unit_store
+        if not preloaded or store is None:
             return {}
         out: Dict[Any, tuple] = {}
         lost = []
         for u in preloaded:
-            payload = self._unit_store.get(jid, u)
+            payload = store.get(jid, u)
             if payload is None:
                 lost.append(u)
             else:
@@ -1025,15 +1056,85 @@ class HeartbeatSender:
             self.beat_once()
 
 
-def maybe_start_heartbeat(port: Optional[int] = None
-                          ) -> Optional[HeartbeatSender]:
-    """Start the worker->master heartbeat when the environment names a
-    master (spawned workers inherit DTPU_MASTER_URL/DTPU_WORKER_ID from
-    the process manager)."""
+class MultiHeartbeatSender:
+    """Multi-master worker heartbeats (ISSUE 14): one
+    :class:`HeartbeatSender` — one LEASE — per master shard, so each
+    master detects and recovers this worker's death independently.
+    Quacks like a single sender for the rehome route."""
+
+    def __init__(self, master_urls: List[str], worker_id: str,
+                 port: Optional[int] = None):
+        self.worker_id = str(worker_id)
+        self.port = port
+        self._lock = threading.Lock()
+        self._senders: Dict[str, HeartbeatSender] = {  # guarded-by: self._lock
+            u.rstrip("/"): HeartbeatSender(u, worker_id, port=port)
+            for u in dict.fromkeys(
+                x.strip() for x in master_urls if x.strip())}
+
+    @property
+    def master_urls(self) -> List[str]:
+        with self._lock:
+            return sorted(self._senders)
+
+    def start(self) -> None:
+        with self._lock:
+            senders = list(self._senders.values())
+        for hb in senders:
+            hb.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            senders = list(self._senders.values())
+        for hb in senders:
+            hb.stop()
+
+    def beat_once(self) -> int:
+        with self._lock:
+            senders = list(self._senders.values())
+        return sum(1 for hb in senders if hb.beat_once())
+
+    def rehome(self, master_url: str, attempts: int = 3) -> bool:
+        """A (new) master announced itself: ensure a lease heartbeat
+        toward it exists and register there NOW.  Existing masters keep
+        their senders — multi-homing is the contract."""
+        url = master_url.rstrip("/")
+        with self._lock:
+            hb = self._senders.get(url)
+            if hb is None:
+                hb = self._senders[url] = HeartbeatSender(
+                    url, self.worker_id, port=self.port)
+                fresh = True
+            else:
+                fresh = False
+        if fresh:
+            hb.start()
+        ok = False
+        for i in range(max(attempts, 1)):
+            if hb.beat_once():
+                ok = True
+                break
+            time.sleep(min(0.2 * (2 ** i), 1.0))
+        return ok
+
+
+def maybe_start_heartbeat(port: Optional[int] = None):
+    """Start the worker->master heartbeat(s) when the environment names
+    a master (spawned workers inherit DTPU_MASTER_URL/DTPU_WORKER_ID
+    from the process manager).  ``DTPU_MASTER_URLS`` (comma list) is
+    the multi-master form: one sender — one lease — per master shard."""
+    multi = os.environ.get(C.MASTER_URLS_ENV, "")
     master = os.environ.get(C.MASTER_URL_ENV)
     wid = os.environ.get(C.WORKER_ID_ENV)
-    if not master or not wid:
+    if not wid or not (multi or master):
         return None
+    if multi:
+        urls = [u for u in multi.split(",") if u.strip()]
+        hb = MultiHeartbeatSender(urls, wid, port=port)
+        hb.start()
+        log(f"heartbeat: renewing {len(hb.master_urls)} master-shard "
+            f"lease(s) for {wid!r} ({', '.join(hb.master_urls)})")
+        return hb
     hb = HeartbeatSender(master, wid, port=port)
     hb.start()
     log(f"heartbeat: renewing lease for {wid!r} at {master} every "

@@ -793,6 +793,36 @@ def verify(dirpath: str) -> Dict[str, Any]:
     }
 
 
+def rehome_workers(master_url: str, config_path: Optional[str]) -> None:
+    """Tell every enabled config worker to heartbeat ``master_url`` now
+    (best-effort; a worker that misses it re-registers when its next
+    redispatch graph names that master_url).  Shared by the standby
+    takeover (DurableMaster) and the multi-master shard absorb
+    (runtime/shard.py) so the rehome protocol cannot diverge."""
+    import urllib.request
+
+    from comfyui_distributed_tpu.utils import config as cfg_mod
+    try:
+        cfg = cfg_mod.load_config(config_path)
+    except Exception:  # noqa: BLE001 - config optional
+        return
+    for w in cfg_mod.enabled_workers(cfg):
+        target = (f"http://{w.get('host') or '127.0.0.1'}:"
+                  f"{w['port']}/distributed/rehome")
+        try:
+            req = urllib.request.Request(
+                target,
+                data=json.dumps({"master_url": master_url,
+                                 "worker_id": str(w["id"])}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=3) as r:
+                r.read()
+            debug_log(f"durable: re-homed worker {w['id']} to "
+                      f"{master_url}")
+        except Exception as e:  # noqa: BLE001 - best-effort
+            debug_log(f"durable: rehome of {w.get('id')} failed: {e}")
+
+
 # --- the ServerState facade --------------------------------------------------
 
 class DurableMaster:
@@ -822,8 +852,14 @@ class DurableMaster:
     # -- construction ---------------------------------------------------------
 
     @classmethod
-    def attach(cls, state) -> Optional["DurableMaster"]:
-        d = wal_dir()
+    def attach(cls, state, dirpath: Optional[str] = None,
+               owner: Optional[str] = None) -> Optional["DurableMaster"]:
+        """``dirpath``/``owner`` overrides are the multi-master shard
+        path (ISSUE 14): each shard keeps its OWN WAL dir under the
+        shared root, and its lease-owner identity is the shard id (so a
+        crash-restart of the same shard reclaims its lease, while a
+        peer's absorb acquire is a fresh-owner epoch bump)."""
+        d = dirpath or wal_dir()
         if not d or state.is_worker:
             return None
         standby = os.environ.get(C.STANDBY_ENV, "").lower() \
@@ -831,7 +867,7 @@ class DurableMaster:
         # same-owner re-acquire is the crash-restart fast path, so a
         # standby must NOT share the primary's default identity — it
         # would be able to steal a live lease
-        owner = os.environ.get(C.WAL_OWNER_ENV, "").strip() \
+        owner = owner or os.environ.get(C.WAL_OWNER_ENV, "").strip() \
             or (f"standby_{os.getpid()}" if standby else "master")
         dm = cls(d, owner=owner, standby=standby)
         dm._state = state
@@ -1030,31 +1066,9 @@ class DurableMaster:
         """Tell every enabled config worker to heartbeat HERE now
         (best-effort; a worker that misses it re-registers when its next
         redispatch graph names this master_url)."""
-        import urllib.request
-
-        from comfyui_distributed_tpu.utils import config as cfg_mod
-        st = self._state
         url = self.master_url()
-        if url is None:
-            return
-        try:
-            cfg = cfg_mod.load_config(st.config_path)
-        except Exception:  # noqa: BLE001 - config optional
-            return
-        for w in cfg_mod.enabled_workers(cfg):
-            target = (f"http://{w.get('host') or '127.0.0.1'}:"
-                      f"{w['port']}/distributed/rehome")
-            try:
-                req = urllib.request.Request(
-                    target,
-                    data=json.dumps({"master_url": url,
-                                     "worker_id": str(w["id"])}).encode(),
-                    headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(req, timeout=3) as r:
-                    r.read()
-                debug_log(f"durable: re-homed worker {w['id']} to {url}")
-            except Exception as e:  # noqa: BLE001 - best-effort
-                debug_log(f"durable: rehome of {w.get('id')} failed: {e}")
+        if url is not None:
+            rehome_workers(url, self._state.config_path)
 
     def master_url(self) -> Optional[str]:
         st = self._state

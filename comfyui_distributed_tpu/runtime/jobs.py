@@ -43,32 +43,70 @@ class JobStore:
         # double-inserting into the rebuilt queue (the PR 4 note: keys
         # used to die with the queue)
         self._wal = None
+        # shard-owner scope (ISSUE 14 satellite): with N active masters
+        # in one process (tests/benches) — or after a peer takeover
+        # merges an absorbed shard's replayed keys in — two shards'
+        # jobs could collide on (job_id, key).  Keys are namespaced by
+        # the owning shard so a takeover can never mistake another
+        # master's acked unit for its own (nor vice versa); "" (the
+        # single-master default) keeps the legacy keyspace bit-for-bit.
+        self._scope = ""
+        # job -> owning-shard scope for ABSORBED jobs: a retried upload
+        # for a job the takeover inherited must dedupe against the DEAD
+        # shard's replayed keys, and its future check-ins stay in that
+        # job's namespace
+        self._job_scope: Dict[str, str] = {}
+
+    def set_scope(self, scope: Optional[str]) -> None:
+        self._scope = str(scope or "")
+
+    def _scoped(self, job_id: str, idem_key: str) -> str:
+        s = self._job_scope.get(str(job_id), self._scope)
+        return f"{s}|{idem_key}" if s else str(idem_key)
 
     def attach_wal(self, wal, recovered_idem: Optional[Dict[str, Any]]
                    = None) -> None:
         """Wire the write-ahead log in and reseed the replayed keys
-        (``{"image": {job: [keys]}, "tile": {...}}``)."""
+        (``{"image": {job: [keys]}, "tile": {...}}``) — under THIS
+        store's scope: they came from our own shard's WAL."""
         self._wal = wal
-        if recovered_idem:
-            for job, keys in (recovered_idem.get("image") or {}).items():
-                self._seen.setdefault(str(job), set()).update(
-                    str(k) for k in keys)
-            for job, keys in (recovered_idem.get("tile") or {}).items():
-                self._tile_seen.setdefault(str(job), set()).update(
-                    str(k) for k in keys)
+        self.merge_idem(recovered_idem, scope=self._scope)
+
+    def merge_idem(self, recovered_idem: Optional[Dict[str, Any]],
+                   scope: Optional[str] = None) -> None:
+        """Seed replayed idempotency keys under ``scope`` (a peer
+        takeover passes the ABSORBED shard's id, so the dead master's
+        acked units stay exactly-once without aliasing ours)."""
+        if not recovered_idem:
+            return
+        scope = self._scope if scope is None else str(scope)
+
+        def seed(seen, block):
+            for job, keys in (block or {}).items():
+                if scope != self._scope:
+                    self._job_scope[str(job)] = scope
+                pfx = f"{scope}|" if scope else ""
+                seen.setdefault(str(job), set()).update(
+                    f"{pfx}{k}" for k in keys)
+
+        seed(self._seen, recovered_idem.get("image"))
+        seed(self._tile_seen, recovered_idem.get("tile"))
 
     def _dedupe(self, seen: Dict[str, Set[str]], job_id: str,
                 idem_key: Optional[str]) -> tuple:
         """``(duplicate, fresh_key)`` — pure bookkeeping under the
         caller's lock; the WAL append for a fresh key happens OUTSIDE
-        the lock (and off the event loop) via :meth:`_log_idem`."""
+        the lock (and off the event loop) via :meth:`_log_idem`.  The
+        returned fresh key is UNSCOPED (what the WAL records — the
+        shard dir IS the scope on disk)."""
         if not idem_key:
             return False, None
         keys = seen.setdefault(job_id, set())
-        if idem_key in keys:
+        scoped = self._scoped(job_id, idem_key)
+        if scoped in keys:
             trace_mod.GLOBAL_COUNTERS.bump("idem_dropped")
             return True, None
-        keys.add(idem_key)
+        keys.add(scoped)
         return False, idem_key
 
     def _log_idem(self, scope: str, job_id: str, idem_key: str) -> None:
@@ -133,6 +171,8 @@ class JobStore:
         async with self._lock:
             self._jobs.pop(multi_job_id, None)
             self._seen.pop(multi_job_id, None)
+            if multi_job_id not in self._tile_seen:
+                self._job_scope.pop(str(multi_job_id), None)
 
     # --- tile jobs (reference distributed_upscale.py:27-34, 711-760) -------
 
@@ -181,6 +221,8 @@ class JobStore:
         async with self._tile_lock:
             self._tile_jobs.pop(multi_job_id, None)
             self._tile_seen.pop(multi_job_id, None)
+            if multi_job_id not in self._seen:
+                self._job_scope.pop(str(multi_job_id), None)
 
     def snapshot(self) -> Dict[str, Any]:
         return {

@@ -109,7 +109,8 @@ def hash_array(arr: Any) -> str:
 
 def result_key(prompt: Dict[str, Any],
                input_dir: Optional[str] = None,
-               models_dir: Optional[str] = None) -> Optional[str]:
+               models_dir: Optional[str] = None,
+               scope: Optional[str] = None) -> Optional[str]:
     """Exact-hit cache key: the canonical FULL node/widget structure
     (seed included — this is the PR 2 structural signature WITHOUT the
     seed mask) over the deterministic-safe node set, plus out-of-graph
@@ -120,6 +121,15 @@ def result_key(prompt: Dict[str, Any],
     normally, every time)."""
     nodes: Dict[str, Any] = {}
     salts: List[str] = [f"dirs:{input_dir or ''}:{models_dir or ''}"]
+    if scope:
+        # shard-owner-epoch scope (ISSUE 14 satellite): with N active
+        # masters sharing this process-global plane, shard A must never
+        # serve shard B's stored outputs, and entries a DEPOSED epoch
+        # stored must go cold after a takeover (the new owner cannot
+        # vouch the dead master finished storing them) — both fall out
+        # of folding "<shard>:e<wal-epoch>" into the key.  Unset (the
+        # single-master default) keys are unchanged bit-for-bit.
+        salts.append(f"scope:{scope}")
     has_sampler = False
     for nid, node in prompt.items():
         if not isinstance(node, dict) or "class_type" not in node:

@@ -62,6 +62,7 @@ from comfyui_distributed_tpu.ops.base import OpContext
 from comfyui_distributed_tpu.runtime import autoscale as autoscale_mod
 from comfyui_distributed_tpu.runtime import cluster as cluster_mod
 from comfyui_distributed_tpu.runtime import reuse as reuse_mod
+from comfyui_distributed_tpu.runtime import shard as shard_mod
 from comfyui_distributed_tpu.runtime.jobs import JobStore
 from comfyui_distributed_tpu.utils import chaos as chaos_mod
 from comfyui_distributed_tpu.runtime.manager import (
@@ -230,6 +231,22 @@ class ServerState:
         # encode/disk.  FIFO -> history lands in execution order.
         self._finalize_q: "queue.Queue" = queue.Queue()
         self._finalize_pending = 0
+        # multi-master shard plane (ISSUE 14): resolve the shard config
+        # BEFORE the durability plane attaches — each shard keeps its
+        # own WAL/epoch stream under DTPU_SHARD_WAL_ROOT/<id>, its
+        # lease-owner identity is the shard id (crash-restart reclaims;
+        # a PEER's absorb acquire is the fresh-owner epoch bump), and
+        # the JobStore's idempotency keys are namespaced by shard so a
+        # takeover can never alias another master's acked units
+        self._shard_cfg = None if is_worker else shard_mod.shard_config()
+        shard_wal_dir = None
+        shard_owner = None
+        if self._shard_cfg is not None:
+            self.jobs.set_scope(self._shard_cfg["id"])
+            shard_owner = self._shard_cfg["id"]
+            if self._shard_cfg.get("wal_root"):
+                shard_wal_dir = os.path.join(
+                    self._shard_cfg["wal_root"], self._shard_cfg["id"])
         # durability plane (ISSUE 7): with DTPU_WAL_DIR set, a master
         # acquires (or, under DTPU_STANDBY=1, watches) the file lease,
         # replays the write-ahead job log, and preloads the recovered
@@ -238,12 +255,22 @@ class ServerState:
         # by resume_recovered() once the server loop is up.
         from comfyui_distributed_tpu.runtime import durable as durable_mod
         try:
-            self.durable = durable_mod.DurableMaster.attach(self)
+            self.durable = durable_mod.DurableMaster.attach(
+                self, dirpath=shard_wal_dir, owner=shard_owner)
         except durable_mod.WalError as e:
             # a held lease (second active master) must fail LOUDLY, not
             # boot a split-brain — but a standby construction never hits
             # this (it only watches)
             raise RuntimeError(f"durable master startup refused: {e}")
+        # the ShardManager itself (ring + gossip + peer-lease watch)
+        # attaches after the durability plane so a takeover can merge
+        # an absorbed shard's recovered state into live planes; the
+        # per-client admission rate splits by the member count (one
+        # client's traffic spreads over the shards by prompt-id hash)
+        self.shard = shard_mod.ShardManager.attach(
+            self, cfg=self._shard_cfg, start_threads=start_exec_thread)
+        if self.shard is not None:
+            self.admission.set_rate_scale(1.0 / self.shard.n_members())
         self._exec_started = bool(start_exec_thread)
         if start_exec_thread:
             if self.cb_enabled:
@@ -311,8 +338,10 @@ class ServerState:
                        trace_span: Any = None,
                        pid: Optional[str] = None,
                        tenant: Optional[str] = None,
+                       span_attrs: Optional[Dict[str, Any]] = None,
                        _recovered: bool = False,
-                       _preadmitted: bool = False) -> str:
+                       _preadmitted: bool = False,
+                       _absorbed: bool = False) -> str:
         """Queue one prompt.  Every job gets a request-scoped trace: a
         ``job`` root span that lives from enqueue to finalize and lands
         in the flight recorder under the prompt id.  ``trace_parent`` is
@@ -323,9 +352,14 @@ class ServerState:
         root, so its dispatch/collect children and the local execution
         share one tree)."""
         # `pid` override = crash recovery re-enqueueing an interrupted
-        # prompt under its ORIGINAL id, so clients polling /history find
-        # it on the restarted/stand-in master
-        pid = pid or f"p_{int(time.time() * 1000)}_{next(self._id_counter)}"
+        # prompt under its ORIGINAL id (clients polling /history find it
+        # on the stand-in master), or a router/client-supplied hash hint.
+        # A sharded master GENERATES ids its own shard owns, so a direct
+        # (hint-less) submission never needs the forward hop.
+        if pid is None:
+            pid = self.shard.local_pid(self._id_counter) \
+                if self.shard is not None \
+                else f"p_{int(time.time() * 1000)}_{next(self._id_counter)}"
         # an extra_data-carried priority survives paths that don't pass
         # tenant explicitly (crash-recovery re-enqueues replay extra_data
         # from the WAL; direct embedded callers)
@@ -342,6 +376,12 @@ class ServerState:
         else:
             sp.attrs.setdefault("prompt_id", pid)
             sp.attrs.setdefault("tenant", tenant)
+        if sp is not None:
+            if self.shard is not None:
+                sp.attrs["shard"] = self.shard.id
+                sp.attrs["ring_epoch"] = self.shard.ring_epoch()
+            for k, v in (span_attrs or {}).items():
+                sp.attrs[k] = v
         # signature hashed OUTSIDE the lock (it walks the whole graph):
         # _pop_group then only compares strings under the lock.  The
         # continuous-batching flag rides along the same way: a cheap
@@ -365,7 +405,8 @@ class ServerState:
         if not self.is_worker and not _recovered \
                 and reuse_mod.reuse_enabled():
             rkey = reuse_mod.result_key(prompt, input_dir=self.input_dir,
-                                        models_dir=self.models_dir)
+                                        models_dir=self.models_dir,
+                                        scope=self.shard_cache_scope())
             if rkey is not None:
                 entry = reuse_mod.get_reuse().result.get(rkey)
                 if entry is not None:
@@ -406,12 +447,25 @@ class ServerState:
         # prompt_id reaches the client (a crash after the append but
         # before the response re-runs the prompt — at-least-once at the
         # prompt level, exactly-once per unit through the ledger).
-        # Recovery re-enqueues suppress the append: their record (the
-        # original admission) is already in the log.
-        if self.durable is not None and not _recovered:
+        # Recovery re-enqueues suppress the append (their record — the
+        # original admission — is already in the log) EXCEPT absorbed
+        # shards' prompts: their record lives in the DEAD shard's now-
+        # dormant log, so ownership transfers by re-logging them here.
+        if self.durable is not None and (not _recovered or _absorbed):
             self.durable.log_enqueue(pid, prompt, client_id, extra_data)
         self._queue_event.set()
         return pid
+
+    def shard_cache_scope(self) -> Optional[str]:
+        """The shard-owner-epoch token salting the exact-hit result
+        cache (ISSUE 14 satellite): shard id + this shard's current WAL
+        epoch, so cross-shard entries never alias and a deposed epoch's
+        entries go cold after a takeover.  None (key unchanged) when
+        sharding is off."""
+        if self.shard is None:
+            return None
+        epoch = self.durable.epoch if self.durable is not None else 0
+        return f"{self.shard.id}:e{epoch}"
 
     def _replay_cached(self, pid: str, sp,
                        entry: Dict[str, Any]) -> None:
@@ -812,6 +866,10 @@ class ServerState:
             # a reconciliation firing mid-shutdown would spawn workers
             # into a dying fleet
             self.autoscaler.stop()
+        if self.shard is not None:
+            # stop gossip + the peer-lease watcher: a dying master must
+            # not absorb a peer's shard on its way out
+            self.shard.stop()
         with self._queue_lock:
             self._draining = True
         deadline = time.monotonic() + max(timeout, 0.0)
@@ -1040,6 +1098,12 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                   # durability plane: WAL size/sync-lag
                                   # gauges, lease holder + epoch
                                   "durability": dur_stats,
+                                  # multi-master shard plane: ring
+                                  # membership/epoch, owned shards,
+                                  # absorbed takeovers, forward count
+                                  "shard": (state.shard.snapshot()
+                                            if state.shard is not None
+                                            else {"enabled": False}),
                                   # multi-tenant admission: per-class
                                   # admitted/shed/completed counters,
                                   # weights, shed bars, drain rate
@@ -1258,6 +1322,30 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
              "freed CB slots).",
              [({}, state.metrics["prompts_abandoned"])]),
         ])
+        if state.shard is not None:
+            # multi-master shard plane (ISSUE 14): ownership + ring
+            # epoch gauges on the scrapeable surface, so a dashboard
+            # can draw who owns which shard through a takeover
+            ssnap = state.shard.snapshot()
+            extra.extend([
+                ("dtpu_shard_owner", "gauge",
+                 "Shards owned by this master (1 per owned shard; an "
+                 "absorbed peer's shard appears after takeover).",
+                 [({"shard": s}, 1) for s in ssnap["owned"]]),
+                ("dtpu_ring_epoch", "gauge",
+                 "Consistent-hash ring membership epoch.",
+                 [({}, ssnap["ring_epoch"])]),
+                ("dtpu_shard_members", "gauge",
+                 "Members in this master's ring view.",
+                 [({}, len(ssnap["members"]))]),
+                ("dtpu_shard_forwards_total", "counter",
+                 "Mis-routed /prompt submissions forwarded to their "
+                 "owning shard.",
+                 [({}, ssnap["forwards"])]),
+                ("dtpu_shard_takeovers_total", "counter",
+                 "Dead peer shards absorbed by this master.",
+                 [({}, ssnap["takeovers"])]),
+            ])
         if state.autoscaler is not None:
             asnap = state.autoscaler.snapshot()
             extra.extend([
@@ -2008,11 +2096,92 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
             is_dispatched_share
         return is_dispatched_share(prompt)
 
+    async def _forward_prompt(url: str, owner: str,
+                              data: Dict[str, Any],
+                              traceparent: Optional[str] = None):
+        """Single-hop mis-route forward: relay the original /prompt
+        body to the owning shard, marked with SHARD_FORWARD_HEADER so
+        the receiver never forwards again.  None on failure (the
+        caller then accepts locally rather than bouncing the client)."""
+        import aiohttp
+
+        from comfyui_distributed_tpu.utils.net import get_client_session
+        session = await get_client_session()
+        headers = {C.SHARD_FORWARD_HEADER: state.shard.id}
+        if traceparent:
+            headers[C.TRACEPARENT_HEADER] = traceparent
+        try:
+            async with session.post(
+                    f"{url}/prompt", json=data, headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=120)) as r:
+                body = await r.json()
+        except Exception as e:  # noqa: BLE001 - fall back to local
+            debug_log(f"shard: forward to {owner} failed: {e}")
+            return None
+        state.shard.forwards += 1
+        trace_mod.GLOBAL_COUNTERS.bump("shard_forwarded")
+        if isinstance(body, dict):
+            body.setdefault("shard", owner)
+            body["forwarded_from"] = state.shard.id
+        resp = web.json_response(body, status=r.status)
+        # relay the owner's backpressure hint: a shed (429) loses its
+        # HTTP-standard Retry-After if only the JSON body survives the
+        # hop, and standards-honoring clients would retry immediately
+        ra = r.headers.get("Retry-After")
+        if ra is not None:
+            resp.headers["Retry-After"] = ra
+        return resp
+
+    async def ring_info(request):
+        """Consistent-hash ring state (ISSUE 14): membership, epoch,
+        vnodes — everything a stateless router or a client-side hasher
+        needs to place prompt-ids."""
+        if state.shard is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(state.shard.ring_snapshot())
+
+    async def ring_gossip(request):
+        """Peer gossip exchange: merge the sender's ring view, answer
+        with ours (pure in-memory merge — event-loop safe)."""
+        if state.shard is None:
+            return web.json_response({"error": "sharding off "
+                                      f"(set {C.SHARD_ID_ENV})"},
+                                     status=409)
+        data = await request.json()
+        return web.json_response(state.shard.merge_gossip(data))
+
     async def post_prompt(request):
         data = await request.json()
         prompt = data.get("prompt")
         if not isinstance(prompt, dict) or not prompt:
             return web.json_response({"error": "missing prompt"}, status=400)
+        # multi-master routing (ISSUE 14): a router/client-supplied
+        # prompt_id hint is the hash key.  Mis-routed submissions are
+        # forwarded AT MOST ONE HOP to the owning shard (the forward
+        # header makes a ring disagreement terminate here instead of
+        # looping) — the admission then lands in the OWNER's WAL before
+        # the client gets its prompt-id.  Hint-less direct submissions
+        # get a self-owned generated id (enqueue_prompt), so they never
+        # forward.
+        pid_hint = str(data.get("prompt_id") or "") or None
+        fwd_from = request.headers.get(C.SHARD_FORWARD_HEADER)
+        span_attrs = {"forwarded_from": fwd_from} if fwd_from else None
+        if state.shard is not None and not state.is_worker \
+                and pid_hint and not fwd_from \
+                and not state.shard.is_mine(pid_hint):
+            owner = state.shard.owner_of(pid_hint)
+            url = state.shard.member_url(owner)
+            if url:
+                fwd = await _forward_prompt(
+                    url, owner, data,
+                    traceparent=request.headers.get(
+                        C.TRACEPARENT_HEADER))
+                if fwd is not None:
+                    return fwd
+            # owner unreachable (or url unknown): accept locally — the
+            # availability choice; the ring heals via absorb/gossip and
+            # the span records where the job actually landed
+            trace_mod.GLOBAL_COUNTERS.bump("shard_forward_fallbacks")
         # master-mode tile jobs: pre-create their queues at prompt-queue
         # time, before the exec thread gets anywhere near the upscale node
         # (reference pre-inits at validation time, distributed_upscale.py:
@@ -2067,6 +2236,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         # local root — propagation can never fail a request
         trace_parent = trace_mod.parse_traceparent(
             request.headers.get(C.TRACEPARENT_HEADER))
+        pid_kw = {"pid": pid_hint} if pid_hint else {}
         try:
             cfg = await _orchestration_config(prompt)
             if cfg is not None:
@@ -2103,7 +2273,8 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                     return await asyncio.get_running_loop() \
                         .run_in_executor(None, lambda: state.enqueue_prompt(
                             api, client_id, extra_data, trace_span=root,
-                            tenant=tenant, _preadmitted=True))
+                            tenant=tenant, span_attrs=span_attrs,
+                            _preadmitted=True, **pid_kw))
 
                 host = cfg.get("master", {}).get("host") or "127.0.0.1"
                 master_url = f"http://{host}:{state.port or 8288}"
@@ -2141,7 +2312,8 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                 None, lambda: state.enqueue_prompt(
                     prompt, client_id, extra_data,
                     trace_parent=trace_parent, tenant=tenant,
-                    _preadmitted=pre))
+                    span_attrs=span_attrs, _preadmitted=pre,
+                    **pid_kw))
         except ShedError as e:
             return _shed_response(e.rejection)
         except QueueFullError as e:
@@ -2327,6 +2499,8 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/distributed/traces", list_traces)
     r.add_get("/distributed/trace/{prompt_id}", get_trace)
     r.add_post("/distributed/warmup", warmup)
+    r.add_get("/distributed/ring", ring_info)
+    r.add_post("/distributed/ring/gossip", ring_gossip)
     r.add_get("/distributed/cluster", cluster_info)
     r.add_get("/distributed/resource", resource_info)
     r.add_get("/distributed/cluster/metrics", cluster_metrics)

@@ -382,6 +382,49 @@ AUTOSCALE_FLAP_S = 60.0
 AUTOSCALE_DECISIONS_KEPT = 128               # decision-ring bound
 WORKER_STATE_RETIRING = "retiring"           # registry state during drain
 
+# --- multi-master sharded control plane (runtime/shard.py) -------------------
+# N *active* masters each own a shard of the prompt-id space via a
+# consistent-hash ring (virtual nodes).  DTPU_SHARD_ID arms the plane on
+# a master; DTPU_SHARD_PEERS names the full member map (self included)
+# as "id=url,id=url".  Each shard keeps its OWN WAL/epoch stream under
+# DTPU_SHARD_WAL_ROOT/<id>; a failed master's shard is taken over by a
+# ring peer (its consistent-hash successor) through the existing
+# MasterLease path: the peer bumps the dead shard's epoch, replays its
+# WAL, re-homes its workers and removes the member from the ring.  Ring
+# state is gossiped between masters and exposed at GET /distributed/ring;
+# a thin stateless router (`cli router`) spreads /prompt admission by
+# prompt-id hash, with single-hop forwarding for mis-routed submissions.
+SHARD_ID_ENV = "DTPU_SHARD_ID"         # this master's shard identity
+SHARD_PEERS_ENV = "DTPU_SHARD_PEERS"   # "m0=http://h:p,m1=..." (incl self)
+SHARD_WAL_ROOT_ENV = "DTPU_SHARD_WAL_ROOT"  # shared root; WAL = root/<id>
+SHARD_VNODES_ENV = "DTPU_SHARD_VNODES"      # virtual nodes per member
+# sized for placement balance: at 512 vnodes a 3-member ring splits the
+# keyspace ~33/34/34% (64 vnodes skews to ~27/37/36, which caps the
+# 3-master scaling win well below the bench bar); ring build is ~3 ms
+SHARD_VNODES_DEFAULT = 512
+SHARD_GOSSIP_ENV = "DTPU_SHARD_GOSSIP_S"    # ring-gossip interval
+SHARD_GOSSIP_DEFAULT = 2.0
+# a peer silent on gossip for this long is marked down in the ring view
+# (reachability only — shard TAKEOVER keys on its master lease expiring)
+SHARD_PEER_DOWN_ENV = "DTPU_SHARD_PEER_DOWN_S"
+SHARD_PEER_DOWN_DEFAULT = 10.0
+SHARD_TAKEOVER_ENV = "DTPU_SHARD_TAKEOVER"  # "0": watch only, never absorb
+# ring-designated fleet-autoscale actuator: the shard owning this
+# sentinel key is the ONLY one that spawns/retires on the merged
+# backlog signal (every master folds the same gossiped depths into its
+# signal — N independent actuators would react N times to one backlog)
+AUTOSCALE_ACTUATOR_KEY = "dtpu-fleet-autoscale-actuator"
+# worker -> many-master heartbeats: one lease per master shard, so a
+# worker death is detected and recovered independently per shard
+MASTER_URLS_ENV = "DTPU_MASTER_URLS"   # comma list; overrides MASTER_URL
+# stateless admission router (`cli router` / runtime/shard.build_router_app)
+ROUTER_MASTERS_ENV = "DTPU_ROUTER_MASTERS"  # seed master URLs (comma list)
+ROUTER_REFRESH_ENV = "DTPU_ROUTER_REFRESH_S"  # ring re-pull cadence
+ROUTER_REFRESH_DEFAULT = 5.0
+# single-hop forwarding marker: a /prompt carrying this header is never
+# forwarded again (the ring views disagreed; the receiver keeps the job)
+SHARD_FORWARD_HEADER = "x-dtpu-forwarded-from"
+
 # --- chaos fault-injection harness (utils/chaos.py) --------------------------
 # Env/route-driven fault injection on the HTTP edges and worker
 # lifecycle, for tests and `bench.py --phase overload`.  DTPU_CHAOS is a
@@ -451,6 +494,8 @@ TRACE_ATTR_WHITELIST = frozenset({
     "mem_source",
     # cross-request compute reuse (ISSUE 13)
     "cache_hit", "cache_tier", "tiles_skipped",
+    # multi-master sharded control plane (ISSUE 14)
+    "shard", "ring_epoch", "forwarded_from",
 })
 
 # --- persistent compilation cache -------------------------------------------

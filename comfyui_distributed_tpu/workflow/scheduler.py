@@ -217,6 +217,14 @@ class AdmissionController:
         if self.default_class not in self.classes:
             self.default_class = C.TENANT_DEFAULT_CLASS
         self._lock = threading.Lock()
+        # federated admission (ISSUE 14): with N active masters each
+        # owning a prompt-id shard, one client's traffic spreads
+        # ~uniformly over the shards, so the GLOBAL per-client rate is
+        # approximated shard-locally by scaling each bucket's refill to
+        # rate/N — no cross-master coordination on the admission hot
+        # path.  Shed bars stay per shard by design (each shard sheds
+        # on ITS queue's occupancy).  1.0 = the single-master default.
+        self._rate_scale = 1.0                   # guarded-by: self._lock
         # stride scheduling: per-class virtual finish time; the next
         # dispatched class is the nonempty one with the smallest pass,
         # which then advances by 1/weight — heavier classes advance
@@ -250,7 +258,7 @@ class AdmissionController:
         ``retry_after_s`` floor the caller may refine with its drain
         rate.  Both metrics surfaces see every decision."""
         with self._lock:
-            rate = self.rate.get(tenant, 0.0)
+            rate = self.rate.get(tenant, 0.0) * self._rate_scale
             if rate > 0:
                 key = f"{tenant}:{client_id}"
                 bucket = self._buckets.get(key)
@@ -278,6 +286,17 @@ class AdmissionController:
                         "retry_after_s": 1.0}
             self.counters[tenant]["admitted"] += 1
             return None
+
+    def set_rate_scale(self, scale: float) -> None:
+        """Re-apply the shard split (called on ring-membership change);
+        buckets lazily rebuild on the next admit because their stored
+        rate no longer matches."""
+        with self._lock:
+            self._rate_scale = max(float(scale), 1e-9)
+
+    def rate_scale(self) -> float:
+        with self._lock:
+            return self._rate_scale
 
     def on_complete(self, tenant: str) -> None:
         with self._lock:
@@ -346,6 +365,7 @@ class AdmissionController:
                 "shed_thresholds": dict(self.shed),
                 "rate_limits": {cls: r for cls, r in self.rate.items()
                                 if r > 0},
+                "rate_scale": self._rate_scale,
                 "tracked_clients": len(self._buckets),
                 "per_class": {cls: dict(v)
                               for cls, v in self.counters.items()},

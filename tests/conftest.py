@@ -90,6 +90,11 @@ _MODULE_COST_S = {
     # tile-tier bit-exactness proofs and the SSE client-gone acceptance
     # are slow-marked in-file, ~25s together with real refine runs)
     "test_reuse.py": 25,
+    # multi-master shard plane (PR 14): ring math + exec-less loopback
+    # forwarding/takeover/router tests run in ~1s; the 3-master
+    # kill-mid-upscale acceptance (~32s, real fan-out + absorb) is
+    # slow-marked in-file
+    "test_shard.py": 2,
 }
 
 

@@ -1,0 +1,1021 @@
+"""Multi-master sharded control plane (ISSUE 14).
+
+Coverage map:
+
+- consistent-hash ring: deterministic placement, BOUNDED key movement
+  on membership change (exactly the leaver's keys move; a joiner takes
+  ~1/N), deterministic successor;
+- shard-owned prompt-id generation, gossip merge semantics, the
+  federated autoscaler signal and the per-shard admission rate split;
+- JobStore idempotency-key and result-cache scoping by shard owner
+  (epoch) — the takeover-can-never-alias regression tests;
+- loopback HTTP: mis-route forwarding (one hop, owner's WAL before the
+  ack, header-terminated), the stateless router's hash routing and
+  merged read views;
+- peer takeover: dead shard absorbed by its ring successor ONLY, with
+  `cli wal verify` rc=0 per shard afterwards;
+- one slow acceptance: 3 masters + 2 workers, the master owning a
+  4-tile tiled-upscale fan-out killed mid-job — the survivor absorbs
+  the shard and the final blend is BIT-IDENTICAL to the no-kill run.
+"""
+
+import asyncio
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.runtime import shard as shard_mod
+from comfyui_distributed_tpu.utils import constants as C
+
+pytestmark = []
+
+
+# --- the ring itself (no server, no jax) -------------------------------------
+
+class TestHashRing:
+    def test_deterministic_placement(self):
+        a = shard_mod.HashRing({"m0": "", "m1": "", "m2": ""}, vnodes=64)
+        b = shard_mod.HashRing({"m2": "", "m0": "", "m1": ""}, vnodes=64)
+        keys = [f"p_{i}" for i in range(500)]
+        assert [a.owner(k) for k in keys] == [b.owner(k) for k in keys]
+        # every member owns a nontrivial share
+        owners = {a.owner(k) for k in keys}
+        assert owners == {"m0", "m1", "m2"}
+
+    def test_leave_moves_only_the_leavers_keys(self):
+        full = shard_mod.HashRing({"m0": "", "m1": "", "m2": ""},
+                                  vnodes=128)
+        rest = shard_mod.HashRing({"m0": "", "m1": ""}, vnodes=128)
+        keys = [f"p_{i}" for i in range(2000)]
+        for k in keys:
+            if full.owner(k) != "m2":
+                # a surviving member's key NEVER moves on a leave
+                assert rest.owner(k) == full.owner(k)
+
+    def test_join_moves_about_one_over_n(self):
+        n3 = shard_mod.HashRing({"m0": "", "m1": "", "m2": ""},
+                                vnodes=128)
+        n4 = shard_mod.HashRing({"m0": "", "m1": "", "m2": "", "m3": ""},
+                                vnodes=128)
+        keys = [f"p_{i}" for i in range(4000)]
+        moved = sum(1 for k in keys if n3.owner(k) != n4.owner(k))
+        # all moved keys land on the joiner, and the moved fraction is
+        # ~1/4 (generous bound: consistent hashing's whole point)
+        for k in keys:
+            if n3.owner(k) != n4.owner(k):
+                assert n4.owner(k) == "m3"
+        assert moved <= len(keys) * 0.40, f"{moved}/{len(keys)} moved"
+        assert moved >= len(keys) * 0.10  # the joiner actually joined
+
+    def test_successor_deterministic_and_excludes_dead(self):
+        r = shard_mod.HashRing({"m0": "", "m1": "", "m2": ""}, vnodes=64)
+        s = r.successor("m1")
+        assert s in ("m0", "m2")
+        assert s == r.successor("m1")  # stable
+        two = shard_mod.HashRing({"m0": "", "m1": ""}, vnodes=64)
+        assert two.successor("m1") == "m0"
+        assert shard_mod.HashRing({"m0": ""}, vnodes=4).successor(
+            "m0") is None
+
+    def test_parse_peers(self):
+        assert shard_mod.parse_peers(
+            "m0=http://a:1, m1=http://b:2/,,bad") == {
+                "m0": "http://a:1", "m1": "http://b:2"}
+        assert shard_mod.parse_peers("") == {}
+
+
+class TestShardManagerUnit:
+    def _mgr(self, sid="m0", members=None):
+        return shard_mod.ShardManager(
+            None, sid, members or {"m0": "u0", "m1": "u1", "m2": "u2"},
+            start_threads=False)
+
+    def test_local_pid_owned_by_self(self):
+        import itertools
+        mgr = self._mgr("m1")
+        ctr = itertools.count()
+        for _ in range(20):
+            pid = mgr.local_pid(ctr)
+            assert mgr.owner_of(pid) == "m1"
+
+    def test_merge_gossip_higher_epoch_wins(self):
+        mgr = self._mgr("m0")
+        reply = mgr.merge_gossip({"from": "m1", "ring_epoch": 1,
+                                  "members": {"m0": "u0", "m1": "u1",
+                                              "m2": "u2"},
+                                  "queue_remaining": 7})
+        # equal epoch: own membership kept; peer liveness + queue noted
+        assert reply["from"] == "m0" and reply["ring_epoch"] == 1
+        assert mgr.peer_queue_depth() == 7
+        assert mgr.live_peer_masters() == 1
+        # a higher epoch (m1 absorbed m2) replaces the membership
+        mgr.merge_gossip({"from": "m1", "ring_epoch": 2,
+                          "members": {"m0": "u0", "m1": "u1"},
+                          "queue_remaining": 3})
+        assert mgr.ring_epoch() == 2
+        assert set(mgr.ring_snapshot()["members"]) == {"m0", "m1"}
+        # a STALE lower-epoch view can't roll the ring back
+        mgr.merge_gossip({"from": "m2", "ring_epoch": 1,
+                          "members": {"m0": "u0", "m1": "u1",
+                                      "m2": "u2"}})
+        assert mgr.ring_epoch() == 2
+
+    def test_merge_gossip_ring_without_self_means_deposed(self):
+        mgr = self._mgr("m0")
+        mgr.merge_gossip({"from": "m1", "ring_epoch": 5,
+                          "members": {"m1": "u1", "m2": "u2"}})
+        # the stale ring is never adopted (we'd vanish from our own
+        # view) — but a higher-epoch ring that excludes us means a
+        # peer absorbed our shard: we are a zombie owner now
+        assert mgr.ring_epoch() == 1
+        assert "m0" in mgr.ring_snapshot()["members"]
+        assert mgr.deposed
+        assert mgr.watch_once() == []  # a deposed master never absorbs
+        assert mgr.snapshot()["deposed"] is True
+
+    def test_equal_epoch_divergence_converges_by_intersection(self):
+        # 4-master ring; m0 absorbed m1 while m2 absorbed m3: both at
+        # epoch 2 with DIFFERENT member sets.  One gossip exchange must
+        # converge both sides to the intersection {m0, m2}.
+        members4 = {"m0": "u0", "m1": "u1", "m2": "u2", "m3": "u3"}
+        a = shard_mod.ShardManager(None, "m0", members4,
+                                   start_threads=False)
+        with a._lock:
+            a._members.pop("m1")
+            a._ring = shard_mod.HashRing(a._members, None)
+            a._ring_epoch = 2
+        a.merge_gossip({"from": "m2", "ring_epoch": 2,
+                        "members": {"m0": "u0", "m1": "u1",
+                                    "m2": "u2"}})
+        assert set(a.ring_snapshot()["members"]) == {"m0", "m2"}
+        assert a.ring_epoch() == 2  # converged WITHOUT an epoch race
+
+    def test_higher_epoch_gossip_cannot_resurrect_absorbed_member(self):
+        # m0 absorbed m1 (epoch 2); a peer's higher-epoch view that
+        # predates the takeover still lists m1.  Adopting it must NOT
+        # re-add m1: dead_peer_shards skips absorbed ids, so a
+        # resurrected dead member would never be removed again.
+        mgr = self._mgr("m0")
+        with mgr._lock:
+            mgr._members.pop("m1")
+            mgr._ring = shard_mod.HashRing(mgr._members, None)
+            mgr._ring_epoch = 2
+            mgr._absorbed["m1"] = {"epoch": 2, "ring_epoch": 2,
+                                   "resumed_prompts": 0,
+                                   "recovered_jobs": 0, "at": 0.0}
+        mgr.merge_gossip({"from": "m2", "ring_epoch": 3,
+                          "members": {"m0": "u0", "m1": "u1",
+                                      "m2": "u2"}})
+        assert mgr.ring_epoch() == 3
+        assert set(mgr.ring_snapshot()["members"]) == {"m0", "m2"}
+        assert mgr.owned_shards() == ["m0", "m1"]
+
+    def test_snapshot_shape(self):
+        snap = self._mgr("m2").snapshot()
+        assert snap["enabled"] and snap["id"] == "m2"
+        assert snap["owned"] == ["m2"]
+        assert set(snap["members"]) == {"m0", "m1", "m2"}
+        ring = self._mgr("m2").ring_snapshot()
+        assert ring["self"] == "m2" and ring["vnodes"] >= 1
+
+
+class TestFederatedSignals:
+    def test_autoscaler_signal_merges_peer_queues(self):
+        from comfyui_distributed_tpu.runtime.autoscale import \
+            FleetAutoscaler
+
+        class FakeShard:
+            def peer_queue_depth(self):
+                return 5
+
+            def live_peer_masters(self):
+                return 2
+
+        scaler = FleetAutoscaler(registry=None,
+                                 queue_depth_fn=lambda: 2,
+                                 shard=FakeShard())
+        sig = scaler.fleet_signal()
+        assert sig["queue_depth"] == 7
+        assert sig["participants"] == 3  # self + 2 peer masters
+        assert sig["peer_masters"] == 2
+        assert sig["queue_per_participant"] == pytest.approx(7 / 3)
+
+    def test_only_ring_designated_actuator_scales(self):
+        """N masters fold the SAME gossiped backlog into their signal;
+        only the ring-designated actuator may spawn on it — otherwise
+        one backlog draws N scale-ups (and N retires on the rebound)."""
+        from comfyui_distributed_tpu.runtime.autoscale import \
+            FleetAutoscaler
+        from comfyui_distributed_tpu.runtime.shard import HashRing
+
+        ring = HashRing({"m0": None, "m1": None}, 64)
+        owner = ring.owner(C.AUTOSCALE_ACTUATOR_KEY)
+        loser = next(m for m in ("m0", "m1") if m != owner)
+
+        class FakeShard:
+            def __init__(self, me):
+                self.me = me
+
+            def peer_queue_depth(self):
+                return 50
+
+            def live_peer_masters(self):
+                return 1
+
+            def is_autoscale_actuator(self):
+                return ring.owner(C.AUTOSCALE_ACTUATOR_KEY) == self.me
+
+        spawned = []
+
+        def mk(me):
+            return FleetAutoscaler(
+                registry=None, queue_depth_fn=lambda: 50,
+                spawner=lambda: spawned.append(me) or f"w-{me}",
+                min_workers=0, max_workers=8, up_queue=1.0,
+                window=1, cooldown_s=0.0, shard=FakeShard(me))
+
+        # the non-designated shard samples but defers actuation
+        out = mk(loser).sample_once(now=100.0)
+        assert out["actuator"] is False
+        assert out["action"] is None
+        assert spawned == []
+        # the designated shard acts exactly once on the same signal
+        out = mk(owner).sample_once(now=100.0)
+        assert out["action"] == "up"
+        assert spawned == [owner]
+
+    def test_admission_rate_splits_by_shard_count(self):
+        from comfyui_distributed_tpu.workflow.scheduler import \
+            AdmissionController
+        adm = AdmissionController(rate={"paid": 10.0, "free": 0.0,
+                                        "batch": 0.0},
+                                  burst={"paid": 1.0, "free": 1.0,
+                                         "batch": 1.0})
+        adm.set_rate_scale(1.0 / 4)
+        assert adm.admit("paid", "c1", 0, 100) is None
+        # the per-client bucket was built at the SPLIT rate
+        bucket = next(iter(adm._buckets.values()))
+        assert bucket.rate == pytest.approx(2.5)
+        assert adm.snapshot()["rate_scale"] == pytest.approx(0.25)
+        # scale 1.0 (single master) keeps the configured rate
+        adm2 = AdmissionController(rate={"paid": 10.0, "free": 0.0,
+                                         "batch": 0.0},
+                                   burst={"paid": 1.0, "free": 1.0,
+                                          "batch": 1.0})
+        assert adm2.admit("paid", "c1", 0, 100) is None
+        assert next(iter(adm2._buckets.values())).rate == 10.0
+
+
+# --- takeover-can-never-alias scoping (satellite) ----------------------------
+
+class TestIdemScoping:
+    def _put(self, store, job, key):
+        return asyncio.run(store.put_result(
+            job, {"worker_id": "w", "tensor": None}, idem_key=key,
+            require_existing=False))
+
+    def test_absorbed_keys_dedupe_without_aliasing_ours(self):
+        from comfyui_distributed_tpu.runtime.jobs import JobStore
+        store = JobStore()
+        store.set_scope("mA")
+        # a peer takeover merges the DEAD shard's replayed keys
+        store.merge_idem({"image": {"J": ["w:0:1"]}}, scope="mB")
+        # the dead master's acked-but-dropped upload replays: DEDUPED
+        # (acked, not enqueued) — exactly-once survives the takeover
+        assert self._put(store, "J", "w:0:1")
+        q = asyncio.run(store.get_queue("J"))
+        assert q.qsize() == 0
+        # the SAME key for one of OUR OWN jobs is a different namespace:
+        # it inserts (the takeover never mistook it for the absorbed ack)
+        assert self._put(store, "J2", "w:0:1")
+        assert asyncio.run(store.get_queue("J2")).qsize() == 1
+        # and a fresh key on the absorbed job keeps that job's scope
+        assert self._put(store, "J", "w:0:2")
+        assert asyncio.run(store.get_queue("J")).qsize() == 1
+        assert not asyncio.run(store.put_result(
+            "J", {"worker_id": "w", "tensor": None}, idem_key="w:0:2",
+            require_existing=True)) or \
+            asyncio.run(store.get_queue("J")).qsize() == 1
+
+    def test_own_recovered_keys_reseed_under_own_scope(self):
+        from comfyui_distributed_tpu.runtime.jobs import JobStore
+        store = JobStore()
+        store.set_scope("mA")
+        store.attach_wal(None, {"image": {"J": ["k1"]},
+                                "tile": {"T": ["t1"]}})
+        assert self._put(store, "J", "k1")  # ack ...
+        assert asyncio.run(store.get_queue("J")).qsize() == 0  # ... drop
+        ok = asyncio.run(store.put_tile(
+            "T", {"worker_id": "w", "tile_idx": 0, "x": 0, "y": 0,
+                  "extracted_width": 1, "extracted_height": 1,
+                  "padding": 0, "is_last": True, "tensor": None},
+            idem_key="t1", require_existing=False))
+        assert ok
+        assert asyncio.run(store.get_tile_queue("T")).qsize() == 0
+
+    def test_unscoped_store_is_bit_compatible(self):
+        from comfyui_distributed_tpu.runtime.jobs import JobStore
+        store = JobStore()
+        assert store._scoped("J", "k") == "k"  # legacy keyspace
+
+
+class TestResultCacheScoping:
+    def _prompt(self):
+        return {
+            "7": {"class_type": "CheckpointLoaderSimple",
+                  "inputs": {"ckpt_name": "tiny.safetensors"}},
+            "5": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "x", "clip": ["7", 1]}},
+            "6": {"class_type": "CLIPTextEncode",
+                  "inputs": {"text": "", "clip": ["7", 1]}},
+            "1": {"class_type": "EmptyLatentImage",
+                  "inputs": {"width": 32, "height": 32,
+                             "batch_size": 1}},
+            "2": {"class_type": "KSampler",
+                  "inputs": {"model": ["7", 0], "positive": ["5", 0],
+                             "negative": ["6", 0],
+                             "latent_image": ["1", 0], "seed": 1,
+                             "steps": 1, "cfg": 2.0,
+                             "sampler_name": "euler",
+                             "scheduler": "normal", "denoise": 1.0}},
+            "3": {"class_type": "VAEDecode",
+                  "inputs": {"samples": ["2", 0], "vae": ["7", 2]}},
+        }
+
+    def test_scope_salts_the_key(self):
+        from comfyui_distributed_tpu.runtime.reuse import result_key
+        p = self._prompt()
+        base = result_key(p)
+        assert base is not None
+        assert result_key(p) == base  # stable, and unchanged w/o scope
+        a1 = result_key(p, scope="m0:e1")
+        b1 = result_key(p, scope="m1:e1")
+        a2 = result_key(p, scope="m0:e2")
+        # cross-shard entries never alias; a takeover's epoch bump
+        # retires the deposed epoch's entries
+        assert len({base, a1, b1, a2}) == 4
+
+
+# --- loopback HTTP: forwarding + router + takeover ---------------------------
+
+def _upscale_prompt(seed=11, size=64, tile=32, steps=1):
+    """4-tile tiled-upscale fan-out with a SaveImage sink (the failover
+    shape): master [0,1], w0 [2], w1 [3]."""
+    return {
+        "7": {"class_type": "CheckpointLoaderSimple",
+              "inputs": {"ckpt_name": "tiny.safetensors"}},
+        "5": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "a map", "clip": ["7", 1]}},
+        "6": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["7", 1]}},
+        "10": {"class_type": "LoadImage",
+               "inputs": {"image": "__shard_card__.png"}},
+        "11": {"class_type": "ImageScale",
+               "inputs": {"image": ["10", 0],
+                          "upscale_method": "bilinear", "width": size,
+                          "height": size, "crop": "disabled"}},
+        "2": {"class_type": "UltimateSDUpscaleDistributed",
+              "inputs": {"upscaled_image": ["11", 0], "model": ["7", 0],
+                         "positive": ["5", 0], "negative": ["6", 0],
+                         "vae": ["7", 2], "seed": seed, "steps": steps,
+                         "cfg": 2.0, "sampler_name": "euler",
+                         "scheduler": "normal", "denoise": 0.4,
+                         "tile_width": tile, "tile_height": tile,
+                         "padding": 8, "mask_blur": 2,
+                         "force_uniform_tiles": True}},
+        "3": {"class_type": "SaveImage",
+              "inputs": {"images": ["2", 0],
+                         "filename_prefix": "shard"}},
+    }
+
+
+def _tiny_prompt(seed=100):
+    return {
+        "1": {"class_type": "EmptyLatentImage",
+              "inputs": {"width": 32, "height": 32, "batch_size": 1}},
+    }
+
+
+class _Fleet:
+    """N sharded exec-less masters over real loopback ports, one shared
+    WAL root.  Exec-less (start_exec_thread=False) keeps the non-slow
+    tests cheap: admission/forwarding/WAL behavior without model work."""
+
+    def __init__(self, n=2, exec_threads=False, cfg_path=None,
+                 lease_s=None):
+        self.n = n
+        self.exec_threads = exec_threads
+        self.cfg_path = cfg_path
+        self.lease_s = lease_s
+        self.tmp = tempfile.mkdtemp(prefix="shard_fleet_")
+        self.states, self.clients, self.urls = [], [], []
+        self._saved = {}
+
+    async def __aenter__(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.server.app import (ServerState,
+                                                        build_app)
+        from comfyui_distributed_tpu.utils.net import find_free_port
+        ports = [find_free_port() for _ in range(self.n)]
+        self.urls = [f"http://127.0.0.1:{p}" for p in ports]
+        peers = ",".join(f"m{i}={u}" for i, u in enumerate(self.urls))
+        keys = (C.SHARD_ID_ENV, C.SHARD_PEERS_ENV, C.SHARD_WAL_ROOT_ENV,
+                C.MASTER_LEASE_ENV, C.CACHE_ENV)
+        self._saved = {k: os.environ.get(k) for k in keys}
+        os.environ[C.SHARD_PEERS_ENV] = peers
+        os.environ[C.SHARD_WAL_ROOT_ENV] = os.path.join(self.tmp, "wal")
+        os.environ[C.CACHE_ENV] = "0"
+        if self.lease_s is not None:
+            os.environ[C.MASTER_LEASE_ENV] = str(self.lease_s)
+        for i in range(self.n):
+            os.environ[C.SHARD_ID_ENV] = f"m{i}"
+            d = os.path.join(self.tmp, f"m{i}")
+            os.makedirs(os.path.join(d, "in"), exist_ok=True)
+            st = ServerState(
+                config_path=self.cfg_path,
+                input_dir=os.path.join(d, "in"), output_dir=d,
+                start_exec_thread=self.exec_threads)
+            client = TestClient(TestServer(build_app(st),
+                                           port=ports[i]))
+            await client.start_server()
+            st.port = ports[i]
+            self.states.append(st)
+            self.clients.append(client)
+        os.environ.pop(C.SHARD_ID_ENV, None)
+        return self
+
+    async def __aexit__(self, *exc):
+        import shutil
+        for st in self.states:
+            if st.durable is not None and st.durable.wal is not None:
+                st.durable.simulate_crash()
+            if st.shard is not None:
+                st.shard.stop()
+        for c in self.clients:
+            try:
+                await c.close()
+            except Exception:  # noqa: BLE001 - already closed
+                pass
+        loop = asyncio.get_running_loop()
+        for st in self.states:
+            st.health.stop()
+            await loop.run_in_executor(None, lambda s=st: s.drain(0.5))
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(self.tmp, ignore_errors=True)
+
+    def pid_owned_by(self, shard_id, tag="k"):
+        mgr = self.states[0].shard
+        return next(f"{tag}{i}" for i in range(10_000)
+                    if mgr.owner_of(f"{tag}{i}") == shard_id)
+
+    def kill(self, i):
+        """SIGKILL proxy + immediate lease expiry, so the takeover test
+        doesn't sleep a full master-lease out."""
+        st = self.states[i]
+        st.durable.simulate_crash()
+        st.shard.stop()
+        st.health.stop()
+        lease = os.path.join(self.tmp, "wal", f"m{i}", "master.lease")
+        rec = json.load(open(lease))
+        rec["expires_at"] = time.time() - 1.0
+        with open(lease, "w") as f:
+            json.dump(rec, f)
+
+
+class TestForwarding:
+    def test_misroute_forwarded_one_hop_lands_in_owner_wal(self):
+        async def go():
+            async with _Fleet(2) as fl:
+                pid = fl.pid_owned_by("m1")
+                r = await fl.clients[0].post("/prompt", json={
+                    "prompt": _tiny_prompt(), "client_id": "c",
+                    "prompt_id": pid})
+                body = await r.json()
+                assert r.status == 200, body
+                assert body["prompt_id"] == pid
+                assert body["forwarded_from"] == "m0"
+                assert body["shard"] == "m1"
+                # the job lives at the OWNER (queued there, not here)
+                assert pid in fl.states[1]._inflight
+                assert pid not in fl.states[0]._inflight
+                # ... and its admission was durable in the OWNER's WAL
+                # BEFORE the client saw the prompt-id
+                from comfyui_distributed_tpu.runtime import durable
+                st, _ = durable.replay(
+                    os.path.join(fl.tmp, "wal", "m1"))
+                assert pid in st.prompts
+                st0, _ = durable.replay(
+                    os.path.join(fl.tmp, "wal", "m0"))
+                assert pid not in st0.prompts
+                assert fl.states[0].shard.forwards == 1
+
+        asyncio.run(go())
+
+    def test_forward_header_terminates_at_one_hop(self):
+        async def go():
+            async with _Fleet(2) as fl:
+                pid = fl.pid_owned_by("m1", tag="h")
+                # a ring disagreement: the forward header is already
+                # set, so m0 must accept locally instead of bouncing
+                r = await fl.clients[0].post(
+                    "/prompt",
+                    json={"prompt": _tiny_prompt(), "client_id": "c",
+                          "prompt_id": pid},
+                    headers={C.SHARD_FORWARD_HEADER: "m1"})
+                body = await r.json()
+                assert r.status == 200, body
+                assert "forwarded_from" not in body
+                assert pid in fl.states[0]._inflight
+                assert fl.states[0].shard.forwards == 0
+
+        asyncio.run(go())
+
+    def test_forwarded_shed_keeps_retry_after_header(self):
+        """A shed (429) relayed through the mis-route forward must keep
+        its HTTP-standard Retry-After header — standards-honoring
+        clients would otherwise retry an overloaded fleet instantly."""
+        async def go():
+            async with _Fleet(2) as fl:
+                pid = fl.pid_owned_by("m1", tag="s")
+                fl.states[1].max_queue = 0  # the OWNER sheds everything
+                r = await fl.clients[0].post("/prompt", json={
+                    "prompt": _tiny_prompt(), "client_id": "c",
+                    "prompt_id": pid})
+                body = await r.json()
+                assert r.status == 429, body
+                assert int(r.headers["Retry-After"]) >= 1
+
+        asyncio.run(go())
+
+    def test_direct_submission_generates_self_owned_pid(self):
+        async def go():
+            async with _Fleet(2) as fl:
+                for i in range(2):
+                    r = await fl.clients[i].post("/prompt", json={
+                        "prompt": _tiny_prompt(), "client_id": "c"})
+                    body = await r.json()
+                    assert r.status == 200, body
+                    pid = body["prompt_id"]
+                    assert fl.states[i].shard.owner_of(pid) == f"m{i}"
+                    assert pid in fl.states[i]._inflight
+
+        asyncio.run(go())
+
+    def test_gossip_roundtrip_and_metrics_surfaces(self):
+        async def go():
+            async with _Fleet(2) as fl:
+                loop = asyncio.get_running_loop()
+                # gossip runs on a daemon thread in production; drive
+                # one round off the loop so the loopback peer can answer
+                reached = await loop.run_in_executor(
+                    None, fl.states[0].shard.gossip_once)
+                assert reached == 1
+                assert fl.states[0].shard.live_peer_masters() == 1
+                # both surfaces carry the shard block/gauges
+                m = await (await fl.clients[0].get(
+                    "/distributed/metrics")).json()
+                assert m["shard"]["enabled"] and m["shard"]["id"] == "m0"
+                assert m["shard"]["ring_epoch"] == 1
+                prom = await (await fl.clients[0].get(
+                    "/distributed/metrics.prom")).text()
+                assert 'dtpu_shard_owner{shard="m0"} 1' in prom
+                assert "dtpu_ring_epoch 1" in prom
+                ring = await (await fl.clients[1].get(
+                    "/distributed/ring")).json()
+                assert ring["self"] == "m1"
+                assert set(ring["members"]) == {"m0", "m1"}
+
+        asyncio.run(go())
+
+
+class TestTakeover:
+    def test_successor_absorbs_dead_shard(self):
+        async def go():
+            async with _Fleet(3) as fl:
+                victim = 1
+                succ = fl.states[0].shard._ring.successor("m1")
+                pid = fl.pid_owned_by("m1", tag="t")
+                r = await fl.clients[victim].post("/prompt", json={
+                    "prompt": _tiny_prompt(), "client_id": "c",
+                    "prompt_id": pid})
+                assert r.status == 200
+                fl.kill(victim)
+                loop = asyncio.get_running_loop()
+                others = [i for i in range(3) if i != victim]
+                non_succ = next(i for i in others
+                                if f"m{i}" != succ)
+                succ_i = next(i for i in others if f"m{i}" == succ)
+                # the NON-successor sees the death but does not absorb
+                got = await loop.run_in_executor(
+                    None, fl.states[non_succ].shard.watch_once)
+                assert got == []
+                assert fl.states[non_succ].shard.ring_epoch() == 1
+                # the successor absorbs: ring epoch bump, prompt
+                # re-enqueued under its ORIGINAL id, ownership gauges
+                got = await loop.run_in_executor(
+                    None, fl.states[succ_i].shard.watch_once)
+                assert got == ["m1"]
+                mgr = fl.states[succ_i].shard
+                assert mgr.ring_epoch() == 2
+                assert mgr.owned_shards() == [succ, "m1"] or \
+                    mgr.owned_shards() == sorted([succ, "m1"])
+                assert pid in fl.states[succ_i]._inflight
+                # the absorbed keyspace now maps to the survivor
+                assert mgr.owner_of(pid) == succ
+                prom = await (await fl.clients[succ_i].get(
+                    "/distributed/metrics.prom")).text()
+                assert 'dtpu_shard_owner{shard="m1"} 1' in prom
+                assert "dtpu_shard_takeovers_total 1" in prom
+                # `cli wal verify` stays rc=0 PER SHARD after takeover
+                from comfyui_distributed_tpu.runtime import durable
+                for sid in ("m0", "m1", "m2"):
+                    rep = durable.verify(
+                        os.path.join(fl.tmp, "wal", sid))
+                    assert rep["ok"], (sid, rep)
+                # absorb is idempotent: a second scan finds nothing
+                got = await loop.run_in_executor(
+                    None, fl.states[succ_i].shard.watch_once)
+                assert got == []
+
+        asyncio.run(go())
+
+    def test_absorbed_prompt_relogged_in_survivor_wal(self):
+        async def go():
+            async with _Fleet(2) as fl:
+                pid = fl.pid_owned_by("m1", tag="w")
+                r = await fl.clients[1].post("/prompt", json={
+                    "prompt": _tiny_prompt(), "client_id": "c",
+                    "prompt_id": pid})
+                assert r.status == 200
+                fl.kill(1)
+                loop = asyncio.get_running_loop()
+                assert await loop.run_in_executor(
+                    None, fl.states[0].shard.watch_once) == ["m1"]
+                from comfyui_distributed_tpu.runtime import durable
+                st, _ = durable.replay(os.path.join(fl.tmp, "wal",
+                                                    "m0"))
+                # ownership transferred: a crash of the SURVIVOR now
+                # also recovers the absorbed prompt (from its own log)
+                assert pid in st.prompts
+                # ... and the DEAD shard's log shows it closed, so a
+                # restart of m1 can never replay it a second time
+                st1, _ = durable.replay(os.path.join(fl.tmp, "wal",
+                                                     "m1"))
+                assert pid not in st1.prompts
+                # the survivor keeps renewing the absorbed lease: a
+                # restarted m1 is refused at startup (fails loudly)
+                # instead of reclaiming its expired lease
+                fl.states[0].shard.renew_absorbed_leases()
+                lease = durable.MasterLease(
+                    os.path.join(fl.tmp, "wal", "m1"))
+                with pytest.raises(durable.LeaseHeldError):
+                    lease.acquire("m1", 2.0)
+                # the per-client rate split re-applied to the new N
+                assert fl.states[0].admission.rate_scale() == \
+                    pytest.approx(1.0)
+
+        asyncio.run(go())
+
+
+    def test_double_death_absorbed_by_the_survivor(self):
+        """Two masters dying together must not deadlock takeover: the
+        one-member-removed successor of each dead shard can be the
+        OTHER dead shard (~25% of vnode layouts), so the successor is
+        computed over LIVE members only — the sole survivor absorbs
+        both, whatever the layout."""
+        async def go():
+            async with _Fleet(3) as fl:
+                fl.kill(1)
+                fl.kill(2)
+                loop = asyncio.get_running_loop()
+                got = await loop.run_in_executor(
+                    None, fl.states[0].shard.watch_once)
+                assert sorted(got) == ["m1", "m2"]
+                mgr = fl.states[0].shard
+                assert sorted(mgr.owned_shards()) == ["m0", "m1", "m2"]
+                assert set(mgr.ring_snapshot()["members"]) == {"m0"}
+
+        asyncio.run(go())
+
+    def test_lost_absorbed_lease_drops_ownership(self):
+        """A superseded absorbed lease (the dead master restarted in an
+        expiry gap) must make the survivor STOP driving that shard:
+        keeping the absorbed/pending records would re-enqueue prompts
+        the new owner is also replaying (duplicate execution)."""
+        async def go():
+            async with _Fleet(2) as fl:
+                pid = fl.pid_owned_by("m1", tag="l")
+                r = await fl.clients[1].post("/prompt", json={
+                    "prompt": _tiny_prompt(), "client_id": "c",
+                    "prompt_id": pid})
+                assert r.status == 200
+                fl.kill(1)
+                loop = asyncio.get_running_loop()
+                assert await loop.run_in_executor(
+                    None, fl.states[0].shard.watch_once) == ["m1"]
+                mgr = fl.states[0].shard
+                assert "m1" in mgr.owned_shards()
+                # another owner force-acquires m1's lease (epoch bump)
+                from comfyui_distributed_tpu.runtime import durable
+                durable.MasterLease(os.path.join(
+                    fl.tmp, "wal", "m1")).acquire("m1", 30.0, force=True)
+                mgr.renew_absorbed_leases()
+                assert mgr.owned_shards() == ["m0"]
+                assert mgr.snapshot()["pending_reenqueue"] == {}
+                # ... and nothing is re-driven for the lost shard
+                assert await loop.run_in_executor(
+                    None, mgr.retry_absorbed_reenqueues) == 0
+
+        asyncio.run(go())
+
+    def test_failed_reenqueue_retried_until_landed(self):
+        """A takeover against a FULL survivor queue must not lose the
+        absorbed prompt: it stays durably open in the dead shard's WAL
+        (whose lease the survivor holds) and in the pending set, and
+        the gossip loop's retry lands + closes it once the queue
+        frees — without the retry it would be gone forever (the dead
+        member leaves every ring, and its restart is fenced out)."""
+        async def go():
+            async with _Fleet(2) as fl:
+                pid = fl.pid_owned_by("m1", tag="q")
+                r = await fl.clients[1].post("/prompt", json={
+                    "prompt": _tiny_prompt(), "client_id": "c",
+                    "prompt_id": pid})
+                assert r.status == 200
+                fl.kill(1)
+                surv = fl.states[0]
+                from comfyui_distributed_tpu.server.app import \
+                    QueueFullError
+
+                def full(*a, **k):
+                    raise QueueFullError("queue full (test)")
+                surv.enqueue_prompt = full
+                loop = asyncio.get_running_loop()
+                try:
+                    assert await loop.run_in_executor(
+                        None, surv.shard.watch_once) == ["m1"]
+                finally:
+                    del surv.enqueue_prompt
+                assert pid not in surv._inflight
+                assert surv.shard.snapshot()["pending_reenqueue"] \
+                    == {"m1": [pid]}
+                # still durably OPEN in the dead WAL (the survivor's
+                # held lease keeps a fenced restart from replaying it)
+                from comfyui_distributed_tpu.runtime import durable
+                st1, _ = durable.replay(
+                    os.path.join(fl.tmp, "wal", "m1"))
+                assert pid in st1.prompts
+                # the retry (gossip-loop cadence) lands it
+                landed = await loop.run_in_executor(
+                    None, surv.shard.retry_absorbed_reenqueues)
+                assert landed == 1
+                assert pid in surv._inflight
+                assert surv.shard.snapshot()["pending_reenqueue"] == {}
+                # ... and closes it in the dead shard's log, exactly
+                # like a first-pass transfer
+                st1, _ = durable.replay(
+                    os.path.join(fl.tmp, "wal", "m1"))
+                assert pid not in st1.prompts
+                rep = durable.verify(os.path.join(fl.tmp, "wal", "m1"))
+                assert rep["ok"], rep
+                # nothing left to drive: the retry is a no-op now
+                assert await loop.run_in_executor(
+                    None, surv.shard.retry_absorbed_reenqueues) == 0
+
+        asyncio.run(go())
+
+
+class TestRouter:
+    def test_router_routes_by_hash_and_merges_views(self):
+        async def go():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from comfyui_distributed_tpu.runtime.shard import \
+                build_router_app
+            async with _Fleet(2) as fl:
+                rc = TestClient(TestServer(build_router_app(fl.urls)))
+                await rc.start_server()
+                try:
+                    ring = await (await rc.get(
+                        "/distributed/ring")).json()
+                    assert ring["router"] is True
+                    assert set(ring["members"]) == {"m0", "m1"}
+                    pids = []
+                    for i in range(12):
+                        r = await rc.post("/prompt", json={
+                            "prompt": _tiny_prompt(),
+                            "client_id": "c"})
+                        body = await r.json()
+                        assert r.status == 200, body
+                        pids.append((body["prompt_id"], body["shard"]))
+                    mgr = fl.states[0].shard
+                    for pid, shard in pids:
+                        # the router's placement agrees with the ring
+                        assert mgr.owner_of(pid) == shard
+                        i = int(shard[1:])
+                        assert pid in fl.states[i]._inflight
+                    # routed to BOTH shards with overwhelming odds
+                    assert len({s for _, s in pids}) == 2
+                    # merged /history sees every shard's jobs
+                    hist = await (await rc.get("/history")).json()
+                    assert isinstance(hist, dict)
+                    # merged cluster metrics: shard-prefixed participants
+                    cm = await (await rc.get(
+                        "/distributed/cluster/metrics")).json()
+                    parts = cm["participants"]
+                    assert any(k.startswith("m0/") for k in parts)
+                    assert any(k.startswith("m1/") for k in parts)
+                    # merged fleet admission counters sum across shards
+                    fleet = await (await rc.get(
+                        "/distributed/fleet")).json()
+                    admitted = sum(
+                        v.get("admitted", 0) for v in
+                        fleet["admission"]["per_class"].values())
+                    assert admitted == 12
+                    cl = await (await rc.get(
+                        "/distributed/cluster")).json()
+                    assert cl["shards"] == ["m0", "m1"]
+                finally:
+                    await rc.close()
+
+        asyncio.run(go())
+
+    def test_router_relays_retry_after_on_shed(self):
+        async def go():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from comfyui_distributed_tpu.runtime.shard import \
+                build_router_app
+            async with _Fleet(2) as fl:
+                for st in fl.states:
+                    st.max_queue = 0  # every shard sheds
+                rc = TestClient(TestServer(build_router_app(fl.urls)))
+                await rc.start_server()
+                try:
+                    r = await rc.post("/prompt", json={
+                        "prompt": _tiny_prompt(), "client_id": "c"})
+                    assert r.status == 429
+                    assert int(r.headers["Retry-After"]) >= 1
+                finally:
+                    await rc.close()
+
+        asyncio.run(go())
+
+
+# --- the slow acceptance -----------------------------------------------------
+
+@pytest.mark.slow
+class TestKillMasterMidUpscale:
+    def test_three_masters_kill_owner_bit_identical_blend(self,
+                                                          tmp_path):
+        """3 active masters + 2 shared workers; the master owning a
+        4-tile tiled-upscale fan-out is killed mid-job (3/4 units
+        checked in, one worker stalled).  Its ring successor absorbs
+        the shard, blends the spilled units from the dead shard's
+        store, redispatches only the remainder — and the final PNG is
+        bit-identical to the no-kill reference."""
+        from comfyui_distributed_tpu.server.app import (ServerState,
+                                                        build_app)
+
+        saved = {k: os.environ.get(k) for k in (
+            C.LEASE_ENV, C.FAULT_POLICY_ENV, C.HEDGE_ENV,
+            C.DRAIN_TIMEOUT_ENV)}
+        os.environ[C.LEASE_ENV] = "4.0"
+        os.environ[C.FAULT_POLICY_ENV] = "reassign"
+        os.environ[C.HEDGE_ENV] = "0"
+        os.environ[C.DRAIN_TIMEOUT_ENV] = "2"
+
+        async def go():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            from comfyui_distributed_tpu.utils.image import decode_png
+            loop = asyncio.get_running_loop()
+            # 2 workers first (their ports go into every master's cfg)
+            wstates, wclients, cfg_workers = [], [], []
+            for i in range(2):
+                d = tmp_path / f"worker{i}"
+                (d / "in").mkdir(parents=True)
+                st = ServerState(config_path=str(d / "cfg.json"),
+                                 input_dir=str(d / "in"),
+                                 output_dir=str(d), is_worker=True)
+                client = TestClient(TestServer(build_app(st)))
+                await client.start_server()
+                st.port = client.server.port
+                wstates.append(st)
+                wclients.append(client)
+                cfg_workers.append({"id": f"w{i}", "host": "127.0.0.1",
+                                    "port": st.port, "enabled": True})
+            cfg_path = tmp_path / "cfg.json"
+            cfg_path.write_text(json.dumps(
+                {"workers": cfg_workers,
+                 "master": {"host": "127.0.0.1"}, "settings": {}}))
+
+            async def wait_history(client, pid, t_s=240.0):
+                deadline = time.monotonic() + t_s
+                while time.monotonic() < deadline:
+                    hist = await (await client.get("/history")).json()
+                    if pid in hist:
+                        return hist[pid]
+                    await asyncio.sleep(0.05)
+                raise TimeoutError(f"{pid} never finished")
+
+            def newest_png(d):
+                pngs = [os.path.join(d, f) for f in os.listdir(d)
+                        if f.endswith(".png")]
+                assert pngs, f"no PNG in {d}"
+                return max(pngs, key=os.path.getmtime)
+
+            async with _Fleet(3, exec_threads=True,
+                              cfg_path=str(cfg_path),
+                              lease_s=2.0) as fl:
+                for st in fl.states:
+                    st.health.interval = 0.5
+                    await loop.run_in_executor(None,
+                                               st.health.poll_once)
+                    st.health.start()
+                victim = 1
+                succ = fl.states[0].shard._ring.successor("m1")
+                succ_i = int(succ[1:])
+                # no-kill reference on the victim (same topology as the
+                # kill run: master + w0 + w1 split the 4 tiles)
+                ref_pid = fl.pid_owned_by("m1", tag="ref")
+                r = await fl.clients[victim].post("/prompt", json={
+                    "prompt": _upscale_prompt(), "client_id": "t",
+                    "prompt_id": ref_pid})
+                assert r.status == 200, await r.text()
+                h = await wait_history(fl.clients[victim], ref_pid)
+                assert h["status"] == "success", h
+                ref_png = np.asarray(decode_png(open(
+                    newest_png(fl.states[victim].output_dir),
+                    "rb").read()))
+
+                # kill run: stall w1 so the job parks at 3/4 units
+                wstates[1].fault_inject = {"stall_s": 300}
+                pid = fl.pid_owned_by("m1", tag="kill")
+                r = await fl.clients[victim].post("/prompt", json={
+                    "prompt": _upscale_prompt(), "client_id": "t",
+                    "prompt_id": pid})
+                assert r.status == 200, await r.text()
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    snap = await (await fl.clients[victim].get(
+                        "/distributed/cluster")).json()
+                    jobs = snap["ledger"]["active_jobs"].values()
+                    if any(j["done_units"] >= 3 for j in jobs):
+                        break
+                    await asyncio.sleep(0.05)
+                else:
+                    raise TimeoutError("job never reached 3/4 units")
+                fl.kill(victim)
+                wstates[1].fault_inject = {}
+                # the successor's lease watcher absorbs on its own
+                # thread; the job completes on the SURVIVOR
+                h = await wait_history(fl.clients[succ_i], pid)
+                assert h["status"] == "success", h
+                mgr = fl.states[succ_i].shard
+                assert "m1" in mgr.owned_shards()
+                assert mgr.ring_epoch() >= 2
+                snap = await (await fl.clients[succ_i].get(
+                    "/distributed/cluster")).json()
+                job = [j for j in snap["ledger"]["completed_jobs"]
+                       if j["kind"] == "tile"][-1]
+                assert job["done_units"] == job["total_units"] == 4
+                # spilled units blended from the dead shard's store,
+                # only the remainder recomputed
+                assert job.get("recovered")
+                assert job.get("preloaded_units", 0) >= 1
+                kill_png = np.asarray(decode_png(open(
+                    newest_png(fl.states[succ_i].output_dir),
+                    "rb").read()))
+                assert np.array_equal(kill_png, ref_png), \
+                    "takeover blend differs from the no-kill run"
+                # per-shard WAL verify stays clean after the takeover
+                from comfyui_distributed_tpu.runtime import durable
+                for sid in ("m0", "m1", "m2"):
+                    rep = durable.verify(
+                        os.path.join(fl.tmp, "wal", sid))
+                    assert rep["ok"], (sid, rep)
+
+            for c in wclients:
+                await c.close()
+            for st in wstates:
+                await loop.run_in_executor(
+                    None, lambda s=st: s.drain(0.5))
+
+        try:
+            asyncio.run(go())
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
