@@ -24,8 +24,28 @@ as a tier-1 test:
   Prometheus family tuples must follow naming conventions, span attr
   names must be in ``constants.TRACE_ATTR_WHITELIST``.
 
+The v2 interprocedural tier (ISSUE 15) rides on a whole-project call
+graph with per-function summaries (``callgraph.py``: may-block,
+locks-acquired-ordered, wal-appends, span reachability; bounded
+fixpoint propagation; executor thunks and ``*_off_loop`` helpers cut
+chains; unresolved dynamic dispatch = conservative no-summary):
+
+- ``async-blocking-transitive`` — an ``async def`` reaching a blocking
+  leaf through any sync call chain, witness chain printed
+  (``route -> helper -> fsync``);
+- ``deadlock-cycle`` — cycles in the aggregated lock-order graph, with
+  a witness chain per edge;
+- ``wal-fencing`` — WAL mutations outside the epoch-fenced surfaces,
+  recovery state handed to live planes outside an epoch-checked entry
+  point, ReplayState advanced outside the durability module;
+- ``route-contract`` — both-directions drift between the registered
+  HTTP surface and the README route registry, plus span-discipline
+  consistency.
+
 Grandfathered findings live in ``baseline.json`` (audited-benign only);
-the gate fails on any NEW violation.  Per-line opt-out:
+the gate fails on any NEW violation, and the bug-class rules
+(async-blocking*, lockset, deadlock-cycle, wal-fencing, registry
+drift) are never grandfathered at all.  Per-line opt-out:
 ``# dtpu-lint: ignore[rule-id] <reason>`` (the reason is mandatory).
 """
 

@@ -1,0 +1,890 @@
+"""dtpu-lint v2: the interprocedural tier (ISSUE 15).
+
+PR 10's rules are strictly intraprocedural — the async-blocking rule
+only sees a blocking call *directly* in an ``async def`` body, and the
+lockset rule only sees locks held within one function — yet the recent
+hazards are exactly the cross-function ones (a sync helper that fsyncs
+three frames below an aiohttp route; absorb/takeover paths taking two
+subsystems' locks).  This module builds the whole-project call graph
+the v2 rules share:
+
+- one :class:`FunctionNode` per ``def``/``async def`` (nested included),
+  carrying its call sites (with the lock set lexically held at each
+  site), lock acquisitions in order, direct span-factory calls and raw
+  WAL-append sites;
+- callee **resolution tiers**: local/nested name -> module-level def ->
+  project import (``from pkg.mod import f`` / ``import pkg.mod as m``)
+  -> ``self.method`` on the enclosing class -> ``Class.method`` ->
+  a *unique-attribute* fallback (``st.queue_remaining(...)`` resolves
+  when exactly one project class defines the method and the name is not
+  generic).  Anything else is a conservative no-summary (dynamic
+  dispatch; counted, surfaced by ``cli lint --stats``);
+- **executor thunks cut the chain**: the target of
+  ``loop.run_in_executor(None, f)`` / ``asyncio.to_thread`` /
+  ``pool.submit`` / ``threading.Thread(target=...)`` /
+  ``functools.partial`` runs off the event loop, so its blocking
+  content never taints an async caller (lambdas passed as thunks are
+  walked with the same flag).  ``*_off_loop`` helpers are offloading by
+  naming contract and cut the chain too;
+- **bounded fixpoint** summary propagation: ``may-block`` (with a
+  witness chain per blocking leaf), ``locks-acquired`` (transitive) and
+  ``reaches-a-span-factory`` iterate to a fixed point with an explicit
+  pass cap — recursion converges because summaries only grow and are
+  keyed by leaf/lock, never by path.
+
+The graph is built once per :class:`~.engine.Project` (cached on the
+project) and shared by the v2 rules: ``async-blocking-transitive``
+(rules_async), ``deadlock-cycle`` + ``wal-fencing`` (rules_lockset) and
+``route-contract`` (rules_registry).  Pure stdlib ``ast`` — files are
+parsed, never imported, and jax never loads.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from comfyui_distributed_tpu.analysis.engine import (
+    PACKAGE_DIR, Project, SourceFile, holds_locks)
+
+# explicit fixpoint bound: summaries are monotone (sets only grow), so
+# convergence needs at most one pass per call-graph diameter; the cap
+# exists so a pathological cycle can never hang the gate
+MAX_FIXPOINT_PASSES = 40
+
+# attribute names too generic for the unique-attribute fallback — a
+# `.get(...)` resolving to some project class's get() would be wrong
+# far more often than right
+GENERIC_ATTRS = frozenset({
+    "append", "add", "acquire", "cancel", "clear", "close", "copy",
+    "count", "decode", "discard", "done", "encode", "extend", "flush",
+    "format", "get", "index", "insert", "items", "join", "keys",
+    "open", "pop", "popleft", "put", "read", "release", "remove",
+    "result", "run", "seek", "send", "set", "shutdown", "sort",
+    "split", "start", "stop", "strip", "submit", "tell", "update",
+    "values", "wait", "write",
+})
+
+# import roots that are never project code: calls through these aliases
+# are external and must not hit the unique-attribute fallback
+_STDLIB_ROOTS = frozenset({
+    "os", "sys", "io", "re", "json", "math", "time", "ast", "gc",
+    "asyncio", "threading", "subprocess", "shutil", "base64", "zlib",
+    "itertools", "collections", "functools", "dataclasses", "typing",
+    "urllib", "socket", "struct", "hashlib", "random", "queue",
+    "logging", "signal", "argparse", "uuid", "bisect", "heapq",
+    "np", "numpy", "jax", "jnp", "web", "aiohttp", "PIL", "psutil",
+})
+
+# span factories (utils/trace.py vocabulary): a function that reaches
+# one of these creates-or-inherits a request span
+_SPAN_FACTORIES = frozenset({"start_span", "event_span", "use_span"})
+_SPAN_CTX = frozenset({"span", "stage"})  # need a trace-ish receiver
+
+# WAL-append receivers: `<recv>.append(...)` is a raw WAL mutation when
+# the receiver names a write-ahead-log handle (or was constructed from
+# WriteAheadLog(...) in the same scope — tracked per function)
+_WAL_RECEIVER_SUFFIXES = ("wal", "_wal")
+
+
+def _norm(text: str) -> str:
+    return "".join(text.split())
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 - exotic shapes
+        return ""
+
+
+def _lockish(expr_norm: str) -> bool:
+    last = expr_norm.rsplit(".", 1)[-1].split("(")[0]
+    low = last.lower()
+    return "lock" in low or "mutex" in low
+
+
+@dataclasses.dataclass
+class CallSite:
+    raw: str                 # dotted callee source text
+    line: int
+    held: Tuple[str, ...]    # lock ids lexically held at the site
+    awaited: bool = False
+    offloaded: bool = False  # executor/thread thunk target: off-loop
+    in_lambda: bool = False
+    callee: Optional[str] = None   # resolved qname (filled in pass 2)
+    tier: str = ""                 # resolution tier, "" = unresolved
+
+
+@dataclasses.dataclass
+class LockAcq:
+    lock: str
+    line: int
+    held: Tuple[str, ...]    # locks already held when this one is taken
+
+
+@dataclasses.dataclass
+class FunctionNode:
+    qname: str               # "<path>::<Qual.name>"
+    path: str
+    qual: str                # dotted qualname within the file
+    name: str
+    line: int
+    is_async: bool
+    cls: Optional[str]       # enclosing class name (None for functions)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    lock_acqs: List[LockAcq] = dataclasses.field(default_factory=list)
+    held_entry: Tuple[str, ...] = ()   # holds[...] caller-holds contract
+    span_lines: List[int] = dataclasses.field(default_factory=list)
+    wal_appends: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list)          # (line, receiver text)
+    wal_ctor_lines: List[Tuple[int, bool]] = dataclasses.field(
+        default_factory=list)          # (line, has epoch= AND lease=)
+
+
+class CallGraph:
+    def __init__(self) -> None:
+        self.nodes: Dict[str, FunctionNode] = {}
+        self.stats: Dict[str, Any] = {}
+        self._callers: Optional[Dict[str, List[Tuple[str, int]]]] = None
+        self._locks_all: Optional[Dict[str, Set[str]]] = None
+        self._blocks: Optional[Dict[str, Dict[str, tuple]]] = None
+        self._blocks_matcher = None
+        self._span_reach: Optional[Set[str]] = None
+        self._lock_edges: Optional[Dict[tuple, List[dict]]] = None
+
+    # -- reverse edges --------------------------------------------------------
+
+    def callers(self) -> Dict[str, List[Tuple[str, int]]]:
+        if self._callers is None:
+            rev: Dict[str, List[Tuple[str, int]]] = {}
+            for f in self.nodes.values():
+                for s in f.calls:
+                    if s.callee:
+                        rev.setdefault(s.callee, []).append(
+                            (f.qname, s.line))
+            self._callers = rev
+        return self._callers
+
+    def entry_chain(self, qname: str, prefer_async: bool = True,
+                    limit: int = 12) -> List[str]:
+        """Shortest caller chain from an entry point (an async def, or
+        a function nobody calls) down to ``qname`` — the witness prefix
+        ``--chain`` prints for fencing findings."""
+        rev = self.callers()
+        seen = {qname}
+        frontier = [[qname]]
+        best: Optional[List[str]] = None
+        while frontier and len(frontier[0]) <= limit:
+            path = frontier.pop(0)
+            head = path[0]
+            ins = rev.get(head, [])
+            node = self.nodes.get(head)
+            if not ins or (prefer_async and node is not None
+                           and node.is_async):
+                best = path
+                if not prefer_async or (node is not None
+                                        and node.is_async):
+                    break
+                continue
+            for caller, _line in ins:
+                if caller not in seen:
+                    seen.add(caller)
+                    frontier.append([caller] + path)
+        return best or [qname]
+
+    # -- transitive lock sets -------------------------------------------------
+
+    def locks_transitive(self) -> Dict[str, Set[str]]:
+        """Locks a function's execution may acquire, at any depth,
+        through resolved non-offloaded callees (bounded fixpoint)."""
+        if self._locks_all is not None:
+            return self._locks_all
+        out: Dict[str, Set[str]] = {
+            q: {a.lock for a in f.lock_acqs}
+            for q, f in self.nodes.items()}
+        passes = 0
+        changed = True
+        while changed and passes < MAX_FIXPOINT_PASSES:
+            changed = False
+            passes += 1
+            for q, f in self.nodes.items():
+                cur = out[q]
+                before = len(cur)
+                for s in f.calls:
+                    if s.offloaded or not s.callee:
+                        continue
+                    callee = self.nodes.get(s.callee)
+                    if callee is None:
+                        continue
+                    if callee.is_async and not s.awaited:
+                        continue  # a coroutine object, never executed here
+                    cur |= out.get(s.callee, set())
+                if len(cur) != before:
+                    changed = True
+        self.stats["lock_fixpoint_passes"] = passes
+        self._locks_all = out
+        return out
+
+    def _acquire_path(self, start: str, lock: str,
+                      limit: int = 10) -> List[str]:
+        """A concrete call path from ``start`` to a function that
+        directly acquires ``lock`` (for witness chains)."""
+        seen = {start}
+        frontier = [[start]]
+        while frontier and len(frontier[0]) <= limit:
+            path = frontier.pop(0)
+            head = self.nodes.get(path[-1])
+            if head is None:
+                continue
+            if any(a.lock == lock for a in head.lock_acqs):
+                return path
+            for s in head.calls:
+                if s.offloaded or not s.callee or s.callee in seen:
+                    continue
+                callee = self.nodes.get(s.callee)
+                if callee is None or (callee.is_async and not s.awaited):
+                    continue
+                seen.add(s.callee)
+                frontier.append(path + [s.callee])
+        return [start]
+
+    def lock_edges(self) -> Dict[tuple, List[dict]]:
+        """Ordered lock-acquisition pairs aggregated across the whole
+        project: edge ``(L, M)`` = some execution acquires ``M`` while
+        holding ``L`` (directly nested ``with`` blocks, or a call made
+        under ``L`` whose transitive lock set contains ``M``).  Each
+        edge carries witness dicts (path/line/chain) for reporting."""
+        if self._lock_edges is not None:
+            return self._lock_edges
+        locks_all = self.locks_transitive()
+        edges: Dict[tuple, List[dict]] = {}
+
+        def add(outer: str, inner: str, witness: dict) -> None:
+            lst = edges.setdefault((outer, inner), [])
+            if len(lst) < 3:
+                lst.append(witness)
+
+        for q, f in self.nodes.items():
+            base = set(f.held_entry)
+            for acq in f.lock_acqs:
+                for outer in set(acq.held) | base:
+                    if outer == acq.lock:
+                        continue  # re-entering the same with is the
+                        # lockset rule's domain, not an ordering edge
+                    add(outer, acq.lock,
+                        {"path": f.path, "line": acq.line,
+                         "chain": [f.qual]})
+            for s in f.calls:
+                if s.offloaded or not s.callee:
+                    continue
+                callee = self.nodes.get(s.callee)
+                if callee is None or (callee.is_async and not s.awaited):
+                    continue
+                held = set(s.held) | base
+                if not held:
+                    continue
+                for inner in locks_all.get(s.callee, ()):
+                    for outer in held:
+                        if inner == outer:
+                            continue
+                        path = self._acquire_path(s.callee, inner)
+                        add(outer, inner,
+                            {"path": f.path, "line": s.line,
+                             "chain": [f.qual] + [
+                                 self.nodes[p].qual for p in path
+                                 if p in self.nodes]})
+        self.stats["lock_edges"] = len(edges)
+        self._lock_edges = edges
+        return edges
+
+    def lock_cycles(self) -> List[dict]:
+        """Cycles in the lock-order graph (Tarjan SCCs + self-loops),
+        each with every in-cycle edge's witnesses."""
+        edges = self.lock_edges()
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            # iterative Tarjan (the lock graph is tiny, but recursion
+            # depth must not depend on input shape)
+            work = [(v, iter(sorted(adj.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(adj.get(w, ())))))
+                        advanced = True
+                        break
+                    if w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    sccs.append(comp)
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        out = []
+        for comp in sccs:
+            comp_set = set(comp)
+            cyclic = len(comp) > 1 or any(
+                (v, v) in edges for v in comp)
+            if not cyclic:
+                continue
+            cyc_edges = {
+                (a, b): ws for (a, b), ws in edges.items()
+                if a in comp_set and b in comp_set}
+            out.append({"locks": sorted(comp_set),
+                        "edges": cyc_edges})
+        return sorted(out, key=lambda c: c["locks"])
+
+    # -- may-block summaries --------------------------------------------------
+
+    def blocking_summaries(self, matcher) -> Dict[str, Dict[str, tuple]]:
+        """``{qname: {leaf_raw: (why, [(qname, line), ...])}}`` — the
+        blocking leaves a function's *synchronous, on-the-same-thread*
+        execution can reach, each with one witness chain (call-site
+        hops ending at the leaf's site).  ``matcher(raw) -> why`` is
+        rules_async's leaf classifier.  Cuts: executor thunks,
+        ``*_off_loop`` helpers, async callees (they are roots of their
+        own findings)."""
+        if self._blocks is not None:
+            if matcher is not self._blocks_matcher:
+                raise ValueError(
+                    "blocking_summaries already computed with a "
+                    "different matcher — the cache is per-graph, one "
+                    "leaf classifier per project")
+            return self._blocks
+        self._blocks_matcher = matcher
+        direct: Dict[str, Dict[str, tuple]] = {}
+        for q, f in self.nodes.items():
+            leaves: Dict[str, tuple] = {}
+            for s in f.calls:
+                if s.offloaded:
+                    continue
+                why = matcher(s.raw)
+                if why:
+                    leaves.setdefault(s.raw, (why, [(q, s.line)]))
+            direct[q] = leaves
+        out = {q: dict(v) for q, v in direct.items()}
+        passes = 0
+        changed = True
+        while changed and passes < MAX_FIXPOINT_PASSES:
+            changed = False
+            passes += 1
+            for q, f in self.nodes.items():
+                mine = out[q]
+                for s in f.calls:
+                    if s.offloaded or not s.callee:
+                        continue
+                    callee = self.nodes.get(s.callee)
+                    if callee is None or callee.is_async:
+                        continue
+                    if callee.name.endswith("_off_loop"):
+                        continue  # offloading-by-contract helper
+                    if matcher(s.raw):
+                        continue  # already a leaf at this site
+                    for leaf, (why, chain) in out[s.callee].items():
+                        if leaf not in mine:
+                            mine[leaf] = (why, [(q, s.line)] + chain)
+                            changed = True
+        self.stats["block_fixpoint_passes"] = passes
+        self._blocks = out
+        return out
+
+    # -- span reachability ----------------------------------------------------
+
+    def span_reach(self) -> Set[str]:
+        """Functions whose execution (any thread — offloaded thunks
+        included, they propagate the captured span context) reaches a
+        span factory."""
+        if self._span_reach is not None:
+            return self._span_reach
+        reached = {q for q, f in self.nodes.items() if f.span_lines}
+        passes = 0
+        changed = True
+        while changed and passes < MAX_FIXPOINT_PASSES:
+            changed = False
+            passes += 1
+            for q, f in self.nodes.items():
+                if q in reached:
+                    continue
+                for s in f.calls:
+                    if s.callee and s.callee in reached:
+                        reached.add(q)
+                        changed = True
+                        break
+        self.stats["span_fixpoint_passes"] = passes
+        self._span_reach = reached
+        return reached
+
+    # -- JSON dump (cli lint --graph) ----------------------------------------
+
+    def to_json(self) -> Dict[str, Any]:
+        call_edges = []
+        for q, f in self.nodes.items():
+            for s in f.calls:
+                if s.callee:
+                    call_edges.append({
+                        "caller": q, "callee": s.callee, "line": s.line,
+                        "tier": s.tier, "offloaded": s.offloaded,
+                        "held": list(s.held)})
+        lock_edges = [
+            {"outer": a, "inner": b, "witnesses": ws}
+            for (a, b), ws in sorted(self.lock_edges().items())]
+        return {"functions": len(self.nodes),
+                "call_edges": call_edges,
+                "lock_edges": lock_edges,
+                "stats": dict(self.stats)}
+
+
+# --- builder ------------------------------------------------------------------
+
+class _ModuleIndex:
+    """Per-file symbol tables pass 1 collects, pass 2 resolves with."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.funcs: Dict[str, str] = {}          # name -> qname
+        self.classes: Dict[str, Dict[str, str]] = {}  # cls -> {meth: q}
+        self.imports: Dict[str, str] = {}        # alias -> dotted module
+        self.from_names: Dict[str, Tuple[str, str]] = {}  # n -> (mod, a)
+        self.nonproject: Set[str] = set()        # aliases to external code
+
+
+def _dotted_to_path(dotted: str) -> Optional[str]:
+    if not dotted.startswith(PACKAGE_DIR.replace("/", ".")):
+        return None
+    return dotted.replace(".", "/") + ".py"
+
+
+class _Builder:
+    def __init__(self, project: Project):
+        self.project = project
+        self.graph = CallGraph()
+        self.modules: Dict[str, _ModuleIndex] = {}
+        # method name -> {qname of Class.method} across the project
+        self.method_owners: Dict[str, Set[str]] = {}
+        # lock-ish attribute -> {class names assigning self.<attr>}
+        self.lock_attr_owners: Dict[str, Set[str]] = {}
+        self.tier_counts: Dict[str, int] = {}
+        self.unresolved = 0
+        self.total_sites = 0
+
+    # -- pass 1: collect ------------------------------------------------------
+    # 1a registers every symbol table (imports, functions, classes,
+    # lock-attribute owners) across ALL files; only then does 1b walk
+    # bodies.  Body walks consult lock_attr_owners to canonicalize lock
+    # ids (`state._queue_lock` -> ServerState._queue_lock), so walking
+    # while the owner map is still filling would make lock identity —
+    # and therefore the deadlock-cycle verdict — depend on filesystem
+    # enumeration order.
+
+    def collect(self) -> None:
+        pending: List[tuple] = []
+        for sf in self.project.python_files():
+            idx = _ModuleIndex(sf)
+            self.modules[sf.path] = idx
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        alias = a.asname or a.name.split(".")[0]
+                        target = a.name
+                        if _dotted_to_path(target):
+                            idx.imports[alias] = target
+                        else:
+                            idx.nonproject.add(alias)
+                elif isinstance(node, ast.ImportFrom):
+                    mod = node.module or ""
+                    if node.level:
+                        continue  # no relative imports in this package
+                    for a in node.names:
+                        alias = a.asname or a.name
+                        if _dotted_to_path(f"{mod}.{a.name}"):
+                            # `from pkg.runtime import durable as dur`
+                            idx.imports[alias] = f"{mod}.{a.name}"
+                        elif _dotted_to_path(mod):
+                            idx.from_names[alias] = (mod, a.name)
+                        else:
+                            idx.nonproject.add(alias)
+            self._collect_scope(sf, idx, sf.tree.body, [], None,
+                                pending)
+        for sf, fn, stmt, cls in pending:
+            self._walk_body(sf, fn, stmt, cls)
+
+    def _collect_scope(self, sf: SourceFile, idx: _ModuleIndex,
+                       body: List[ast.stmt], scopes: List[str],
+                       cls: Optional[str],
+                       pending: List[tuple]) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                idx.classes.setdefault(stmt.name, {})
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        q = f"{sf.path}::" + ".".join(
+                            scopes + [stmt.name, sub.name])
+                        idx.classes[stmt.name][sub.name] = q
+                        if not scopes:
+                            self.method_owners.setdefault(
+                                sub.name, set()).add(q)
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) \
+                            and sub.name == "__init__":
+                        for n in ast.walk(sub):
+                            if isinstance(n, ast.Assign):
+                                for t in n.targets:
+                                    if isinstance(t, ast.Attribute) \
+                                            and isinstance(t.value,
+                                                           ast.Name) \
+                                            and t.value.id == "self" \
+                                            and _lockish(t.attr):
+                                        self.lock_attr_owners \
+                                            .setdefault(t.attr, set()) \
+                                            .add(stmt.name)
+                self._collect_scope(sf, idx, stmt.body,
+                                    scopes + [stmt.name], stmt.name,
+                                    pending)
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                qual = ".".join(scopes + [stmt.name])
+                q = f"{sf.path}::{qual}"
+                if not scopes:
+                    idx.funcs[stmt.name] = q
+                fn = FunctionNode(
+                    qname=q, path=sf.path, qual=qual, name=stmt.name,
+                    line=stmt.lineno,
+                    is_async=isinstance(stmt, ast.AsyncFunctionDef),
+                    cls=cls)
+                self.graph.nodes[q] = fn
+                pending.append((sf, fn, stmt, cls))
+                self._collect_scope(sf, idx, stmt.body,
+                                    scopes + [stmt.name], cls,
+                                    pending)
+
+    # -- body walk (one function, nested defs excluded) -----------------------
+
+    def _lock_id(self, expr: ast.AST, cls: Optional[str],
+                 path: str) -> Optional[str]:
+        text = _norm(_unparse(expr))
+        if not text or not _lockish(text):
+            return None
+        parts = text.split(".")
+        attr = parts[-1].split("(")[0]
+        if parts[0] == "self" and len(parts) == 2 and cls:
+            return f"{cls}.{attr}"
+        owners = self.lock_attr_owners.get(attr)
+        if owners is not None and len(owners) == 1 and len(parts) >= 2:
+            return f"{next(iter(owners))}.{attr}"
+        if len(parts) == 1:
+            # a bare name is a module-global lock of THIS module —
+            # qualify by file so two modules' `_lock` globals never
+            # conflate into one graph node (a merged node could close
+            # a spurious, never-baselineable cycle)
+            return f"{path}::{text}"
+        return text
+
+    def _walk_body(self, sf: SourceFile, fn: FunctionNode,
+                   func_node: ast.AST, cls: Optional[str]) -> None:
+        held_marks = holds_locks(sf, func_node)
+        fn.held_entry = tuple(sorted(
+            x for x in (self._lock_id(ast.parse(h, mode="eval").body,
+                                      cls, sf.path)
+                        if _is_parsable(h) else None
+                        for h in held_marks) if x))
+
+        def record_call(node: ast.Call, held: tuple, awaited: bool,
+                        offloaded: bool, in_lambda: bool) -> None:
+            raw = _norm(_unparse(node.func))
+            self.total_sites += 1
+            fn.calls.append(CallSite(
+                raw=raw, line=node.lineno, held=held, awaited=awaited,
+                offloaded=offloaded, in_lambda=in_lambda))
+            # span factories
+            attr = raw.rsplit(".", 1)[-1]
+            recv = raw.rsplit(".", 1)[0] if "." in raw else ""
+            if attr in _SPAN_FACTORIES or (
+                    attr in _SPAN_CTX
+                    and (recv == "" or "trace" in recv)):
+                fn.span_lines.append(node.lineno)
+            # raw WAL mutations + constructions
+            if attr == "append" and "." in raw:
+                recv_last = recv.rsplit(".", 1)[-1]
+                if recv_last in _WAL_RECEIVER_SUFFIXES \
+                        or recv_last.endswith("wal") \
+                        or recv in fn_wal_names:
+                    fn.wal_appends.append((node.lineno, recv))
+            if attr == "WriteAheadLog":
+                kw = {k.arg for k in node.keywords}
+                fn.wal_ctor_lines.append(
+                    (node.lineno, "epoch" in kw and "lease" in kw))
+
+        fn_wal_names: Set[str] = set()
+
+        def note_wal_binding(stmt: ast.AST) -> None:
+            # `closer = dur.WriteAheadLog(...)` binds a WAL handle name
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call):
+                raw = _norm(_unparse(stmt.value.func))
+                if raw.rsplit(".", 1)[-1] == "WriteAheadLog":
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            fn_wal_names.add(t.id)
+
+        def thunk_edge(arg: ast.AST, held: tuple,
+                       in_lambda: bool) -> None:
+            """The off-loop target of an executor/thread hand-off."""
+            if arg is None:
+                return
+            if isinstance(arg, ast.Lambda):
+                walk(arg.body, held, offloaded=True, in_lambda=True)
+                return
+            if isinstance(arg, ast.Call):
+                raw = _norm(_unparse(arg.func))
+                if raw.rsplit(".", 1)[-1] == "partial" and arg.args:
+                    thunk_edge(arg.args[0], held, in_lambda)
+                    for a in arg.args[1:]:
+                        walk(a, held, False, in_lambda)
+                    return
+                walk(arg, held, offloaded=False, in_lambda=in_lambda)
+                return
+            raw = _norm(_unparse(arg))
+            if raw:
+                self.total_sites += 1
+                fn.calls.append(CallSite(
+                    raw=raw, line=getattr(arg, "lineno", fn.line),
+                    held=held, offloaded=True, in_lambda=in_lambda))
+
+        def walk(node: ast.AST, held: tuple, offloaded: bool,
+                 in_lambda: bool) -> None:
+            if node is None:
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return  # separate graph nodes
+            if isinstance(node, ast.Lambda):
+                walk(node.body, held, offloaded, in_lambda=True)
+                return
+            if isinstance(node, ast.Await):
+                if isinstance(node.value, ast.Call):
+                    handle_call(node.value, held, True, offloaded,
+                                in_lambda)
+                else:
+                    walk(node.value, held, offloaded, in_lambda)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                added: List[str] = []
+                for item in node.items:
+                    walk(item.context_expr, held, offloaded, in_lambda)
+                    lid = self._lock_id(item.context_expr, cls,
+                                        sf.path)
+                    if lid:
+                        fn.lock_acqs.append(LockAcq(
+                            lock=lid, line=item.context_expr.lineno,
+                            held=tuple(held) + tuple(added)))
+                        added.append(lid)
+                inner = tuple(held) + tuple(added)
+                for stmt in node.body:
+                    note_wal_binding(stmt)
+                    walk(stmt, inner, offloaded, in_lambda)
+                return
+            if isinstance(node, ast.Call):
+                handle_call(node, held, False, offloaded, in_lambda)
+                return
+            note_wal_binding(node)
+            for child in ast.iter_child_nodes(node):
+                walk(child, held, offloaded, in_lambda)
+
+        def handle_call(node: ast.Call, held: tuple, awaited: bool,
+                        offloaded: bool, in_lambda: bool) -> None:
+            raw = _norm(_unparse(node.func))
+            attr = raw.rsplit(".", 1)[-1]
+            if attr == "run_in_executor" and len(node.args) >= 2:
+                thunk_edge(node.args[1], held, in_lambda)
+                for a in node.args[2:]:
+                    walk(a, held, offloaded, in_lambda)
+                return
+            if raw in ("asyncio.to_thread", "to_thread") and node.args:
+                thunk_edge(node.args[0], held, in_lambda)
+                for a in node.args[1:]:
+                    walk(a, held, offloaded, in_lambda)
+                return
+            if attr in ("Thread", "Timer"):
+                for k in node.keywords:
+                    if k.arg == "target":
+                        thunk_edge(k.value, held, in_lambda)
+                    else:
+                        walk(k.value, held, offloaded, in_lambda)
+                for a in node.args:
+                    walk(a, held, offloaded, in_lambda)
+                return
+            if attr == "partial" and node.args:
+                thunk_edge(node.args[0], held, in_lambda)
+                for a in node.args[1:]:
+                    walk(a, held, offloaded, in_lambda)
+                return
+            record_call(node, held, awaited, offloaded, in_lambda)
+            for a in node.args:
+                walk(a, held, offloaded, in_lambda)
+            for k in node.keywords:
+                walk(k.value, held, offloaded, in_lambda)
+
+        body = getattr(func_node, "body", [])
+        for stmt in body:
+            note_wal_binding(stmt)
+            walk(stmt, (), False, False)
+
+    # -- pass 2: resolve ------------------------------------------------------
+
+    def resolve(self) -> None:
+        for q, fn in self.graph.nodes.items():
+            idx = self.modules.get(fn.path)
+            if idx is None:
+                continue
+            for site in fn.calls:
+                callee, tier = self._resolve(site.raw, fn, idx)
+                site.callee = callee
+                site.tier = tier
+                if callee:
+                    self.tier_counts[tier] = \
+                        self.tier_counts.get(tier, 0) + 1
+                else:
+                    self.unresolved += 1
+
+    def _resolve(self, raw: str, fn: FunctionNode,
+                 idx: _ModuleIndex) -> Tuple[Optional[str], str]:
+        if not raw:
+            return None, ""
+        parts = raw.split(".")
+        # bare name: nested def in an enclosing scope, module function,
+        # from-import, or a class constructor
+        if len(parts) == 1:
+            name = parts[0].split("(")[0]
+            scope_parts = fn.qual.split(".")
+            for i in range(len(scope_parts), 0, -1):
+                cand = f"{fn.path}::" + ".".join(
+                    scope_parts[:i] + [name])
+                if cand in self.graph.nodes:
+                    return cand, "local"
+            if name in idx.funcs:
+                return idx.funcs[name], "module"
+            if name in idx.classes:
+                init = idx.classes[name].get("__init__")
+                return (init, "class") if init else (None, "")
+            if name in idx.from_names:
+                mod, attr = idx.from_names[name]
+                return self._resolve_in_module(mod, attr)
+            return None, ""
+        root, attr = parts[0], parts[-1].split("(")[0]
+        if root in ("self", "cls") and fn.cls:
+            if len(parts) == 2:
+                meths = idx.classes.get(fn.cls, {})
+                if attr in meths:
+                    return meths[attr], "self"
+            return self._unique_attr(root, attr)
+        if root in idx.imports and len(parts) >= 2:
+            # module alias: mod.f / mod.Class
+            return self._resolve_in_module(idx.imports[root],
+                                           parts[1].split("(")[0])
+        if root in idx.classes and len(parts) == 2:
+            meths = idx.classes[root]
+            if attr in meths:
+                return meths[attr], "class"
+        if root in idx.from_names and len(parts) == 2:
+            # imported class: Cls.method
+            mod, name = idx.from_names[root]
+            mpath = _dotted_to_path(mod)
+            midx = self.modules.get(mpath or "")
+            if midx and name in midx.classes \
+                    and attr in midx.classes[name]:
+                return midx.classes[name][attr], "class"
+        return self._unique_attr(root, attr)
+
+    def _resolve_in_module(self, dotted: str,
+                           name: str) -> Tuple[Optional[str], str]:
+        mpath = _dotted_to_path(dotted)
+        midx = self.modules.get(mpath or "")
+        if midx is None:
+            return None, ""
+        if name in midx.funcs:
+            return midx.funcs[name], "import"
+        if name in midx.classes:
+            init = midx.classes[name].get("__init__")
+            return (init, "import") if init else (None, "")
+        return None, ""
+
+    def _unique_attr(self, root: str,
+                     attr: str) -> Tuple[Optional[str], str]:
+        """The dynamic-dispatch fallback: ``obj.method(...)`` resolves
+        only when exactly one project class defines the method and the
+        name is specific enough to mean it."""
+        if root in _STDLIB_ROOTS or attr in GENERIC_ATTRS \
+                or attr.startswith("__"):
+            return None, ""
+        owners = self.method_owners.get(attr)
+        if owners is not None and len(owners) == 1:
+            return next(iter(owners)), "unique"
+        return None, ""
+
+
+def _is_parsable(expr: str) -> bool:
+    try:
+        ast.parse(expr, mode="eval")
+        return True
+    except SyntaxError:
+        return False
+
+
+def build_callgraph(project: Project) -> CallGraph:
+    b = _Builder(project)
+    b.collect()
+    b.resolve()
+    g = b.graph
+    g.stats.update({
+        "functions": len(g.nodes),
+        "call_sites": b.total_sites,
+        "resolved_by_tier": dict(sorted(b.tier_counts.items())),
+        "unresolved_calls": b.unresolved,
+    })
+    return g
+
+
+def get_callgraph(project: Project) -> CallGraph:
+    """Build-once accessor: the graph is cached on the project so every
+    v2 rule (and ``cli lint --stats``/``--graph``) shares one build."""
+    g = getattr(project, "_callgraph", None)
+    if g is None:
+        g = build_callgraph(project)
+        project._callgraph = g
+    return g

@@ -56,9 +56,15 @@ class Violation:
     message: str
     scope: str = ""      # enclosing def/class qualname (baseline keying)
     key: str = ""        # filled by lint_project
+    # interprocedural witness (v2 rules): each entry one hop,
+    # "qualname (path:line)" — printed by `cli lint --chain`
+    chain: List[str] = dataclasses.field(default_factory=list)
 
     def format(self) -> str:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def format_chain(self) -> str:
+        return "".join(f"\n      {hop}" for hop in self.chain)
 
 
 @dataclasses.dataclass
@@ -109,7 +115,10 @@ def load_project(root: str,
     files: Dict[str, SourceFile] = {}
     pkg_root = os.path.join(root, PACKAGE_DIR)
     for dirpath, dirnames, names in os.walk(pkg_root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        # sorted: the callgraph's symbol tables (and therefore the
+        # interprocedural verdicts) must not depend on filesystem
+        # enumeration order
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
         for name in sorted(names):
             if not name.endswith(".py"):
                 continue
@@ -278,6 +287,13 @@ class LintReport:
     violations: List[Violation]          # everything found
     new: List[Violation]                 # beyond the baseline counts
     baseline_total: int
+    # per-rule accounting for `cli lint --stats` (baseline growth must
+    # be visible per PR): {rule: {"found": n, "suppressed": n}}
+    rule_counts: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+    # call-graph size/resolution stats when the interprocedural tier
+    # ran (nodes/edges/fixpoint passes/unresolved dynamic dispatch)
+    graph_stats: Optional[Dict[str, object]] = None
 
     @property
     def ok(self) -> bool:
@@ -300,10 +316,14 @@ def _split_new(violations: List[Violation],
 
 
 def lint_project(project: Project,
-                 rules: Optional[List[str]] = None) -> List[Violation]:
+                 rules: Optional[List[str]] = None,
+                 rule_counts: Optional[Dict[str, Dict[str, int]]] = None
+                 ) -> List[Violation]:
     """Run the (selected) rules; suppressions applied, keys filled.
     Unknown rule names raise — a misspelled ``--rule`` must never
-    select zero rules and report a clean tree."""
+    select zero rules and report a clean tree.  ``rule_counts`` (an
+    out-param dict) receives per-rule found/suppressed tallies for
+    ``cli lint --stats``."""
     if rules is not None:
         unknown = sorted(set(rules) - set(ALL_RULES))
         if unknown:
@@ -313,18 +333,29 @@ def lint_project(project: Project,
     selected = ALL_RULES if rules is None else {
         name: fn for name, fn in ALL_RULES.items() if name in rules}
     out: List[Violation] = []
+
+    def count(rule: str, field: str) -> None:
+        if rule_counts is not None:
+            rule_counts.setdefault(
+                rule, {"found": 0, "suppressed": 0})[field] += 1
+
     for sf in project.files.values():
         if sf.parse_error:
             v = Violation("parse-error", sf.path, 1, sf.parse_error)
             v.key = violation_key(v, sf)
+            count("parse-error", "found")
             out.append(v)
     for name, fn in selected.items():
+        if rule_counts is not None:
+            rule_counts.setdefault(name, {"found": 0, "suppressed": 0})
         for v in fn(project):
+            count(v.rule, "found")
             sf = project.get(v.path) or (
                 project.readme if v.path == README_PATH else None)
             if sf is not None:
                 sup, reasonless = suppressed_rules(sf, v.line)
                 if v.rule in sup:
+                    count(v.rule, "suppressed")
                     continue
                 if reasonless:
                     # diagnose the inert marker: the developer meant to
@@ -345,12 +376,18 @@ def run_lint(root: Optional[str] = None,
     """The one-call entry point ``cli lint`` and the tier-1 gate use."""
     root = root or repo_root()
     project = load_project(root, overrides=overrides)
-    violations = lint_project(project, rules=rules)
+    rule_counts: Dict[str, Dict[str, int]] = {}
+    violations = lint_project(project, rules=rules,
+                              rule_counts=rule_counts)
     if baseline is None:
         baseline = load_baseline(root)
+    graph = getattr(project, "_callgraph", None)
     return LintReport(violations=violations,
                       new=_split_new(violations, baseline),
-                      baseline_total=sum(baseline.values()))
+                      baseline_total=sum(baseline.values()),
+                      rule_counts=rule_counts,
+                      graph_stats=(dict(graph.stats)
+                                   if graph is not None else None))
 
 
 def repo_root() -> str:

@@ -149,3 +149,66 @@ def check_async_blocking(project: Project) -> List[Violation]:
             for stmt in node.body:
                 v.visit(stmt)
     return out
+
+
+# --- the interprocedural tier (ISSUE 15) --------------------------------------
+
+_TRANSITIVE = "async-blocking-transitive"
+
+
+def blocking_matcher(raw: str) -> str:
+    """The leaf classifier the call-graph summaries use — the same
+    table as the direct rule, so the two tiers can never disagree on
+    what counts as blocking."""
+    return _callee_matches(raw)
+
+
+@rule(_TRANSITIVE)
+def check_async_blocking_transitive(project: Project) -> List[Violation]:
+    """An ``async def`` reaching a blocking leaf through ANY sync call
+    chain (``route -> helper -> fsync``) stalls the event loop exactly
+    like a direct call — the v1 rule's blind spot once the blocking
+    call moves one frame down.  Chain cuts mirror the direct rule's
+    exemptions: executor thunks (``run_in_executor``/``to_thread``/
+    ``Thread(target=...)``/``partial`` hand-offs) run off-loop,
+    ``*_off_loop`` helpers offload by contract, lambdas stay exempt at
+    the async body (thunk position), and awaited async callees are
+    roots of their own findings.  Direct blocking calls stay the v1
+    rule's findings — this tier reports only depth >= 2 chains."""
+    from comfyui_distributed_tpu.analysis import callgraph as cg
+    graph = cg.get_callgraph(project)
+    blocks = graph.blocking_summaries(blocking_matcher)
+    out: List[Violation] = []
+    for qname, fn in sorted(graph.nodes.items()):
+        if not fn.is_async:
+            continue
+        for site in fn.calls:
+            if site.offloaded or site.in_lambda or not site.callee:
+                continue
+            if blocking_matcher(site.raw):
+                continue  # the direct rule's finding, not ours
+            callee = graph.nodes.get(site.callee)
+            if callee is None or callee.is_async:
+                continue
+            if callee.name.endswith("_off_loop"):
+                continue
+            leaves = blocks.get(site.callee)
+            if not leaves:
+                continue
+            leaf, (why, chain) = sorted(leaves.items())[0]
+            hops = [fn.qual] + [graph.nodes[q].qual
+                                for q, _ln in chain
+                                if q in graph.nodes]
+            arrow = " -> ".join(hops + [f"{leaf}()"])
+            v = Violation(
+                _TRANSITIVE, fn.path, site.line,
+                f"`{site.raw}(...)` reaches blocking `{leaf}` ({why}) "
+                f"on the event loop via {arrow} — offload the call "
+                f"(`await loop.run_in_executor(None, ...)`) or push "
+                f"the blocking leaf behind an executor",
+                scope=fn.qual)
+            v.chain = [f"{fn.qual} ({fn.path}:{site.line})"] + [
+                f"{graph.nodes[q].qual} ({graph.nodes[q].path}:{ln})"
+                for q, ln in chain if q in graph.nodes] + [f"{leaf}()"]
+            out.append(v)
+    return out

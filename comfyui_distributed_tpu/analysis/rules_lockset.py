@@ -140,6 +140,143 @@ class _MethodChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# --- the interprocedural tier (ISSUE 15) --------------------------------------
+
+_DEADLOCK = "deadlock-cycle"
+_WAL_FENCING = "wal-fencing"
+
+
+@rule(_DEADLOCK)
+def check_deadlock_cycles(project: Project) -> List[Violation]:
+    """Lock-order deadlock detector: aggregate every ordered
+    lock-acquisition pair from the call-graph summaries (a ``with L:``
+    whose body — directly or through any resolved call chain —
+    acquires ``M`` contributes edge ``L -> M``; ``holds[...]``
+    caller-holds contracts seed the held set), then report every cycle
+    in the resulting lock-order graph with a witness chain per edge.
+    Two threads taking the same two locks in opposite orders is the
+    classic ABBA deadlock; the static version needs no schedule, only
+    the order.  Executor/thread thunk hand-offs are excluded (the thunk
+    runs later, without the lexically surrounding locks).  This is a
+    bug-class rule: findings are never baselined (test-enforced)."""
+    from comfyui_distributed_tpu.analysis import callgraph as cg
+    graph = cg.get_callgraph(project)
+    out: List[Violation] = []
+    for cyc in graph.lock_cycles():
+        locks = cyc["locks"]
+        edges = sorted(cyc["edges"].items())
+        first_w = edges[0][1][0]
+        lines = []
+        chain = []
+        for (a, b), ws in edges:
+            w = ws[0]
+            via = " -> ".join(w["chain"])
+            lines.append(f"{a} -> {b} (held across {via} at "
+                         f"{w['path']}:{w['line']})")
+            chain.append(f"{a} -> {b}: {via} ({w['path']}:{w['line']})")
+        v = Violation(
+            _DEADLOCK, first_w["path"], first_w["line"],
+            f"lock-order cycle over {{{', '.join(locks)}}}: "
+            + "; ".join(lines)
+            + " — pick ONE acquisition order (or narrow the critical "
+              "section so no foreign lock is taken while held)",
+            scope="lock-cycle:" + ">".join(locks))
+        v.chain = chain
+        out.append(v)
+    return out
+
+
+# WAL-fencing discipline (the multi-master correctness invariant):
+# every WAL mutation must carry the current epoch, which means every
+# append flows through a fenced surface —
+#   - runtime/durable.py itself (WriteAheadLog internals, DurableMaster
+#     log_* wrappers: the attached WAL carries the acquired epoch);
+#   - the per-plane append chokepoints (WorkLedger._wal_append,
+#     JobStore._log_idem): their WAL arrives via attach_wal from an
+#     epoch-checked owner, ONE audited call site per plane;
+#   - a scope that constructed its own WriteAheadLog with EXPLICIT
+#     epoch= and lease= credentials (the shard absorb/retry closers:
+#     their epoch comes from a lease they just acquired/renewed).
+# Everything else writing a WAL — or handing recovered state to the
+# live planes outside an epoch-checked entry point — is a finding.
+_DURABLE_PATH = "comfyui_distributed_tpu/runtime/durable.py"
+_APPEND_CHOKEPOINTS = ("WorkLedger._wal_append", "JobStore._log_idem")
+_RECOVERY_SURFACES = ("attach_wal", "merge_recovered", "merge_idem")
+
+
+def _acquires_lease(fn) -> bool:
+    """True when the scope itself acquires/renews a master lease — the
+    'epoch-checked entry point' credential (ShardManager.absorb's
+    ``lease.acquire`` before it merges recovered state)."""
+    for s in fn.calls:
+        attr = s.raw.rsplit(".", 1)[-1]
+        recv = s.raw.rsplit(".", 1)[0] if "." in s.raw else ""
+        if attr in ("acquire", "renew") and "lease" in recv.lower():
+            return True
+    return False
+
+
+@rule(_WAL_FENCING)
+def check_wal_fencing(project: Project) -> List[Violation]:
+    from comfyui_distributed_tpu.analysis import callgraph as cg
+    graph = cg.get_callgraph(project)
+    out: List[Violation] = []
+    for qname, fn in sorted(graph.nodes.items()):
+        if fn.path == _DURABLE_PATH:
+            continue
+        credentialed = any(ok for _ln, ok in fn.wal_ctor_lines)
+        for line, ok in fn.wal_ctor_lines:
+            if not ok:
+                out.append(Violation(
+                    _WAL_FENCING, fn.path, line,
+                    "WriteAheadLog constructed outside runtime/durable"
+                    ".py without explicit epoch=/lease= fencing "
+                    "credentials — an unfenced writer's appends can "
+                    "never be fenced out by a takeover epoch bump",
+                    scope=fn.qual))
+        for line, recv in fn.wal_appends:
+            if fn.qual in _APPEND_CHOKEPOINTS or credentialed:
+                continue
+            entry = " -> ".join(
+                graph.nodes[q].qual
+                for q in graph.entry_chain(qname)
+                if q in graph.nodes)
+            v = Violation(
+                _WAL_FENCING, fn.path, line,
+                f"raw WAL append on `{recv}` outside the fenced "
+                f"surfaces (DurableMaster/WorkLedger.attach_wal, or a "
+                f"scope holding its own epoch+lease) — every WAL "
+                f"mutation must carry the current epoch; reachable "
+                f"via {entry}",
+                scope=fn.qual)
+            v.chain = [entry, f"{fn.qual} ({fn.path}:{line})"]
+            out.append(v)
+        for s in fn.calls:
+            attr = s.raw.rsplit(".", 1)[-1]
+            if attr in _RECOVERY_SURFACES and "." in s.raw \
+                    and fn.name not in _RECOVERY_SURFACES \
+                    and not _acquires_lease(fn):
+                out.append(Violation(
+                    _WAL_FENCING, fn.path, s.line,
+                    f"`{s.raw}(...)` hands recovered state to a live "
+                    f"plane from a scope that never acquired/renewed a "
+                    f"master lease — ledger transitions must originate "
+                    f"from an epoch-checked entry point",
+                    scope=fn.qual))
+            if attr == "apply" and "." in s.raw:
+                recv = s.raw.rsplit(".", 1)[0]
+                if recv.rsplit(".", 1)[-1] == "tracker" \
+                        or recv == "replayed":
+                    out.append(Violation(
+                        _WAL_FENCING, fn.path, s.line,
+                        f"direct ReplayState mutation `{s.raw}(...)` "
+                        f"outside runtime/durable.py — the materializer "
+                        f"only advances through fenced appends or "
+                        f"recovery replay",
+                        scope=fn.qual))
+    return out
+
+
 @rule(_RULE)
 def check_lockset(project: Project) -> List[Violation]:
     out: List[Violation] = []

@@ -269,6 +269,137 @@ def _iter_span_attr_keys(sf: SourceFile):
                                scope_qualname(stack))
 
 
+# --- HTTP route contract (ISSUE 15) -------------------------------------------
+
+_ROUTE = "route-contract"
+_ROUTE_BUILDERS = ("build_app", "build_router_app")
+_ADD_METHODS = {"add_get": "GET", "add_post": "POST",
+                "add_put": "PUT", "add_delete": "DELETE"}
+_SPAN_NONE = ("", "—", "-", "none", "no")
+
+
+def _registered_routes(project: Project):
+    """(surface, method, path, file, line, handler_qual) for every
+    route wired in a ``build_app``/``build_router_app`` module-level
+    builder — ``surface`` distinguishes the master's app from the
+    stateless router's, which deliberately reuse paths (``/prompt``)."""
+    out = []
+    for sf in project.python_files():
+        for node in sf.tree.body:
+            if not isinstance(node, ast.FunctionDef) \
+                    or node.name not in _ROUTE_BUILDERS:
+                continue
+            surface = "router" if node.name == "build_router_app" \
+                else "master"
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call) \
+                        or not isinstance(call.func, ast.Attribute) \
+                        or call.func.attr not in _ADD_METHODS \
+                        or len(call.args) < 2:
+                    continue
+                path_arg, handler = call.args[0], call.args[1]
+                if not (isinstance(path_arg, ast.Constant)
+                        and isinstance(path_arg.value, str)
+                        and isinstance(handler, ast.Name)):
+                    continue
+                out.append((surface, _ADD_METHODS[call.func.attr],
+                            path_arg.value, sf.path, call.lineno,
+                            f"{node.name}.{handler.id}"))
+    return out
+
+
+def _readme_routes(project: Project):
+    """README route-table rows:
+    ``| surface | METHOD | `/path` | span | ... |`` (a row without a
+    surface cell defaults to the master app).  Returns
+    {(surface, method, path): (line, span_cell)}."""
+    out: Dict[Tuple[str, str, str], Tuple[int, str]] = {}
+    if project.readme is None:
+        return out
+    for i, line in enumerate(project.readme.lines, start=1):
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip().strip("`").strip()
+                 for c in line.split("|")]
+        for j in range(len(cells) - 1):
+            if cells[j] in ("GET", "POST", "PUT", "DELETE") \
+                    and cells[j + 1].startswith("/"):
+                surface = cells[j - 1] if j > 0 \
+                    and cells[j - 1] in ("master", "router") \
+                    else "master"
+                span_cell = cells[j + 2] if j + 2 < len(cells) else ""
+                out.setdefault((surface, cells[j], cells[j + 1]),
+                               (i, span_cell.lower()))
+                break
+    return out
+
+
+@rule(_ROUTE)
+def check_route_contract(project: Project) -> List[Violation]:
+    """Both-directions drift gate between the registered HTTP surface
+    and the README route table (the env-registry pattern applied to
+    routes), plus span discipline: a route documented as traced must
+    transitively create-or-inherit a span (call-graph summary over
+    ``start_span``/``event_span``/``span``/``stage``/``use_span``,
+    executor thunks included — the span context crosses the offload),
+    and a handler that traces must be documented as such.  Transitive
+    offload-cleanliness of every route is enforced by the
+    ``async-blocking``/``async-blocking-transitive`` pair, which cover
+    all ``async def`` bodies including these handlers."""
+    registered = _registered_routes(project)
+    if not registered or project.readme is None:
+        return []  # fixture projects without a route surface: skip
+    documented = _readme_routes(project)
+    from comfyui_distributed_tpu.analysis import callgraph as cg
+    graph = cg.get_callgraph(project)
+    span_reach = graph.span_reach()
+    out: List[Violation] = []
+    seen: Set[Tuple[str, str, str]] = set()
+    for surface, method, rpath, fpath, line, handler_qual in registered:
+        seen.add((surface, method, rpath))
+        doc = documented.get((surface, method, rpath))
+        if doc is None:
+            v = Violation(
+                _ROUTE, fpath, line,
+                f"route {method} {rpath} ({surface}) is registered "
+                f"here but missing from the README route table — "
+                f"every route ships documented (surface, method, "
+                f"path, span discipline)",
+                scope=handler_qual)
+            v.chain = [f"{handler_qual} ({fpath}:{line})"]
+            out.append(v)
+            continue
+        handler_q = f"{fpath}::{handler_qual}"
+        if handler_q not in graph.nodes:
+            continue  # unresolvable handler shape: stay conservative
+        traced = handler_q in span_reach
+        doc_traced = doc[1] not in _SPAN_NONE
+        if traced and not doc_traced:
+            out.append(Violation(
+                _ROUTE, fpath, line,
+                f"route {method} {rpath} ({surface}) creates/inherits "
+                f"a span but its README row marks it untraced ('—') — "
+                f"update the row's span column",
+                scope=handler_qual))
+        elif doc_traced and not traced:
+            out.append(Violation(
+                _ROUTE, fpath, line,
+                f"route {method} {rpath} ({surface}) is documented as "
+                f"traced ({doc[1]!r}) but its handler never reaches a "
+                f"span factory — trace it or fix the row",
+                scope=handler_qual))
+    for (surface, method, rpath), (line, _span) \
+            in sorted(documented.items()):
+        if (surface, method, rpath) not in seen:
+            out.append(Violation(
+                _ROUTE, README_PATH, line,
+                f"README route table names {method} {rpath} "
+                f"({surface}), which no build_app/build_router_app "
+                f"registers",
+                scope="readme"))
+    return out
+
+
 @rule(_SPAN_ATTR)
 def check_span_attrs(project: Project) -> List[Violation]:
     whitelist = _whitelist(project)

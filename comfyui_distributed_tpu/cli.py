@@ -556,6 +556,14 @@ def cmd_lint(args) -> int:
         print("--write-baseline requires a full run: drop --rule",
               file=sys.stderr)
         return 2
+    if args.graph:
+        # interprocedural introspection: the call graph + lock-order
+        # edges the v2 rules share, as JSON (no lint verdict)
+        from comfyui_distributed_tpu.analysis import callgraph
+        project = engine.load_project(root)
+        print(json.dumps(callgraph.get_callgraph(project).to_json(),
+                         indent=1))
+        return 0
     try:
         report = engine.run_lint(root=root, rules=rules)
     except ValueError as e:
@@ -572,12 +580,47 @@ def cmd_lint(args) -> int:
             "new": [vars(v) for v in report.new],
             "total_findings": len(report.violations),
             "baselined": report.baseline_total,
+            "rule_counts": report.rule_counts,
+            "graph": report.graph_stats,
         }, indent=2))
         return 1 if report.new else 0
     shown = report.violations if args.all else report.new
     for v in shown:
         mark = "" if v in report.new else "  (baselined)"
         print(f"{v.format()}{mark}")
+        if args.chain and v.chain:
+            print("    witness chain:" + v.format_chain())
+    if args.stats:
+        by_rule_baselined = {}
+        for k, n in engine.load_baseline(root).items():
+            by_rule_baselined[k.split("|", 1)[0]] = \
+                by_rule_baselined.get(k.split("|", 1)[0], 0) + n
+        new_by_rule = {}
+        for v in report.new:
+            new_by_rule[v.rule] = new_by_rule.get(v.rule, 0) + 1
+        print("\nper-rule stats (found / suppressed / baselined / new):")
+        for name in sorted(set(report.rule_counts)
+                           | set(by_rule_baselined)):
+            c = report.rule_counts.get(name,
+                                       {"found": 0, "suppressed": 0})
+            print(f"  {name:28s} {c['found']:4d} "
+                  f"{c['suppressed']:4d} "
+                  f"{by_rule_baselined.get(name, 0):4d} "
+                  f"{new_by_rule.get(name, 0):4d}")
+        g = report.graph_stats or {}
+        if g:
+            tiers = g.get("resolved_by_tier", {})
+            print(f"call graph: {g.get('functions', 0)} function(s), "
+                  f"{g.get('call_sites', 0)} call site(s), "
+                  f"{sum(tiers.values())} resolved "
+                  f"({', '.join(f'{k}={v}' for k, v in tiers.items())}), "
+                  f"{g.get('unresolved_calls', 0)} dynamic-dispatch "
+                  f"no-summary, {g.get('lock_edges', 0)} lock-order "
+                  f"edge(s)")
+            print(f"fixpoint passes: "
+                  f"block={g.get('block_fixpoint_passes', '-')} "
+                  f"lock={g.get('lock_fixpoint_passes', '-')} "
+                  f"span={g.get('span_fixpoint_passes', '-')}")
     if report.new:
         print(f"\ndtpu-lint: {len(report.new)} NEW violation(s) "
               f"({len(report.violations)} total, "
@@ -730,6 +773,16 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="regenerate the grandfather baseline from the "
                         "current findings (audit first!)")
+    p.add_argument("--stats", action="store_true",
+                   help="per-rule finding/suppression/baseline counts "
+                        "plus call-graph size and fixpoint passes")
+    p.add_argument("--graph", action="store_true",
+                   help="dump the interprocedural call graph and "
+                        "lock-order edges as JSON (no lint verdict)")
+    p.add_argument("--chain", action="store_true",
+                   help="print each finding's witness chain "
+                        "(file:line hops to the blocking leaf / "
+                        "cycle edge)")
     p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("trace", help="read a job's distributed trace "

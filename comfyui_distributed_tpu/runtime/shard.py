@@ -252,14 +252,20 @@ class ShardManager:
     # -- gossip ---------------------------------------------------------------
 
     def _gossip_payload(self) -> Dict[str, Any]:
+        # queue depth read BEFORE taking the ring lock: queue_remaining
+        # acquires ServerState._queue_lock (and the CB executor's lock),
+        # and calling a foreign subsystem while holding self._lock is
+        # the ordering edge the dtpu-lint deadlock-cycle rule hunts —
+        # one queue-side call back into the ring would have closed an
+        # ABBA cycle between the gossip thread and the admission path
         st = self._state
+        queue_remaining = st.queue_remaining() if st is not None else 0
         with self._lock:
             return {
                 "from": self.id,
                 "ring_epoch": self._ring_epoch,
                 "members": dict(self._members),
-                "queue_remaining": (st.queue_remaining()
-                                    if st is not None else 0),
+                "queue_remaining": queue_remaining,
             }
 
     def merge_gossip(self, payload: Dict[str, Any]) -> Dict[str, Any]:

@@ -412,37 +412,49 @@ class ServerState:
                 if entry is not None:
                     self._replay_cached(pid, sp, entry)
                     return pid
+        # rejection decided under the lock, but the span seal/commit
+        # (FlightRecorder lock) and the raise happen OUTSIDE it: the
+        # queue lock is the hottest lock in the process and must never
+        # be held across a foreign subsystem's lock — the dtpu-lint
+        # deadlock-cycle rule tracks exactly these ordering edges
+        reject: Optional[tuple] = None
         with self._queue_lock:
             if self._draining:
-                self._abandon_span(sp, pid, "rejected: draining")
-                raise DrainingError("server is draining; not accepting "
-                                    "prompts")
-            # class-aware admission (token bucket + shed thresholds);
-            # recovery re-enqueues and pre-admitted fan-out shares skip
-            # it — their admission already happened (and was WAL'd)
-            if not _recovered and not _preadmitted:
+                reject = (DrainingError("server is draining; not "
+                                        "accepting prompts"),
+                          "rejected: draining")
+            elif not _recovered and not _preadmitted:
+                # class-aware admission (token bucket + shed
+                # thresholds); recovery re-enqueues and pre-admitted
+                # fan-out shares skip it — their admission already
+                # happened (and was WAL'd).  The admission lock is a
+                # leaf: AdmissionController never calls back out.
                 rejection = self.admission.admit(
                     tenant, str(client_id), len(self._queue),
                     self.max_queue)
                 if rejection is not None:
-                    self._abandon_span(
-                        sp, pid, f"rejected: shed "
-                                 f"({rejection['reason']}, {tenant})")
-                    raise ShedError(rejection)
-            if len(self._queue) >= self.max_queue:
-                self._abandon_span(sp, pid, "rejected: queue full")
-                raise QueueFullError(
-                    f"prompt queue full ({self.max_queue})")
-            self._queue.append({"id": pid, "prompt": prompt,
-                                "client_id": client_id,
-                                "extra_data": extra_data or {},
-                                "sig": sig,
-                                "cb": cb_ok,
-                                "rkey": rkey,
-                                "tenant": tenant,
-                                "span": sp,
-                                "t_enq": time.perf_counter()})
-            self._inflight.add(pid)
+                    reject = (ShedError(rejection),
+                              f"rejected: shed "
+                              f"({rejection['reason']}, {tenant})")
+            if reject is None \
+                    and len(self._queue) >= self.max_queue:
+                reject = (QueueFullError(
+                    f"prompt queue full ({self.max_queue})"),
+                    "rejected: queue full")
+            if reject is None:
+                self._queue.append({"id": pid, "prompt": prompt,
+                                    "client_id": client_id,
+                                    "extra_data": extra_data or {},
+                                    "sig": sig,
+                                    "cb": cb_ok,
+                                    "rkey": rkey,
+                                    "tenant": tenant,
+                                    "span": sp,
+                                    "t_enq": time.perf_counter()})
+                self._inflight.add(pid)
+        if reject is not None:
+            self._abandon_span(sp, pid, reject[1])
+            raise reject[0]
         # write-ahead: the admission record is durable BEFORE the
         # prompt_id reaches the client (a crash after the append but
         # before the response re-runs the prompt — at-least-once at the
@@ -1472,18 +1484,26 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     # --- profiling (the subsystem the reference lacks, SURVEY.md §5) -------
 
     async def profile_start(request):
+        # off the loop: start_device_trace mkdirs the output dir and
+        # spins up the device profiler (backend touch) — the dtpu-lint
+        # async-blocking-transitive finding this route shipped with
         from comfyui_distributed_tpu.utils import trace as trace_mod
         data = await request.json() if request.can_read_body else {}
         try:
-            out = trace_mod.start_device_trace(data.get("dir"))
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: trace_mod.start_device_trace(
+                    data.get("dir")))
         except RuntimeError as e:
             return web.json_response({"error": str(e)}, status=409)
         return ok({"dir": out})
 
     async def profile_stop(request):
+        # off the loop for the same reason: stop flushes the collected
+        # device trace to disk before returning
         from comfyui_distributed_tpu.utils import trace as trace_mod
         try:
-            out = trace_mod.stop_device_trace()
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, trace_mod.stop_device_trace)
         except RuntimeError as e:
             return web.json_response({"error": str(e)}, status=409)
         return ok({"dir": out})
@@ -1572,7 +1592,12 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         return ok()
 
     async def managed_workers(request):
-        return web.json_response(state.manager.get_managed_workers())
+        # off the loop: liveness of each managed pid is probed via
+        # `kill -0` through subprocess on some platforms — the dtpu-lint
+        # async-blocking-transitive finding this route shipped with
+        managed = await asyncio.get_running_loop().run_in_executor(
+            None, state.manager.get_managed_workers)
+        return web.json_response(managed)
 
     async def cluster_info(request):
         """Cluster control plane snapshot: lease-based worker states,

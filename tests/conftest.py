@@ -78,9 +78,11 @@ _MODULE_COST_S = {
     # master+standby+worker exec loops over a shared WAL)
     "test_durable.py": 12,
     "test_resource.py": 12,
-    # pure-AST static analysis (dtpu-lint): parses the package ~10x
-    # (fixtures + live-tree gate + seeded mutations), no device work
-    "test_analysis.py": 13,
+    # pure-AST static analysis (dtpu-lint): parses the package ~15x
+    # (fixtures + live-tree gate + the v1 AND v2/interprocedural
+    # seeded mutations, each a full run_lint with call-graph build),
+    # no device work
+    "test_analysis.py": 36,
     # continuous batching (PR 12): bucket-level exactness + a few real
     # CB ServerStates on the tiny model (~30s warm-cache; the late-join
     # bit-exactness proof is the priciest call at ~8s warm)

@@ -737,10 +737,13 @@ class TestOpsDrainOffloadRegression:
 
 
 class TestBaselineHygiene:
-    def test_no_lockset_or_drift_grandfathered(self):
+    def test_no_bug_class_rule_grandfathered(self):
         """Only the audited spine host-edge class is baselined; the
-        bug-class rules (async-blocking, lockset, env drift) ship
-        clean."""
+        bug-class rules ship clean.  The ISSUE 15 satellite extends
+        the zero set: a *deadlock-cycle* or *wal-fencing* finding (or
+        a transitive async-blocking / route drift one) must never be
+        grandfathered — like async-blocking, these classes are fixed,
+        not baselined."""
         baseline = engine.load_baseline(ROOT)
         assert baseline, "shipped baseline missing"
         bad = [k for k in baseline
@@ -748,5 +751,764 @@ class TestBaselineHygiene:
                                          "env-undeclared",
                                          "env-readme-drift",
                                          "metric-name", "span-attr",
-                                         "parse-error")]
+                                         "parse-error",
+                                         "async-blocking-transitive",
+                                         "deadlock-cycle",
+                                         "wal-fencing",
+                                         "route-contract")]
         assert bad == []
+
+
+# =============================================================================
+# dtpu-lint v2: the interprocedural tier (ISSUE 15)
+# =============================================================================
+
+from comfyui_distributed_tpu.analysis import callgraph as cg  # noqa: E402
+
+
+def mini_project(files):
+    """An in-memory project (like lint_sources, but returning the
+    Project so tests can inspect the call graph too)."""
+    return engine.Project(
+        ROOT,
+        {rel: engine._parse_file(rel, src)
+         for rel, src in files.items() if rel != "README.md"},
+        readme=(engine._parse_file("README.md", files["README.md"])
+                if "README.md" in files else None))
+
+
+TRANSITIVE_POS = f"""
+import os
+
+async def route(request):
+    helper()
+    return 1
+
+def helper():
+    deeper()
+
+def deeper():
+    os.fsync(3)
+"""
+
+
+class TestCallGraphSummaries:
+    def test_transitive_chain_through_module_helpers(self):
+        vs = lint_sources({f"{PKG}/server/x.py": TRANSITIVE_POS},
+                          rules=["async-blocking-transitive"])
+        assert len(vs) == 1
+        assert "route -> helper -> deeper -> os.fsync()" \
+            in vs[0].message
+        assert vs[0].chain[-1] == "os.fsync()"
+        assert len(vs[0].chain) == 4  # route, helper, deeper, leaf
+
+    def test_direct_blocking_not_double_reported(self):
+        src = ("import os\n\nasync def route(request):\n"
+               "    os.fsync(3)\n")
+        vs = lint_sources({f"{PKG}/server/x.py": src},
+                          rules=["async-blocking-transitive"])
+        assert vs == []  # v1's finding, not the transitive tier's
+
+    def test_executor_thunk_cuts_chain(self):
+        src = """
+import os, asyncio, functools, threading
+
+def helper():
+    os.fsync(3)
+
+async def named_thunk(request):
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, helper)
+
+async def lambda_thunk(request):
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, lambda: helper())
+
+async def partial_thunk(request):
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, functools.partial(helper))
+
+async def thread_target(request):
+    threading.Thread(target=helper, daemon=True).start()
+"""
+        vs = lint_sources({f"{PKG}/server/x.py": src},
+                          rules=["async-blocking-transitive"])
+        assert vs == []
+
+    def test_off_loop_helper_cuts_chain(self):
+        src = """
+import os
+
+def _spill_off_loop():
+    os.fsync(3)
+
+async def route(request):
+    _spill_off_loop()
+"""
+        vs = lint_sources({f"{PKG}/server/x.py": src},
+                          rules=["async-blocking-transitive"])
+        assert vs == []
+
+    def test_recursion_bounded_fixpoint_terminates(self):
+        src = """
+import os
+
+def ping(n):
+    pong(n)
+
+def pong(n):
+    ping(n - 1)
+    os.fsync(3)
+
+async def route(request):
+    ping(9)
+"""
+        project = mini_project({f"{PKG}/server/x.py": src})
+        vs = engine.lint_project(project,
+                                 rules=["async-blocking-transitive"])
+        assert len(vs) == 1 and "os.fsync" in vs[0].message
+        graph = cg.get_callgraph(project)
+        assert graph.stats["block_fixpoint_passes"] \
+            <= cg.MAX_FIXPOINT_PASSES
+
+    def test_dynamic_dispatch_unknown_callee_is_conservative(self):
+        """An unresolvable obj.method() gets no summary — no finding,
+        but the gap is COUNTED (surfaced by `cli lint --stats`)."""
+        src = """
+async def route(request):
+    request.app.mystery_dispatch()
+"""
+        project = mini_project({f"{PKG}/server/x.py": src})
+        vs = engine.lint_project(project,
+                                 rules=["async-blocking-transitive"])
+        assert vs == []
+        graph = cg.get_callgraph(project)
+        assert graph.stats["unresolved_calls"] >= 1
+
+    def test_unique_attr_resolution_crosses_files(self):
+        helper_mod = """
+import os
+
+class SpillPlane:
+    def spill_everything(self):
+        os.fsync(3)
+"""
+        app_mod = """
+async def route(request):
+    request.plane.spill_everything()
+"""
+        vs = lint_sources({f"{PKG}/runtime/plane.py": helper_mod,
+                           f"{PKG}/server/x.py": app_mod},
+                          rules=["async-blocking-transitive"])
+        assert len(vs) == 1
+        assert "SpillPlane.spill_everything" in vs[0].message
+
+
+# --- deadlock-cycle ----------------------------------------------------------
+
+ABBA_SRC = """
+import threading
+
+class Alpha:
+    def __init__(self, beta):
+        self._lock = threading.Lock()
+        self.beta = beta
+
+    def forward(self):
+        with self._lock:
+            self.beta.poke_beta()
+
+    def poke_alpha(self):
+        with self._lock:
+            pass
+
+class Beta:
+    def __init__(self, alpha):
+        self._lock = threading.Lock()
+        self.alpha = alpha
+
+    def backward(self):
+        with self._lock:
+            self.alpha.poke_alpha()
+
+    def poke_beta(self):
+        with self._lock:
+            pass
+"""
+
+
+class TestDeadlockCycleRule:
+    def test_abba_cycle_reported_with_both_witness_chains(self):
+        vs = lint_sources({f"{PKG}/runtime/abba.py": ABBA_SRC},
+                          rules=["deadlock-cycle"])
+        assert len(vs) == 1
+        v = vs[0]
+        assert "Alpha._lock" in v.message and "Beta._lock" in v.message
+        # one witness chain per cycle edge, both directions
+        assert len(v.chain) == 2
+        joined = " ".join(v.chain)
+        assert "Alpha.forward" in joined and "Beta.backward" in joined
+
+    def test_consistent_order_is_clean(self):
+        src = ABBA_SRC.replace(
+            "    def backward(self):\n"
+            "        with self._lock:\n"
+            "            self.alpha.poke_alpha()\n",
+            "    def backward(self):\n"
+            "        self.alpha.poke_alpha()\n")
+        vs = lint_sources({f"{PKG}/runtime/abba.py": src},
+                          rules=["deadlock-cycle"])
+        assert vs == []
+
+    def test_thread_handoff_under_lock_is_not_an_edge(self):
+        """A Thread(target=...) started while holding a lock runs
+        later, without the lexical lock — no ordering edge, no false
+        cycle."""
+        src = ABBA_SRC.replace(
+            "        with self._lock:\n"
+            "            self.beta.poke_beta()\n",
+            "        with self._lock:\n"
+            "            threading.Thread(\n"
+            "                target=self.beta.poke_beta).start()\n")
+        vs = lint_sources({f"{PKG}/runtime/abba.py": src},
+                          rules=["deadlock-cycle"])
+        assert vs == []
+
+    def test_holds_marker_seeds_the_held_set(self):
+        """A `# dtpu-lint: holds[self._lock]` caller-holds contract
+        contributes ordering edges exactly like a lexical `with`: the
+        contract-held lock is the outer of every acquisition the body
+        reaches."""
+        src = """
+import threading
+
+class Gamma:
+    def __init__(self, delta):
+        self._lock = threading.Lock()
+        self.delta = delta
+
+    # dtpu-lint: holds[self._lock]
+    def under_contract(self):
+        self.delta.poke_delta()
+
+    def grab_gamma(self):
+        with self._lock:
+            pass
+
+class Delta:
+    def __init__(self, gamma):
+        self._lock = threading.Lock()
+        self.gamma = gamma
+
+    def poke_delta(self):
+        with self._lock:
+            pass
+
+    def reverse(self):
+        with self._lock:
+            self.gamma.grab_gamma()
+"""
+        vs = lint_sources({f"{PKG}/runtime/hold.py": src},
+                          rules=["deadlock-cycle"])
+        assert len(vs) == 1
+        assert "Gamma._lock" in vs[0].message \
+            and "Delta._lock" in vs[0].message
+
+
+# --- wal-fencing -------------------------------------------------------------
+
+class TestWalFencingRule:
+    def test_raw_append_outside_fenced_surfaces_flagged(self):
+        src = """
+class SneakyPlane:
+    def __init__(self, wal):
+        self._wal = wal
+
+    def mutate(self):
+        self._wal.append("exec_done", pid="x")
+"""
+        vs = lint_sources({f"{PKG}/runtime/sneaky.py": src},
+                          rules=["wal-fencing"])
+        assert len(vs) == 1
+        assert "raw WAL append" in vs[0].message
+
+    def test_plane_chokepoints_allowed(self):
+        src = """
+class WorkLedger:
+    def _wal_append(self, rtype, **fields):
+        self._wal.append(rtype, **fields)
+
+
+class JobStore:
+    def _log_idem(self, scope, job_id, idem_key):
+        self._wal.append("idem", scope=scope, job=job_id,
+                         key=idem_key)
+"""
+        vs = lint_sources({f"{PKG}/runtime/planes.py": src},
+                          rules=["wal-fencing"])
+        assert vs == []
+
+    def test_uncredentialed_ctor_flagged_credentialed_allowed(self):
+        bad = """
+from comfyui_distributed_tpu.runtime.durable import WriteAheadLog
+
+def zombie_writer(path):
+    wal = WriteAheadLog(path, epoch=1)
+    wal.append("enqueue", pid="p")
+"""
+        vs = lint_sources({f"{PKG}/runtime/z.py": bad},
+                          rules=["wal-fencing"])
+        # the lease-less construction AND its append are both findings
+        assert len(vs) == 2
+        assert any("fencing credentials" in v.message for v in vs)
+        good = bad.replace("WriteAheadLog(path, epoch=1)",
+                           "WriteAheadLog(path, epoch=epoch, "
+                           "lease=lease)")
+        vs = lint_sources({f"{PKG}/runtime/z.py": good},
+                          rules=["wal-fencing"])
+        assert vs == []
+
+    def test_recovery_surface_needs_epoch_checked_entry(self):
+        bad = """
+def casual_merge(state, replayed):
+    state.ledger.merge_recovered(dict(replayed.jobs))
+"""
+        vs = lint_sources({f"{PKG}/runtime/m.py": bad},
+                          rules=["wal-fencing"])
+        assert len(vs) == 1
+        assert "epoch-checked entry point" in vs[0].message
+        good = """
+def takeover_merge(state, replayed, lease, lease_s):
+    epoch = lease.acquire("m0", lease_s)
+    state.ledger.merge_recovered(dict(replayed.jobs))
+"""
+        vs = lint_sources({f"{PKG}/runtime/m.py": good},
+                          rules=["wal-fencing"])
+        assert vs == []
+
+    def test_replay_state_mutation_outside_durable_flagged(self):
+        src = """
+def poke(wal, rec):
+    wal.tracker.apply(rec)
+"""
+        vs = lint_sources({f"{PKG}/runtime/r.py": src},
+                          rules=["wal-fencing"])
+        assert len(vs) == 1
+        assert "ReplayState" in vs[0].message
+
+
+# --- route-contract ----------------------------------------------------------
+
+ROUTE_APP = """
+from aiohttp import web
+from comfyui_distributed_tpu.utils import trace as trace_mod
+
+
+def build_app(state):
+    app = web.Application()
+    r = app.router
+
+    async def traced(request):
+        trace_mod.start_span("job")
+        return web.json_response({})
+
+    async def plain(request):
+        return web.json_response({})
+
+    r.add_get("/a", traced)
+    r.add_post("/b", plain)
+    return app
+"""
+
+ROUTE_README = """
+### HTTP route registry
+| Surface | Method | Path | Span | Purpose |
+|---|---|---|---|---|
+| master | GET | `/a` | span | traced read |
+| master | POST | `/b` | — | plain write |
+"""
+
+
+class TestRouteContractRule:
+    def test_in_sync_table_is_clean(self):
+        vs = lint_sources({f"{PKG}/server/app.py": ROUTE_APP,
+                           "README.md": ROUTE_README},
+                          rules=["route-contract"])
+        assert vs == []
+
+    def test_both_direction_drift(self):
+        app = ROUTE_APP.replace(
+            'r.add_post("/b", plain)',
+            'r.add_post("/b", plain)\n    r.add_get("/ghostless", '
+            'plain)')
+        readme = ROUTE_README + "| master | GET | `/phantom` | — | gone |\n"
+        vs = lint_sources({f"{PKG}/server/app.py": app,
+                           "README.md": readme},
+                          rules=["route-contract"])
+        msgs = " ".join(v.message for v in vs)
+        assert len(vs) == 2
+        assert "/ghostless" in msgs and "/phantom" in msgs
+
+    def test_span_drift_both_ways(self):
+        # documented traced but handler never reaches a span factory
+        readme = ROUTE_README.replace("| master | POST | `/b` | — |",
+                                      "| master | POST | `/b` | span |")
+        vs = lint_sources({f"{PKG}/server/app.py": ROUTE_APP,
+                           "README.md": readme},
+                          rules=["route-contract"])
+        assert len(vs) == 1 and "never reaches a span" in vs[0].message
+        # handler traces but the row says untraced
+        readme = ROUTE_README.replace("| master | GET | `/a` | span |",
+                                      "| master | GET | `/a` | — |")
+        vs = lint_sources({f"{PKG}/server/app.py": ROUTE_APP,
+                           "README.md": readme},
+                          rules=["route-contract"])
+        assert len(vs) == 1 and "marks it untraced" in vs[0].message
+
+    def test_router_and_master_surfaces_are_distinct(self):
+        app = ROUTE_APP + """
+
+def build_router_app(masters):
+    from aiohttp import web as w2
+    app = w2.Application()
+
+    async def post_prompt(request):
+        return None
+
+    app.router.add_post("/b", post_prompt)
+    return app
+"""
+        # the router's POST /b needs its OWN row — the master row
+        # cannot cover it
+        vs = lint_sources({f"{PKG}/server/app.py": app,
+                           "README.md": ROUTE_README},
+                          rules=["route-contract"])
+        assert len(vs) == 1 and "(router)" in vs[0].message
+        readme = ROUTE_README + "| router | POST | `/b` | — | routed |\n"
+        vs = lint_sources({f"{PKG}/server/app.py": app,
+                           "README.md": readme},
+                          rules=["route-contract"])
+        assert vs == []
+
+
+# --- the live tree + seeded mutations (v2 acceptance) ------------------------
+
+@pytest.fixture(scope="module")
+def live_report():
+    import time as _time
+    t0 = _time.perf_counter()
+    report = engine.run_lint(root=ROOT)
+    return report, _time.perf_counter() - t0
+
+
+def _live_src(rel):
+    with open(os.path.join(ROOT, *rel.split("/")),
+              encoding="utf-8") as f:
+        return f.read()
+
+
+class TestInterprocLiveGate:
+    def test_live_tree_clean_and_bug_class_rules_at_zero(self,
+                                                         live_report):
+        report, _ = live_report
+        assert report.new == [], "\n".join(v.format()
+                                           for v in report.new)
+        # the new bug-class families report ZERO findings on the
+        # shipped tree — not zero-new, zero-total (nothing baselined,
+        # nothing suppressed away silently)
+        for rule in ("async-blocking-transitive", "deadlock-cycle",
+                     "wal-fencing", "route-contract"):
+            assert report.rule_counts.get(rule, {}).get("found", 0) \
+                == 0, rule
+
+    def test_runtime_budget_under_30s(self, live_report):
+        """The tier-1 gate stays cheap: the FULL rule suite (call
+        graph build + fixpoints included) completes well inside 30s
+        on CPU."""
+        _, elapsed = live_report
+        assert elapsed < 30.0, f"full lint took {elapsed:.1f}s"
+
+    def test_graph_stats_exposed(self, live_report):
+        report, _ = live_report
+        g = report.graph_stats
+        assert g is not None
+        assert g["functions"] > 500
+        assert g["resolved_by_tier"].get("unique", 0) > 0
+        assert g["unresolved_calls"] > 0  # conservative no-summaries
+        assert g["block_fixpoint_passes"] <= cg.MAX_FIXPOINT_PASSES
+
+    # -- the four seeded mutations, each vs the SHIPPED baseline --------------
+
+    def test_seeded_transitive_blocking_caught_with_chain(self):
+        app = _live_src(f"{PKG}/server/app.py")
+        anchor = "    async def interrupt(request):\n"
+        assert anchor in app
+        mutated = app.replace(
+            anchor, anchor + "        _seeded_sync_helper()\n", 1) + (
+            "\n\ndef _seeded_sync_helper():\n"
+            "    _seeded_deeper_helper()\n"
+            "\n\ndef _seeded_deeper_helper():\n"
+            "    os.fsync(0)\n")
+        rep = engine.run_lint(
+            root=ROOT, overrides={f"{PKG}/server/app.py": mutated})
+        hits = [v for v in rep.new
+                if v.rule == "async-blocking-transitive"]
+        assert len(hits) == 1
+        v = hits[0]
+        assert ("interrupt -> _seeded_sync_helper -> "
+                "_seeded_deeper_helper -> os.fsync()") in v.message
+        assert v.chain[-1] == "os.fsync()"
+        assert all(":" in hop for hop in v.chain[:-1])  # file:line hops
+
+    def test_seeded_lock_order_inversion_caught(self):
+        """Re-introduce the pre-ISSUE-15 gossip edge (ring lock held
+        across queue_remaining) AND seed the reverse edge — the
+        detector reports the ABBA cycle with both witness chains."""
+        shard = _live_src(f"{PKG}/runtime/shard.py")
+        a_ring = ("with self._lock:\n"
+                  "            return self._ring_epoch")
+        assert a_ring in shard
+        shard_mut = shard.replace(
+            a_ring,
+            "with self._lock:\n"
+            "            self._state.queue_remaining()\n"
+            "            return self._ring_epoch", 1)
+        app = _live_src(f"{PKG}/server/app.py")
+        a_q = ("with self._queue_lock:\n"
+               "            n = len(self._queue) "
+               "+ (1 if self._running else 0)")
+        assert a_q in app
+        app_mut = app.replace(
+            a_q,
+            "with self._queue_lock:\n"
+            "            self.shard.ring_epoch()\n"
+            "            n = len(self._queue) "
+            "+ (1 if self._running else 0)", 1)
+        rep = engine.run_lint(
+            root=ROOT,
+            overrides={f"{PKG}/runtime/shard.py": shard_mut,
+                       f"{PKG}/server/app.py": app_mut})
+        hits = [v for v in rep.new if v.rule == "deadlock-cycle"]
+        assert len(hits) == 1
+        v = hits[0]
+        assert "ServerState._queue_lock" in v.message
+        assert "ShardManager._lock" in v.message
+        assert len(v.chain) == 2  # both directions witnessed
+        joined = " ".join(v.chain)
+        assert "ShardManager.ring_epoch" in joined
+        assert "ServerState.queue_remaining" in joined
+
+    def test_seeded_unfenced_wal_append_caught(self):
+        app = _live_src(f"{PKG}/server/app.py")
+        anchor = "    async def interrupt(request):\n"
+        mutated = app.replace(
+            anchor,
+            anchor + '        state.durable.wal.append('
+                     '"exec_done", pid="zombie")\n', 1)
+        rep = engine.run_lint(
+            root=ROOT, overrides={f"{PKG}/server/app.py": mutated})
+        hits = [v for v in rep.new if v.rule == "wal-fencing"]
+        assert len(hits) == 1
+        assert "raw WAL append" in hits[0].message
+        assert hits[0].chain  # entry-chain witness attached
+
+    def test_seeded_undocumented_route_caught(self):
+        app = _live_src(f"{PKG}/server/app.py")
+        anchor = 'r.add_get("/history", history)'
+        assert anchor in app
+        mutated = app.replace(
+            anchor,
+            anchor + '\n    r.add_get("/distributed/lint_probe", '
+                     'history)', 1)
+        rep = engine.run_lint(
+            root=ROOT, overrides={f"{PKG}/server/app.py": mutated})
+        hits = [v for v in rep.new if v.rule == "route-contract"]
+        assert len(hits) == 1
+        assert "/distributed/lint_probe" in hits[0].message
+
+    def test_readme_ghost_route_caught(self):
+        readme = _live_src("README.md")
+        anchor = "| router | GET | `/distributed/fleet` | — |"
+        assert anchor in readme
+        mutated = readme.replace(
+            anchor,
+            "| master | GET | `/distributed/ghost_route` | — | "
+            "gone |\n" + anchor, 1)
+        rep = engine.run_lint(root=ROOT,
+                              overrides={"README.md": mutated})
+        hits = [v for v in rep.new if v.rule == "route-contract"]
+        assert len(hits) == 1
+        assert "/distributed/ghost_route" in hits[0].message
+        assert hits[0].path == "README.md"
+
+
+# --- cli lint v2 flags -------------------------------------------------------
+
+class TestCliLintV2:
+    def test_stats_flag(self, capsys):
+        from comfyui_distributed_tpu import cli
+        rc = cli.main(["lint", "--stats"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "per-rule stats" in out
+        assert "call graph:" in out
+        assert "fixpoint passes:" in out
+        assert "async-blocking-transitive" in out
+
+    def test_graph_flag_dumps_json(self, capsys):
+        from comfyui_distributed_tpu import cli
+        rc = cli.main(["lint", "--graph"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        data = json.loads(out)
+        assert data["functions"] > 500
+        assert isinstance(data["lock_edges"], list)
+        assert all({"outer", "inner", "witnesses"} <= set(e)
+                   for e in data["lock_edges"])
+
+    def test_chain_flag_prints_witness(self, tmp_path, capsys):
+        pkg = tmp_path / PKG
+        (pkg / "server").mkdir(parents=True)
+        (pkg / "analysis").mkdir()
+        (pkg / "server" / "app.py").write_text(
+            "import os\n\n"
+            "async def h(request):\n"
+            "    helper()\n\n"
+            "def helper():\n"
+            "    os.fsync(1)\n")
+        from comfyui_distributed_tpu import cli
+        rc = cli.main(["lint", "--root", str(tmp_path), "--chain"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "witness chain:" in out
+        assert "helper" in out and "os.fsync()" in out
+
+
+# --- regression tests for the REAL v2 findings fixed this PR -----------------
+
+class TestV2OffloadRegressions:
+    """profile_start/profile_stop (device-trace start mkdirs + flush)
+    and managed_workers (pid liveness probes subprocess) were the two
+    live async-blocking-transitive findings — all three now run their
+    blocking core on an executor thread (fixed, not baselined)."""
+
+    @pytest.fixture()
+    def app_state(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DTPU_RESOURCE", "0")
+        from comfyui_distributed_tpu.server.app import ServerState
+        return ServerState(start_exec_thread=False,
+                           input_dir=str(tmp_path / "in"),
+                           output_dir=str(tmp_path / "out"))
+
+    def _handler(self, state, name):
+        from comfyui_distributed_tpu.server.app import build_app
+        app = build_app(state)
+        for route in app.router.routes():
+            if route.handler.__name__ == name:
+                return route.handler
+        raise AssertionError(f"route handler {name} not found")
+
+    class _Req:
+        can_read_body = False
+        remote = "127.0.0.1"
+
+        async def json(self):
+            return {}
+
+    def test_profile_start_offloaded(self, app_state, monkeypatch):
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        record = []
+
+        def fake_start(out_dir=None):
+            record.append(threading.current_thread())
+            return "/tmp/t"
+        monkeypatch.setattr(trace_mod, "start_device_trace",
+                            fake_start)
+        handler = self._handler(app_state, "profile_start")
+        resp = asyncio.new_event_loop().run_until_complete(
+            handler(self._Req()))
+        assert resp.status == 200
+        assert record and all(t is not threading.current_thread()
+                              for t in record)
+
+    def test_profile_stop_offloaded(self, app_state, monkeypatch):
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        record = []
+
+        def fake_stop():
+            record.append(threading.current_thread())
+            return "/tmp/t"
+        monkeypatch.setattr(trace_mod, "stop_device_trace", fake_stop)
+        handler = self._handler(app_state, "profile_stop")
+        resp = asyncio.new_event_loop().run_until_complete(
+            handler(self._Req()))
+        assert resp.status == 200
+        assert record and all(t is not threading.current_thread()
+                              for t in record)
+
+    def test_managed_workers_offloaded(self, app_state):
+        record = []
+
+        def fake_managed():
+            record.append(threading.current_thread())
+            return []
+        app_state.manager.get_managed_workers = fake_managed
+        handler = self._handler(app_state, "managed_workers")
+        resp = asyncio.new_event_loop().run_until_complete(
+            handler(self._Req()))
+        assert resp.status == 200
+        assert record and all(t is not threading.current_thread()
+                              for t in record)
+
+
+class TestLockNarrowingRegressions:
+    """The deadlock-cycle edge dump drove two critical-section
+    narrowings: ShardManager._gossip_payload no longer calls into
+    ServerState while holding the ring lock, and enqueue_prompt's
+    rejection paths seal/commit the job span AFTER releasing the
+    queue lock."""
+
+    def test_gossip_payload_reads_queue_outside_ring_lock(self):
+        from comfyui_distributed_tpu.runtime.shard import ShardManager
+        holder = {}
+        calls = []
+
+        class _FakeState:
+            is_worker = False
+
+            def queue_remaining(self):
+                calls.append(holder["mgr"]._lock.locked())
+                return 7
+
+        mgr = ShardManager(_FakeState(), "m0", {"m0": ""},
+                           start_threads=False)
+        holder["mgr"] = mgr
+        payload = mgr._gossip_payload()
+        assert payload["queue_remaining"] == 7
+        assert calls == [False], \
+            "queue_remaining called while holding ShardManager._lock"
+
+    def test_enqueue_rejection_seals_span_outside_queue_lock(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DTPU_RESOURCE", "0")
+        monkeypatch.setenv("DTPU_MAX_QUEUE", "1")
+        from comfyui_distributed_tpu.server import app as app_mod
+        state = app_mod.ServerState(start_exec_thread=False,
+                                    input_dir=str(tmp_path / "in"),
+                                    output_dir=str(tmp_path / "out"))
+        with state._queue_lock:
+            state._queue.append({"id": "p0", "prompt": {},
+                                 "client_id": "c", "extra_data": {},
+                                 "sig": None, "cb": False,
+                                 "rkey": None, "tenant": "paid",
+                                 "span": None, "t_enq": 0.0})
+        lock_states = []
+        state._abandon_span = (
+            lambda sp, pid, reason:
+            lock_states.append(state._queue_lock.locked()))
+        with pytest.raises(app_mod.QueueFullError):
+            state.enqueue_prompt(
+                {"1": {"class_type": "EmptyLatentImage",
+                       "inputs": {}}}, "client")
+        assert lock_states == [False], \
+            "span sealed while still holding the queue lock"
