@@ -121,7 +121,8 @@ def parse_args(argv=None):
     p.add_argument("--phase", default=None,
                    choices=["tensor_plane", "pipeline", "observability",
                             "fault", "telemetry", "failover", "overload",
-                            "batching", "reuse", "multimaster"],
+                            "batching", "reuse", "multimaster",
+                            "tp_serve"],
                    help="run ONE named software-proxy phase. "
                         "'tensor_plane': repeated 2-image SPMD txt2img on "
                         "the CPU backend reporting host_transfer_mb_per_"
@@ -195,7 +196,14 @@ def parse_args(argv=None):
                         "SIGKILL'd mid-job: its ring successor absorbs "
                         "the shard (completion 1.0, blend bit-identical "
                         "to the no-kill run, p95 within 20%%, per-shard "
-                        "WAL verify clean)")
+                        "WAL verify clean). "
+                        "'tp_serve': tensor-parallel serving proof on a "
+                        "4-virtual-device data×tensor CPU mesh (DTPU_TP "
+                        "env plumbing) — sharded UNet params + 2-D-"
+                        "sharded CB buckets with per-array sharding-"
+                        "spec assertions, TP-vs-replicated output "
+                        "tolerance, late-join CB==solo bit-exactness "
+                        "under TP, and zero steady-state retraces")
     p.add_argument("--check", action="store_true",
                    help="perf-regression watchdog: after the run, compare "
                         "the fresh result against the most recent prior "
@@ -334,6 +342,8 @@ def metric_name(args):
         return "reuse_storm_speedup_retry_variant"
     if getattr(args, "phase", None) == "multimaster":
         return "multimaster_scaling_3masters"
+    if getattr(args, "phase", None) == "tp_serve":
+        return "tp_serve_bit_exact_fraction"
     if args.real_ckpt:
         return (f"real_ckpt_{args.family}_{args.width}x{args.height}_"
                 f"{args.steps}step_sec_per_image")
@@ -363,7 +373,8 @@ def metric_unit(args):
         return "imgs/s"
     if getattr(args, "phase", None) == "telemetry":
         return "imgs/s"
-    if getattr(args, "phase", None) in ("fault", "failover", "overload"):
+    if getattr(args, "phase", None) in ("fault", "failover", "overload",
+                                        "tp_serve"):
         return "fraction"
     if args.scaling_sweep or args.multiproc_sweep:
         return "fraction"
@@ -839,6 +850,8 @@ CHECK_TOLERANCE_PCT = {
     "batching_cb_speedup_poisson": 15.0,
     "reuse_storm_speedup_retry_variant": 15.0,
     "multimaster_scaling_3masters": 15.0,
+    # exactness is a bar, not a measurement: any drop is a regression
+    "tp_serve_bit_exact_fraction": 0.0,
 }
 
 
@@ -2969,6 +2982,203 @@ def run_batching(args):
     emit(args, payload)
 
 
+def _tp_serve_prompt(seed, steps=3, size=32):
+    return {
+        "7": {"class_type": "CheckpointLoaderSimple",
+              "inputs": {"ckpt_name": "tiny.safetensors"}},
+        "5": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "cat", "clip": ["7", 1]}},
+        "6": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["7", 1]}},
+        "9": {"class_type": "EmptyLatentImage",
+              "inputs": {"width": size, "height": size, "batch_size": 1}},
+        "8": {"class_type": "KSampler",
+              "inputs": {"model": ["7", 0], "positive": ["5", 0],
+                         "negative": ["6", 0], "latent_image": ["9", 0],
+                         "seed": seed, "steps": steps, "cfg": 2.0,
+                         "sampler_name": "euler_ancestral",
+                         "scheduler": "normal", "denoise": 1.0}},
+    }
+
+
+def measure_tp_serve(steps: int = 3):
+    """Measurement core behind ``--phase tp_serve`` (ISSUE 16) — the
+    sharding-spec plumbing + exactness proof on a 4-virtual-device
+    data=2×tensor=2 CPU mesh, standing in for real-chip scaling numbers
+    until TPU time lands.
+
+    Three legs, all on the SAME two seeded prompts:
+
+    * replicated reference — continuous-batching solo buckets with NO
+      mesh live (the pre-TP serving path, byte-identical HLO);
+    * TP solo — the same buckets on the 2-D mesh engaged through the
+      ``DTPU_TP`` serve-path env (per-array sharding-spec assertions on
+      params and bucket buffers; output within tolerance of the
+      replicated arm — XLA CPU lowers the sharded graph differently,
+      so the cross-arm match is tight but not bitwise);
+    * TP shared — one prompt late-joins the other's running bucket;
+      its rows must be BIT-identical to its TP-solo run, with zero
+      steady-state retraces after the solo warm pass."""
+    import numpy as np
+
+    from comfyui_distributed_tpu.models import registry
+    from comfyui_distributed_tpu.ops.base import OpContext
+    from comfyui_distributed_tpu.parallel import mesh as mesh_mod
+    from comfyui_distributed_tpu.parallel import sharding as shd
+    from comfyui_distributed_tpu.utils import constants as C
+    from comfyui_distributed_tpu.utils import trace as tr
+    from comfyui_distributed_tpu.workflow import batch_executor as cb_mod
+    from comfyui_distributed_tpu.workflow import scheduler as sched
+
+    import jax
+
+    os.environ.setdefault("DTPU_DEFAULT_FAMILY", "tiny")
+    saved_env = {k: os.environ.get(k)
+                 for k in (C.CB_PAD_BUCKETS_ENV,
+                           C.TP_MIN_SHARD_ELEMENTS_ENV, C.TP_ENV)}
+    # one pad size (XLA CPU SPMD matmuls are not row-wise bit-stable
+    # ACROSS batch sizes); tiny-model leaves must clear the shard floor
+    os.environ[C.CB_PAD_BUCKETS_ENV] = "2"
+    os.environ[C.TP_MIN_SHARD_ELEMENTS_ENV] = "2"
+    os.environ[C.TP_ENV] = "2"          # the serve-path engage knob
+    prompts = {11: _tp_serve_prompt(11, steps=steps),
+               22: _tp_serve_prompt(22, steps=steps)}
+    sig = sched.coalesce_signature(prompts[11])
+
+    def bucket_rows(runs, tag):
+        """runs: {id: (seed, join_after_steps)} -> {id: latent rows}."""
+        out = {}
+        ids = sorted(runs, key=lambda i: runs[i][1])
+        first = ids[0]
+        it0 = {"id": first, "prompt": prompts[runs[first][0]],
+               "sig": sig, "cb": True}
+        bkt = cb_mod._Bucket(sig, it0, OpContext(), max_slots=2)
+        bkt.admit(it0)
+        pending = ids[1:]
+        for _ in range(8 * steps):
+            bkt.step_once()
+            if pending and bkt.steps_done >= runs[pending[0]][1]:
+                pid = pending.pop(0)
+                bkt.admit({"id": pid, "prompt": prompts[runs[pid][0]],
+                           "sig": sig, "cb": True})
+            for its, rows, _t in bkt.take_finished():
+                arr = np.asarray(rows)
+                for j, it in enumerate(its):
+                    out[it["id"]] = arr[j * bkt.b:(j + 1) * bkt.b]
+            if not bkt.n_active and not pending:
+                return out, bkt
+        raise RuntimeError(f"{tag} bucket never drained")
+
+    problems = []
+    try:
+        # --- leg 1: replicated reference (no mesh live) ---------------
+        mesh_mod.set_runtime(None)
+        registry.clear_pipeline_cache()
+        ref = {}
+        for pid, seed in (("a", 11), ("b", 22)):
+            got, _ = bucket_rows({pid: (seed, 0)}, "replicated")
+            ref.update(got)
+
+        # --- engage the 2-D mesh through the serve-path env -----------
+        axes = mesh_mod.axes_from_env()
+        assert axes is not None, "DTPU_TP env did not resolve axes"
+        mesh = mesh_mod.build_mesh(axes, devices=jax.devices()[:4])
+        mesh_mod.set_runtime(mesh_mod.MeshRuntime(mesh=mesh))
+        registry.clear_pipeline_cache()
+        mesh_axes = {k: int(v) for k, v in mesh.shape.items()}
+        if mesh_axes.get(C.TENSOR_AXIS) != 2 \
+                or mesh_axes.get(C.DATA_AXIS) != 2:
+            problems.append(f"mesh axes {mesh_axes} != data=2,tensor=2")
+
+        # --- leg 2: TP solo + spec assertions -------------------------
+        tp_solo = {}
+        n_param_sharded = 0
+        bkt = None
+        for pid, seed in (("a", 11), ("b", 22)):
+            got, bkt = bucket_rows({pid: (seed, 0)}, "tp_solo")
+            tp_solo.update(got)
+        pipe = registry.load_pipeline("tiny.safetensors")
+        if pipe._tp_mesh is not mesh:
+            problems.append("TP layout not engaged on the pipeline")
+        for leaf in jax.tree_util.tree_leaves(pipe.unet_params):
+            spec = shd.spec_of(leaf)
+            if spec is not None and C.TENSOR_AXIS in str(spec):
+                n_param_sharded += 1
+        if not n_param_sharded:
+            problems.append("no UNet param leaf sharded over tensor")
+        rows_spec = shd.batch_axis_spec(bkt.x.ndim)
+        if shd.spec_of(bkt.x) != rows_spec:
+            problems.append(
+                f"bucket x spec {shd.spec_of(bkt.x)} != canonical "
+                f"rows layout {rows_spec}")
+        tp_diff = max(float(np.max(np.abs(tp_solo[p] - ref[p])))
+                      for p in ("a", "b"))
+        if tp_diff > 5e-4:
+            problems.append(f"TP-vs-replicated diff {tp_diff} > 5e-4")
+
+        # --- leg 3: late join, bit-exact, zero retraces ---------------
+        mark = tr.GLOBAL_RETRACES.mark()
+        shared, _ = bucket_rows({"a": (11, 0), "b": (22, 1)}, "shared")
+        steady_retraces = int(
+            tr.GLOBAL_RETRACES.since(mark).get("traces", 0))
+        exact = [int((shared[p] == tp_solo[p]).all()) for p in ("a", "b")]
+        bit_exact_fraction = sum(exact) / len(exact)
+        if bit_exact_fraction < 1.0:
+            problems.append(
+                f"late-join rows not bit-identical to TP solo "
+                f"(exact per prompt: {exact})")
+        if steady_retraces:
+            problems.append(f"{steady_retraces} steady-state retraces "
+                            "after the TP warm pass (must be 0)")
+    finally:
+        mesh_mod.set_runtime(None)
+        registry.clear_pipeline_cache()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "bit_exact_fraction": bit_exact_fraction,
+        "tp_vs_replicated_max_abs_diff": tp_diff,
+        "sharded_param_leaves": n_param_sharded,
+        "steady_retraces": steady_retraces,
+        "mesh_axes": mesh_axes,
+        "problems": problems,
+    }
+
+
+def run_tp_serve(args):
+    """``--phase tp_serve``: the tensor-parallel serving proof (ISSUE
+    16) — DTPU_TP env plumbing to a data=2×tensor=2 virtual mesh,
+    per-array sharding-spec assertions on params and CB bucket buffers,
+    TP-vs-replicated tolerance, late-join CB==solo bit-exactness under
+    TP, and zero steady-state retraces."""
+    from comfyui_distributed_tpu.parallel.mesh import force_cpu_platform
+    got = force_cpu_platform(4)
+    if got < 4:
+        fail(args, "backend_init",
+             f"tp_serve needs >=4 virtual CPU devices, got {got}")
+    # NOTE: deliberately no enable_compile_cache() — while the TP mesh
+    # is live, parallel/mesh.py force-disables it anyway (cached
+    # sharded executables deserialize corrupt on this jaxlib)
+    m = measure_tp_serve()
+    log(f"tp_serve: bit_exact {m['bit_exact_fraction']}, tp-vs-repl "
+        f"diff {m['tp_vs_replicated_max_abs_diff']}, "
+        f"{m['sharded_param_leaves']} sharded param leaves, steady "
+        f"retraces {m['steady_retraces']}, mesh {m['mesh_axes']}")
+    payload = {
+        "metric": metric_name(args),
+        "value": m["bit_exact_fraction"],
+        "unit": metric_unit(args),
+        **{k: v for k, v in m.items() if k != "problems"},
+    }
+    if m["problems"]:
+        payload["error"] = {"stage": "tp_serve_invariants",
+                            "detail": "; ".join(m["problems"])}
+    emit(args, payload)
+
+
 def _reuse_img2img_prompt(seed, steps=2, name="cond.png"):
     """Seeded img2img storm unit: LoadImage -> VAEEncode conditioning +
     two text encodes feed the sampler — the sub-graph tiers' shape."""
@@ -3941,6 +4151,14 @@ def run_suite(args):
                                extra=("--check",))
         if mm is not None:
             payload_b["stages"]["multimaster"] = mm
+        # tp_serve watchdog stage: the CPU proxy re-proves the tensor-
+        # parallel serving contract (sharded params + 2-D CB buckets
+        # with per-array spec assertions, TP-vs-replicated tolerance,
+        # late-join bit-exactness, zero steady-state retraces) and
+        # --check flags any exactness drop vs the prior BENCH artifact
+        tps = _phase_subprocess("tp_serve", extra=("--check",))
+        if tps is not None:
+            payload_b["stages"]["tp_serve"] = tps
         emit(args, payload_b)
     finally:
         try:
@@ -4379,6 +4597,8 @@ def main():
             run_reuse(args)
         elif args.phase == "multimaster":
             run_multimaster(args)
+        elif args.phase == "tp_serve":
+            run_tp_serve(args)
         elif args.real_ckpt:
             run_real_ckpt(args)
         elif args.multiproc_sweep:

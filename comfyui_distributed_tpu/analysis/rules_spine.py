@@ -168,3 +168,76 @@ def check_retrace_hazard(project: Project) -> List[Violation]:
                         f"accept a compile per value)",
                         scope=fn.name))
     return out
+
+
+# --- TP spec discipline (ISSUE 16) -------------------------------------------
+#
+# Tensor parallelism works BECAUSE every PartitionSpec in the package
+# flows through parallel/sharding.py's logical-axis rule table: the
+# serving-mesh gate, the MIN_SHARD_ELEMENTS floor, the rows-divisibility
+# fallback and the concat-miscompile pins all live there.  A raw
+# ``PartitionSpec(...)``/``NamedSharding(...)`` constructed anywhere
+# else bypasses every one of those, so ad-hoc hand sharding is a
+# bug-class finding: never baselined (test-enforced), fix by calling
+# the sharding helpers (mesh_spec/batch_axis_spec/named/replicated/...).
+
+_TP_SPEC = "tp-spec-discipline"
+_SHARDING_HOME = "comfyui_distributed_tpu/parallel/sharding.py"
+_SPEC_CTORS = ("PartitionSpec", "NamedSharding")
+_SHARDING_MODULES = ("jax.sharding", "jax.experimental.pjit")
+
+
+def _spec_ctor_aliases(tree: ast.AST):
+    """(direct, modules): local names bound to the spec constructors
+    (``from jax.sharding import PartitionSpec as P`` -> {"P":
+    "PartitionSpec"}) and local names bound to a module that exports
+    them (``import jax.sharding as js`` -> {"js"})."""
+    direct = {}
+    modules = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module in _SHARDING_MODULES or (
+                    node.module or "").startswith("jax.sharding"):
+                for a in node.names:
+                    if a.name in _SPEC_CTORS:
+                        direct[a.asname or a.name] = a.name
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in _SHARDING_MODULES:
+                    modules.add(a.asname or a.name)
+                elif a.name == "jax":
+                    modules.add((a.asname or "jax") + ".sharding")
+    return direct, modules
+
+
+@rule(_TP_SPEC)
+def check_tp_spec_discipline(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in project.python_files():
+        if sf.path == _SHARDING_HOME:
+            continue
+        direct, modules = _spec_ctor_aliases(sf.tree)
+        if not direct and not modules:
+            continue
+        for node, stack in iter_scoped(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            ctor = ""
+            if name in direct:
+                ctor = direct[name]
+            elif "." in name:
+                head, attr = name.rsplit(".", 1)
+                if attr in _SPEC_CTORS and head in modules:
+                    ctor = attr
+            if ctor:
+                out.append(Violation(
+                    _TP_SPEC, sf.path, node.lineno,
+                    f"raw `{ctor}` construction outside the "
+                    f"parallel/sharding.py rule table — hand shardings "
+                    f"skip the serving-mesh gate, the size floor and "
+                    f"the concat-miscompile pins; use its helpers "
+                    f"(mesh_spec/batch_axis_spec/named/replicated/"
+                    f"constrain*) instead",
+                    scope=scope_qualname(stack)))
+    return out

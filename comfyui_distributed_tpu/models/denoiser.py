@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from comfyui_distributed_tpu.models.schedules import DiscreteSchedule
+from comfyui_distributed_tpu.parallel import sharding as shd
 
 
 def _run_one_controlnet(spec, xin, ts, context, y, sigma):
@@ -46,7 +47,7 @@ def _run_one_controlnet(spec, xin, ts, context, y, sigma):
         gates = [_gate(w) for w in swindow] if per_block \
             else [_gate(swindow)]
     reps = xin.shape[0] // hint.shape[0]
-    hb = jnp.concatenate([hint] * reps, axis=0) if reps > 1 else hint
+    hb = shd.stack_rows([hint] * reps) if reps > 1 else hint
 
     def run_cn(_):
         return cn_apply(cn_params, xin, ts, context, hb, y)
@@ -171,9 +172,13 @@ def make_denoiser(apply_fn: Callable, params: Any, ds: DiscreteSchedule,
             # AFTER the control block: a ControlNet sees the plain
             # 4-channel scaled input, only the UNet gets the 9 channels
             creps = xin.shape[0] // concat.shape[0]
-            cb = jnp.concatenate([concat] * creps, axis=0) \
+            cb = shd.stack_rows([concat] * creps) \
                 if creps > 1 else concat
-            xin = jnp.concatenate([xin, cb.astype(xin.dtype)], axis=-1)
+            # channel concat: pin the result so conv_in's kernel layout
+            # can't back-propagate a sharding onto the concat dim
+            # (tp-concat-cpu-miscompile)
+            xin = shd.constrain_rows(
+                jnp.concatenate([xin, cb.astype(xin.dtype)], axis=-1))
         ctx_in, kw = context, {}
         if hypernet is not None and context is not None:
             from comfyui_distributed_tpu.models.hypernetwork import \

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from comfyui_distributed_tpu.parallel import sharding as shd
+
 Dtype = Any
 
 
@@ -30,7 +32,11 @@ def timestep_embedding(t: jax.Array, dim: int,
     emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
     if dim % 2:
         emb = jnp.concatenate([emb, jnp.zeros_like(emb[:, :1])], axis=-1)
-    return emb
+    # pin: time_fc1's kernel layout (input-dim fallback split) must not
+    # back-propagate a tensor sharding onto the cos/sin concat dim
+    # (tp-concat-cpu-miscompile); the embedding is tiny, replication
+    # is free
+    return shd.replicate(emb)
 
 
 class GroupNorm32(nn.Module):
@@ -91,9 +97,14 @@ class Attention(nn.Module):
 
         B, N, _ = q.shape
         M = k.shape[1]
-        q = q.reshape(B, N, self.num_heads, hd)
-        k = k.reshape(B, M, self.num_heads, hd)
-        v = v.reshape(B, M, self.num_heads, hd)
+        # megatron head split: q/k/v heads ride the tensor axis (inert on
+        # dp-only meshes; see parallel/sharding.py rule table)
+        q = shd.constrain(q.reshape(B, N, self.num_heads, hd),
+                          "batch", None, "heads", None)
+        k = shd.constrain(k.reshape(B, M, self.num_heads, hd),
+                          "batch", None, "heads", None)
+        v = shd.constrain(v.reshape(B, M, self.num_heads, hd),
+                          "batch", None, "heads", None)
 
         if self.sow_probs:
             logits = jnp.einsum("bnhd,bmhd->bhnm", q, k,
@@ -204,6 +215,10 @@ class GEGLU(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         h = nn.Dense(self.dim_out * 2, dtype=self.dtype, name="proj")(x)
+        # column-split ffn hidden over the tensor axis (rule table "mlp");
+        # the gate/value halves split at dim_out, which is also a shard
+        # boundary for any tensor size dividing dim_out
+        h = shd.constrain(h, "batch", None, "mlp")
         a, b = jnp.split(h, 2, axis=-1)
         # exact (erf) gelu: torch F.gelu's default, what SD was trained
         # with — flax's default tanh approximation drifts ~1e-3

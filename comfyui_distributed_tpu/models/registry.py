@@ -31,6 +31,7 @@ from comfyui_distributed_tpu.models import unet as unet_mod
 from comfyui_distributed_tpu.models import vae as vae_mod
 from comfyui_distributed_tpu.models.denoiser import make_denoiser
 from comfyui_distributed_tpu.models.tokenizer import make_tokenizer
+from comfyui_distributed_tpu.parallel import sharding as shd
 from comfyui_distributed_tpu.models.upscalers import (
     ESRGAN_4X_CONFIG,
     TINY_RRDB_CONFIG,
@@ -322,6 +323,14 @@ class DiffusionPipeline:
             self.clip_params = [lay_out(p) for p in self.clip_params]
             self.vae_params = lay_out(self.vae_params)
             self._tp_mesh = mesh
+            # Cached cores were TRACED while no mesh was live, so every
+            # activation constraint (shd.constrain*) resolved to a no-op
+            # inside the cached jaxpr — jit re-lowers for the new param
+            # shardings but never re-traces, which would serve the
+            # tp-concat-cpu-miscompile graph.  A layout transition is a
+            # serve-boot one-off; drop the cache so post-layout traces
+            # re-resolve the gates against the live mesh.
+            self._jit_cache.clear()
             log(f"tp: UNet/CLIP/VAE params laid out over tensor="
                 f"{int(mesh.shape[TENSOR_AXIS])} for serving")
 
@@ -498,6 +507,13 @@ class DiffusionPipeline:
 
             def step(unet_params, x, ctx, unc, y_in, keys, sigma,
                      sigma_next, step_i, active):
+                # 2-D CB composition (ISSUE 16): pin the persistent batch
+                # to its canonical rows-on-data layout on BOTH ends of the
+                # step, so the donated output sharding always matches the
+                # input and every steady-state call sees one layout —
+                # anything else would re-lower per call and break the
+                # zero-retrace invariant.  Inert without a tensor axis.
+                x = shd.constrain_rows(x)
                 den = make_denoiser(self.raw_unet_apply, unet_params,
                                     self.schedule, self.prediction_type)
                 model = smp.cfg_denoiser_multi(
@@ -507,13 +523,13 @@ class DiffusionPipeline:
                 if not has_y:
                     extra = {}
                 else:
-                    y2 = jnp.concatenate([y_in] * reps, axis=0) \
+                    y2 = shd.stack_rows([y_in] * reps) \
                         if reps > 1 else y_in
                     extra = {"y": y2}
                 x_new = step_impl(model, x, sigma, sigma_next, step_i,
                                   keys, extra_args=extra)
                 act = jnp.reshape(active, (-1,) + (1,) * (x.ndim - 1))
-                return jnp.where(act, x_new, x)
+                return shd.constrain_rows(jnp.where(act, x_new, x))
 
             return jax.jit(step, donate_argnums=(1,))
 
@@ -826,7 +842,7 @@ class DiffusionPipeline:
                     idxs = tuple(gligen_objs[2])[:max(reps, 1)]
                     parts = [og[i] if i >= 0 else on for i in idxs]
                     parts += [on] * (max(reps, 1) - len(parts))
-                    extra_objs = jnp.concatenate(parts, axis=0) \
+                    extra_objs = shd.stack_rows(parts) \
                         if reps > 1 else parts[0]
                 else:
                     extra_objs = None
@@ -835,11 +851,11 @@ class DiffusionPipeline:
                 elif y_is_list:
                     # one ADM vector per entry (regional SDXL: each
                     # region's own pooled), conds first then unconds
-                    y2 = jnp.concatenate(list(y_in)[:reps], axis=0) \
+                    y2 = shd.stack_rows(list(y_in)[:reps]) \
                         if reps > 1 else y_in[0]
                 else:
                     # a single ADM vector rides every block
-                    y2 = jnp.concatenate([y_in] * reps, axis=0) \
+                    y2 = shd.stack_rows([y_in] * reps) \
                         if reps > 1 else y_in
                 # init noise uses a reserved fold-in index so it never
                 # collides with per-step ancestral noise (steps from 0)

@@ -26,6 +26,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from comfyui_distributed_tpu.parallel import sharding as shd
+
 Model = Callable[..., jax.Array]  # model(x, sigma, **extra) -> denoised
 
 
@@ -1717,20 +1719,23 @@ def cfg_denoiser_multi(model: Model, conds, uncond: Any,
             den = model(x, sigma, context=conds[0][0], **extra)
             wrapped.last_uncond = den      # cfg==1: no separate uncond
             return den
-        x_rep = jnp.concatenate([x] * reps, axis=0)
-        ctx = jnp.concatenate(
+        # CFG row-stack: a batch-dim concat whose concat dim picks up a
+        # mesh axis hits the same XLA CPU SPMD miscompile as the UNet
+        # skip concat (tp-concat-cpu-miscompile) — shd.stack_rows /
+        # shd.unstack_rows keep the stack/split seams off shard
+        # boundaries (inert without an engaged tensor axis)
+        x_rep = shd.stack_rows([x] * reps)
+        ctx = shd.stack_rows(
             [c for c, _, _, _ in conds]
-            + ([c for c, _, _, _ in unconds] if use_uncond else []),
-            axis=0)
+            + ([c for c, _, _, _ in unconds] if use_uncond else []))
         # per-sample sigma (continuous batching: a padded batch's slots
         # sit at different sigmas) tiles in lockstep with the CFG-stacked
         # rows; scalar sigma broadcasts exactly as before
         sigma_rep = sigma
         if getattr(sigma, "ndim", 0):
-            sigma_rep = jnp.concatenate([jnp.asarray(sigma)] * reps,
-                                        axis=0)
+            sigma_rep = shd.stack_rows([jnp.asarray(sigma)] * reps)
         out = model(x_rep, sigma_rep, context=ctx, **extra)
-        parts = jnp.split(out, reps, axis=0)
+        parts = shd.unstack_rows(out, reps)
         den_cond = _mask_blend(conds, parts[:n], sigma)
         if not use_uncond:
             wrapped.last_uncond = den_cond
@@ -1761,10 +1766,12 @@ def cfg_denoiser_dual(model: Model, cond: jax.Array, middle: jax.Array,
     A RescaleCFG patch applies to the middle/negative combine (ComfyUI:
     the sampler_cfg_function rides ``cfg_function`` there)."""
     def wrapped(x, sigma, **extra):
-        x_rep = jnp.concatenate([x, x, x], axis=0)
-        ctx = jnp.concatenate([cond, middle, uncond], axis=0)
+        # seam-safe CFG stack/split (tp-concat-cpu-miscompile; see
+        # cfg_denoiser_multi)
+        x_rep = shd.stack_rows([x, x, x])
+        ctx = shd.stack_rows([cond, middle, uncond])
         out = model(x_rep, sigma, context=ctx, **extra)
-        pos, mid, neg = jnp.split(out, 3, axis=0)
+        pos, mid, neg = shd.unstack_rows(out, 3)
         wrapped.last_uncond = neg       # CFG++ side-channel
         if cfg_rescale:
             base = _rescale_cfg(x, sigma, mid, neg, cfg2, cfg_rescale)
@@ -1811,10 +1818,12 @@ def cfg_denoiser_sag(model_capture: Model, model_plain: Model,
 
     def wrapped(x, sigma, **extra):
         B = x.shape[0]
-        x_rep = jnp.concatenate([x, x], axis=0)
-        ctx = jnp.concatenate([cond, uncond], axis=0)
+        # seam-safe CFG stack/split (tp-concat-cpu-miscompile; see
+        # cfg_denoiser_multi)
+        x_rep = shd.stack_rows([x, x])
+        ctx = shd.stack_rows([cond, uncond])
         out, probs = model_capture(x_rep, sigma, context=ctx, **extra)
-        den_cond, den_unc = jnp.split(out, 2, axis=0)
+        den_cond, den_unc = shd.unstack_rows(out, 2)
         wrapped.last_uncond = den_unc   # CFG++ side-channel
         # probs [2B, heads, N, N]: uncond rows second; mean over heads,
         # sum over the QUERY axis -> per-key attention mass
@@ -1865,10 +1874,12 @@ def cfg_denoiser_perp_neg(model: Model, cond: jax.Array,
     projection).  A RescaleCFG patch re-stds the combine toward the
     cond prediction like the plain CFG path."""
     def wrapped(x, sigma, **extra):
-        x_rep = jnp.concatenate([x, x, x], axis=0)
-        ctx = jnp.concatenate([cond, empty, uncond], axis=0)
+        # seam-safe CFG stack/split (tp-concat-cpu-miscompile; see
+        # cfg_denoiser_multi)
+        x_rep = shd.stack_rows([x, x, x])
+        ctx = shd.stack_rows([cond, empty, uncond])
         out = model(x_rep, sigma, context=ctx, **extra)
-        den_cond, den_empty, den_unc = jnp.split(out, 3, axis=0)
+        den_cond, den_empty, den_unc = shd.unstack_rows(out, 3)
         wrapped.last_uncond = den_unc   # CFG++ side-channel
         pos = den_cond - den_empty
         neg = den_unc - den_empty

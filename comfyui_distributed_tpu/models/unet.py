@@ -28,6 +28,7 @@ from comfyui_distributed_tpu.models.layers import (
     Upsample,
     timestep_embedding,
 )
+from comfyui_distributed_tpu.parallel import sharding as shd
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,7 +184,10 @@ def _apply_freeu(cfg: "UNetConfig", h: jax.Array, hsp: jax.Array):
         boost = ((b - 1.0) * hm + 1.0).astype(h.dtype)
     else:
         boost = jnp.asarray(b, h.dtype)
-    h = jnp.concatenate([h[..., :half] * boost, h[..., half:]], axis=-1)
+    # pin: channel concat of backbone halves must keep an unsharded
+    # concat dim (tp-concat-cpu-miscompile)
+    h = shd.constrain_rows(
+        jnp.concatenate([h[..., :half] * boost, h[..., half:]], axis=-1))
     return h, _fourier_filter(hsp, 1, s)
 
 
@@ -297,7 +301,19 @@ class UNet(nn.Module):
                         method="bilinear").astype(h.dtype)
                 if cfg.freeu is not None:
                     h, skip = _apply_freeu(cfg, h, skip)
-                h = jnp.concatenate([h, skip], axis=-1)
+                # replicate-before-concat (tp-concat-cpu-miscompile,
+                # ROADMAP item 8): XLA's CPU SPMD partitioner miscompiles
+                # a channel concat whose operands or result carry a
+                # tensor-axis layout on the concat dim (shard boundaries
+                # misalign with the operand seam) — pin operands AND the
+                # result to batch-only sharding so consumer-side
+                # propagation (e.g. the ResBlock skip projection) cannot
+                # re-shard the concat (inert without an engaged tensor
+                # axis)
+                h = shd.constrain_rows(h)
+                skip = shd.constrain_rows(skip)
+                h = shd.constrain_rows(
+                    jnp.concatenate([h, skip], axis=-1))
                 h = ResBlock(out_ch, dtype=cfg.dtype,
                              name=f"up_{level}_res_{i}")(h, emb)
                 if cfg.transformer_depth[level] > 0:

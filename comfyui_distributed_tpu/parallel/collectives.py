@@ -21,13 +21,14 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 try:                                    # JAX >= 0.6: top-level export
     from jax import shard_map as _shard_map_impl
 except ImportError:                     # older JAX: experimental module
     from jax.experimental.shard_map import shard_map as _shard_map_impl
 
+from comfyui_distributed_tpu.parallel import sharding as shd
 from comfyui_distributed_tpu.utils.constants import DATA_AXIS
 
 # the replication-check kwarg was renamed check_rep -> check_vma across JAX
@@ -77,8 +78,8 @@ def shard_batch(x: Any, mesh: Mesh, spec: Optional[P] = None) -> jax.Array:
     The analog of the reference's dispatch fan-out (POST the workflow to every
     worker, ``gpupanel.js:1313-1362``) — except no data moves per-participant;
     XLA lays each shard directly into its device's HBM."""
-    spec = spec if spec is not None else P(DATA_AXIS)
-    return jax.device_put(x, NamedSharding(mesh, spec))
+    spec = spec if spec is not None else shd.mesh_spec(DATA_AXIS)
+    return shd.put_on_mesh(x, mesh, spec)
 
 
 def gather_batch(x: jax.Array) -> np.ndarray:
@@ -101,8 +102,8 @@ def all_gather_data(x: jax.Array, mesh: Mesh) -> jax.Array:
         return jax.lax.all_gather(shard, DATA_AXIS, axis=0, tiled=True)
     # check_vma=False: replication over the unused tensor/seq axes (size 1)
     # can't be statically inferred by shard_map's rep checker.
-    return shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS),
-                     out_specs=P(), check_vma=False)(x)
+    return shard_map(f, mesh=mesh, in_specs=shd.mesh_spec(DATA_AXIS),
+                     out_specs=shd.mesh_spec(), check_vma=False)(x)
 
 
 def psum_data(x: jax.Array, mesh: Mesh) -> jax.Array:
@@ -110,8 +111,8 @@ def psum_data(x: jax.Array, mesh: Mesh) -> jax.Array:
     gathering and for gradient reduction in the train step)."""
     def f(shard):
         return jax.lax.psum(shard, DATA_AXIS)
-    return shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(),
-                     check_vma=False)(x)
+    return shard_map(f, mesh=mesh, in_specs=shd.mesh_spec(DATA_AXIS),
+                     out_specs=shd.mesh_spec(), check_vma=False)(x)
 
 
 def pad_to_multiple(n: int, m: int) -> int:
@@ -122,4 +123,4 @@ def pad_to_multiple(n: int, m: int) -> int:
 
 
 def device_put_replicated(x: Any, mesh: Mesh) -> jax.Array:
-    return jax.device_put(x, NamedSharding(mesh, P()))
+    return shd.put_on_mesh(x, mesh, shd.mesh_spec())

@@ -27,13 +27,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from comfyui_distributed_tpu.utils.constants import DATA_AXIS, SEQ_AXIS, TENSOR_AXIS
+from comfyui_distributed_tpu.utils.constants import (
+    DATA_AXIS, MESH_SHAPE_ENV, SEQ_AXIS, TENSOR_AXIS, TP_ENV)
 from comfyui_distributed_tpu.utils.logging import debug_log, log
 
 AXIS_ORDER = (DATA_AXIS, TENSOR_AXIS, SEQ_AXIS)
 
 
-def force_cpu_platform(n_devices: int) -> None:
+def force_cpu_platform(n_devices: int) -> int:
     """Pin JAX to ``n_devices`` virtual CPU devices WITHOUT ever probing the
     default backend.
 
@@ -43,7 +44,18 @@ def force_cpu_platform(n_devices: int) -> None:
     VERDICT.md).  Works even when sitecustomize imported jax at interpreter
     startup (env alone is frozen then — the live config update is the
     reliable switch) and when a CPU backend already initialized with a
-    different device count (cleared first so the new count applies)."""
+    different device count (cleared first so the new count applies).
+
+    Returns the virtual device count actually achieved.  On JAX builds
+    without ``jax_num_cpu_devices`` the fallback is ``XLA_FLAGS``, which XLA
+    parses ONCE per process at first client creation — ``clear_backends``
+    does not re-parse it, so a process whose CPU client already froze a
+    SMALLER count cannot honor a larger request in-process.  That used to
+    silently proceed on the stale count (a 2-D mesh bench asking for 4
+    devices would "succeed" with 1 and fail later at mesh build with a
+    misleading divisibility error); now it raises RuntimeError naming the
+    real cause.  Achieving MORE devices than requested is allowed — the
+    test harness pre-freezes 8 and every smaller request still fits."""
     try:  # drop any backend a host process already initialized
         import jax.extend as jex
         jex.backend.clear_backends()
@@ -53,6 +65,7 @@ def force_cpu_platform(n_devices: int) -> None:
     jax.config.update("jax_platforms", "cpu")
     try:
         jax.config.update("jax_num_cpu_devices", n_devices)
+        return n_devices
     except AttributeError:
         # older JAX: the option doesn't exist — the XLA flag (read at
         # client creation, i.e. after the clear_backends above) is the
@@ -63,6 +76,18 @@ def force_cpu_platform(n_devices: int) -> None:
         os.environ["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count={n_devices}"
             .strip())
+    # verify the flag actually took: this touches ONLY the cpu backend we
+    # just pinned, and freezes the flag we just set (a feature — nothing
+    # can sneak a different count in before first real use)
+    achieved = len(jax.devices("cpu"))
+    if achieved < n_devices:
+        raise RuntimeError(
+            f"force_cpu_platform({n_devices}) got {achieved} device(s): "
+            f"XLA parsed --xla_force_host_platform_device_count at this "
+            f"process's first client creation and won't re-read it; "
+            f"request the count before any backend init (or from a fresh "
+            f"subprocess, as bench.py phase runners do)")
+    return achieved
 
 
 _PROBE_SRC = r"""
@@ -343,15 +368,64 @@ def _resolve_axes(axes: Dict[str, int], n_devices: int) -> Dict[str, int]:
     return resolved
 
 
+def _axis_size(raw: str, where: str) -> int:
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{where}: axis size must be an integer (or -1 to fill), "
+            f"got {raw.strip()!r}") from None
+
+
+def axes_from_env() -> Optional[Dict[str, int]]:
+    """Mesh shape from the serve-path environment (ISSUE 16).
+
+    ``DTPU_MESH_SHAPE`` — full layout, either ``data=2,tensor=2`` pairs or
+    positional ``2x2x1`` in AXIS_ORDER (data, tensor, seq); ``-1`` fills.
+    ``DTPU_TP`` — shorthand: tensor-axis size, data fills the rest.  Returns
+    None when neither is set, so every existing caller keeps the pure
+    data-parallel default."""
+    shape = os.environ.get(MESH_SHAPE_ENV, "").strip()
+    if shape:
+        axes: Dict[str, int] = {}
+        if "=" in shape:
+            for part in shape.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                name, _, val = part.partition("=")
+                name = name.strip()
+                if name not in AXIS_ORDER:
+                    raise ValueError(
+                        f"{MESH_SHAPE_ENV}: unknown axis {name!r} "
+                        f"(axes: {AXIS_ORDER})")
+                axes[name] = _axis_size(val, f"{MESH_SHAPE_ENV} axis {name}")
+        else:
+            sizes = [_axis_size(v, MESH_SHAPE_ENV)
+                     for v in shape.replace("x", ",").split(",")
+                     if v.strip()]
+            if len(sizes) > len(AXIS_ORDER):
+                raise ValueError(
+                    f"{MESH_SHAPE_ENV}: {len(sizes)} sizes for "
+                    f"{len(AXIS_ORDER)} axes {AXIS_ORDER}")
+            axes = dict(zip(AXIS_ORDER, sizes))
+        return axes
+    tp = os.environ.get(TP_ENV, "").strip()
+    if tp and _axis_size(tp, TP_ENV) > 1:
+        return {TENSOR_AXIS: _axis_size(tp, TP_ENV), DATA_AXIS: -1}
+    return None
+
+
 def build_mesh(axes: Optional[Dict[str, int]] = None,
                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Construct a named mesh over the available devices.
 
     ``axes`` maps axis name -> size; ``-1`` means "all remaining devices"
-    (default: everything on the data axis, mirroring the reference's pure
-    data-parallel fan-out)."""
+    (default: ``DTPU_MESH_SHAPE``/``DTPU_TP`` from the environment when set
+    — the serve path's 2-D data×tensor switch — else everything on the data
+    axis, mirroring the reference's pure data-parallel fan-out)."""
     devices = list(devices) if devices is not None else jax.devices()
-    axes = dict(axes or {})
+    axes = dict(axes if axes is not None else (axes_from_env() or {}))
     axes.setdefault(DATA_AXIS, -1)
     resolved = _resolve_axes(axes, len(devices))
     shape = tuple(resolved[name] for name in AXIS_ORDER)
@@ -384,10 +458,13 @@ class MeshRuntime:
 
     def data_sharding(self, spec: Optional[P] = None) -> NamedSharding:
         """Sharding with the leading (batch) dim over the data axis."""
-        return NamedSharding(self.mesh, spec if spec is not None else P(DATA_AXIS))
+        from comfyui_distributed_tpu.parallel import sharding as shd
+        return shd.named(self.mesh,
+                         spec if spec is not None else shd.mesh_spec(DATA_AXIS))
 
     def replicated(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P())
+        from comfyui_distributed_tpu.parallel import sharding as shd
+        return shd.replicated(self.mesh)
 
     def status(self) -> Dict[str, Any]:
         """Cluster status payload (feeds the control plane's /status route —
@@ -403,6 +480,36 @@ class MeshRuntime:
 
 _runtime: Optional[MeshRuntime] = None
 _runtime_lock = threading.Lock()
+# True once the TP cache guard below has fired; the disable is STICKY
+# for the remainder of the process.
+_cc_disabled = False
+
+
+def _tp_compile_cache_guard(rt: Optional[MeshRuntime]) -> None:
+    """XLA CPU cannot round-trip this repo's tensor-parallel serving
+    executables through the persistent compilation cache: a cached
+    donated SPMD step deserializes into an executable that returns
+    garbage rows (observed latents ~1e10) and corrupts the heap (later
+    unrelated device_puts segfault).  Fresh compilation of the very
+    same HLO is fine — only the serialize/deserialize path is broken
+    (jaxlib 0.4.37) — so the first time a tensor>1 serving mesh goes
+    live on the cpu backend the cache is switched off FOR THE REST OF
+    THE PROCESS.  The disable is deliberately sticky: re-enabling after
+    the mesh clears and then loading cached entries reproducibly aborts
+    with glibc heap-corruption (even for replicated programs), so a
+    process that has ever run the TP serve path never touches the cache
+    again.  TPU backends are unaffected.  Callers hold _runtime_lock."""
+    global _cc_disabled
+    tp_cpu = (rt is not None
+              and int(rt.mesh.shape.get(TENSOR_AXIS, 1)) > 1
+              and rt.mesh.devices.flat[0].platform == "cpu")
+    if tp_cpu and not _cc_disabled:
+        _cc_disabled = True
+        if bool(jax.config.jax_enable_compilation_cache):
+            jax.config.update("jax_enable_compilation_cache", False)
+            log("tp: persistent compilation cache disabled for the rest "
+                "of this process — a tensor-parallel mesh went live on "
+                "cpu (cached sharded executables deserialize corrupt)")
 
 
 def get_runtime(axes: Optional[Dict[str, int]] = None,
@@ -417,6 +524,7 @@ def get_runtime(axes: Optional[Dict[str, int]] = None,
     with _runtime_lock:
         if _runtime is None or refresh:
             _runtime = MeshRuntime(mesh=build_mesh(axes))
+            _tp_compile_cache_guard(_runtime)
         elif axes is not None:
             requested = dict(axes)
             requested.setdefault(DATA_AXIS, -1)  # same default build_mesh uses
@@ -441,6 +549,7 @@ def set_runtime(rt: Optional[MeshRuntime]) -> None:
     global _runtime
     with _runtime_lock:
         _runtime = rt
+        _tp_compile_cache_guard(rt)
 
 
 def initialize_multihost(coordinator_address: Optional[str] = None,

@@ -28,10 +28,11 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 # version-portable shard_map (check_vma/check_rep shim) — ONE shim for
 # every call site, see parallel/collectives.py
+from comfyui_distributed_tpu.parallel import sharding as shd
 from comfyui_distributed_tpu.parallel.collectives import shard_map
 
 from comfyui_distributed_tpu.utils.constants import (
@@ -155,7 +156,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     b_ax = _axis_if_divisible(batch_axis, q.shape[0])
     h_ax = _axis_if_divisible(head_axis, q.shape[2])
-    spec = P(b_ax, axis_name, h_ax, None)
+    spec = shd.mesh_spec(b_ax, axis_name, h_ax, None)
     body = partial(_ring_body, axis_name=axis_name, n_shards=n_shards,
                    causal=causal, scale=scale)
     return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),

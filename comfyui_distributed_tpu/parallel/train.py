@@ -125,9 +125,8 @@ def shard_train_step(step: Callable, mesh: Mesh, params: Any, opt_state: Any,
     # (step counters, clip state) replicate.
     def opt_leaf(x):
         if hasattr(x, "shape") and len(getattr(x, "shape", ())) >= 2:
-            return shd.NamedSharding(mesh, shd.param_spec(
-                "", tuple(x.shape), mesh.shape[shd.TENSOR_AXIS],
-                min_shard_elements))
+            return shd.param_sharding(mesh, "", tuple(x.shape),
+                                      min_shard_elements)
         return shd.replicated(mesh)
     o_shard = jax.tree_util.tree_map(opt_leaf, opt_state)
     b_shard = shd.batch_shardings(batch, mesh, seq_dims)

@@ -158,6 +158,18 @@ class WorkerProcessManager:
             for k in ("DTPU_COORDINATOR", "DTPU_NUM_PROCESSES",
                       "DTPU_PROCESS_ID"):
                 env.pop(k, None)
+            # serve-path mesh layout (ISSUE 16): the worker inherits
+            # DTPU_TP / DTPU_MESH_SHAPE — resolve them HERE so a
+            # malformed layout fails THIS launch with a clear error
+            # instead of crashing every spawned worker at mesh build,
+            # and the launch log records the fleet's layout
+            if env.get(C.TP_ENV) or env.get(C.MESH_SHAPE_ENV):
+                from comfyui_distributed_tpu.parallel.mesh import \
+                    axes_from_env
+                tp_axes = axes_from_env()
+                if tp_axes is not None:
+                    log(f"worker {wid}: serve-path mesh layout "
+                        f"{tp_axes} (inherited)")
             cmd = self.build_launch_command(worker)
             if stop_on_master_exit:
                 # wrap with the master-death monitor (reference

@@ -462,6 +462,11 @@ SKIP_BACKEND_PROBE_ENV = "DTPU_SKIP_BACKEND_PROBE"  # skip subprocess probe
 INIT_PATIENCE_ENV = "DTPU_INIT_PATIENCE_S"  # total backend-init budget
 INIT_PROBE_TIMEOUT_ENV = "DTPU_INIT_PROBE_TIMEOUT_S"  # per-probe bound
 CPU_FALLBACK_DEVICES_ENV = "DTPU_CPU_FALLBACK_DEVICES"  # virtual dev count
+# serve-path mesh layout (parallel/mesh.axes_from_env, ISSUE 16): full
+# shape ("data=2,tensor=2" or positional "2x2x1") or the tensor-size
+# shorthand; unset keeps the pure data-parallel default
+MESH_SHAPE_ENV = "DTPU_MESH_SHAPE"
+TP_ENV = "DTPU_TP"
 # model plane (models/)
 DEFAULT_FAMILY_ENV = "DTPU_DEFAULT_FAMILY"  # family override (tests: tiny)
 BF16_WEIGHTS_ENV = "DTPU_BF16_WEIGHTS"      # bf16 weight storage toggle

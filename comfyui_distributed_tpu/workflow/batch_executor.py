@@ -335,9 +335,21 @@ class _Bucket:
                 or isinstance(prep.uncond, list):
             raise CBIneligible("conditioning shape outside the plain "
                                "single-entry CFG case")
-        self._ctx_full = prep.context
-        self._unc_full = prep.uncond
-        self._y_full = prep.y
+        # 2-D tensor-parallel composition (ISSUE 16): when the live mesh
+        # has an engaged tensor axis, the persistent padded batch lives
+        # 2-D-sharded — rows over "data", UNet internals over "tensor"
+        # (the step fn's params/constraints handle the latter).  _pin()
+        # normalizes every rows-leading buffer onto ONE canonical layout
+        # per pad (rows on data when divisible, else replicated), so the
+        # step executable sees a single input sharding per pad and the
+        # zero-steady-state-retrace argument survives sharding.  Without
+        # a tensor axis _pin is identity and nothing here changes.
+        from comfyui_distributed_tpu.parallel import sharding as shd
+        self._shd = shd
+        self._tp_mesh = shd.serving_mesh()
+        self._ctx_full = self._pin(prep.context)
+        self._unc_full = self._pin(prep.uncond)
+        self._y_full = self._pin(prep.y)
         self.has_y = prep.y is not None
         self._per_pad: Dict[int, tuple] = {}
         # process-shared slot-plumbing executables (module docstring):
@@ -348,9 +360,9 @@ class _Bucket:
         self._jnp = jnp
         self.slots: List[_Slot] = []      # dense: slot i owns rows [i*b, (i+1)*b)
         self.pad = self.pads[0]
-        self.x = jnp.zeros((self.pad * self.b,) + self.lat_shape,
-                           jnp.float32)
-        self.keys = jnp.zeros((self.pad * self.b, 2), jnp.uint32)
+        self.x = self._pin(jnp.zeros((self.pad * self.b,) + self.lat_shape,
+                                     jnp.float32))
+        self.keys = self._pin(jnp.zeros((self.pad * self.b, 2), jnp.uint32))
         self.admits = 0
         self.retires = 0
         self.steps_done = 0
@@ -359,6 +371,13 @@ class _Bucket:
         self.last_active = time.monotonic()
 
     # -- geometry -------------------------------------------------------------
+
+    def _pin(self, x):
+        """Canonical 2-D bucket layout for a rows-leading array (identity
+        when no tensor axis is engaged, or for None leaves)."""
+        if x is None or self._tp_mesh is None:
+            return x
+        return self._shd.put_rows(x, self._tp_mesh)
 
     @property
     def n_active(self) -> int:
@@ -387,8 +406,8 @@ class _Bucket:
             perm[new_i * self.b:(new_i + 1) * self.b] = np.arange(
                 old_i * self.b, (old_i + 1) * self.b, dtype=np.int32)
         idx = jnp.asarray(perm)
-        self.x = self._permute(self.x, idx)
-        self.keys = self._permute(self.keys, idx)
+        self.x = self._pin(self._permute(self.x, idx))
+        self.keys = self._pin(self._permute(self.keys, idx))
         if new_pad != self.pad:
             self.pad_transitions += 1
         self.pad = new_pad
@@ -425,8 +444,9 @@ class _Bucket:
         x_rows = self._init_rows(keys_rows,
                                  jnp.asarray(self.sigmas_np[0]))
         start = jnp.asarray(n * self.b, jnp.int32)
-        self.x = self._write(self.x, x_rows, start)
-        self.keys = self._write(self.keys, jnp.asarray(keys_rows), start)
+        self.x = self._pin(self._write(self.x, x_rows, start))
+        self.keys = self._pin(
+            self._write(self.keys, jnp.asarray(keys_rows), start))
         # perf_counter, matching every other finalize t0 producer
         # (monotonic shares its epoch only on Linux)
         now = time.perf_counter()
@@ -455,8 +475,12 @@ class _Bucket:
         key = (rows, self.has_y)
         cached = self._per_pad.get(key)
         if cached is None:
-            cached = (self._ctx_full[:rows], self._unc_full[:rows],
-                      self._y_full[:rows] if self.has_y else None,
+            # per-pad conditioning slices are cached AND pinned once:
+            # their sharding is part of the step executable's signature
+            cached = (self._pin(self._ctx_full[:rows]),
+                      self._pin(self._unc_full[:rows]),
+                      self._pin(self._y_full[:rows]) if self.has_y
+                      else None,
                       self.pipe.denoise_step_fn(
                           self.sampler_name, self.cfg, rows,
                           self.lat_shape, has_y=self.has_y))

@@ -110,8 +110,23 @@ _MODULE_COST_S = {
 _SLOW_TESTS = {
     "test_parallel.py::TestDryrunMultichip::test_dryrun_green[8]",
     "test_parallel.py::TestDryrunMultichip::test_dryrun_green[16]",
+    # TP serve workloads (ISSUE 16 budget guard + cache hygiene): the
+    # 2-D-mesh bucket programs can't use the persistent compile cache
+    # (see parallel/mesh._tp_compile_cache_guard — the disable is sticky
+    # for the whole process), so they pay full compiles every run AND
+    # strand every later test in the same process cacheless.  Tier-1
+    # therefore runs NO in-process tensor>1 serve programs at all; the
+    # slow tier and the bench tp_serve subprocess keep the coverage.
+    "test_batching.py::TestBucketTensorParallel::"
+    "test_late_join_bit_identical_to_solo_under_tp",
+    "test_batching.py::TestBucketTensorParallel::"
+    "test_zero_steady_state_retraces_under_tp",
+    "test_batching.py::TestBucketTensorParallel::"
+    "test_bucket_buffers_carry_canonical_rows_layout",
     "test_parallel.py::TestServingTensorParallel::"
     "test_tp_sharded_sample_matches_replicated_oracle",
+    "test_parallel.py::TestServingTensorParallel::"
+    "test_upstream_sharded_concat_miscompile",
     "test_train.py::test_sharded_train_step_runs",
     "test_train.py::test_training_reduces_loss",
     "test_samplers.py::TestRound5SamplerLongTail::"

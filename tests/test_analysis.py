@@ -225,6 +225,71 @@ class TestSpineRules:
         assert len(vs) == 1 and "x" in vs[0].message
 
 
+# --- rule fixtures: tp-spec-discipline (ISSUE 16) ----------------------------
+
+HAND_SPEC_DIRECT = '''
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def shard_rows(x, mesh):
+    return jax.device_put(x, NamedSharding(mesh, P("data")))
+'''
+
+HAND_SPEC_MODULE = '''
+import jax.sharding as js
+
+
+def spec_for():
+    return js.PartitionSpec(None, None)
+'''
+
+SPEC_VIA_HELPERS = '''
+from comfyui_distributed_tpu.parallel import sharding as shd
+
+
+def shard_rows(x, mesh):
+    return shd.put_rows(x, mesh)
+'''
+
+
+class TestTpSpecDisciplineRule:
+    def test_direct_alias_construction_flagged(self):
+        vs = lint_sources(
+            {f"{PKG}/workflow/hand.py": HAND_SPEC_DIRECT},
+            rules=["tp-spec-discipline"])
+        assert len(vs) == 2          # NamedSharding AND the P() inside
+        assert all(v.rule == "tp-spec-discipline" for v in vs)
+        assert "rule table" in vs[0].message
+
+    def test_module_attribute_construction_flagged(self):
+        vs = lint_sources(
+            {f"{PKG}/models/hand.py": HAND_SPEC_MODULE},
+            rules=["tp-spec-discipline"])
+        assert [v.line for v in vs] and len(vs) == 1
+
+    def test_sharding_home_and_helper_callers_exempt(self):
+        # the rule table itself constructs specs freely; callers that
+        # go through its helpers are clean
+        vs = lint_sources(
+            {f"{PKG}/parallel/sharding.py": HAND_SPEC_DIRECT,
+             f"{PKG}/workflow/clean.py": SPEC_VIA_HELPERS},
+            rules=["tp-spec-discipline"])
+        assert vs == []
+
+    def test_suppression_needs_reason(self):
+        line = "return js.PartitionSpec(None, None)"
+        bad = HAND_SPEC_MODULE.replace(
+            line, line + "  # dtpu-lint: ignore[tp-spec-discipline]")
+        # reasonless ignore does not suppress
+        assert lint_sources({f"{PKG}/models/hand.py": bad},
+                            rules=["tp-spec-discipline"])
+        ok = HAND_SPEC_MODULE.replace(
+            line, line + "  # dtpu-lint: ignore[tp-spec-discipline] "
+                         "host-only layout probe")
+        assert lint_sources({f"{PKG}/models/hand.py": ok},
+                            rules=["tp-spec-discipline"]) == []
+
+
 # --- rule fixtures: registry drift -------------------------------------------
 
 CONSTANTS_FIXTURE = '''
@@ -755,7 +820,8 @@ class TestBaselineHygiene:
                                          "async-blocking-transitive",
                                          "deadlock-cycle",
                                          "wal-fencing",
-                                         "route-contract")]
+                                         "route-contract",
+                                         "tp-spec-discipline")]
         assert bad == []
 
 
@@ -1217,7 +1283,8 @@ class TestInterprocLiveGate:
         # shipped tree — not zero-new, zero-total (nothing baselined,
         # nothing suppressed away silently)
         for rule in ("async-blocking-transitive", "deadlock-cycle",
-                     "wal-fencing", "route-contract"):
+                     "wal-fencing", "route-contract",
+                     "tp-spec-discipline"):
             assert report.rule_counts.get(rule, {}).get("found", 0) \
                 == 0, rule
 

@@ -275,6 +275,148 @@ class TestBucketExactness:
         assert trace_mod.GLOBAL_RETRACES.since(mark)["traces"] == 0
 
 
+class TestBucketTensorParallel:
+    """ISSUE 16 composition: with a 2-D data×tensor mesh live, the
+    bucket's persistent padded batch is 2-D-sharded (rows over ``data``,
+    UNet internals over ``tensor``) and every ISSUE-12 invariant must
+    survive: per-image bit-exactness vs the serial run, the canonical
+    per-pad buffer layout, and zero steady-state retraces."""
+
+    @pytest.fixture()
+    def tp_mesh(self, monkeypatch):
+        import jax
+        from comfyui_distributed_tpu.parallel import mesh as mesh_mod
+        monkeypatch.setenv("DTPU_TP_MIN_SHARD_ELEMENTS", "2")
+        registry.clear_pipeline_cache()
+        mesh = mesh_mod.build_mesh(
+            axes={C.DATA_AXIS: 2, C.TENSOR_AXIS: 2, C.SEQ_AXIS: 1},
+            devices=jax.devices()[:4])
+        mesh_mod.set_runtime(mesh_mod.MeshRuntime(mesh=mesh))
+        yield mesh
+        mesh_mod.set_runtime(None)
+        registry.clear_pipeline_cache()
+
+    def _drain(self, bkt, done, rounds=12):
+        for _ in range(rounds):
+            bkt.step_once()
+            for its, rows, _t in bkt.take_finished():
+                arr = np.asarray(rows)
+                for j, it in enumerate(its):
+                    done[it["id"]] = arr[j * bkt.b:(j + 1) * bkt.b]
+            if not bkt.n_active:
+                return done
+        raise AssertionError("bucket never drained")
+
+    def test_late_join_bit_identical_to_solo_under_tp(self, tp_mesh,
+                                                      monkeypatch):
+        """CB per-image bit-exactness on the 2-D-sharded bucket: a slot's
+        math depends only on its own (seed, fold-idx) and schedule
+        position, never on co-tenants — a row that late-joins a running
+        batch is BIT-identical to the same prompt run solo through the
+        same sharded step kernel.  The pad set is pinned to one size
+        because XLA CPU's SPMD matmuls are not row-wise bit-stable
+        ACROSS batch sizes (a B=2 and a B=4 lowering round differently
+        at ~1e-6) — within one padded shape, rows are bit-independent;
+        vs the full-loop serial graph the match is tolerance-tight, not
+        bitwise (asserted separately)."""
+        monkeypatch.setenv(C.CB_PAD_BUCKETS_ENV, "2")
+        p1 = make_prompt(11, steps=3, sampler="euler_ancestral")
+        p2 = make_prompt(22, steps=3, sampler="euler_ancestral")
+        sig = sched.coalesce_signature(p1)
+        serial = {}
+        for s, p in ((11, p1), (22, p2)):
+            res = WorkflowExecutor(OpContext()).execute(p)
+            serial[s] = np.asarray(res.outputs["8"][0]["samples"].data)
+        pipe = registry.load_pipeline("tiny.safetensors")
+        assert pipe._tp_mesh is tp_mesh     # serving layout engaged
+        # solo reference: each prompt alone in its own bucket (padded
+        # to the same rows=2 shape the shared run uses)
+        solo = {}
+        for pid, p in (("a", p1), ("b", p2)):
+            it = {"id": pid, "prompt": p, "sig": sig, "cb": True}
+            bkt = cb_mod._Bucket(sig, it, OpContext(), max_slots=2)
+            assert bkt.pads == [2] and bkt._tp_mesh is tp_mesh
+            bkt.admit(it)
+            self._drain(bkt, solo)
+        # shared run: a is mid-flight when b joins at a step boundary
+        i1 = {"id": "a2", "prompt": p1, "sig": sig, "cb": True}
+        i2 = {"id": "b2", "prompt": p2, "sig": sig, "cb": True}
+        bkt = cb_mod._Bucket(sig, i1, OpContext(), max_slots=2)
+        bkt.admit(i1)
+        bkt.step_once()
+        bkt.admit(i2)
+        done = {}
+        self._drain(bkt, done)
+        assert (done["a2"] == solo["a"]).all()
+        assert (done["b2"] == solo["b"]).all()
+        # and the sharded CB rows track the (differently-lowered)
+        # serial full-loop graph tightly
+        assert np.allclose(done["a2"], serial[11], atol=5e-4)
+        assert np.allclose(done["b2"], serial[22], atol=5e-4)
+
+    def test_bucket_buffers_carry_canonical_rows_layout(self, tp_mesh):
+        """Every rows-leading persistent buffer sits on ONE layout per
+        pad: rows over ``data`` when divisible, replicated otherwise —
+        the invariant that keeps the donated step executable from
+        re-lowering (parallel/sharding.put_rows)."""
+        from comfyui_distributed_tpu.parallel import sharding as shd
+        p = make_prompt(7, steps=2)
+        sig = sched.coalesce_signature(p)
+        it0 = {"id": "r0", "prompt": p, "sig": sig, "cb": True}
+        bkt = cb_mod._Bucket(sig, it0, OpContext(), max_slots=4)
+        bkt.admit(it0)
+        # pad 1: one row cannot split over data=2 -> replicated
+        assert bkt.pad == 1
+        assert shd.spec_of(bkt.x) == shd.mesh_spec()
+        assert shd.spec_of(bkt.keys) == shd.mesh_spec()
+        bkt.admit({"id": "r1", "prompt": make_prompt(8, steps=2),
+                   "sig": sig, "cb": True})
+        # pad 2: rows ride the data axis
+        assert bkt.pad == 2
+        assert shd.spec_of(bkt.x) == shd.batch_axis_spec(bkt.x.ndim)
+        assert shd.spec_of(bkt.keys) == \
+            shd.batch_axis_spec(bkt.keys.ndim)
+        bkt.step_once()
+        # the donated step hands back the SAME canonical layout
+        assert bkt.x.sharding.is_equivalent_to(
+            shd.named(tp_mesh, shd.batch_axis_spec(bkt.x.ndim)),
+            bkt.x.ndim)
+
+    def test_zero_steady_state_retraces_under_tp(self, tp_mesh):
+        """Warm pads stay warm on the 2-D mesh: admit/retire churn after
+        one pass over each pad size must not retrace — the sharded
+        buffers are re-pinned to the canonical layout after every
+        write/repad, so each executable only ever sees one input
+        sharding."""
+        from comfyui_distributed_tpu.utils import trace as trace_mod
+        p = make_prompt(5, steps=2)
+        sig = sched.coalesce_signature(p)
+        it0 = {"id": "w", "prompt": p, "sig": sig, "cb": True}
+        bkt = cb_mod._Bucket(sig, it0, OpContext(), max_slots=2)
+        bkt.admit(it0)
+        while bkt.n_active:
+            bkt.step_once()
+            bkt.take_finished()
+        bkt.admit({"id": "w1", "prompt": make_prompt(4, steps=2),
+                   "sig": sig, "cb": True})
+        bkt.admit({"id": "w2", "prompt": make_prompt(6, steps=2),
+                   "sig": sig, "cb": True})
+        while bkt.n_active:
+            bkt.step_once()
+            bkt.take_finished()
+        mark = trace_mod.GLOBAL_RETRACES.mark()
+        for i in range(3):
+            bkt.admit({"id": f"s{i}", "prompt":
+                       make_prompt(100 + i, steps=2), "sig": sig,
+                       "cb": True})
+            bkt.step_once()
+            bkt.take_finished()
+        while bkt.n_active:
+            bkt.step_once()
+            bkt.take_finished()
+        assert trace_mod.GLOBAL_RETRACES.since(mark)["traces"] == 0
+
+
 class TestServerContinuousBatching:
     def test_interleaved_signatures_all_complete_and_merge(self,
                                                            tmp_path):

@@ -166,3 +166,35 @@ class TestSuiteMode:
         assert r.returncode == 0, (r.returncode, r.stderr[-500:])
         last = json.loads(r.stdout.strip().splitlines()[-1])
         assert last["value"] == 2.1 and "terminated" in last
+
+
+class TestTpServePhaseSurface:
+    """ISSUE 16: the tp_serve phase's CLI/metric/watchdog surface.
+    The harness itself (mesh build + sharded compiles) runs in
+    tests/test_batching.py and the bench subprocess; here we pin the
+    cheap contract: the phase parses, names its metric, and its
+    exactness bar tolerates zero regression."""
+
+    def _bench(self):
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        import bench
+        return bench
+
+    def test_phase_parses_and_names_metric(self):
+        bench = self._bench()
+        args = bench.parse_args(["--phase", "tp_serve"])
+        assert args.phase == "tp_serve"
+        assert bench.metric_name(args) == "tp_serve_bit_exact_fraction"
+        assert bench.metric_unit(args) == "fraction"
+
+    def test_exactness_bar_tolerates_nothing(self):
+        bench = self._bench()
+        assert bench.CHECK_TOLERANCE_PCT[
+            "tp_serve_bit_exact_fraction"] == 0.0
+        fresh = {"metric": "tp_serve_bit_exact_fraction",
+                 "value": 0.5, "unit": "fraction"}
+        base = {"metric": "tp_serve_bit_exact_fraction",
+                "value": 1.0, "unit": "fraction"}
+        assert bench.check_regression(fresh, base)["regressed"]
+        assert not bench.check_regression(base, dict(base))["regressed"]
