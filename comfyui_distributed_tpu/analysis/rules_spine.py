@@ -241,3 +241,86 @@ def check_tp_spec_discipline(project: Project) -> List[Violation]:
                     f"constrain*) instead",
                     scope=scope_qualname(stack)))
     return out
+
+
+# --- CB slot-state discipline (ISSUE 17) -------------------------------------
+#
+# The continuous-batching exactness proof rests on one invariant: a
+# slot's iteration state (``_Slot``) and a parked row's host truth
+# (``_ParkedRow``) are "the whole truth" — and they are mutated ONLY by
+# the admit/step/park/resume API in ``workflow/batch_executor.py``.  A
+# direct field write anywhere else forks that truth (a ``.step`` nudged
+# off-boundary desyncs the sigma schedule from the latent; an ``.item``
+# swap orphans the finalize path; a stale ``.t_admit`` corrupts latency
+# accounting across a park/resume cycle), so it is a bug-class finding:
+# never baselined (test-enforced), fix by going through the API.  The
+# protected field set is read from batch_executor.py's own
+# ``__slots__`` declarations, so the rule tracks the record layout
+# without hand-sync.
+
+_SLOT_STATE = "cb-slot-state-discipline"
+_CB_HOME = "comfyui_distributed_tpu/workflow/batch_executor.py"
+_SLOT_CLASSES = ("_Slot", "_ParkedRow")
+# fallback when the home file is absent from the project (fixture
+# lints): the fields both record classes have always carried
+_SLOT_FIELDS_FALLBACK = frozenset({"item", "step", "t_admit"})
+
+
+def _slot_state_fields(project: Project) -> frozenset:
+    home = next((sf for sf in project.python_files()
+                 if sf.path == _CB_HOME), None)
+    fields: set = set()
+    if home is not None and home.tree is not None:
+        for node in ast.walk(home.tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name in _SLOT_CLASSES):
+                continue
+            for st in node.body:
+                if not isinstance(st, ast.Assign):
+                    continue
+                if not any(isinstance(t, ast.Name)
+                           and t.id == "__slots__"
+                           for t in st.targets):
+                    continue
+                if isinstance(st.value, (ast.Tuple, ast.List)):
+                    for el in st.value.elts:
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, str):
+                            fields.add(el.value)
+    return frozenset(fields) if fields else _SLOT_FIELDS_FALLBACK
+
+
+@rule(_SLOT_STATE)
+def check_cb_slot_state_discipline(project: Project) -> List[Violation]:
+    fields = _slot_state_fields(project)
+    out: List[Violation] = []
+    for sf in project.python_files():
+        if sf.path == _CB_HOME:
+            continue
+        for node, stack in iter_scoped(sf.tree):
+            if isinstance(node, ast.Assign):
+                targets: List[ast.expr] = []
+                for t in node.targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        targets.extend(t.elts)
+                    else:
+                        targets.append(t)
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            elif isinstance(node, ast.AnnAssign) \
+                    and node.value is not None:
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr in fields:
+                    out.append(Violation(
+                        _SLOT_STATE, sf.path, node.lineno,
+                        f"direct write to CB slot-state field "
+                        f"`.{t.attr}` outside workflow/"
+                        f"batch_executor.py — slot/parked-row state is "
+                        f"the exactness proof's whole truth and is "
+                        f"mutated only through the admit/step/park/"
+                        f"resume API",
+                        scope=scope_qualname(stack)))
+    return out

@@ -101,13 +101,19 @@ class FleetAutoscaler:
                  interval_s: Optional[float] = None,
                  drain_s: Optional[float] = None,
                  flap_window_s: Optional[float] = None,
-                 shard: Optional[Any] = None):
+                 shard: Optional[Any] = None,
+                 parked_backlog_fn: Optional[Callable[[], int]] = None):
         self.registry = registry
         self.queue_depth_fn = queue_depth_fn
         self.util_fn = util_fn
         self.spawner = spawner
         self.retirer = retirer
         self.worker_queue_fn = worker_queue_fn
+        # latent paging (ISSUE 17): parked continuous-batching rows are
+        # ADMITTED work the fleet has not finished — invisible to the
+        # queue-depth probe (they left the queue at admission) but real
+        # backlog, so they fold into the scale-up signal
+        self.parked_backlog_fn = parked_backlog_fn
         # multi-master federation (ISSUE 14): the ShardManager (or None)
         # — its gossiped peer queue depths fold into the signal, so each
         # shard's reconciliation sees the MERGED fleet pressure instead
@@ -211,8 +217,17 @@ class FleetAutoscaler:
                 peer_masters = int(self.shard.live_peer_masters())
             except Exception as e:  # noqa: BLE001 - signal survives
                 debug_log(f"autoscale: shard signal failed: {e}")
+        # parked backlog (ISSUE 17): rows paged out of their CB slot
+        # wait on RESIDENCY, not on a queue — scale-up pressure all the
+        # same (an extra participant is exactly what would let them run)
+        parked = 0
+        if self.parked_backlog_fn is not None:
+            try:
+                parked = int(self.parked_backlog_fn() or 0)
+            except Exception as e:  # noqa: BLE001 - signal survives
+                debug_log(f"autoscale: parked probe failed: {e}")
         participants = 1 + live + peer_masters   # masters serve too
-        depth = master_q + worker_q + peer_q
+        depth = master_q + worker_q + peer_q + parked
         out = {
             "queue_depth": depth,
             "queue_per_participant": depth / participants,
@@ -220,6 +235,8 @@ class FleetAutoscaler:
             "live_workers": live,
             "participants": participants,
         }
+        if parked:
+            out["parked_backlog"] = parked
         if self.shard is not None:
             out["peer_masters"] = peer_masters
             out["peer_queue_depth"] = peer_q
@@ -526,6 +543,7 @@ def install(state) -> Optional[FleetAutoscaler]:
         u = snap.get("utilization")
         return float(u) if isinstance(u, (int, float)) else None
 
+    cb = getattr(state, "cb", None)
     scaler = FleetAutoscaler(
         registry=state.cluster,
         queue_depth_fn=state.queue_remaining,
@@ -533,6 +551,7 @@ def install(state) -> Optional[FleetAutoscaler]:
         spawner=default_spawner(state),
         retirer=default_retirer(state),
         shard=getattr(state, "shard", None),
+        parked_backlog_fn=cb.parked_count if cb is not None else None,
     )
     scaler.start()
     return scaler

@@ -12,7 +12,8 @@ owned by the server app directly.
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Dict, Optional, Set
+import threading
+from typing import Any, Callable, Dict, List, Optional, Set
 
 from comfyui_distributed_tpu.utils import trace as trace_mod
 
@@ -229,3 +230,111 @@ class JobStore:
             "image_jobs": sorted(self._jobs),
             "tile_jobs": sorted(self._tile_jobs),
         }
+
+
+class ParkedStore:
+    """Host-side registry of PARKED continuous-batching rows (ISSUE 17).
+
+    A parked record is a started job whose device slot was handed to a
+    higher-class prompt: the latent row, per-row PRNG key, sigma index and
+    admit timestamp pulled to host — the *whole* slot truth, so a later
+    RESUME is bit-identical.  The store is the "beyond-HBM" working set:
+    ``DTPU_CB_SLOTS`` stays the physical cap while admission capacity
+    becomes ``slots + room()``.
+
+    Records are opaque to this store except for the fields the residency
+    scheduler orders by: ``.pid`` (double-park guard / client-gone lookup),
+    ``.sig`` (bucket signature — resume must land in a same-shape bucket),
+    ``.rank`` (tenant-class rank: resume highest class first) and
+    ``.t_park`` (FIFO within a class).  Mutating slot-state fields is the
+    park/resume API's job alone (dtpu-lint ``cb-slot-state-discipline``).
+
+    Own ``threading.Lock`` (NOT the driver's implicit single-thread
+    ownership): the driver thread parks/resumes, but the HTTP metrics
+    thread reads ``count()`` and the autoscaler samples the backlog.
+    """
+
+    def __init__(self, capacity: int) -> None:
+        self._capacity = max(0, int(capacity))
+        self._rows: List[Any] = []            # guarded-by: self._lock
+        self._pids: Set[str] = set()          # guarded-by: self._lock
+        self._lock = threading.Lock()
+
+    # --- write side (driver thread) ----------------------------------------
+
+    def park(self, records: List[Any]) -> None:
+        """Register freshly-parked rows.  Raises ``ValueError`` on a
+        double-park (a pid already resident — slot state would fork) or
+        when the batch would exceed ``DTPU_CB_PARK_MAX`` (callers must
+        check :meth:`room` first; the raise is the invariant's backstop,
+        not a control-flow path)."""
+        with self._lock:
+            if len(self._rows) + len(records) > self._capacity:
+                raise ValueError(
+                    f"parked-store overflow: {len(self._rows)} resident + "
+                    f"{len(records)} new > capacity {self._capacity}")
+            for rec in records:
+                pid = str(rec.pid)
+                if pid in self._pids:
+                    raise ValueError(f"double-park of prompt {pid}")
+            for rec in records:
+                self._pids.add(str(rec.pid))
+                self._rows.append(rec)
+
+    def pop_for(self, sig: Any, k: int) -> List[Any]:
+        """Up to ``k`` records with bucket signature ``sig``, best-first:
+        highest tenant-class rank, then earliest park time (FIFO) — the
+        starved row a class has waited longest on resumes first."""
+        if k <= 0:
+            return []
+        with self._lock:
+            cands = [r for r in self._rows if r.sig == sig]
+            cands.sort(key=lambda r: (-int(r.rank), float(r.t_park)))
+            picked = cands[:k]
+            for rec in picked:
+                self._rows.remove(rec)
+                self._pids.discard(str(rec.pid))
+            return picked
+
+    def pop_abandoned(self, is_abandoned: Callable[[str], bool]) -> List[Any]:
+        """Remove and return records whose owning client is gone (the
+        PR 13 client-gone signal): a parked row for a disconnected client
+        is freed, never resumed."""
+        with self._lock:
+            gone = [r for r in self._rows if is_abandoned(str(r.pid))]
+            for rec in gone:
+                self._rows.remove(rec)
+                self._pids.discard(str(rec.pid))
+            return gone
+
+    def drain_all(self) -> List[Any]:
+        """Remove and return everything (abort/shutdown path)."""
+        with self._lock:
+            rows, self._rows = self._rows, []
+            self._pids.clear()
+            return rows
+
+    # --- read side (any thread) --------------------------------------------
+
+    def has(self, pid: str) -> bool:
+        with self._lock:
+            return str(pid) in self._pids
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def room(self) -> int:
+        with self._lock:
+            return max(0, self._capacity - len(self._rows))
+
+    def sigs(self) -> List[Any]:
+        """Distinct signatures of resident rows, resume-priority order."""
+        with self._lock:
+            ordered = sorted(self._rows,
+                             key=lambda r: (-int(r.rank), float(r.t_park)))
+            out: List[Any] = []
+            for r in ordered:
+                if r.sig not in out:
+                    out.append(r.sig)
+            return out

@@ -170,6 +170,24 @@ class WorkerProcessManager:
                 if tp_axes is not None:
                     log(f"worker {wid}: serve-path mesh layout "
                         f"{tp_axes} (inherited)")
+            # continuous-batching knobs (ISSUE 17, same fail-fast
+            # pattern): a malformed DTPU_CB_SLOTS / DTPU_CB_PARK* value
+            # dies at THIS launch with the knob named, instead of
+            # poisoning the spawned worker's driver thread at its first
+            # admission
+            if env.get(C.CB_ENV) or env.get(C.CB_PARK_ENV) \
+                    or env.get(C.CB_SLOTS_ENV) \
+                    or env.get(C.CB_PARK_MAX_ENV) \
+                    or env.get(C.CB_PARK_HBM_FRACTION_ENV):
+                from comfyui_distributed_tpu.workflow.batch_executor \
+                    import validate_cb_env
+                validate_cb_env(env)
+                if env.get(C.CB_PARK_ENV):
+                    log(f"worker {wid}: continuous batching with "
+                        f"latent paging "
+                        f"({C.CB_PARK_ENV}={env[C.CB_PARK_ENV]}, "
+                        f"max parked="
+                        f"{env.get(C.CB_PARK_MAX_ENV) or C.CB_PARK_MAX_DEFAULT})")
             cmd = self.build_launch_command(worker)
             if stop_on_master_exit:
                 # wrap with the master-death monitor (reference

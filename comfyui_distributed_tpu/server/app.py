@@ -1296,6 +1296,21 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                  "steady state).",
                  [({"bucket": b["sig"]}, b["retraces"])
                   for b in bsnap["buckets"]]),
+                # latent paging + SLO preemption (ISSUE 17)
+                ("dtpu_cb_parked", "gauge",
+                 "Continuous-batching rows parked to host (started "
+                 "jobs waiting on slot residency).",
+                 [({}, bsnap["parked"])]),
+                ("dtpu_cb_parks_total", "counter",
+                 "Slots parked to host at a step boundary.",
+                 [({}, bsnap["parks"])]),
+                ("dtpu_cb_resumes_total", "counter",
+                 "Parked rows resumed into a slot.",
+                 [({}, bsnap["resumes"])]),
+                ("dtpu_cb_preemptions_total", "counter",
+                 "Parks forced by a higher-class admit (SLO "
+                 "preemption; subset of parks).",
+                 [({}, bsnap["preemptions"])]),
             ])
         # cross-request reuse + preview channel (ISSUE 13): per-tier
         # cache counters and byte gauges, tile-skip and abandonment

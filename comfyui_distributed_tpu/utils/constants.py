@@ -139,6 +139,31 @@ CB_ADMIT_WINDOW_DEFAULT = 0.0
 # entry is stateless across steps (no multistep history carry), so a
 # slot's step N is a pure function of (x, sigma_N, sigma_N+1, keys)
 CB_SAFE_SAMPLERS = frozenset({"euler", "ddim", "euler_ancestral"})
+# --- latent paging + SLO-aware preemption (ISSUE 17) -------------------------
+# The vLLM/PagedAttention lesson around the UNCHANGED step kernel: a CB
+# slot's full truth is tiny and explicit (latent row, sigma index,
+# remaining steps, per-row PRNG key), so a batch/free-tier slot can be
+# PARKED to host at a step boundary — freeing HBM-backed slot capacity
+# for a paid burst — and RESUMED later bit-identically.  The admissible
+# working set (started jobs) may then exceed physical slots; a per-step
+# residency scheduler decides which rows occupy slots, ordered by the
+# PR 9 tenant classes.  Off by default; requires DTPU_CB=1 too.
+CB_PARK_ENV = "DTPU_CB_PARK"             # "1" arms paging/preemption
+# bound on host-parked rows across all buckets (each is one latent +
+# key row set — small, but the registry must not grow without limit)
+CB_PARK_MAX_ENV = "DTPU_CB_PARK_MAX"
+CB_PARK_MAX_DEFAULT = 64
+# device-memory residency bar (PR 5 telemetry): parked rows resume only
+# while bytes_in_use/bytes_limit stays BELOW this fraction, and slots
+# page OUT (lowest class first) while above it.  Unknown limits (CPU,
+# host_rss fallback) read as headroom — the gate is a TPU-HBM guard,
+# not a host-memory one.
+CB_PARK_HBM_FRACTION_ENV = "DTPU_CB_PARK_HBM_FRACTION"
+CB_PARK_HBM_FRACTION_DEFAULT = 0.9
+# preempt order over TENANT_CLASSES: leftmost pages out first, and a
+# class may only preempt classes listed BEFORE its own position —
+# "batch < free < paid", with paid absent from the list: never paged.
+CB_PREEMPT_ORDER = ("batch", "free")
 
 # --- cross-request compute reuse (runtime/reuse.py) ---------------------------
 # Three content-addressed cache tiers + the SSE preview/cancellation
@@ -492,6 +517,9 @@ TRACE_ATTR_WHITELIST = frozenset({
     "worker", "node", "target",
     # coalescing / continuous batching
     "coalesced", "coalesced_into", "bucket", "slot",
+    # latent paging + SLO-aware preemption (ISSUE 17): the sigma index a
+    # row parked/resumed at, and what displaced it
+    "step", "preempted_by",
     # recovery / hedging
     "lost", "to", "units", "tile_idx", "n_workers",
     # resource attribution (ISSUE 5)

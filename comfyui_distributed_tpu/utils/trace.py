@@ -253,10 +253,43 @@ class CounterStats:
 GLOBAL_COUNTERS = CounterStats()
 
 
+class GaugeStats:
+    """Named level gauges (thread-safe) — current-state values the
+    counters can't express (a monotonic bump has no "now there are N"):
+    parked continuous-batching rows, residency occupancy, ...  Setters
+    publish, the metrics surfaces read."""
+
+    def __init__(self) -> None:
+        self._values: Dict[str, float] = {}  # guarded-by: self._lock
+        self._lock = threading.Lock()
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._values[name] = float(value)
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return float(self._values.get(name, default))
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+
+# cb_parked (latent paging, ISSUE 17) ... — level views next to the
+# monotonic counters on the same metrics surfaces
+GLOBAL_GAUGES = GaugeStats()
+
+
 def pipeline_snapshot() -> Dict[str, Any]:
     """The serving-pipeline block of /distributed/metrics."""
     return {"stages": GLOBAL_STAGES.snapshot(),
-            "counters": GLOBAL_COUNTERS.snapshot()}
+            "counters": GLOBAL_COUNTERS.snapshot(),
+            "gauges": GLOBAL_GAUGES.snapshot()}
 
 
 # --- device/XLA tracing ------------------------------------------------------

@@ -75,6 +75,84 @@ class CBIneligible(Exception):
     blacklists the signature and routes the group to the fallback."""
 
 
+def _class_rank(cls: str) -> int:
+    """Preemption rank (ISSUE 17): position in ``CB_PREEMPT_ORDER`` is
+    the rank — batch (0) parks before free (1) — and any class OUTSIDE
+    the order (paid, custom tenants) ranks above every preemptible
+    class, so a paid row is never parked."""
+    try:
+        return C.CB_PREEMPT_ORDER.index(str(cls))
+    except ValueError:
+        return len(C.CB_PREEMPT_ORDER)
+
+
+def validate_cb_env(env: Dict[str, str]) -> None:
+    """Fail-fast validation of the continuous-batching knobs at worker
+    launch (the PR 16 ``DTPU_TP``/``DTPU_MESH_SHAPE`` pattern in
+    runtime/manager.py): a malformed value dies HERE with a clear
+    error naming the knob, instead of deep inside the driver thread's
+    first admission where it would surface as a poisoned bucket."""
+
+    def _int_knob(name: str, lo: int, what: str) -> None:
+        raw = env.get(name)
+        if raw in (None, ""):
+            return
+        try:
+            v = int(str(raw).strip())
+        except ValueError:
+            raise ValueError(
+                f"{name}={raw!r}: not an integer ({what})") from None
+        if v < lo:
+            raise ValueError(f"{name}={raw!r}: must be >= {lo} ({what})")
+
+    _int_knob(C.CB_SLOTS_ENV, 1, "slots per bucket")
+    _int_knob(C.CB_PARK_MAX_ENV, 0, "max parked rows; 0 disables "
+              "preemption while leaving DTPU_CB_PARK armed")
+    raw = env.get(C.CB_PARK_ENV)
+    if raw not in (None, "") and str(raw).strip().lower() not in (
+            "0", "1", "true", "false", "yes", "no", "on", "off"):
+        raise ValueError(f"{C.CB_PARK_ENV}={raw!r}: expected a boolean "
+                         "('1'/'0')")
+    raw = env.get(C.CB_PARK_HBM_FRACTION_ENV)
+    if raw not in (None, ""):
+        try:
+            f = float(str(raw).strip())
+        except ValueError:
+            raise ValueError(
+                f"{C.CB_PARK_HBM_FRACTION_ENV}={raw!r}: not a float "
+                "(HBM residency gate)") from None
+        if not 0.0 < f <= 1.0:
+            raise ValueError(
+                f"{C.CB_PARK_HBM_FRACTION_ENV}={raw!r}: must be in "
+                "(0, 1] (fraction of the device memory limit)")
+
+
+class _ParkedRow:
+    """One PARKED slot's complete truth, pulled to host (produced by
+    the driver thread, held by ``runtime.jobs.ParkedStore``): the
+    latent rows mid-schedule, the sigma index to resume at, and the
+    ORIGINAL admit timestamp so latency accounting spans the parked
+    gap.  PRNG keys are NOT stored — they are a pure function of
+    ``(seed, row-index)`` (``samplers.sample_keys``) and are recomputed
+    bit-identically at resume, so parking round-trips one f32 buffer,
+    not two."""
+
+    __slots__ = ("pid", "item", "sig", "rank", "step", "t_admit",
+                 "t_park", "x_rows")
+
+    def __init__(self, item: Dict[str, Any], sig: str, rank: int,
+                 step: int, t_admit: float, x_rows: np.ndarray,
+                 t_park: float):
+        self.pid = str(item["id"])
+        self.item = item
+        self.sig = sig
+        self.rank = int(rank)
+        self.step = int(step)
+        self.t_admit = float(t_admit)
+        self.t_park = float(t_park)
+        self.x_rows = x_rows
+
+
 def quick_eligible(prompt: Dict[str, Any]) -> bool:
     """Cheap enqueue-time screen for step-batchability, layered ON TOP
     of a non-None coalescing signature (which already guarantees the
@@ -536,6 +614,78 @@ class _Bucket:
         self._repad(keep)
         return items
 
+    def park_slots(self, park: List[int]) -> List[tuple]:
+        """PARK: slice out ``park``'s slots at a step boundary with
+        their latent rows pulled to HOST — the latent-paging exit
+        (ISSUE 17).  Returns ``[(item, step, t_admit, x_rows), ...]``
+        with ``x_rows`` a host f32 copy of the slot's ``b`` rows (a
+        sharded 2-D mesh buffer gathers cleanly; ``resume_parked``'s
+        ``_pin`` restores the canonical layout).  Duplicate or
+        out-of-range indices raise — a double-park would fork one
+        slot's truth into two records.  Device work is ONE gather (the
+        same ``(pad*b -> k*b)`` shape pair a retire cohort uses) plus
+        the compaction repad — no executables outside the warmed set."""
+        jnp = self._jnp
+        if len(set(park)) != len(park):
+            raise ValueError(f"double-park of slot(s) {sorted(park)}")
+        for i in park:
+            if not 0 <= i < len(self.slots):
+                raise ValueError(f"park of unknown slot {i} "
+                                 f"({len(self.slots)} active)")
+        order = sorted(park)
+        perm = np.concatenate(
+            [np.arange(i * self.b, (i + 1) * self.b, dtype=np.int32)
+             for i in order])
+        rows = np.asarray(self._permute(self.x, jnp.asarray(perm)))
+        out = []
+        for n, i in enumerate(order):
+            s = self.slots[i]
+            out.append((s.item, s.step, s.t_admit,
+                        rows[n * self.b:(n + 1) * self.b]))
+        doomed = set(order)
+        keep = [i for i in range(len(self.slots)) if i not in doomed]
+        self.slots = [self.slots[i] for i in keep]
+        self._repad(keep)
+        return out
+
+    def resume_parked(self, recs: List[Any]) -> int:
+        """RESUME: the exact inverse of :meth:`park_slots`, at a later
+        step boundary.  Latent rows are written back from the host
+        copies and the per-row PRNG keys are REBUILT from each prompt's
+        seed — the same ``sample_keys(repeat(seed), arange(b))``
+        expression admission used, so the resumed slot's remaining
+        steps consume exactly the key stream its serial run would.
+        Bit-exactness is an identity argument (f32 host round trip +
+        deterministic key derivation), not a tolerance.  Device work is
+        the admit path's ``(k*b)`` write pair — no new executables —
+        and ``_pin`` restores the canonical 2-D mesh layout.  Returns
+        the first slot index."""
+        from comfyui_distributed_tpu.models import samplers as smp
+        jnp = self._jnp
+        k = len(recs)
+        n = self.n_active
+        if n + k > self.capacity:
+            raise RuntimeError("bucket full (driver resumed past room)")
+        if n + k > self.pad:
+            self._repad(list(range(n)), target=n + k)
+        x_rows = jnp.asarray(np.concatenate(
+            [np.asarray(r.x_rows, np.float32) for r in recs]))
+        seeds = np.repeat(np.asarray(
+            [int(r.item["prompt"][self.ks_node]["inputs"].get("seed", 0))
+             for r in recs], np.uint64), self.b)
+        idx = np.tile(np.arange(self.b, dtype=np.uint32), k)
+        keys_rows = smp.sample_keys(seeds, idx)
+        start = jnp.asarray(n * self.b, jnp.int32)
+        self.x = self._pin(self._write(self.x, x_rows, start))
+        self.keys = self._pin(
+            self._write(self.keys, jnp.asarray(keys_rows), start))
+        for r in recs:
+            slot = _Slot(r.item, r.t_admit)
+            slot.step = int(r.step)
+            self.slots.append(slot)
+        self.last_active = time.monotonic()
+        return n
+
     def abort_all(self) -> List[Dict[str, Any]]:
         items = [s.item for s in self.slots]
         self.slots = []
@@ -559,6 +709,27 @@ class ContinuousBatchExecutor:
                 C.CB_ADMIT_WINDOW_ENV, C.CB_ADMIT_WINDOW_DEFAULT)))
         except ValueError:
             self.admit_window = C.CB_ADMIT_WINDOW_DEFAULT
+        # latent paging + SLO-aware preemption (ISSUE 17): DTPU_CB_PARK=1
+        # arms the park/resume plane; the ParkedStore is the beyond-HBM
+        # working set (capacity 0 when disarmed keeps every park path
+        # structurally unreachable — ParkedStore.room() == 0)
+        self.park_enabled = str(os.environ.get(
+            C.CB_PARK_ENV, "0")).strip().lower() in ("1", "true",
+                                                     "yes", "on")
+        try:
+            park_max = max(0, int(os.environ.get(
+                C.CB_PARK_MAX_ENV, C.CB_PARK_MAX_DEFAULT)))
+        except ValueError:
+            park_max = C.CB_PARK_MAX_DEFAULT
+        try:
+            self.park_hbm_fraction = float(os.environ.get(
+                C.CB_PARK_HBM_FRACTION_ENV,
+                C.CB_PARK_HBM_FRACTION_DEFAULT))
+        except ValueError:
+            self.park_hbm_fraction = C.CB_PARK_HBM_FRACTION_DEFAULT
+        from comfyui_distributed_tpu.runtime.jobs import ParkedStore
+        self.parked = ParkedStore(park_max if self.park_enabled else 0)
+        self._mem_probe = None    # test seam; None -> PR 5 telemetry
         self._buckets: "Dict[str, _Bucket]" = {}   # driver thread only
         self._bad_sigs: set = set()                # driver thread only
         self._rr: int = 0                          # round-robin cursor
@@ -570,7 +741,9 @@ class ContinuousBatchExecutor:
         self._stats = {"admits": 0, "retires": 0, "steps": 0,
                        "fallbacks": 0, "retraces": 0,
                        "pad_transitions": 0,
-                       "abandoned": 0}             # guarded-by: self._lock
+                       "abandoned": 0,
+                       "parks": 0, "resumes": 0,
+                       "preemptions": 0}           # guarded-by: self._lock
         self._bucket_stats: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
         self._active = 0                           # guarded-by: self._lock
         self._tailing = 0                          # guarded-by: self._lock
@@ -589,14 +762,25 @@ class ContinuousBatchExecutor:
     # -- cross-thread views ---------------------------------------------------
 
     def active_prompts(self) -> int:
+        # parked rows are deliberately NOT counted here: queue_remaining
+        # feeds the autoscaler's queue_depth_fn, and the parked backlog
+        # folds into that signal ONCE through parked_backlog_fn (its own
+        # attributed term) — counting it here too would double it.  The
+        # parked store has its own admission cap (DTPU_CB_PARK_MAX), and
+        # drain correctness rides on idle(), which does count parked.
         with self._lock:
             return self._active + self._tailing
+
+    def parked_count(self) -> int:
+        """Parked-backlog level for the autoscaler and metrics (any
+        thread; ParkedStore is self-locked)."""
+        return self.parked.count()
 
     def idle(self) -> bool:
         with self._lock:
             busy = self._active or self._tailing or self._fallback_busy
         return not busy and self._fallback_q.empty() \
-            and self._tail_q.empty()
+            and self._tail_q.empty() and self.parked.count() == 0
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -612,6 +796,9 @@ class ContinuousBatchExecutor:
             "slots_active": active,
             "slots_free": max(slots_total - active, 0),
             "buckets": buckets,
+            "park_enabled": self.park_enabled,
+            "parked": self.parked.count(),
+            "park_room": self.parked.room(),
             **stats,
         }
 
@@ -635,17 +822,40 @@ class ContinuousBatchExecutor:
 
     # -- admission ------------------------------------------------------------
 
+    def _class_of(self, item: Dict[str, Any]) -> str:
+        return str(item.get("tenant")
+                   or self.state.admission.default_class)
+
+    def _preemptible(self, bkt: _Bucket, item: Dict[str, Any]) -> int:
+        """How many of ``bkt``'s slots a would-be admit of ``item`` may
+        PARK: slots whose tenant class ranks strictly below the
+        incoming class in the preempt order (batch < free < paid; a
+        paid-class row is never parked)."""
+        new_rank = _class_rank(self._class_of(item))
+        return sum(1 for s in bkt.slots
+                   if _class_rank(self._class_of(s.item)) < new_rank)
+
     def room_for(self, item: Dict[str, Any]) -> int:
         """scheduler.pop_cb_admit capacity oracle: >0 = admit that many
         now, -1 = batchable but full (defer; a slot exit will free
-        room), 0 = not batchable (legacy fallback)."""
+        room), 0 = not batchable (legacy fallback).  With latent paging
+        armed (DTPU_CB_PARK=1) a full bucket is no longer a hard -1: a
+        higher-class item may claim as many slots as the bucket holds
+        lower-class rows (bounded by parked-store room) — the actual
+        park happens in _admit_cb at the same boundary."""
         sig = item.get("sig")
         if not item.get("cb") or sig is None or sig in self._bad_sigs:
             return 0
         bkt = self._buckets.get(sig)
         if bkt is not None:
             free = bkt.capacity - bkt.n_active
-            return free if free > 0 else -1
+            if free > 0:
+                return free
+            if self.park_enabled:
+                k = min(self._preemptible(bkt, item), self.parked.room())
+                if k > 0:
+                    return k
+            return -1
         if len(self._buckets) < self.max_buckets:
             return self.max_slots
         # all bucket tables taken: an idle one can be evicted
@@ -654,8 +864,12 @@ class ContinuousBatchExecutor:
         return -1
 
     def _evict_idle_bucket(self) -> None:
+        # a bucket whose every row is PARKED is idle-by-count but not
+        # evictable: its captured conditioning is the only thing the
+        # parked rows can resume into
+        parked_sigs = set(self.parked.sigs())
         idle = [(b.last_active, sig) for sig, b in self._buckets.items()
-                if b.n_active == 0]
+                if b.n_active == 0 and sig not in parked_sigs]
         if idle:
             _, sig = min(idle)
             self._buckets.pop(sig, None)
@@ -744,6 +958,12 @@ class ContinuousBatchExecutor:
                 self._fallback_q.put(items)
                 return
             self._buckets[sig] = bkt
+        # SLO preemption (ISSUE 17): when the group was admitted INTO a
+        # full bucket (room_for counted preemptible lower-class rows),
+        # park the victims first so admit_many sees real free slots
+        need = bkt.n_active + len(items) - bkt.capacity
+        if need > 0 and self.park_enabled:
+            self._park_victims(bkt, need, items[0])
         now_wall = time.time()
         try:
             # whole group in one device round trip (one key build, one
@@ -758,6 +978,7 @@ class ContinuousBatchExecutor:
                 f"{type(e).__name__}: {e}")
             self._bad_sigs.add(sig)
             self._buckets.pop(sig, None)
+            self._fail_parked(sig, e)
             for slot in bkt.abort_all():
                 self.state._finalize_hand([slot], None, e,
                                           time.perf_counter())
@@ -781,6 +1002,207 @@ class ContinuousBatchExecutor:
             debug_log(f"cb: {item['id']} joined bucket {sig[:8]} "
                       f"slot {first_slot + off} "
                       f"({bkt.n_active}/{bkt.capacity})")
+
+    # -- latent paging: park / resume (driver thread only) --------------------
+
+    def _park_victims(self, bkt: _Bucket, need: int,
+                      incoming: Dict[str, Any]) -> None:
+        """SLO preemption: park up to ``need`` lowest-class slots to
+        free room for ``incoming``.  Victim order is lowest rank first,
+        then YOUNGEST admit first within a rank — the oldest started
+        work keeps its slot and finishes, bounding batch-tier
+        completion delay instead of starving one unlucky prompt."""
+        new_rank = _class_rank(self._class_of(incoming))
+        cands = [(i, s) for i, s in enumerate(bkt.slots)
+                 if _class_rank(self._class_of(s.item)) < new_rank]
+        cands.sort(key=lambda t: (
+            _class_rank(self._class_of(t[1].item)), -t[1].t_admit))
+        victims = [i for i, _ in
+                   cands[:min(need, len(cands), self.parked.room())]]
+        if victims:
+            self._park_out(bkt, victims, preempted_by=incoming)
+
+    def _park_out(self, bkt: _Bucket, indices: List[int],
+                  preempted_by: Optional[Dict[str, Any]] = None) -> None:
+        """Pull ``indices``'s slots to host and register them with the
+        ParkedStore; emits cb_park spans and the parked gauge.  The
+        ONLY writer of parked records (with _resume_boundary as the
+        only reader) — slot-state mutation never leaves this file
+        (dtpu-lint cb-slot-state-discipline)."""
+        t_park = time.perf_counter()
+        now_wall = time.time()
+        recs = [
+            _ParkedRow(item, bkt.sig,
+                       _class_rank(self._class_of(item)),
+                       step, t_admit, x_rows, t_park)
+            for item, step, t_admit, x_rows in bkt.park_slots(indices)]
+        self.parked.park(recs)
+        trace_mod.GLOBAL_COUNTERS.bump("cb_parks", len(recs))
+        if preempted_by is not None:
+            trace_mod.GLOBAL_COUNTERS.bump("cb_preemptions", len(recs))
+        trace_mod.GLOBAL_GAUGES.set("cb_parked", self.parked.count())
+        with self._lock:
+            self._stats["parks"] += len(recs)
+            if preempted_by is not None:
+                self._stats["preemptions"] += len(recs)
+        for rec in recs:
+            if rec.item.get("span") is not None:
+                attrs = {"bucket": bkt.sig[:8], "step": rec.step,
+                         "tenant": self._class_of(rec.item)}
+                if preempted_by is not None:
+                    attrs["preempted_by"] = self._class_of(preempted_by)
+                trace_mod.event_span("cb_park", now_wall, now_wall,
+                                     parent=rec.item["span"],
+                                     attrs=attrs)
+            debug_log(f"cb: {rec.pid} parked from bucket {bkt.sig[:8]} "
+                      f"at step {rec.step} "
+                      f"({self.parked.count()} parked)")
+
+    def _mem_fraction(self) -> Optional[float]:
+        """PR 5 telemetry residency gate: fraction of the accelerator
+        memory limit in use, or None when the backend exposes no limit
+        (CPU) — in which case only slot pressure drives paging."""
+        probe = self._mem_probe
+        if probe is None:
+            from comfyui_distributed_tpu.utils import resource as res_mod
+            probe = res_mod.device_memory_snapshot
+        try:
+            snap = probe() or {}
+        except Exception:  # noqa: BLE001 - telemetry must not kill the driver
+            return None
+        limit = snap.get("bytes_limit")
+        if not limit:
+            return None
+        return float(snap.get("bytes_in_use", 0) or 0) / float(limit)
+
+    def _pressure_park(self) -> None:
+        """Residency under memory pressure: above the HBM fraction,
+        shed ONE lowest-class slot per boundary to host (the compaction
+        repad shrinks the live buffers along the pad set) — gradual on
+        purpose, so a transient allocation spike doesn't evict the
+        whole batch tier in a burst."""
+        if self.parked.room() <= 0:
+            return
+        frac = self._mem_fraction()
+        if frac is None or frac < self.park_hbm_fraction:
+            return
+        best = None   # ((rank, -t_admit), bucket, slot index)
+        for bkt in self._buckets.values():
+            for i, s in enumerate(bkt.slots):
+                r = _class_rank(self._class_of(s.item))
+                if r >= len(C.CB_PREEMPT_ORDER):
+                    continue
+                key = (r, -s.t_admit)
+                if best is None or key < best[0]:
+                    best = (key, bkt, i)
+        if best is not None:
+            self._park_out(best[1], [best[2]])
+            self._mirror_stats()
+
+    def _drop_abandoned_parked(self) -> None:
+        """PR 13 client-gone composed with paging: a parked row whose
+        client disconnected is FREED — finalized as abandoned — instead
+        of resumed (resuming it would spend denoise steps on an image
+        nobody can receive)."""
+        gone = self.parked.pop_abandoned(
+            reuse_mod.PREVIEWS.is_abandoned)
+        if not gone:
+            return
+        err = reuse_mod.AbandonedError(
+            "client disconnected while parked")
+        now_wall = time.time()
+        trace_mod.GLOBAL_COUNTERS.bump("cb_abandoned", len(gone))
+        trace_mod.GLOBAL_GAUGES.set("cb_parked", self.parked.count())
+        with self._lock:
+            self._stats["abandoned"] += len(gone)
+        for rec in gone:
+            if rec.item.get("span") is not None:
+                trace_mod.event_span("cb_exit", now_wall, now_wall,
+                                     parent=rec.item["span"],
+                                     attrs={"bucket": rec.sig[:8]})
+            debug_log(f"cb: parked {rec.pid} abandoned (client gone); "
+                      "row freed without resume")
+            self.state._finalize_hand([rec.item], None, err,
+                                      time.perf_counter())
+
+    def _fail_parked(self, sig: str, err: BaseException) -> None:
+        """A bucket died (poisoned step / failed admit) while rows of
+        its signature were parked: their captured conditioning died
+        with it, so the rows error-finalize instead of waiting on a
+        resume that can never come."""
+        recs = self.parked.pop_for(sig, self.parked.count())
+        if not recs:
+            return
+        trace_mod.GLOBAL_GAUGES.set("cb_parked", self.parked.count())
+        for rec in recs:
+            self.state._finalize_hand([rec.item], None, err,
+                                      time.perf_counter())
+
+    def _resume_boundary(self) -> bool:
+        """The residency scheduler's resume half, run every boundary:
+        refill free slots from the parked store — highest class first,
+        FIFO within a class — gated on PR 5 memory telemetry (no
+        resume while HBM use sits above DTPU_CB_PARK_HBM_FRACTION:
+        re-admitting rows under pressure would undo the shed).  Runs
+        AFTER queue admission, so stride-fair dequeue keeps first claim
+        on free slots and a resumed row is never immediately re-parked
+        by the same boundary's admit (no park/resume thrash).  Returns
+        True when anything resumed."""
+        if self.parked.count() == 0:
+            return False
+        self._drop_abandoned_parked()
+        frac = self._mem_fraction()
+        if frac is not None and frac >= self.park_hbm_fraction:
+            return False
+        moved = False
+        for sig in self.parked.sigs():
+            bkt = self._buckets.get(sig)
+            if bkt is None:
+                # evicted-while-parked is prevented (_evict_idle_bucket
+                # skips parked sigs); reaching here means the bucket
+                # died on an error path that already blacklisted it
+                self._fail_parked(sig, RuntimeError(
+                    f"bucket {sig[:8]} lost while rows were parked"))
+                continue
+            free = bkt.capacity - bkt.n_active
+            if free <= 0:
+                continue
+            recs = self.parked.pop_for(sig, free)
+            if not recs:
+                continue
+            now_wall = time.time()
+            try:
+                first_slot = bkt.resume_parked(recs)
+            except Exception as e:  # noqa: BLE001 - rows must not vanish
+                log(f"cb: resume failed in bucket {sig[:8]}: "
+                    f"{type(e).__name__}: {e}")
+                for rec in recs:
+                    self.state._finalize_hand([rec.item], None, e,
+                                              time.perf_counter())
+                continue
+            moved = True
+            trace_mod.GLOBAL_COUNTERS.bump("cb_resumes", len(recs))
+            with self._lock:
+                self._stats["resumes"] += len(recs)
+            for off, rec in enumerate(recs):
+                if rec.item.get("span") is not None:
+                    trace_mod.event_span(
+                        "cb_resume", now_wall, now_wall,
+                        parent=rec.item["span"],
+                        attrs={"bucket": sig[:8],
+                               "slot": first_slot + off,
+                               "step": rec.step})
+                debug_log(f"cb: {rec.pid} resumed into bucket "
+                          f"{sig[:8]} slot {first_slot + off} "
+                          f"at step {rec.step}")
+            # no-op resume: a row parked AT its final boundary has no
+            # steps left — retire it straight to the decode tail
+            self._retire_cohorts(bkt)
+        if moved:
+            trace_mod.GLOBAL_GAUGES.set("cb_parked",
+                                        self.parked.count())
+            self._mirror_stats()
+        return moved
 
     # -- the step loop --------------------------------------------------------
 
@@ -849,6 +1271,7 @@ class ContinuousBatchExecutor:
                 self.state._finalize_hand([item], None, e,
                                           time.perf_counter())
             self._buckets.pop(bkt.sig, None)
+            self._fail_parked(bkt.sig, e)
             self._mirror_stats()
             return
         trace_mod.GLOBAL_STAGES.record("cb_step",
@@ -872,6 +1295,14 @@ class ContinuousBatchExecutor:
             self._stats["retraces"] += traced
         if reuse_mod.previews_enabled():
             self._publish_previews(bkt)
+        if self._retire_cohorts(bkt):
+            self._mirror_stats()
+
+    def _retire_cohorts(self, bkt: _Bucket) -> bool:
+        """Hand every finished slot to the decode tail (shared by the
+        step loop and the no-op-resume path — a row resumed at its
+        final boundary retires without stepping, because step_once on
+        a finished row would index past the sigma schedule)."""
         finished = bkt.take_finished()
         now_wall = time.time()
         for items, rows, t_admit in finished:
@@ -886,14 +1317,17 @@ class ContinuousBatchExecutor:
                         parent=item["span"],
                         attrs={"bucket": bkt.sig[:8]})
             self._tail_q.put((bkt, items, rows, t_admit))
-        if finished:
-            self._mirror_stats()
+        return bool(finished)
 
     def _abort_active(self, err: BaseException) -> None:
         for bkt in list(self._buckets.values()):
             for item in bkt.abort_all():
                 self.state._finalize_hand([item], None, err,
                                           time.perf_counter())
+        for rec in self.parked.drain_all():
+            self.state._finalize_hand([rec.item], None, err,
+                                      time.perf_counter())
+        trace_mod.GLOBAL_GAUGES.set("cb_parked", 0)
         self._mirror_stats()
 
     def _drive(self) -> None:
@@ -935,10 +1369,18 @@ class ContinuousBatchExecutor:
                         st._queue_event.wait(timeout=0.05)
                         continue
                 admitted = self._admit_boundary()
+                resumed = False
+                if self.park_enabled:
+                    # residency scheduling at the boundary: shed under
+                    # memory pressure, then refill free slots from the
+                    # parked backlog (admission above already took its
+                    # stride-fair share of the room)
+                    self._pressure_park()
+                    resumed = self._resume_boundary()
                 bkt = self._next_bucket()
                 if bkt is None:
                     batch_started = None
-                    if not admitted:
+                    if not admitted and not resumed:
                         if st._queue_event.is_set():
                             # queued work that can't dispatch right now
                             # (non-batchable head behind a busy

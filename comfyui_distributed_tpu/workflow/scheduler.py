@@ -433,58 +433,86 @@ def pop_cb_admit(queue: List[Dict[str, Any]],
     - ``("fallback", group)``: the head is not batchable — the exact
       legacy contiguous-within-class group pop, for the classic
       one-dispatch executor path;
-    - ``("defer", [])``: batchable-but-full, or not batchable while
-      ``fallback_ok`` is False (the fallback executor is mid-group) —
-      nothing popped, and the stride pass is NOT charged (the class is
-      blocked on capacity, not skipping its turn).
+    - ``("defer", [])``: every queued class is blocked — batchable-but-
+      full, or not batchable while ``fallback_ok`` is False (the
+      fallback executor is mid-group) — nothing popped, and no stride
+      pass is charged (a class blocked on capacity is not skipping its
+      turn).
+
+    A capacity-blocked class no longer stalls the whole boundary
+    (ISSUE 17): it is excluded from the counts and the stride peeks
+    again among the remaining classes, so a paid burst admits — with
+    latent paging, by PREEMPTING the very rows that block it — while
+    the batch class's head waits on a slot exit.  The blocked class's
+    items keep their queue positions and its stride pass is never
+    charged, so the paid/free/batch dequeue ratios are untouched for
+    every unblocked boundary.
 
     Caller holds the queue lock."""
     if not queue:
         return "defer", []
-    counts: Dict[str, int] = {}
+    counts_all: Dict[str, int] = {}
     for item in queue:
         c = item.get("tenant") or admission.default_class
-        counts[c] = counts.get(c, 0) + 1
-    # peek first, commit the stride charge only on an actual dequeue —
-    # next_class() on the same counts deterministically re-picks the
-    # peeked class
-    cls = admission.peek_class(counts) or admission.default_class
-    idx = next((i for i, item in enumerate(queue)
-                if (item.get("tenant") or admission.default_class)
-                == cls), 0)
-    head = queue[idx]
-    room = int(room_for(head) or 0)
-    if room > 0:
-        admission.next_class(counts)
-        sig = head.get("sig")
-        take = [idx]
-        j = idx + 1
-        while sig is not None and len(take) < room and j < len(queue):
-            it = queue[j]
-            if (it.get("tenant") or admission.default_class) == cls \
-                    and it.get("sig") == sig:
-                take.append(j)
-            j += 1
-        items = [queue[i] for i in take]
-        for i in reversed(take):
-            queue.pop(i)
-        return "cb", items
-    if room < 0 or not fallback_ok:
-        return "defer", []
-    admission.next_class(counts)
-    # legacy group semantics for the non-batchable head: contiguous
-    # same-signature run WITHIN the class (pop_fair_group's tail logic)
-    group = [queue.pop(idx)]
-    sig = group[0].get("sig")
-    j = idx
-    while sig is not None and len(group) < max(legacy_max, 1):
-        while j < len(queue) and (queue[j].get("tenant")
-                                  or admission.default_class) != cls:
-            j += 1
-        if j >= len(queue) or queue[j].get("sig") != sig:
-            break
-        group.append(queue.pop(j))
-    return "fallback", group
+        counts_all[c] = counts_all.get(c, 0) + 1
+    blocked: set = set()
+    while True:
+        counts = {c: n for c, n in counts_all.items()
+                  if c not in blocked}
+        if not counts:
+            return "defer", []
+        # peek first, commit the stride charge only on an actual
+        # dequeue — next_class() on the same counts deterministically
+        # re-picks the peeked class
+        cls = admission.peek_class(counts) or admission.default_class
+        idx = next((i for i, item in enumerate(queue)
+                    if (item.get("tenant") or admission.default_class)
+                    == cls), None)
+        if idx is None:
+            # peeked class has nothing queued (default-class fallback):
+            # take the first unblocked item's class instead
+            idx = next(i for i, item in enumerate(queue)
+                       if (item.get("tenant")
+                           or admission.default_class) not in blocked)
+            cls = queue[idx].get("tenant") or admission.default_class
+        head = queue[idx]
+        room = int(room_for(head) or 0)
+        if room > 0:
+            admission.next_class(counts)
+            sig = head.get("sig")
+            take = [idx]
+            j = idx + 1
+            while sig is not None and len(take) < room \
+                    and j < len(queue):
+                it = queue[j]
+                if (it.get("tenant") or admission.default_class) == cls \
+                        and it.get("sig") == sig:
+                    take.append(j)
+                j += 1
+            items = [queue[i] for i in take]
+            for i in reversed(take):
+                queue.pop(i)
+            return "cb", items
+        if room == 0 and fallback_ok:
+            admission.next_class(counts)
+            # legacy group semantics for the non-batchable head:
+            # contiguous same-signature run WITHIN the class
+            # (pop_fair_group's tail logic)
+            group = [queue.pop(idx)]
+            sig = group[0].get("sig")
+            j = idx
+            while sig is not None and len(group) < max(legacy_max, 1):
+                while j < len(queue) and (queue[j].get("tenant")
+                                          or admission.default_class) \
+                        != cls:
+                    j += 1
+                if j >= len(queue) or queue[j].get("sig") != sig:
+                    break
+                group.append(queue.pop(j))
+            return "fallback", group
+        # batchable-but-full (room < 0), or non-batchable while the
+        # fallback thread is busy: block the class and re-peek
+        blocked.add(cls)
 
 
 def split_images(images: List[Any], k: int) -> List[List[Any]]:

@@ -290,6 +290,88 @@ class TestTpSpecDisciplineRule:
                             rules=["tp-spec-discipline"]) == []
 
 
+# --- rule fixtures: cb-slot-state-discipline (ISSUE 17) ----------------------
+
+SLOT_WRITE_OUTSIDE = f'''
+def nudge(slot):
+    slot.step = 0
+    slot.t_admit += 1.0
+'''
+
+SLOT_TUPLE_WRITE = '''
+def swap(a, b):
+    a.item, b.item = b.item, a.item
+'''
+
+SLOT_READS_ONLY = '''
+def view(slot):
+    s = slot.step
+    return (s, slot.item["id"], slot.t_admit)
+'''
+
+CB_HOME_WITH_SLOTS = '''
+class _Slot:
+    __slots__ = ("item", "step", "t_admit")
+
+
+class _ParkedRow:
+    __slots__ = ("pid", "item", "sig", "rank", "step", "t_admit",
+                 "t_park", "x_rows")
+
+
+def park(rec):
+    rec.x_rows = None
+'''
+
+
+class TestCbSlotStateDisciplineRule:
+    def test_direct_writes_outside_home_flagged(self):
+        vs = lint_sources(
+            {f"{PKG}/workflow/scheduler.py": SLOT_WRITE_OUTSIDE},
+            rules=["cb-slot-state-discipline"])
+        assert len(vs) == 2          # plain assign AND the augassign
+        assert all(v.rule == "cb-slot-state-discipline" for v in vs)
+        assert "park" in vs[0].message
+
+    def test_tuple_unpack_write_flagged(self):
+        vs = lint_sources(
+            {f"{PKG}/runtime/jobs.py": SLOT_TUPLE_WRITE},
+            rules=["cb-slot-state-discipline"])
+        assert len(vs) == 2          # both .item targets
+
+    def test_reads_and_home_file_exempt(self):
+        vs = lint_sources(
+            {f"{PKG}/workflow/batch_executor.py": CB_HOME_WITH_SLOTS,
+             f"{PKG}/server/app.py": SLOT_READS_ONLY},
+            rules=["cb-slot-state-discipline"])
+        assert vs == []
+
+    def test_field_set_tracks_home_slots_declaration(self):
+        # the protected set comes from batch_executor.py's __slots__:
+        # a _ParkedRow-only field (x_rows) is protected too
+        vs = lint_sources(
+            {f"{PKG}/workflow/batch_executor.py": CB_HOME_WITH_SLOTS,
+             f"{PKG}/runtime/jobs.py":
+                 "def f(rec):\n    rec.x_rows = []\n"},
+            rules=["cb-slot-state-discipline"])
+        assert len(vs) == 1 and ".x_rows" in vs[0].message
+
+    def test_suppression_needs_reason(self):
+        bad = SLOT_WRITE_OUTSIDE.replace(
+            "slot.step = 0",
+            "slot.step = 0  # dtpu-lint: ignore[cb-slot-state-discipline]")
+        assert len(lint_sources(
+            {f"{PKG}/workflow/scheduler.py": bad},
+            rules=["cb-slot-state-discipline"])) == 2
+        ok = SLOT_WRITE_OUTSIDE.replace(
+            "slot.step = 0",
+            "slot.step = 0  # dtpu-lint: "
+            "ignore[cb-slot-state-discipline] test-only fixture")
+        assert len(lint_sources(
+            {f"{PKG}/workflow/scheduler.py": ok},
+            rules=["cb-slot-state-discipline"])) == 1
+
+
 # --- rule fixtures: registry drift -------------------------------------------
 
 CONSTANTS_FIXTURE = '''
@@ -821,7 +903,8 @@ class TestBaselineHygiene:
                                          "deadlock-cycle",
                                          "wal-fencing",
                                          "route-contract",
-                                         "tp-spec-discipline")]
+                                         "tp-spec-discipline",
+                                         "cb-slot-state-discipline")]
         assert bad == []
 
 
@@ -1284,7 +1367,8 @@ class TestInterprocLiveGate:
         # nothing suppressed away silently)
         for rule in ("async-blocking-transitive", "deadlock-cycle",
                      "wal-fencing", "route-contract",
-                     "tp-spec-discipline"):
+                     "tp-spec-discipline",
+                     "cb-slot-state-discipline"):
             assert report.rule_counts.get(rule, {}).get("found", 0) \
                 == 0, rule
 

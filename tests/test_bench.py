@@ -198,3 +198,37 @@ class TestTpServePhaseSurface:
                 "value": 1.0, "unit": "fraction"}
         assert bench.check_regression(fresh, base)["regressed"]
         assert not bench.check_regression(base, dict(base))["regressed"]
+
+
+class TestPreemptPhaseSurface:
+    """ISSUE 17: the preempt phase's CLI/metric/watchdog surface.  The
+    harness itself (park/resume round trips under contention) runs in
+    the bench subprocess and tests/test_batching.py; here we pin the
+    cheap contract: the phase parses, names its metric, and its
+    completion bar tolerates zero regression (preemption pauses work,
+    never sheds it)."""
+
+    def _bench(self):
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        import bench
+        return bench
+
+    def test_phase_parses_and_names_metric(self):
+        bench = self._bench()
+        args = bench.parse_args(["--phase", "preempt"])
+        assert args.phase == "preempt"
+        assert bench.metric_name(args) == \
+            "preempt_batch_completion_under_preemption"
+        assert bench.metric_unit(args) == "fraction"
+
+    def test_completion_bar_tolerates_nothing(self):
+        bench = self._bench()
+        assert bench.CHECK_TOLERANCE_PCT[
+            "preempt_batch_completion_under_preemption"] == 0.0
+        fresh = {"metric": "preempt_batch_completion_under_preemption",
+                 "value": 0.9, "unit": "fraction"}
+        base = {"metric": "preempt_batch_completion_under_preemption",
+                "value": 1.0, "unit": "fraction"}
+        assert bench.check_regression(fresh, base)["regressed"]
+        assert not bench.check_regression(base, dict(base))["regressed"]
