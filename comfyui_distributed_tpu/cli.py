@@ -434,9 +434,73 @@ def cmd_trace(args) -> int:
     """Flight-recorder reader: no id lists recent job traces; with an id,
     pretty-prints the job's span tree (indent = parent/child, one line
     per span with duration and status) — the headless way to answer
-    "where did THIS job spend its time, across processes"."""
+    "where did THIS job spend its time, across processes".  With
+    --export-dir, reads durable capture files instead of a live server
+    (post-mortem: the server may be gone); --perfetto emits
+    Chrome/Perfetto trace-event JSON for chrome://tracing / ui.perfetto.dev.
+    """
     import urllib.error
     import urllib.request
+    from comfyui_distributed_tpu.utils import trace_export
+
+    def emit(rec) -> int:
+        if args.perfetto:
+            doc = trace_export.to_perfetto(rec)
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(doc, f)
+                print(f"wrote {len(doc['traceEvents'])} events to "
+                      f"{args.out}", file=sys.stderr)
+            else:
+                print(json.dumps(doc))
+            return 0
+        n_spans = rec.get("n_spans", len(rec.get("spans", ())))
+        print(f"trace {rec['trace_id']}  job {rec['prompt_id']}  "
+              f"status={rec['status']}  {rec.get('duration_s')}s  "
+              f"{n_spans} spans")
+
+        def walk(node, depth):
+            mark = "" if node.get("status") == "ok" else \
+                f"  !{node.get('status')}"
+            attrs = node.get("attrs") or {}
+            extra = "".join(f"  {k}={v}" for k, v in attrs.items()
+                            if k in ("worker", "node", "coalesced", "job",
+                                     "mem_peak_mb", "mem_peak_delta_mb",
+                                     "device_peak_mb", "rss_mb"))
+            print(f"{'  ' * depth}{node['name']}  "
+                  f"{node['duration_s'] * 1e3:.1f}ms{extra}{mark}")
+            for child in node.get("children", []):
+                walk(child, depth + 1)
+
+        tree = rec.get("tree")
+        if tree is None:
+            tree = trace_export.load_forest(rec)
+        for root in tree:
+            walk(root, 0)
+        return 0
+
+    if args.export_dir:
+        # offline path: the durable capture files, no server required
+        if not args.prompt_id:
+            n = 0
+            for rec in trace_export.iter_records(args.export_dir):
+                dur = rec.get("duration_s")
+                print(f"{rec['prompt_id']}  {rec['status']:5s}  "
+                      f"{dur if dur is not None else '?':>8}s  "
+                      f"{len(rec.get('spans', ())):3d} spans  "
+                      f"trace={rec['trace_id']}")
+                n += 1
+            if not n:
+                print("(no captured traces in "
+                      f"{args.export_dir})")
+            return 0
+        rec = trace_export.load_trace(args.export_dir,
+                                      prompt_id=args.prompt_id)
+        if rec is None:
+            print(f"no captured trace for {args.prompt_id!r} in "
+                  f"{args.export_dir}", file=sys.stderr)
+            return 1
+        return emit(rec)
     if not args.prompt_id:
         with urllib.request.urlopen(f"{args.url}/distributed/traces",
                                     timeout=10) as r:
@@ -463,25 +527,79 @@ def cmd_trace(args) -> int:
             msg = str(e)
         print(msg, file=sys.stderr)
         return 1
-    print(f"trace {rec['trace_id']}  job {rec['prompt_id']}  "
-          f"status={rec['status']}  {rec.get('duration_s')}s  "
-          f"{rec['n_spans']} spans")
+    return emit(rec)
 
-    def walk(node, depth):
-        mark = "" if node.get("status") == "ok" else \
-            f"  !{node.get('status')}"
-        attrs = node.get("attrs") or {}
-        extra = "".join(f"  {k}={v}" for k, v in attrs.items()
-                        if k in ("worker", "node", "coalesced", "job",
-                                 "mem_peak_mb", "mem_peak_delta_mb",
-                                 "device_peak_mb", "rss_mb"))
-        print(f"{'  ' * depth}{node['name']}  "
-              f"{node['duration_s'] * 1e3:.1f}ms{extra}{mark}")
-        for child in node.get("children", []):
-            walk(child, depth + 1)
 
-    for root in rec.get("tree", []):
-        walk(root, 0)
+def cmd_slo(args) -> int:
+    """SLO burn-rate reader: per-tenant-class objectives, fast/slow
+    window burn rates and the remaining slow-window error budget — the
+    headless answer to "are we burning the paid error budget right
+    now, and how fast"."""
+    import urllib.request
+    with urllib.request.urlopen(f"{args.url}/distributed/slo",
+                                timeout=10) as r:
+        data = json.loads(r.read())
+    if args.json:
+        print(json.dumps(data, indent=2))
+        return 0
+    if not data.get("enabled"):
+        print("slo engine off (set DTPU_SLO_SPEC, e.g. "
+              "'paid:p95<2s,completion>0.999')")
+        return 0
+    print(f"slo windows: fast={data['fast_window_s']:g}s "
+          f"slow={data['slow_window_s']:g}s")
+    for cls, t in sorted(data.get("tenants", {}).items()):
+        objs = ", ".join(o["raw"] for o in t["objectives"]) or "-"
+        print(f"  {cls}: {objs}  "
+              f"budget_remaining={t['budget_remaining']:.2%}")
+        for wname in ("fast", "slow"):
+            w = t["windows"][wname]
+            flag = "  BURNING" if w["burn_rate"] > 1.0 else ""
+            print(f"    {wname:4s} n={w['count']:4d} "
+                  f"ok={w['ok_ratio']:.3f} p95={w['p95_s']:.3f}s "
+                  f"burn={w['burn_rate']:.2f}{flag}")
+    return 0
+
+
+def cmd_flightdeck(args) -> int:
+    """Continuous-batching flight deck: the per-step-boundary occupancy
+    timeline (busy/free slots, parked, admits/retires/preemptions per
+    boundary) plus the admit-to-first-step latency histogram — the
+    headless answer to "what did the batcher do in the last N steps"."""
+    import urllib.request
+    with urllib.request.urlopen(f"{args.url}/distributed/metrics",
+                                timeout=10) as r:
+        data = json.loads(r.read())
+    b = data.get("batching") or {}
+    if args.json:
+        print(json.dumps(b, indent=2))
+        return 0
+    if not b:
+        print("(no batching block reported — continuous batching off?)")
+        return 1
+    print(f"flight deck: running={b.get('running')} "
+          f"admits={b.get('admits', 0)} retires={b.get('retires', 0)} "
+          f"preemptions={b.get('preemptions', 0)} "
+          f"retraces={b.get('retraces', 0)} "
+          f"parked={b.get('parked', 0)}")
+    h = b.get("admit_to_first_step") or {}
+    if h.get("count"):
+        print(f"admit->first step: n={h['count']} "
+              f"p50={h.get('p50_s', 0):.3f}s p95={h.get('p95_s', 0):.3f}s "
+              f"max={h.get('max_s', 0):.3f}s")
+    deck = b.get("deck") or []
+    rows = deck[-args.last:] if args.last else deck
+    if rows:
+        print(f"{'seq':>6s} {'bucket':8s} {'occupancy':18s} "
+              f"{'park':>4s} {'adm':>4s} {'ret':>4s} {'pre':>4s}")
+    for r_ in rows:
+        busy, free = r_["busy"], r_["free"]
+        bar = "#" * busy + "." * free
+        print(f"{r_['seq']:>6d} {r_['bucket']:8s} "
+              f"{bar:18s} {r_['parked']:>4d} {r_['admits']:>4d} "
+              f"{r_['retires']:>4d} {r_['preemptions']:>4d}")
+    if not rows:
+        print("(deck timeline empty — no step boundaries yet)")
     return 0
 
 
@@ -786,11 +904,39 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("trace", help="read a job's distributed trace "
-                                     "from a server's flight recorder")
+                                     "from a server's flight recorder "
+                                     "or durable capture files")
     p.add_argument("prompt_id", nargs="?", default=None,
                    help="prompt id to print (omit to list recent traces)")
     p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.add_argument("--export-dir", default=None, metavar="DIR",
+                   help="read durable capture files from DIR instead of "
+                        "a live server (post-mortem)")
+    p.add_argument("--perfetto", action="store_true",
+                   help="emit Chrome/Perfetto trace-event JSON instead "
+                        "of the pretty tree (load in ui.perfetto.dev)")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help="write --perfetto JSON to FILE instead of stdout")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser("slo", help="SLO burn rates: per-tenant objective "
+                                   "status over fast/slow windows, "
+                                   "remaining error budget")
+    p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the pretty report")
+    p.set_defaults(fn=cmd_slo)
+
+    p = sub.add_parser("flightdeck", help="continuous-batching flight "
+                                          "deck: step-boundary occupancy "
+                                          "timeline + admit-to-first-"
+                                          "step latency")
+    p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.add_argument("--last", type=int, default=32, metavar="N",
+                   help="show only the last N timeline rows (0 = all)")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON batching block instead of the table")
+    p.set_defaults(fn=cmd_flightdeck)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -102,7 +102,9 @@ class FleetAutoscaler:
                  drain_s: Optional[float] = None,
                  flap_window_s: Optional[float] = None,
                  shard: Optional[Any] = None,
-                 parked_backlog_fn: Optional[Callable[[], int]] = None):
+                 parked_backlog_fn: Optional[Callable[[], int]] = None,
+                 slo_burn_fn: Optional[Callable[[], Optional[float]]]
+                 = None):
         self.registry = registry
         self.queue_depth_fn = queue_depth_fn
         self.util_fn = util_fn
@@ -114,6 +116,11 @@ class FleetAutoscaler:
         # queue-depth probe (they left the queue at admission) but real
         # backlog, so they fold into the scale-up signal
         self.parked_backlog_fn = parked_backlog_fn
+        # SLO burn-rate fold-in (ISSUE 18, DTPU_AUTOSCALE_SLO=1): the
+        # paid class burning its fast-window budget is scale-up pressure
+        # even when the queue looks shallow — latency violations don't
+        # queue, they finish late
+        self.slo_burn_fn = slo_burn_fn
         # multi-master federation (ISSUE 14): the ShardManager (or None)
         # — its gossiped peer queue depths fold into the signal, so each
         # shard's reconciliation sees the MERGED fleet pressure instead
@@ -226,6 +233,12 @@ class FleetAutoscaler:
                 parked = int(self.parked_backlog_fn() or 0)
             except Exception as e:  # noqa: BLE001 - signal survives
                 debug_log(f"autoscale: parked probe failed: {e}")
+        slo_burn = None
+        if self.slo_burn_fn is not None:
+            try:
+                slo_burn = self.slo_burn_fn()
+            except Exception as e:  # noqa: BLE001 - signal survives
+                debug_log(f"autoscale: slo probe failed: {e}")
         participants = 1 + live + peer_masters   # masters serve too
         depth = master_q + worker_q + peer_q + parked
         out = {
@@ -237,6 +250,8 @@ class FleetAutoscaler:
         }
         if parked:
             out["parked_backlog"] = parked
+        if slo_burn is not None:
+            out["slo_burn"] = round(float(slo_burn), 4)
         if self.shard is not None:
             out["peer_masters"] = peer_masters
             out["peer_queue_depth"] = peer_q
@@ -293,8 +308,11 @@ class FleetAutoscaler:
         action = self._reap_retiring(now)
         qpp = signal["queue_per_participant"]
         util = signal["utilization"]
+        slo_burn = signal.get("slo_burn")
+        slo_hot = slo_burn is not None and slo_burn > 1.0
         over = qpp > self.up_queue or (util is not None
-                                       and util > self.up_util)
+                                       and util > self.up_util) \
+            or slo_hot
         under = qpp < self.down_queue and (util is None
                                            or util < self.down_util)
         # streaks + readiness decided under the lock (the HTTP
@@ -336,10 +354,15 @@ class FleetAutoscaler:
                     self._spawned.append(str(wid))
                     self.scale_ups += 1
                     self._over_streak = 0
-                reason = (f"queue/participant {qpp:.2f} > "
-                          f"{self.up_queue:g}" if qpp > self.up_queue
-                          else f"utilization {util:.2f} > "
-                               f"{self.up_util:g}")
+                if qpp > self.up_queue:
+                    reason = (f"queue/participant {qpp:.2f} > "
+                              f"{self.up_queue:g}")
+                elif util is not None and util > self.up_util:
+                    reason = (f"utilization {util:.2f} > "
+                              f"{self.up_util:g}")
+                else:
+                    reason = (f"paid SLO burn rate {slo_burn:.2f} > 1 "
+                              f"(fast window)")
                 self._record("up", reason, now, signal, wid)
                 action = "up"
         elif under_ready and live > self.min_workers \
@@ -544,6 +567,19 @@ def install(state) -> Optional[FleetAutoscaler]:
         return float(u) if isinstance(u, (int, float)) else None
 
     cb = getattr(state, "cb", None)
+    # SLO fold-in (ISSUE 18): opt-in via DTPU_AUTOSCALE_SLO=1 and only
+    # meaningful when a spec is configured — the paid class's fast-window
+    # burn rate becomes a third scale-up trigger next to queue depth and
+    # utilization (burn > 1.0 means the objective fails at this rate)
+    from comfyui_distributed_tpu.utils import slo as slo_mod
+    slo_engine = getattr(state, "slo", None)
+    slo_burn_fn = None
+    if slo_mod.autoscale_slo_armed() and slo_engine is not None \
+            and slo_engine.enabled:
+        def slo_burn() -> Optional[float]:
+            return slo_engine.burn_rate(C.TENANT_DEFAULT_CLASS, "fast")
+
+        slo_burn_fn = slo_burn
     scaler = FleetAutoscaler(
         registry=state.cluster,
         queue_depth_fn=state.queue_remaining,
@@ -552,6 +588,7 @@ def install(state) -> Optional[FleetAutoscaler]:
         retirer=default_retirer(state),
         shard=getattr(state, "shard", None),
         parked_backlog_fn=cb.parked_count if cb is not None else None,
+        slo_burn_fn=slo_burn_fn,
     )
     scaler.start()
     return scaler

@@ -27,6 +27,7 @@ Route inventory (capability parity with reference ``distributed.py:49-599,
     POST /distributed/metrics/reset          clear aggregate sinks (new)
     GET  /distributed/traces                 flight-recorder index (new)
     GET  /distributed/trace/<prompt_id>      one job's span tree (new)
+    GET  /distributed/slo                    SLO burn-rate snapshot (new)
     GET  /distributed/cluster                lease states + work ledger (new)
     POST /distributed/register               elastic worker registration (new)
     POST /distributed/heartbeat              worker lease renewal (new)
@@ -73,7 +74,9 @@ from comfyui_distributed_tpu.utils import config as cfg_mod
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils import net as net_mod
 from comfyui_distributed_tpu.utils import resource as resource_mod
+from comfyui_distributed_tpu.utils import slo as slo_mod
 from comfyui_distributed_tpu.utils import trace as trace_mod
+from comfyui_distributed_tpu.utils import trace_export as trace_export_mod
 from comfyui_distributed_tpu.utils.constants import LOG_TAIL_BYTES
 from comfyui_distributed_tpu.utils.image import decode_png, decode_tensor
 from comfyui_distributed_tpu.utils.logging import debug_log, log
@@ -177,6 +180,10 @@ class ServerState:
         # class, so single-tenant deployments keep the plain
         # DTPU_MAX_QUEUE backpressure semantics unchanged.
         self.admission = sched_mod.AdmissionController()
+        # SLO burn-rate engine (ISSUE 18): per-tenant-class objectives
+        # from DTPU_SLO_SPEC over fast/slow rolling windows, fed by
+        # _finalize_group; disarmed (record() is a no-op) without a spec
+        self.slo = slo_mod.SLOEngine.from_env()
         # completion timestamps ring feeding the 429 Retry-After hint
         # (drain rate = prompts finalized per second, recent window)
         self._completions: collections.deque = collections.deque(
@@ -777,6 +784,33 @@ class ServerState:
                     f"{jr['device_peak_bytes'] / 1e6:.1f}MB "
                     f"rss={jr['host_rss_bytes'] / 1e6:.1f}MB "
                     f"({jr['source']})")
+        # SLO burn-rate feed (ISSUE 18): EVERY finalized prompt lands in
+        # its class's fast/slow windows — span-less ones too (tracing
+        # off must not blind the engine).  Abandoned counts as bad: the
+        # client saw no completion.
+        ok = err is None
+        if ok and res is not None:
+            fallback_dur = float(res.total_s)
+        else:
+            fallback_dur = max(time.perf_counter() - t0, 0.0)
+        for item in group:
+            sp = item.get("span")
+            dur_slo = round(done_t - sp.start_s, 6) if sp is not None \
+                else fallback_dur
+            tenant = str(item.get("tenant")
+                         or self.admission.default_class)
+            self.slo.record(tenant, dur_slo, ok)
+            if sp is not None:
+                # trace <-> SLO cross-links: the class on the root span,
+                # and an slo_breach event when the job blew its class's
+                # latency objective (the spec-driven cousin of the
+                # DTPU_SLOW_JOB_S log line)
+                sp.attrs.setdefault("tenant", tenant)
+                thr = self.slo.latency_threshold(tenant)
+                if thr is not None and dur_slo > thr:
+                    trace_mod.event_span(
+                        "slo_breach", done_t, done_t, parent=sp,
+                        attrs={"tenant": tenant, "threshold_s": thr})
         for item in group:
             sp = item.get("span")
             if sp is None:
@@ -794,6 +828,11 @@ class ServerState:
                     round(_job_res()["host_rss_bytes"] / 1e6, 2))
             dur = round(done_t - sp.start_s, 6)
             sp.end()
+            # end-to-end latency histogram WITH an exemplar: the bucket
+            # this job landed in now points at its trace, so a slow
+            # .prom bucket resolves to a flight-recorder/capture entry
+            trace_mod.GLOBAL_STAGES.record("job_e2e", dur,
+                                           trace_id=sp.trace_id)
             trace_mod.GLOBAL_TRACES.commit(
                 item["id"], sp.trace_id, status=status,
                 root_span_id=sp.span_id, duration_s=dur)
@@ -1065,12 +1104,18 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         if state.durable is not None:
             dur_stats = await asyncio.get_running_loop() \
                 .run_in_executor(None, state.durable.stats)
+        # the exporter's first stats() call may construct it (a dir
+        # scan) — keep that filesystem touch off the event loop
+        export_stats = await asyncio.get_running_loop() \
+            .run_in_executor(None, trace_export_mod.stats)
         return web.json_response({**state.metrics,
                                   "phases": GLOBAL_PHASES.snapshot(),
                                   # per-node-type op latency histograms
                                   # (count/mean/p50/p95/p99)
                                   "nodes": GLOBAL_NODES.snapshot(),
-                                  # request-tracing health
+                                  # request-tracing health (+ the
+                                  # durable capture plane: exporter
+                                  # counters, eviction visibility)
                                   "tracing": {
                                       "enabled": tracing_enabled(),
                                       "ring_size": GLOBAL_TRACES.size(),
@@ -1078,7 +1123,14 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                           GLOBAL_TRACES.max_traces,
                                       "dropped_spans":
                                           GLOBAL_TRACES.dropped_spans,
+                                      "evictions": GLOBAL_TRACES
+                                          .eviction_count(),
+                                      "export": export_stats,
                                   },
+                                  # SLO burn-rate engine: per-tenant
+                                  # objectives, fast/slow window stats,
+                                  # burn rates + budget remaining
+                                  "slo": state.slo.evaluate(),
                                   # per-job stage timeline (queue_wait /
                                   # coalesced_batch / compute / d2h /
                                   # encode / upload) + scheduler and wire
@@ -1424,6 +1476,32 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                  "Lease takeovers performed by this process.",
                  [({}, ds.get("takeovers", 0))]),
             ])
+        # continuous capture plane (ISSUE 18): exporter counters when
+        # armed (first stats() may construct the exporter — a dir scan,
+        # so off the loop), plus the SLO burn-rate gauges
+        exp_stats = await loop.run_in_executor(None,
+                                               trace_export_mod.stats)
+        if exp_stats.get("enabled"):
+            extra.extend([
+                ("dtpu_trace_export_traces_total", "counter",
+                 "Committed traces appended to capture segments.",
+                 [({}, exp_stats["exported"])]),
+                ("dtpu_trace_export_dropped_total", "counter",
+                 "Capture records dropped (disk errors or "
+                 "unserializable payloads).",
+                 [({}, exp_stats["dropped"])]),
+                ("dtpu_trace_export_bytes_total", "counter",
+                 "Bytes appended to capture segments.",
+                 [({}, exp_stats["bytes_written"])]),
+                ("dtpu_trace_export_rotations_total", "counter",
+                 "Capture segment rotations.",
+                 [({}, exp_stats["rotations"])]),
+                ("dtpu_trace_export_retired_total", "counter",
+                 "Oldest capture segments deleted by the retention "
+                 "cap.",
+                 [({}, exp_stats["retired_segments"])]),
+            ])
+        extra.extend(state.slo.prom_families())
         # current resource gauges (unlabelled = this process); the
         # worker_id-labelled fleet view lives on /cluster/metrics.prom
         extra.extend(resource_mod.resource_prom_families(
@@ -1446,12 +1524,27 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                           f"({C.METRICS_RESET_ENV}=0)"}, status=403)
         data = await request.json() if request.can_read_body else {}
         cleared = trace_mod.reset_aggregate_metrics()
+        # keep the reset surface TOTAL (ISSUE 18): the new planes clear
+        # with everything else — SLO windows, exemplar samples (inside
+        # the histograms reset_aggregate_metrics just recreated) and the
+        # exporter counters (its first touch may scan the capture dir,
+        # so off the loop); capture FILES are durable by design and stay
+        state.slo.reset()
+        cleared["slo_windows"] = True
+        await asyncio.get_running_loop().run_in_executor(
+            None, trace_export_mod.reset_counters)
+        cleared["export_counters"] = True
         if data.get("include_traces"):
             trace_mod.GLOBAL_TRACES.reset()
             cleared["traces"] = True
         log("aggregate metrics reset "
             f"(by {request.remote or 'unknown'})")
         return ok({"cleared": cleared})
+
+    async def slo_view(request):
+        """SLO burn-rate engine snapshot: per-tenant objectives, window
+        stats, burn rates and remaining budget (`cli slo` reads this)."""
+        return web.json_response(state.slo.evaluate())
 
     async def get_trace(request):
         """Flight recorder: one completed job's full span tree."""
@@ -2538,6 +2631,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_post("/distributed/metrics/reset", metrics_reset)
     r.add_get("/distributed/traces", list_traces)
     r.add_get("/distributed/trace/{prompt_id}", get_trace)
+    r.add_get("/distributed/slo", slo_view)
     r.add_post("/distributed/warmup", warmup)
     r.add_get("/distributed/ring", ring_info)
     r.add_post("/distributed/ring/gossip", ring_gossip)

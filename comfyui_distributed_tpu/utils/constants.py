@@ -242,6 +242,43 @@ METRICS_RESET_ENV = "DTPU_METRICS_RESET"  # "0" disables POST .../metrics/reset
 HISTOGRAM_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# --- continuous capture plane (utils/trace_export.py) ------------------------
+# Durable trace export: committed flight-recorder traces stream to
+# rotating, size-bounded, schema-versioned JSONL capture files — the
+# record half of the record/replay plan (ROADMAP item 6).  Off unless an
+# export dir is set; appends are fsync-free and happen on the
+# finalizer/executor threads, never the event loop.
+TRACE_EXPORT_DIR_ENV = "DTPU_TRACE_EXPORT_DIR"       # unset/empty: off
+TRACE_EXPORT_SEGMENT_ENV = "DTPU_TRACE_EXPORT_SEGMENT_BYTES"
+TRACE_EXPORT_SEGMENT_DEFAULT = 4 * 1024 * 1024       # rotate past 4 MiB
+TRACE_EXPORT_RETAIN_ENV = "DTPU_TRACE_EXPORT_RETAIN_BYTES"
+TRACE_EXPORT_RETAIN_DEFAULT = 64 * 1024 * 1024       # dir cap (oldest out)
+TRACE_EXPORT_SCHEMA = 1                              # capture-file schema
+TRACE_EXPORT_PREFIX = "capture-"                     # segment file prefix
+# no-silent-caps: ring evictions and export drops log once per N
+TRACE_EVICT_LOG_EVERY = 50
+TRACE_EXPORT_DROP_LOG_EVERY = 20
+
+# --- SLO burn-rate engine (utils/slo.py) -------------------------------------
+# Declarative per-tenant-class objectives evaluated over multi-window
+# rolling rings (fast ~5m / slow ~1h), fed by the finalize path.  Spec
+# grammar: "class:obj,obj;class:obj" where obj is pNN<DURs (latency:
+# at most (100-NN)% of requests slower than DUR) or completion>RATIO
+# (success fraction), e.g. "paid:p95<2s,completion>0.999;free:p95<10s".
+SLO_SPEC_ENV = "DTPU_SLO_SPEC"           # unset/empty: engine disarmed
+SLO_FAST_WINDOW_ENV = "DTPU_SLO_FAST_S"
+SLO_FAST_WINDOW_DEFAULT = 300.0          # fast burn window (~5m)
+SLO_SLOW_WINDOW_ENV = "DTPU_SLO_SLOW_S"
+SLO_SLOW_WINDOW_DEFAULT = 3600.0         # slow burn window (~1h)
+SLO_RING_MAX = 4096                      # samples kept per tenant window
+AUTOSCALE_SLO_ENV = "DTPU_AUTOSCALE_SLO"  # "1": paid fast burn>1 scales up
+
+# CB flight deck: per-bucket step-boundary occupancy timeline ring
+# (busy/parked/free + admits/retires/preemptions deltas per boundary)
+# in the batching snapshot, rendered by `cli flightdeck`.
+CB_DECK_RING_ENV = "DTPU_CB_DECK_RING"
+CB_DECK_RING_DEFAULT = 128               # boundaries retained
+
 # --- resource telemetry plane (utils/resource.py) ----------------------------
 # Device-memory / host-RSS / utilization sampling into bounded in-memory
 # ring timeseries (the Gorilla model: operational telemetry is only
@@ -520,6 +557,9 @@ TRACE_ATTR_WHITELIST = frozenset({
     # latent paging + SLO-aware preemption (ISSUE 17): the sigma index a
     # row parked/resumed at, and what displaced it
     "step", "preempted_by",
+    # SLO burn-rate engine (ISSUE 18): slo_breach event marks a job that
+    # exceeded its class's latency objective
+    "threshold_s",
     # recovery / hedging
     "lost", "to", "units", "tile_idx", "n_workers",
     # resource attribution (ISSUE 5)

@@ -54,10 +54,15 @@ class LatencyHistogram:
     Prometheus-shaped: per-bucket counts over
     :data:`constants.HISTOGRAM_BUCKETS_S` plus an overflow (+Inf) bucket,
     with sum/count/max — enough for ``_bucket``/``_sum``/``_count`` series
-    AND interpolated p50/p95/p99 without storing samples (thread-safe)."""
+    AND interpolated p50/p95/p99 without storing samples (thread-safe).
+
+    Buckets optionally carry OpenMetrics exemplars: ``record(...,
+    trace_id=...)`` remembers the latest (trace_id, value, wall-clock)
+    that landed in each bucket, so the ``.prom`` exposition can link a
+    slow bucket straight to a flight-recorder / capture-file trace."""
 
     __slots__ = ("bounds", "counts", "overflow", "count", "sum_s", "max_s",
-                 "_lock")
+                 "exemplars", "_lock")
 
     def __init__(self, bounds: Tuple[float, ...] = C.HISTOGRAM_BUCKETS_S):
         self.bounds = tuple(bounds)
@@ -66,19 +71,27 @@ class LatencyHistogram:
         self.count = 0                        # guarded-by: self._lock
         self.sum_s = 0.0                      # guarded-by: self._lock
         self.max_s = 0.0                      # guarded-by: self._lock
+        # bucket index (len(bounds) = overflow) -> (trace_id, value, ts)
+        self.exemplars: Dict[int, Tuple[str, float, float]] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
 
-    def record(self, seconds: float) -> None:
+    def record(self, seconds: float,
+               trace_id: Optional[str] = None) -> None:
         s = max(float(seconds), 0.0)
         with self._lock:
             self.count += 1
             self.sum_s += s
             self.max_s = max(self.max_s, s)
+            idx = len(self.bounds)
             for i, le in enumerate(self.bounds):
                 if s <= le:
                     self.counts[i] += 1
-                    return
-            self.overflow += 1
+                    idx = i
+                    break
+            else:
+                self.overflow += 1
+            if trace_id:
+                self.exemplars[idx] = (str(trace_id), s, time.time())
 
     def cumulative(self) -> List[Tuple[float, int]]:
         """``[(le, cumulative_count), ..., (inf, total)]`` — the
@@ -96,6 +109,11 @@ class LatencyHistogram:
                 out.append((le, cum))
             out.append((float("inf"), cum + self.overflow))
             return out, self.sum_s, self.count
+
+    def exemplars_snapshot(self) -> Dict[int, Tuple[str, float, float]]:
+        """Bucket-index -> (trace_id, value, unix_ts) under the lock."""
+        with self._lock:
+            return dict(self.exemplars)
 
     # dtpu-lint: holds[self._lock]
     def _percentile(self, q: float) -> float:
@@ -154,8 +172,9 @@ class PhaseStats:
                 h = self._stats[phase] = LatencyHistogram()
             return h
 
-    def record(self, phase: str, seconds: float) -> None:
-        self._hist(phase).record(seconds)
+    def record(self, phase: str, seconds: float,
+               trace_id: Optional[str] = None) -> None:
+        self._hist(phase).record(seconds, trace_id=trace_id)
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -819,6 +838,7 @@ class FlightRecorder:
         # committed trace -> prompt
         self._by_trace: Dict[str, str] = {}     # guarded-by: self._lock
         self.dropped_spans = 0                  # guarded-by: self._lock
+        self.evictions = 0                      # guarded-by: self._lock
 
     # -- span sinks ---------------------------------------------------------
 
@@ -916,6 +936,7 @@ class FlightRecorder:
         the master's fan-out job share the trace and the recorder) — the
         later commit absorbs the earlier record's spans so whichever
         prompt id the client holds resolves to the full tree."""
+        evicted_total = 0
         with self._lock:
             by_id = dict(self._active.pop(trace_id, {}))
             prev_pid = self._by_trace.get(trace_id)
@@ -933,6 +954,10 @@ class FlightRecorder:
             self._jobs[str(prompt_id)] = rec
             self._jobs.move_to_end(str(prompt_id))
             self._by_trace[trace_id] = str(prompt_id)
+            # snapshot for the exporter inside the lock: a late-arrival
+            # add() may mutate rec["spans"] the moment we release
+            export_rec = {k: v for k, v in rec.items() if k != "_ids"}
+            export_rec["spans"] = list(spans)
             while len(self._jobs) > self.max_traces:
                 _, old = self._jobs.popitem(last=False)
                 # only unmap the trace if the mapping still points at the
@@ -942,6 +967,23 @@ class FlightRecorder:
                 if self._by_trace.get(old["trace_id"]) \
                         == old["prompt_id"]:
                     self._by_trace.pop(old["trace_id"], None)
+                self.evictions += 1
+                evicted_total = self.evictions
+        if evicted_total:
+            GLOBAL_COUNTERS.bump("trace_evictions")
+            # no-silent-caps: the ring forgetting history is normal but
+            # must be visible — one line per N, not one per trace
+            if evicted_total % C.TRACE_EVICT_LOG_EVERY == 0:
+                log(f"flight recorder: {evicted_total} committed traces "
+                    f"evicted from the {self.max_traces}-entry ring "
+                    f"(raise {C.TRACE_RING_ENV} or set "
+                    f"{C.TRACE_EXPORT_DIR_ENV} for durable capture)")
+        # durable capture plane (ISSUE 18): committed traces stream to
+        # the capture files; a no-op unless DTPU_TRACE_EXPORT_DIR is set.
+        # This runs on the finalizer/executor threads (never the event
+        # loop) and outside the recorder lock — the exporter has its own.
+        from comfyui_distributed_tpu.utils import trace_export
+        trace_export.on_commit(export_rec)
 
     def get(self, prompt_id: str) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -979,6 +1021,10 @@ class FlightRecorder:
         with self._lock:
             return len(self._jobs)
 
+    def eviction_count(self) -> int:
+        with self._lock:
+            return self.evictions
+
     def reset(self) -> None:
         with self._lock:
             self._active.clear()
@@ -986,6 +1032,7 @@ class FlightRecorder:
             self._jobs.clear()
             self._by_trace.clear()
             self.dropped_spans = 0
+            self.evictions = 0
 
 
 GLOBAL_TRACES = FlightRecorder()
@@ -1037,11 +1084,21 @@ def _render_histogram_family(lines: List[str], family: str, help_text: str,
     lines.append(f"# TYPE {family} histogram")
     for name in sorted(hists):
         base = {label_key: name}
-        buckets, sum_s, count = hists[name].prom_series()
-        for le, cum in buckets:
+        h = hists[name]
+        buckets, sum_s, count = h.prom_series()
+        exemplars = h.exemplars_snapshot()
+        for i, (le, cum) in enumerate(buckets):
             le_s = "+Inf" if le == float("inf") else _prom_num(le)
-            lines.append(f"{family}_bucket"
-                         f"{_prom_labels({**base, 'le': le_s})} {cum}")
+            line = (f"{family}_bucket"
+                    f"{_prom_labels({**base, 'le': le_s})} {cum}")
+            ex = exemplars.get(i)
+            if ex is not None:
+                # OpenMetrics exemplar: the last sample that landed in
+                # THIS (non-cumulative) bucket, linking it to a trace
+                tid, val, ts = ex
+                line += (f' # {{trace_id="{_prom_escape(tid)}"}} '
+                         f"{_prom_num(val)} {round(ts, 3)}")
+            lines.append(line)
         lines.append(f"{family}_sum{_prom_labels(base)} {repr(sum_s)}")
         lines.append(f"{family}_count{_prom_labels(base)} {count}")
 
@@ -1099,6 +1156,12 @@ def prometheus_text(extra: Optional[List[Tuple[str, str, str,
                  "by the flight recorder.")
     lines.append("# TYPE dtpu_trace_ring_size gauge")
     lines.append(f"dtpu_trace_ring_size {GLOBAL_TRACES.size()}")
+
+    lines.append("# HELP dtpu_trace_evictions_total Committed traces "
+                 "pushed out of the flight-recorder ring.")
+    lines.append("# TYPE dtpu_trace_evictions_total counter")
+    lines.append(f"dtpu_trace_evictions_total "
+                 f"{GLOBAL_TRACES.eviction_count()}")
 
     _append_prom_families(lines, extra or [])
     return "\n".join(lines) + "\n"

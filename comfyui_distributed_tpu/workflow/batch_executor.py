@@ -54,6 +54,7 @@ import functools
 import queue as queue_mod
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -747,6 +748,19 @@ class ContinuousBatchExecutor:
         self._bucket_stats: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
         self._active = 0                           # guarded-by: self._lock
         self._tailing = 0                          # guarded-by: self._lock
+        # flight deck (ISSUE 18): step-boundary occupancy timeline ring
+        # + admit-to-first-step latency — the observability face of the
+        # continuous-batching plane, rendered by `cli flightdeck`
+        try:
+            deck_ring = max(1, int(os.environ.get(
+                C.CB_DECK_RING_ENV, C.CB_DECK_RING_DEFAULT)))
+        except ValueError:
+            deck_ring = C.CB_DECK_RING_DEFAULT
+        self._deck: deque = deque(maxlen=deck_ring)  # guarded-by: self._lock
+        self._deck_seq = 0                         # guarded-by: self._lock
+        self._deck_prev = {"admits": 0, "retires": 0,
+                           "preemptions": 0}       # driver thread only
+        self.admit_to_first_step = trace_mod.LatencyHistogram()
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -787,6 +801,8 @@ class ContinuousBatchExecutor:
             stats = dict(self._stats)
             buckets = [dict(v) for v in self._bucket_stats.values()]
             active = self._active
+            deck = [dict(r) for r in self._deck]
+            deck_ring = self._deck.maxlen
         slots_total = self.max_buckets * self.max_slots
         return {
             "enabled": True,
@@ -799,8 +815,33 @@ class ContinuousBatchExecutor:
             "park_enabled": self.park_enabled,
             "parked": self.parked.count(),
             "park_room": self.parked.room(),
+            "deck": deck,
+            "deck_ring": deck_ring,
+            "admit_to_first_step": self.admit_to_first_step.snapshot(),
             **stats,
         }
+
+    def _deck_record(self, bkt: _Bucket) -> None:
+        """One step-boundary occupancy row into the flight-deck ring:
+        busy/parked/free slots plus the admits/retires/preemptions that
+        landed since the previous boundary (driver thread writes; the
+        scrape routes read the ring under the lock)."""
+        parked = self.parked.count()
+        with self._lock:
+            cur = {k: self._stats[k] for k in self._deck_prev}
+            self._deck.append({
+                "seq": self._deck_seq, "t": round(time.time(), 3),
+                "bucket": bkt.sig[:8],
+                "busy": bkt.n_active,
+                "free": max(bkt.capacity - bkt.n_active, 0),
+                "parked": parked,
+                "admits": cur["admits"] - self._deck_prev["admits"],
+                "retires": cur["retires"] - self._deck_prev["retires"],
+                "preemptions": cur["preemptions"]
+                - self._deck_prev["preemptions"],
+            })
+            self._deck_seq += 1
+        self._deck_prev = cur
 
     def _mirror_stats(self) -> None:
         """Driver -> metrics handoff: copy the driver-owned bucket
@@ -1260,6 +1301,7 @@ class ContinuousBatchExecutor:
         if not bkt.slots:
             return
         mark = trace_mod.GLOBAL_RETRACES.mark()
+        first_timers = [s for s in bkt.slots if s.step == 0]
         t0 = time.perf_counter()
         try:
             bkt.step_once()
@@ -1274,8 +1316,18 @@ class ContinuousBatchExecutor:
             self._fail_parked(bkt.sig, e)
             self._mirror_stats()
             return
-        trace_mod.GLOBAL_STAGES.record("cb_step",
-                                       time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        trace_mod.GLOBAL_STAGES.record("cb_step", t1 - t0)
+        # flight deck: admit-to-first-step — the CB admission tail the
+        # queue_wait stage can't see (time parked at the boundary
+        # waiting for a step, not time in the queue)
+        for s in first_timers:
+            wait = max(t1 - s.t_admit, 0.0)
+            sp = s.item.get("span")
+            tid = sp.trace_id if sp is not None else None
+            self.admit_to_first_step.record(wait, trace_id=tid)
+            trace_mod.GLOBAL_STAGES.record("cb_admit_to_first_step",
+                                           wait, trace_id=tid)
         traced = trace_mod.GLOBAL_RETRACES.since(mark).get("traces", 0)
         with self._lock:
             concurrent = self._fallback_busy or self._tailing > 0
@@ -1297,6 +1349,7 @@ class ContinuousBatchExecutor:
             self._publish_previews(bkt)
         if self._retire_cohorts(bkt):
             self._mirror_stats()
+        self._deck_record(bkt)
 
     def _retire_cohorts(self, bkt: _Bucket) -> bool:
         """Hand every finished slot to the decode tail (shared by the

@@ -61,6 +61,9 @@ _MODULE_COST_S = {
     "test_tensor_plane.py": 40,
     "test_pipeline.py": 35,
     "test_observability.py": 30,
+    # capture plane (PR 18): exporter rotation/retention units are
+    # instant; the two ServerState e2e surfaces dominate (~15s total)
+    "test_capture_plane.py": 15,
     "test_attention.py": 35,
     "test_multihost.py": 30,
     "test_checkpoints_canonical.py": 18,

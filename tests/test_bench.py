@@ -232,3 +232,37 @@ class TestPreemptPhaseSurface:
                 "value": 1.0, "unit": "fraction"}
         assert bench.check_regression(fresh, base)["regressed"]
         assert not bench.check_regression(base, dict(base))["regressed"]
+
+
+class TestSloPhaseSurface:
+    """ISSUE 18: the slo phase's CLI/metric/watchdog surface.  The
+    harness itself (armed capture plane vs all-off, burn/exemplar/
+    round-trip invariants) runs in the bench subprocess and
+    tests/test_capture_plane.py; here we pin the cheap contract: the
+    phase parses, names its metric, and carries a throughput
+    tolerance."""
+
+    def _bench(self):
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        import bench
+        return bench
+
+    def test_phase_parses_and_names_metric(self):
+        bench = self._bench()
+        args = bench.parse_args(["--phase", "slo"])
+        assert args.phase == "slo"
+        assert bench.metric_name(args) == \
+            "slo_capture_plane_imgs_per_s_4prompt"
+        assert bench.metric_unit(args) == "imgs/s"
+
+    def test_throughput_tolerance_registered(self):
+        bench = self._bench()
+        assert bench.CHECK_TOLERANCE_PCT[
+            "slo_capture_plane_imgs_per_s_4prompt"] == 15.0
+        fresh = {"metric": "slo_capture_plane_imgs_per_s_4prompt",
+                 "value": 50.0, "unit": "imgs/s"}
+        base = {"metric": "slo_capture_plane_imgs_per_s_4prompt",
+                "value": 75.0, "unit": "imgs/s"}
+        assert bench.check_regression(fresh, base)["regressed"]
+        assert not bench.check_regression(base, dict(base))["regressed"]

@@ -308,6 +308,12 @@ _SAMPLE_RE = re.compile(
     r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
     r" [0-9eE+.\-]+(?: [0-9]+)?$")
 
+# OpenMetrics exemplar suffix (ISSUE 18): `# {label="v",...} value [ts]`
+_EXEMPLAR_RE = re.compile(
+    r"^\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\}"
+    r" [0-9eE+.\-]+(?: [0-9eE+.\-]+)?$")
+
 
 def validate_prometheus(text):
     """Grammar + histogram-invariant check; returns {family: type}."""
@@ -320,6 +326,15 @@ def validate_prometheus(text):
         elif line.startswith("# HELP ") or not line.strip():
             continue
         else:
+            if " # " in line:
+                # exemplar-carrying sample: validate the suffix, then
+                # the base sample; exemplars only ride _bucket series
+                line, exemplar = line.split(" # ", 1)
+                assert _EXEMPLAR_RE.match(exemplar), \
+                    f"bad exemplar: {exemplar!r}"
+                name = re.split(r"[{ ]", line, 1)[0]
+                assert name.endswith("_bucket"), \
+                    f"exemplar on non-bucket series: {line!r}"
             assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
             samples.append(line)
     # every sample belongs to a declared family
