@@ -122,7 +122,7 @@ def parse_args(argv=None):
                    choices=["tensor_plane", "pipeline", "observability",
                             "fault", "telemetry", "failover", "overload",
                             "batching", "reuse", "multimaster",
-                            "tp_serve", "preempt", "slo"],
+                            "tp_serve", "preempt", "slo", "sim"],
                    help="run ONE named software-proxy phase. "
                         "'tensor_plane': repeated 2-image SPMD txt2img on "
                         "the CPU backend reporting host_transfer_mb_per_"
@@ -359,6 +359,8 @@ def metric_name(args):
         return "preempt_batch_completion_under_preemption"
     if getattr(args, "phase", None) == "slo":
         return "slo_capture_plane_imgs_per_s_4prompt"
+    if getattr(args, "phase", None) == "sim":
+        return "sim_calibration_error"
     if args.real_ckpt:
         return (f"real_ckpt_{args.family}_{args.width}x{args.height}_"
                 f"{args.steps}step_sec_per_image")
@@ -390,6 +392,8 @@ def metric_unit(args):
         return "imgs/s"
     if getattr(args, "phase", None) == "slo":
         return "imgs/s"
+    if getattr(args, "phase", None) == "sim":
+        return "rel_err"
     if getattr(args, "phase", None) in ("fault", "failover", "overload",
                                         "tp_serve", "preempt"):
         return "fraction"
@@ -850,7 +854,7 @@ def _artifact_replay(args):
 # process exits nonzero so CI/driver pipelines fail loudly.
 
 # units where a LOWER value is the better one (wall-clock style)
-LOWER_IS_BETTER_UNITS = ("sec/image", "sec/run", "s")
+LOWER_IS_BETTER_UNITS = ("sec/image", "sec/run", "s", "rel_err")
 
 # regression tolerance (percent drop from baseline) per metric; the
 # default absorbs CPU-container scheduler noise on sub-second serving
@@ -872,6 +876,10 @@ CHECK_TOLERANCE_PCT = {
     # preemption must pause work, never shed it: completion is exact
     "preempt_batch_completion_under_preemption": 0.0,
     "slo_capture_plane_imgs_per_s_4prompt": 15.0,
+    # the sim is deterministic: the same fixtures produce the same
+    # calibration error byte for byte, so any increase is a real
+    # fidelity regression (someone changed policy code or the sim)
+    "sim_calibration_error": 0.0,
 }
 
 
@@ -1651,6 +1659,107 @@ def run_slo(args):
                         f"{m['export_stats']['dropped']} trace(s)")
     if problems:
         payload["error"] = {"stage": "slo_invariants",
+                            "detail": "; ".join(problems)}
+    emit(args, payload)
+
+
+def run_sim(args):
+    """``--phase sim``: the traffic twin's fidelity gate (ISSUE 19).
+    The simulator runs the REAL policy code (admission, fair dequeue,
+    leases, hedging, autoscaler, hash ring) on a virtual clock, so it
+    is only trustworthy if it reproduces the benches it claims to
+    model.  Three bars:
+
+    - **calibration** — the committed overload and multimaster scenario
+      fixtures must land within SIM_CALIBRATION_MAX_ERR mean relative
+      error of their measured BENCH artifacts with every ordering bar
+      (paid sheds zero, shed batch-first, p95 class order, one takeover
+      by the computed ring successor) intact;
+    - **determinism** — an identical (seed, scenario) rerun must replay
+      the event log byte for byte (digest equality);
+    - **scale** — the 1000-worker diurnal day (>=100k virtual prompts)
+      must simulate in under 60s of wall clock on one CPU core, drained
+      at completion 1.0 — the 'million-user traffic twin' claim is a
+      throughput claim about the SIMULATOR, so it is measured here.
+
+    Pure stdlib + virtual time: no backend, no sleeps, no sockets."""
+    from comfyui_distributed_tpu.sim import calibrate, fleet
+    from comfyui_distributed_tpu.sim import scenario as sc_mod
+    here = os.path.dirname(os.path.abspath(__file__))
+    scen_dir = os.path.join(here, "benchmarks", "scenarios")
+    problems = []
+    scores = {}
+    for kind, scn, art_name in (
+            ("overload", "overload_r09.json",
+             "BENCH_overload_r09.json"),
+            ("multimaster", "multimaster_r14.json",
+             "BENCH_multimaster_r14.json")):
+        with open(os.path.join(here, art_name)) as f:
+            artifact = json.load(f)
+        path = os.path.join(scen_dir, scn)
+        s1 = fleet.run_scenario(sc_mod.load_scenario(path))
+        s2 = fleet.run_scenario(sc_mod.load_scenario(path))
+        if s1["log_digest"] != s2["log_digest"]:
+            problems.append(
+                f"{kind}: nondeterministic — rerun digest "
+                f"{s2['log_digest'][:12]} != {s1['log_digest'][:12]}")
+        scores[kind] = calibrate.SCORERS[kind](s1, artifact)
+        log(f"sim {kind}: calibration_error="
+            f"{scores[kind]['calibration_error']} "
+            f"bars_failed={scores[kind]['bars_failed']} "
+            f"events={s1['events']}")
+    comb = calibrate.combine(scores)
+    if not comb["ok"]:
+        problems.append(
+            f"calibration {comb['calibration_error']} over the "
+            f"{comb['max_allowed']} gate or an ordering bar failed: "
+            + "; ".join(
+                f"{k}: err={v['mean_rel_err']} "
+                f"bars_failed={v['bars_failed']}"
+                for k, v in scores.items()))
+    t0 = time.time()
+    big = fleet.run_scenario(sc_mod.load_scenario(
+        os.path.join(scen_dir, "diurnal_1k.json")))
+    scale_wall = round(time.time() - t0, 2)
+    log(f"sim scale: {big['admitted_total']} prompts / "
+        f"{big['events']} events in {scale_wall}s wall "
+        f"(completion {big['completion_rate']}, "
+        f"drained={big['drained']})")
+    if big["admitted_total"] < 100_000:
+        problems.append(f"scale run admitted {big['admitted_total']} "
+                        f"< 100000 virtual prompts")
+    if big["completion_rate"] != 1.0 or not big["drained"]:
+        problems.append(f"scale run completion "
+                        f"{big['completion_rate']} drained="
+                        f"{big['drained']} (want 1.0, drained)")
+    if scale_wall >= 60.0:
+        problems.append(f"scale run took {scale_wall}s wall "
+                        f"(bar: < 60s for a 1000-worker virtual day)")
+    payload = {
+        "metric": metric_name(args),
+        "value": comb["calibration_error"],
+        "unit": metric_unit(args),
+        "vs_baseline": 1.0,
+        "max_allowed": comb["max_allowed"],
+        "fixtures": {k: {"calibration_error": v["calibration_error"],
+                         "mean_rel_err": v["mean_rel_err"],
+                         "bars": v["bars"],
+                         "quantities": v["quantities"]}
+                     for k, v in scores.items()},
+        "scale": {
+            "scenario": "diurnal_1k",
+            "virtual_prompts": big["admitted_total"],
+            "events": big["events"],
+            "wall_s": scale_wall,
+            "events_per_s": round(big["events"] / scale_wall, 1)
+            if scale_wall else None,
+            "completion_rate": big["completion_rate"],
+            "drained": big["drained"],
+            "log_digest": big["log_digest"],
+        },
+    }
+    if problems:
+        payload["error"] = {"stage": "sim_invariants",
                             "detail": "; ".join(problems)}
     emit(args, payload)
 
@@ -4721,6 +4830,15 @@ def run_suite(args):
         sl = _phase_subprocess("slo", extra=("--check",))
         if sl is not None:
             payload_b["stages"]["slo"] = sl
+        # sim watchdog stage: the traffic twin's fidelity gate —
+        # calibration against the committed overload/multimaster
+        # artifacts (within SIM_CALIBRATION_MAX_ERR with every
+        # ordering bar intact), byte-identical determinism, and the
+        # 1000-worker virtual-day scale bar (<60s wall); --check flags
+        # any calibration drift against the prior BENCH artifact
+        sm = _phase_subprocess("sim", extra=("--check",))
+        if sm is not None:
+            payload_b["stages"]["sim"] = sm
         emit(args, payload_b)
     finally:
         try:
@@ -5165,6 +5283,8 @@ def main():
             run_preempt(args)
         elif args.phase == "slo":
             run_slo(args)
+        elif args.phase == "sim":
+            run_sim(args)
         elif args.real_ckpt:
             run_real_ckpt(args)
         elif args.multiproc_sweep:

@@ -414,3 +414,4 @@ from comfyui_distributed_tpu.analysis import rules_async  # noqa: E402,F401
 from comfyui_distributed_tpu.analysis import rules_lockset  # noqa: E402,F401
 from comfyui_distributed_tpu.analysis import rules_spine  # noqa: E402,F401
 from comfyui_distributed_tpu.analysis import rules_registry  # noqa: E402,F401
+from comfyui_distributed_tpu.analysis import rules_sim  # noqa: E402,F401

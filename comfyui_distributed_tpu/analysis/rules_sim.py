@@ -1,0 +1,88 @@
+"""Rule family 6: virtual-time discipline in the traffic twin.
+
+The simulator's whole claim (ISSUE 19) is that a (seed, scenario) pair
+fully determines the event log.  One ``time.time()`` call smuggles the
+host's wall clock into a virtual world; one ``random.random()`` call
+draws from process-global state that any import can perturb; one
+``jax`` import drags in a backend whose initialization is neither
+needed nor deterministic.  All three break replay silently — the run
+still *works*, it just stops being a twin — so the ban is a lint gate,
+not a convention:
+
+- ``sim-virtual-time-discipline`` — no file under
+  ``comfyui_distributed_tpu/sim/`` may import ``time`` or ``random``,
+  call ``time.*`` / ``random.*`` through any module alias, or import
+  ``jax`` (or any ``jax.*`` submodule).  Clocks come from the engine's
+  :class:`~..sim.engine.VirtualClock`; randomness comes from the
+  scenario-seeded :class:`~..utils.clock.Rng` forks.
+
+This rule is NEVER baselined: there is no audited-benign wall-clock
+read inside a deterministic simulator (``tests/test_analysis.py``
+asserts the baseline holds zero entries for it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from comfyui_distributed_tpu.analysis.engine import (
+    PACKAGE_DIR, Project, Violation, call_name, iter_scoped, rule,
+    scope_qualname)
+
+_RULE = "sim-virtual-time-discipline"
+_SIM_PREFIX = f"{PACKAGE_DIR}/sim/"
+
+# modules whose import (or attribute call) is wall-clock / global-state
+# leakage inside the sim package
+_BANNED_MODULES = ("time", "random")
+
+
+def _banned_import(node: ast.AST) -> str:
+    """The offending module name, or '' if the import is fine."""
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            top = alias.name.split(".")[0]
+            if top in _BANNED_MODULES or top == "jax":
+                return alias.name
+    elif isinstance(node, ast.ImportFrom):
+        if node.level:
+            return ""          # relative: stays inside the package
+        top = (node.module or "").split(".")[0]
+        if top in _BANNED_MODULES or top == "jax":
+            return node.module or top
+    return ""
+
+
+@rule(_RULE)
+def check_sim_virtual_time(project: Project) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in project.python_files():
+        if not sf.path.startswith(_SIM_PREFIX):
+            continue
+        for child, stack in iter_scoped(sf.tree):
+            if isinstance(child, (ast.Import, ast.ImportFrom)):
+                mod = _banned_import(child)
+                if mod:
+                    why = ("jax initializes a backend the sim neither "
+                           "needs nor controls"
+                           if mod.split(".")[0] == "jax" else
+                           f"'{mod}' is wall-clock/global-state — use "
+                           f"the engine's VirtualClock / the scenario-"
+                           f"seeded Rng forks")
+                    out.append(Violation(
+                        _RULE, sf.path, child.lineno,
+                        f"sim/ imports '{mod}': {why}",
+                        scope=scope_qualname(stack)))
+            elif isinstance(child, ast.Call):
+                cn = call_name(child)
+                parts = cn.split(".")
+                if len(parts) >= 2 and parts[-2] in _BANNED_MODULES:
+                    out.append(Violation(
+                        _RULE, sf.path, child.lineno,
+                        f"sim/ calls '{cn}': virtual time and seeded "
+                        f"Rng forks only — a wall-clock read or a "
+                        f"global random draw breaks (seed, scenario) "
+                        f"determinism",
+                        scope=scope_qualname(stack)))
+    return out

@@ -752,6 +752,84 @@ def cmd_lint(args) -> int:
     return 0
 
 
+def _sim_brief(summary) -> None:
+    """The human-readable tail of a sim run (the full dict is --json)."""
+    print(f"scenario {summary['name']} seed={summary['seed']}: "
+          f"{summary['events']} events over "
+          f"{summary['virtual_duration_s']}s virtual "
+          f"({'drained' if summary['drained'] else 'WEDGED'})")
+    print(f"  admitted {summary['admitted_total']}  "
+          f"completed {summary['completed_total']}  "
+          f"shed {summary['shed_total']}  "
+          f"completion {summary['completion_rate']}")
+    for cls, row in (summary.get("per_class") or {}).items():
+        print(f"  {cls:6s} admitted={row['admitted']:>6d} "
+              f"shed={row['shed_rate'] + row['shed_overload']:>5d} "
+              f"p50={row['p50_s']:>8.3f}s p95={row['p95_s']:>8.3f}s")
+    au = summary.get("autoscale")
+    if au:
+        print(f"  autoscale ups={au['scale_ups']} "
+              f"downs={au['scale_downs']} flaps={au['flaps']}")
+    tk = summary.get("takeover")
+    if tk:
+        print(f"  takeover x{tk['takeovers']} -> {tk['successor']} "
+              f"epoch={tk['ring_epoch']}")
+    print(f"  log digest {summary['log_digest']}")
+
+
+def cmd_sim(args) -> int:
+    """Traffic twin (ISSUE 19): run the real policy code — admission,
+    fair dequeue, leases, hedging, autoscaler, hash ring — against a
+    virtual clock.  Deterministic: same (seed, scenario) is the same
+    event log, byte for byte."""
+    from comfyui_distributed_tpu.sim import fleet
+    from comfyui_distributed_tpu.sim import replay as replay_mod
+    from comfyui_distributed_tpu.sim import scenario as sc_mod
+    from comfyui_distributed_tpu.sim import sweep as sweep_mod
+    if args.mode == "sweep":
+        with open(args.source, "r", encoding="utf-8") as f:
+            spec = json.load(f)
+        values = sweep_mod.parse_values(args.values)
+        if not values:
+            print("--values parsed to nothing", file=sys.stderr)
+            return 2
+        results = sweep_mod.run_sweep(spec, args.param, values)
+        if args.json:
+            print(json.dumps(results, indent=1))
+        else:
+            print(sweep_mod.format_table(results))
+        return 0
+    if args.mode == "replay":
+        base = None
+        if args.base:
+            with open(args.base, "r", encoding="utf-8") as f:
+                base = json.load(f)
+        spec, stats = replay_mod.build_replay_spec(args.source,
+                                                   base=base)
+        if not spec["arrivals"]:
+            print(f"no replayable records under {args.source} "
+                  f"(skipped {stats['skipped_lines']} line(s), "
+                  f"{stats['skipped_records']} record(s))",
+                  file=sys.stderr)
+            return 1
+        summary = fleet.run_scenario(sc_mod.from_dict(spec))
+        summary["replay"] = stats
+        if args.json:
+            print(json.dumps(summary, indent=1))
+        else:
+            print(f"replayed {stats['records']} capture record(s) "
+                  f"({stats['skipped_lines']} torn/unknown line(s) "
+                  f"skipped) over {stats['window_s']}s")
+            _sim_brief(summary)
+        return 0
+    summary = fleet.run_scenario(sc_mod.load_scenario(args.source))
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        _sim_brief(summary)
+    return 0 if summary["drained"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="comfyui_distributed_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -937,6 +1015,42 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="raw JSON batching block instead of the table")
     p.set_defaults(fn=cmd_flightdeck)
+
+    p = sub.add_parser("sim", help="traffic twin: deterministic fleet "
+                                   "simulation running the real policy "
+                                   "code on a virtual clock")
+    simsub = p.add_subparsers(dest="mode", required=True)
+
+    sp = simsub.add_parser("run", help="run one scenario JSON")
+    sp.add_argument("source", metavar="SCENARIO",
+                    help="scenario spec (see benchmarks/scenarios/)")
+    sp.add_argument("--json", action="store_true",
+                    help="full summary dict instead of the brief")
+    sp.set_defaults(fn=cmd_sim, mode="run")
+
+    sp = simsub.add_parser("sweep", help="vary one dotted knob across "
+                                         "values, tabulate outcomes")
+    sp.add_argument("source", metavar="SCENARIO")
+    sp.add_argument("--param", required=True, metavar="DOTTED",
+                    help="knob path, e.g. admission.shed.batch or "
+                         "traffic.0.rate")
+    sp.add_argument("--values", required=True, metavar="V1,V2,...",
+                    help="comma-separated values (JSON tokens ok)")
+    sp.add_argument("--json", action="store_true",
+                    help="per-value summaries instead of the table")
+    sp.set_defaults(fn=cmd_sim, mode="sweep")
+
+    sp = simsub.add_parser("replay", help="replay a capture directory "
+                                          "(utils/trace_export "
+                                          "segments) as the arrival "
+                                          "stream")
+    sp.add_argument("source", metavar="CAPTURE_DIR",
+                    help="directory of trace-export segment files")
+    sp.add_argument("--base", default=None, metavar="SCENARIO",
+                    help="scenario JSON supplying the fleet/policy "
+                         "side (capture supplies arrivals)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_sim, mode="replay")
 
     args = ap.parse_args(argv)
     return args.fn(args)

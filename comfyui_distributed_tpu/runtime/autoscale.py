@@ -45,6 +45,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from comfyui_distributed_tpu.utils import clock as clock_mod
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.logging import debug_log, log
@@ -104,7 +105,11 @@ class FleetAutoscaler:
                  shard: Optional[Any] = None,
                  parked_backlog_fn: Optional[Callable[[], int]] = None,
                  slo_burn_fn: Optional[Callable[[], Optional[float]]]
-                 = None):
+                 = None,
+                 clock: Optional[Any] = None):
+        # clock seam (ISSUE 19): cooldowns, drain deadlines and decision
+        # timestamps run off this; the wall default is the old behavior
+        self._clock = clock if clock is not None else clock_mod.WALL
         self.registry = registry
         self.queue_depth_fn = queue_depth_fn
         self.util_fn = util_fn
@@ -262,7 +267,8 @@ class FleetAutoscaler:
     def _record(self, action: str, reason: str, now: float,
                 signal: Dict[str, Any],
                 worker_id: Optional[str] = None) -> None:
-        entry = {"t": time.time(), "action": action, "reason": reason,
+        entry = {"t": self._clock.time(), "action": action,
+                 "reason": reason,
                  "worker_id": worker_id,
                  "queue_per_participant": round(
                      signal.get("queue_per_participant", 0.0), 3),
@@ -302,7 +308,7 @@ class FleetAutoscaler:
         """One reconciliation step (thread-free — tests drive this
         directly with a fake clock).  Returns the sample + the action
         taken ("up"/"down"/"retire_done"/None)."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.monotonic() if now is None else now
         signal = self.fleet_signal()
         # finish in-flight retirements first (their drain is async)
         action = self._reap_retiring(now)

@@ -37,6 +37,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from comfyui_distributed_tpu.utils import clock as clock_mod
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.logging import debug_log, log
@@ -127,7 +128,11 @@ class ClusterRegistry:
     buffer + counters) when the computed state changes."""
 
     def __init__(self, lease_s: Optional[float] = None,
-                 suspect_probes: Optional[int] = None):
+                 suspect_probes: Optional[int] = None,
+                 clock: Optional[Any] = None):
+        # clock seam (ISSUE 19): lease expiry and transition timestamps
+        # run off this; the wall default preserves the pre-seam behavior
+        self._clock = clock if clock is not None else clock_mod.WALL
         if lease_s is None:
             try:
                 lease_s = float(os.environ.get(C.LEASE_ENV,
@@ -160,7 +165,7 @@ class ClusterRegistry:
         first probe so a configured-but-never-started worker is never
         reported healthy."""
         wid = str(worker_id)
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             rec = self._workers.get(wid)
             if rec is None:
@@ -190,7 +195,7 @@ class ClusterRegistry:
         """Health-poller feed: a successful probe renews the lease, a
         failed one advances the suspect counter."""
         wid = str(worker_id)
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             rec = self._workers.get(wid)
             if rec is None:
@@ -214,7 +219,7 @@ class ClusterRegistry:
         path's positional ``worker_N`` labels must not pollute the
         registry with phantom entries."""
         wid = str(worker_id)
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             rec = self._workers.get(wid)
             if rec is None:
@@ -237,12 +242,12 @@ class ClusterRegistry:
             if rec is None:
                 return
             rec["resources"] = dict(snapshot)
-            rec["resources_at"] = time.monotonic()
+            rec["resources_at"] = self._clock.monotonic()
 
     def resource_snapshots(self) -> Dict[str, Dict[str, Any]]:
         """Latest retained resource snapshot per worker with its age
         and the worker's address/state — the federation merge input."""
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             out = {}
             for wid, rec in self._workers.items():
@@ -279,7 +284,7 @@ class ClusterRegistry:
         dispatcher stops handing it new work.  Returns False for
         unknown ids."""
         wid = str(worker_id)
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             rec = self._workers.get(wid)
             if rec is None:
@@ -317,7 +322,7 @@ class ClusterRegistry:
             rec["state"] = new
             self._transitions.append(
                 {"worker_id": wid, "from": old, "to": new,
-                 "t": time.time()})
+                 "t": self._clock.time()})
             trace_mod.GLOBAL_COUNTERS.bump(f"cluster_{new}_transitions")
             (log if new in (SUSPECT, DEAD) else debug_log)(
                 f"cluster: worker {wid} {old} -> {new}")
@@ -326,7 +331,7 @@ class ClusterRegistry:
     def state(self, worker_id: str) -> str:
         """Effective state now; UNKNOWN for unregistered ids."""
         wid = str(worker_id)
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             rec = self._workers.get(wid)
             if rec is None:
@@ -334,13 +339,13 @@ class ClusterRegistry:
             return self._refresh_locked(wid, rec, now)
 
     def healthy_ids(self) -> List[str]:
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             return [wid for wid, rec in self._workers.items()
                     if self._refresh_locked(wid, rec, now) == HEALTHY]
 
     def snapshot(self) -> Dict[str, Any]:
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             workers = {}
             for wid, rec in self._workers.items():
@@ -376,7 +381,10 @@ class WorkLedger:
     units can be reassigned (locally) or redispatched (to a healthy
     HTTP worker via the orchestrator's registered callback)."""
 
-    def __init__(self) -> None:
+    def __init__(self, clock: Optional[Any] = None) -> None:
+        # clock seam (ISSUE 19): job ages, the latency EMA and the
+        # hedge-overdue bars run off this; wall default = old behavior
+        self._clock = clock if clock is not None else clock_mod.WALL
         self._lock = threading.Lock()
         self._jobs: Dict[str, Dict[str, Any]] = {}      # guarded-by: self._lock
         self._redispatch: Dict[str, Callable] = {}      # guarded-by: self._lock
@@ -446,7 +454,7 @@ class WorkLedger:
     def create_job(self, job_id: str, owners: Dict[Any, str],
                    kind: str = "tile") -> None:
         jid = str(job_id)
-        now = time.monotonic()
+        now = self._clock.monotonic()
         preloaded = []
         with self._lock:
             # consume the recovered state under the lock (it used to be
@@ -535,9 +543,9 @@ class WorkLedger:
                 "hedged_units": job["hedged"],
                 "recovered": bool(job.get("recovered")),
                 "preloaded_units": len(job.get("preloaded") or ()),
-                "duration_s": round(time.monotonic() - job["created_at"],
+                "duration_s": round(self._clock.monotonic() - job["created_at"],
                                     4),
-                "finished_at": time.time(),
+                "finished_at": self._clock.time(),
             }
             self._completed.append(summary)
         self._wal_append("job_finish", job=jid)
@@ -567,7 +575,7 @@ class WorkLedger:
         recovered master blends this unit from disk instead of
         re-refining it; a crash between spill and append leaves an
         orphan payload that replay ignores."""
-        now = time.monotonic()
+        now = self._clock.monotonic()
         status = self._check_in_locked(job_id, unit, worker_id, now)
         if status == "dup":
             return False
@@ -803,7 +811,7 @@ class WorkLedger:
         min_pct = hedge_pct() if min_progress_pct is None \
             else min_progress_pct
         min_wait = hedge_min_wait() if min_wait_s is None else min_wait_s
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             job = self._jobs.get(str(job_id))
             if job is None or job["latency_ema"] is None:
@@ -945,13 +953,13 @@ class WorkLedger:
                     "done_units": done,
                     "slo_deadline_remaining_s": (
                         None if dl is None
-                        else round(dl - time.monotonic(), 3)),
+                        else round(dl - self._clock.monotonic(), 3)),
                     "reassigned_units": job["reassigned"],
                     "hedged_units": job["hedged"],
                     "latency_estimate_s": (
                         None if job["latency_ema"] is None
                         else round(job["latency_ema"], 4)),
-                    "age_s": round(time.monotonic() - job["created_at"],
+                    "age_s": round(self._clock.monotonic() - job["created_at"],
                                    3),
                 }
             return {"active_jobs": active,

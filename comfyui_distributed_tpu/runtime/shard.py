@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from comfyui_distributed_tpu.utils import clock as clock_mod
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.utils.logging import debug_log, log
@@ -147,7 +148,11 @@ class ShardManager:
                  wal_root: Optional[str] = None,
                  vnodes: Optional[int] = None,
                  gossip_s: Optional[float] = None,
-                 start_threads: bool = True):
+                 start_threads: bool = True,
+                 clock: Optional[Any] = None):
+        # clock seam (ISSUE 19): peer-gossip liveness ages and takeover
+        # timestamps run off this; wall default = pre-seam behavior
+        self._clock = clock if clock is not None else clock_mod.WALL
         self.id = str(shard_id)
         self.wal_root = wal_root
         self._state = state
@@ -240,7 +245,7 @@ class ShardManager:
         """Generate a prompt id THIS shard owns (bounded rejection
         sampling over a disambiguating suffix), so a directly-submitted
         prompt with no router hint never needs a forward hop."""
-        base = f"p_{int(time.time() * 1000)}_{next(counter)}"
+        base = f"p_{int(self._clock.time() * 1000)}_{next(counter)}"
         if self.is_mine(base):
             return base
         for k in range(256):
@@ -274,7 +279,7 @@ class ShardManager:
         epoch replaces our membership; at equal epochs each side keeps
         its own (they started identical and only absorb bumps them)."""
         peer = str(payload.get("from", ""))
-        now = time.monotonic()
+        now = self._clock.monotonic()
         changed = None
         with self._lock:
             if peer and peer != self.id:
@@ -678,7 +683,7 @@ class ShardManager:
                     "ring_epoch": ring_epoch,
                     "resumed_prompts": resumed,
                     "recovered_jobs": len(replayed.jobs),
-                    "at": time.time(),
+                    "at": self._clock.time(),
                 }
                 if failed_reenq:
                     self._pending_reenqueue[dead_id] = failed_reenq
@@ -718,14 +723,14 @@ class ShardManager:
     def peer_queue_depth(self) -> int:
         """Sum of the peers' last-gossiped queue depths — the merged
         half of the autoscaler's federated signal."""
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             return sum(q for sid, q in self._peer_queue.items()
                        if now - self._peer_seen.get(sid, 0)
                        <= self.peer_down_s)
 
     def live_peer_masters(self) -> int:
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             return sum(1 for sid in self._members
                        if sid != self.id
@@ -747,7 +752,7 @@ class ShardManager:
             return self._ring.owner(C.AUTOSCALE_ACTUATOR_KEY) == self.id
 
     def snapshot(self) -> Dict[str, Any]:
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._lock:
             peers = {
                 sid: {

@@ -1,0 +1,26 @@
+"""Traffic twin (ISSUE 19): a deterministic discrete-event simulator
+that runs the REAL serving-policy code — AdmissionController + token
+buckets, ``pop_fair_group`` stride scheduling, FleetAutoscaler,
+WorkLedger hedging/reassignment, ClusterRegistry leases, HashRing
+membership — against a virtual clock and virtual compute.
+
+No code forks: the policy objects are the production classes, driven
+through the ISSUE 19 ``clock=`` seam.  Service times come from fitted
+latency models (parametric or telemetry-histogram-shaped), faults go
+through the seeded chaos-spec schema, and traffic is either generated
+(Poisson / diurnal / burst / tenant-mix scenario JSON) or replayed
+from PR 18 capture segments.
+
+Virtual-time discipline: nothing in this package may call ``time.*``
+or ``random.*`` directly, or import ``jax`` — the injected
+``Clock``/``Rng`` (``utils/clock.py``) are the only sources of time
+and randomness.  The ``sim-virtual-time-discipline`` dtpu-lint rule
+enforces this and is never baselined.
+"""
+
+from comfyui_distributed_tpu.sim.engine import Engine, VirtualClock
+from comfyui_distributed_tpu.sim.fleet import FleetSim, run_scenario
+from comfyui_distributed_tpu.sim.scenario import Scenario, load_scenario
+
+__all__ = ["Engine", "VirtualClock", "FleetSim", "run_scenario",
+           "Scenario", "load_scenario"]

@@ -1,0 +1,149 @@
+"""Discrete-event core: virtual clock + ordered event heap.
+
+The engine is the only thing in the simulator that advances time.
+Events are ``(time, seq, fn)`` heap entries — ``seq`` is a global
+insertion counter, so two events at the same virtual instant fire in
+schedule order and a run is a pure function of (scenario, seed).  The
+event *log* is the determinism witness: every line is appended to a
+rolling SHA-256 (plus a bounded tail for humans), and the acceptance
+test asserts two runs of the same (seed, scenario) produce identical
+digests AND identical summary metrics.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import os
+from typing import Any, Callable, List, Optional
+
+from comfyui_distributed_tpu.utils import constants as C
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return int(default)
+
+
+class VirtualClock:
+    """The sim half of the ISSUE 19 clock seam.  ``monotonic()`` is the
+    virtual now; ``time()`` offsets it from a fixed epoch so wall-style
+    timestamps in policy snapshots stay plausible; ``sleep()`` raises —
+    inside a discrete-event simulation, blocking IS a bug."""
+
+    def __init__(self, start: float = 0.0,
+                 epoch: float = 1_700_000_000.0):
+        self.now = float(start)
+        self.epoch = float(epoch)
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def time(self) -> float:
+        return self.epoch + self.now
+
+    def sleep(self, seconds: float) -> None:
+        raise RuntimeError(
+            "virtual time never sleeps: schedule an event instead")
+
+    def advance_to(self, t: float) -> None:
+        if t < self.now - 1e-9:
+            raise RuntimeError(
+                f"virtual clock would run backwards: {t} < {self.now}")
+        self.now = max(self.now, float(t))
+
+
+class Engine:
+    """Event heap over a :class:`VirtualClock`.
+
+    ``max_events`` (default :data:`constants.SIM_MAX_EVENTS_DEFAULT`,
+    override via ``DTPU_SIM_MAX_EVENTS``) is a runaway backstop — a
+    mis-built scenario that self-schedules forever dies loudly instead
+    of spinning a CPU core silently."""
+
+    def __init__(self, clock: Optional[VirtualClock] = None,
+                 max_events: Optional[int] = None,
+                 log_tail: Optional[int] = None):
+        self.clock = clock if clock is not None else VirtualClock()
+        self.max_events = _env_int(C.SIM_MAX_EVENTS_ENV,
+                                   C.SIM_MAX_EVENTS_DEFAULT) \
+            if max_events is None else int(max_events)
+        self._heap: List[Any] = []
+        self._seq = 0
+        self.events_processed = 0
+        # determinism witness: rolling digest over every log line; the
+        # bounded tail is for humans/CLI only
+        self._digest = hashlib.sha256()
+        self.log_lines = 0
+        self._tail_cap = _env_int(C.SIM_EVENT_LOG_TAIL_ENV,
+                                  C.SIM_EVENT_LOG_TAIL_DEFAULT) \
+            if log_tail is None else int(log_tail)
+        self.tail: List[str] = []
+
+    # -- scheduling -----------------------------------------------------------
+
+    def at(self, t: float, fn: Callable[[], None]) -> None:
+        """Schedule ``fn`` at absolute virtual time ``t`` (clamped to
+        now — an event can never be scheduled into the past)."""
+        self._seq += 1
+        heapq.heappush(self._heap,
+                       (max(float(t), self.clock.now), self._seq, fn))
+
+    def after(self, delay: float, fn: Callable[[], None]) -> None:
+        self.at(self.clock.now + max(float(delay), 0.0), fn)
+
+    # -- event log ------------------------------------------------------------
+
+    def log(self, line: str) -> None:
+        stamped = f"{self.clock.now:.6f} {line}"
+        self._digest.update(stamped.encode())
+        self._digest.update(b"\n")
+        self.log_lines += 1
+        if len(self.tail) < self._tail_cap:
+            self.tail.append(stamped)
+
+    def log_digest(self) -> str:
+        return self._digest.hexdigest()
+
+    # -- run loop -------------------------------------------------------------
+
+    def run(self, until: Optional[float] = None) -> float:
+        """Drain the heap in (time, seq) order; returns the final
+        virtual time.  ``until`` stops the run once the next event lies
+        beyond it (the clock parks AT ``until``)."""
+        while self._heap:
+            t, _, fn = self._heap[0]
+            if until is not None and t > until:
+                self.clock.advance_to(until)
+                return self.clock.now
+            heapq.heappop(self._heap)
+            self.clock.advance_to(t)
+            self.events_processed += 1
+            if self.events_processed > self.max_events:
+                raise RuntimeError(
+                    f"sim exceeded max_events={self.max_events} "
+                    f"(runaway scenario? raise {C.SIM_MAX_EVENTS_ENV})")
+            fn()
+        return self.clock.now
+
+    def pending(self) -> int:
+        return len(self._heap)
+
+
+def percentile(sorted_values: List[float], q: float) -> float:
+    """Deterministic linear-interpolation percentile over an already-
+    sorted sample list (numpy-free: the sim must not touch jax/numpy,
+    and metrics must be bit-stable across platforms)."""
+    if not sorted_values:
+        return 0.0
+    if len(sorted_values) == 1:
+        return float(sorted_values[0])
+    qq = min(max(float(q), 0.0), 1.0)
+    pos = qq * (len(sorted_values) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_values) - 1)
+    frac = pos - lo
+    return float(sorted_values[lo] * (1.0 - frac)
+                 + sorted_values[hi] * frac)

@@ -1,0 +1,110 @@
+"""Fault models: the chaos spec, virtualized, plus timed kills.
+
+:class:`SimChaos` parses the SAME spec schema ``utils/chaos.py`` does
+(``drop_pct``/``delay_pct``/``delay_s``/``http_5xx_pct``/
+``corrupt_pct``/``freeze_heartbeats``/``routes``) and reproduces
+:meth:`ChaosMonkey._roll`'s exact probability semantics
+(``uniform(0, 100) < pct``), but draws from an injected
+:class:`utils.clock.Rng` stream instead of the process-global monkey —
+no threads, no global state, and a sim chaos roll can never perturb a
+concurrently-running live harness.
+
+In the simulator the faults act on *message edges* rather than HTTP:
+
+- a completion report (``tile_complete``-shaped edge) can be dropped
+  (the sender retries after a backoff, re-rolling the dice — exercising
+  the same idempotent-redelivery path the live ledger dedupes),
+  delayed, 5xx'd (treated as a drop+retry, which is what
+  ``post_form_with_retry`` does), or corrupted (the delivery fails
+  decode and is retried clean, exactly one extra round-trip);
+- a heartbeat edge can be frozen per worker id (the lease expires while
+  the virtual worker keeps computing — the suspect/rehome edge the
+  overload bench measures).
+
+Timed kills (``faults: [{"t": ..., "kind": "kill_worker"|"kill_master",
+"id": ...}]``) are scheduled by the fleet as ordinary events; they are
+listed here only for schema documentation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils.clock import Rng
+
+
+class SimChaos:
+    """Seeded, thread-free twin of :class:`utils.chaos.ChaosMonkey`."""
+
+    def __init__(self, spec: Dict[str, Any], rng: Rng):
+        spec = dict(spec or {})
+        self.spec = spec
+        self.drop_pct = float(spec.get("drop_pct", 0) or 0)
+        self.delay_pct = float(spec.get("delay_pct", 0) or 0)
+        self.delay_s = float(spec.get("delay_s",
+                                      C.CHAOS_DELAY_DEFAULT_S) or 0)
+        self.http_5xx_pct = float(spec.get("http_5xx_pct", 0) or 0)
+        self.corrupt_pct = float(spec.get("corrupt_pct", 0) or 0)
+        fh = spec.get("freeze_heartbeats", False)
+        self.freeze_all = fh is True
+        self.freeze_ids = set(str(x) for x in fh) \
+            if isinstance(fh, (list, tuple, set)) else set()
+        self.routes = tuple(spec.get("routes")
+                            or C.CHAOS_DEFAULT_ROUTES)
+        self._rng = rng
+        self.counters: Dict[str, int] = {}
+
+    @property
+    def active(self) -> bool:
+        return bool(self.drop_pct or self.delay_pct or self.http_5xx_pct
+                    or self.corrupt_pct or self.freeze_all
+                    or self.freeze_ids)
+
+    def _roll(self, pct: float) -> bool:
+        if pct <= 0:
+            return False
+        return self._rng.uniform(0, 100) < pct
+
+    def _bump(self, kind: str) -> None:
+        self.counters[kind] = self.counters.get(kind, 0) + 1
+
+    def route_matches(self, route: str) -> bool:
+        return any(route.startswith(r) for r in self.routes)
+
+    def message_edge(self, route: str) -> Tuple[str, float]:
+        """Fate of one message send on ``route``: ``("ok", delay_s)``,
+        ``("drop", 0)`` (client-edge drop OR server 5xx OR payload
+        corruption — all three resolve to retry-after-backoff for a sim
+        message), with injected delay folded into the ok path.  Rolls
+        happen in the live monkey's edge order (client drop, client
+        delay, server 5xx, server delay, corrupt) so a spec's fault mix
+        lands with the same relative frequencies."""
+        if not self.active or not self.route_matches(route):
+            return "ok", 0.0
+        if self._roll(self.drop_pct):
+            self._bump("drop")
+            return "drop", 0.0
+        delay = 0.0
+        if self._roll(self.delay_pct):
+            self._bump("delay")
+            delay += max(self.delay_s, 0.0)
+        if self._roll(self.http_5xx_pct):
+            self._bump("5xx")
+            return "drop", 0.0
+        if self._roll(self.delay_pct):
+            self._bump("delay")
+            delay += max(self.delay_s, 0.0)
+        if self._roll(self.corrupt_pct):
+            self._bump("corrupt")
+            return "drop", 0.0
+        return "ok", delay
+
+    def heartbeat_frozen(self, worker_id: str) -> bool:
+        if self.freeze_all or str(worker_id) in self.freeze_ids:
+            self._bump("heartbeat_frozen")
+            return True
+        return False
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"active": self.active, "injected": dict(self.counters)}

@@ -1,0 +1,139 @@
+"""Trace replay: PR 18 capture segments as a sim arrival stream.
+
+The capture plane (``utils/trace_export.py``) already records the
+record half of the record/replay plan: one JSONL line per committed
+prompt with ``finished_at``, ``duration_s`` and the full span forest.
+This adapter is the replay half — it walks a capture directory and
+turns each record into one explicit arrival
+``{"t", "cls", "client", "service_s"}`` for
+:class:`sim.scenario.Scenario.arrivals`:
+
+- **arrival instant** — ``finished_at - duration_s`` (the recorder's
+  ``duration_s`` spans submission to finalize), normalized so the
+  earliest valid record is t=0.  Torn lines, unknown schemas and
+  records missing timestamps are *counted and skipped* — they never
+  shift the normalization origin or the relative spacing of the
+  surviving arrivals, so a crashed segment tail cannot drift the
+  virtual clock of a replay.
+- **class / client** — the root span's ``tenant`` and ``client_id``
+  attrs (the server stamps both at admission); absent attrs fall back
+  to the admission default class.
+- **service floor** — the summed duration of worker-attributed spans
+  (the compute the fleet actually did, minus queue wait), so a replay
+  against a *smaller* virtual fleet shows the queueing that capacity
+  loss would have caused.  Records with no worker spans leave
+  ``service_s`` unset and draw from the scenario's service model.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from comfyui_distributed_tpu.utils import constants as C
+from comfyui_distributed_tpu.utils import trace_export as tx
+
+
+def _root_attrs(rec: Dict[str, Any]) -> Dict[str, Any]:
+    spans = list(rec.get("spans") or [])
+    root_id = rec.get("root_span_id")
+    for s in spans:
+        if root_id is not None and s.get("span_id") == root_id:
+            return dict(s.get("attrs") or {})
+    return dict(spans[0].get("attrs") or {}) if spans else {}
+
+
+def _service_floor(rec: Dict[str, Any]) -> Optional[float]:
+    total = 0.0
+    seen = False
+    for s in rec.get("spans") or []:
+        attrs = s.get("attrs") or {}
+        if attrs.get("worker"):
+            try:
+                total += max(float(s.get("duration_s") or 0.0), 0.0)
+                seen = True
+            except (TypeError, ValueError):
+                continue
+    if not seen:
+        return None
+    dur = rec.get("duration_s")
+    try:
+        if dur is not None:
+            total = min(total, max(float(dur), 0.0))
+    except (TypeError, ValueError):
+        pass
+    return round(total, 6) if total > 0 else None
+
+
+def load_arrivals(dir_path: str) -> Tuple[List[Dict[str, Any]],
+                                          Dict[str, Any]]:
+    """All replayable arrivals in a capture dir plus adapter stats
+    (``records``, ``skipped_lines``, ``skipped_records``,
+    ``window_s``).  Arrivals come back sorted by t with t=0 at the
+    earliest valid record."""
+    raw: List[Tuple[float, Dict[str, Any]]] = []
+    skipped_lines = 0
+    skipped_records = 0
+    for path in tx.segment_paths(dir_path):
+        try:
+            fh = open(path, "rb")
+        except OSError:
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    skipped_lines += 1      # torn tail after a crash
+                    continue
+                if not isinstance(rec, dict) \
+                        or rec.get("schema") != tx.SCHEMA_VERSION:
+                    skipped_lines += 1      # unknown / future schema
+                    continue
+                try:
+                    fin = float(rec["finished_at"])
+                    dur = max(float(rec.get("duration_s") or 0.0), 0.0)
+                except (KeyError, TypeError, ValueError):
+                    skipped_records += 1
+                    continue
+                attrs = _root_attrs(rec)
+                cls = str(attrs.get("tenant")
+                          or C.TENANT_DEFAULT_CLASS)
+                client = str(attrs.get("client_id")
+                             or f"{cls}-replay")
+                item: Dict[str, Any] = {"cls": cls, "client": client,
+                                        "pid": rec.get("prompt_id")}
+                svc = _service_floor(rec)
+                if svc is not None:
+                    item["service_s"] = svc
+                raw.append((fin - dur, item))
+    raw.sort(key=lambda p: p[0])
+    t0 = raw[0][0] if raw else 0.0
+    arrivals = [{"t": round(t - t0, 6), **item} for t, item in raw]
+    stats = {
+        "records": len(arrivals),
+        "skipped_lines": skipped_lines,
+        "skipped_records": skipped_records,
+        "window_s": round(arrivals[-1]["t"], 6) if arrivals else 0.0,
+    }
+    return arrivals, stats
+
+
+def build_replay_spec(dir_path: str,
+                      base: Optional[Dict[str, Any]] = None
+                      ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """A raw scenario dict replaying a capture dir.  ``base`` (an
+    optional scenario dict, e.g. a fixture) supplies the fleet /
+    policy side; the capture supplies arrivals and the window."""
+    arrivals, stats = load_arrivals(dir_path)
+    spec: Dict[str, Any] = dict(base or {})
+    spec.setdefault("name", "replay")
+    spec.setdefault("seed", 0)
+    spec.setdefault("service", {"model": "exp", "mean_s": 0.2})
+    spec["arrivals"] = arrivals
+    spec["duration_s"] = max(stats["window_s"], 1e-6)
+    spec.pop("traffic", None)
+    return spec, stats

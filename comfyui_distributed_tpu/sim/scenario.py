@@ -1,0 +1,149 @@
+"""Scenario specs: the JSON description of one simulated world.
+
+A scenario names everything a run needs — traffic (per-class arrival
+models), the admission/fair-dequeue config, fleet size + autoscaler
+thresholds, lease/hedge policy, a seeded chaos spec (the SAME schema
+``utils/chaos.py`` parses for the live harness), timed faults (worker
+and master kills) and an optional multimaster ring — so a (seed,
+scenario) pair fully determines the event log.  The bench fixtures
+under ``benchmarks/scenarios/`` encode the exact measured
+configurations of the overload and multimaster benches; the calibration
+gate runs those, not re-derived copies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from comfyui_distributed_tpu.utils import constants as C
+
+# keys a fault entry may carry: {"t": 3.5, "kind": "kill_worker",
+# "id": "w1"} (also "kill_master")
+FAULT_KINDS = ("kill_worker", "kill_master")
+
+
+@dataclasses.dataclass
+class TrafficSpec:
+    """One tenant class's arrival model.
+
+    ``pattern``: ``poisson`` (constant-rate), ``burst`` (constant base
+    with a ``burst_x`` multiplier inside [``burst_at``, ``burst_at`` +
+    ``burst_dur_s``]), or ``diurnal`` (sinusoidal modulation with
+    ``period_s`` and relative ``amplitude`` in [0, 1]).  ``clients``
+    spreads arrivals round-robin over that many client ids, which is
+    what the per-client token buckets key on."""
+    cls: str
+    rate: float                      # mean arrivals/s over the window
+    pattern: str = "poisson"
+    clients: int = 4
+    burst_at: float = 0.0
+    burst_x: float = 1.0
+    burst_dur_s: float = 0.0
+    period_s: float = 86_400.0
+    amplitude: float = 0.0
+    slo_s: Optional[float] = None    # stamp admitted jobs' deadlines
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    seed: int
+    duration_s: float                # arrival window (virtual seconds)
+    traffic: List[TrafficSpec]
+    service: Dict[str, Any]
+    workers: int = 2
+    masters: List[str] = dataclasses.field(default_factory=list)
+    vnodes: Optional[int] = None
+    admission: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    cluster: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    hedge: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    autoscale: Optional[Dict[str, Any]] = None
+    chaos: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    faults: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    # scheduled one-off jobs riding alongside the streams — the
+    # overload bench's churn act (tiled fan-out work) in fixture form:
+    # [{"t": 2.0, "cls": "paid", "units": 9, "slo_s": 60.0}]
+    jobs: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    # replay mode: explicit arrivals [{t, cls, client, service_s}]
+    # (built by sim/replay.py) override the generative traffic specs
+    arrivals: Optional[List[Dict[str, Any]]] = None
+    # hard stop: virtual seconds after the arrival window the drain may
+    # run before the scenario is declared wedged
+    drain_limit_s: float = 600.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = dataclasses.asdict(self)
+        return out
+
+
+def _traffic_from(raw: Dict[str, Any]) -> TrafficSpec:
+    known = {f.name for f in dataclasses.fields(TrafficSpec)}
+    return TrafficSpec(**{k: v for k, v in raw.items() if k in known})
+
+
+def from_dict(spec: Dict[str, Any]) -> Scenario:
+    """Build a scenario from parsed JSON.  Unknown top-level keys are
+    ignored (fixtures may carry provenance comments like
+    ``_fitted_from``); ``DTPU_SIM_SEED`` overrides the spec's seed."""
+    seed = spec.get("seed", 0)
+    env_seed = os.environ.get(C.SIM_SEED_ENV, "")
+    if env_seed:
+        try:
+            seed = int(env_seed)
+        except ValueError:
+            pass
+    traffic = [_traffic_from(t) for t in spec.get("traffic", [])]
+    for f in spec.get("faults", []):
+        if f.get("kind") not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind: {f.get('kind')!r} "
+                             f"(known: {', '.join(FAULT_KINDS)})")
+    return Scenario(
+        name=str(spec.get("name", "scenario")),
+        seed=int(seed),
+        duration_s=float(spec.get("duration_s", 10.0)),
+        traffic=traffic,
+        service=dict(spec.get("service", {"model": "exp",
+                                          "mean_s": 0.2})),
+        workers=int(spec.get("workers", 2)),
+        masters=[str(m) for m in spec.get("masters", [])],
+        vnodes=spec.get("vnodes"),
+        admission=dict(spec.get("admission", {})),
+        cluster=dict(spec.get("cluster", {})),
+        hedge=dict(spec.get("hedge", {})),
+        autoscale=(dict(spec["autoscale"])
+                   if spec.get("autoscale") else None),
+        chaos=dict(spec.get("chaos", {})),
+        faults=[dict(f) for f in spec.get("faults", [])],
+        jobs=[dict(j) for j in spec.get("jobs", [])],
+        arrivals=([dict(a) for a in spec["arrivals"]]
+                  if spec.get("arrivals") else None),
+        drain_limit_s=float(spec.get("drain_limit_s", 600.0)),
+    )
+
+
+def load_scenario(path: str) -> Scenario:
+    with open(path, "r", encoding="utf-8") as f:
+        return from_dict(json.load(f))
+
+
+def set_by_path(spec: Dict[str, Any], dotted: str, value: Any) -> None:
+    """``set_by_path(d, "admission.shed.batch", 0.5)`` — the sweep
+    driver's parameter injection into a raw scenario dict.  For a
+    ``traffic`` index use ``traffic.1.rate``."""
+    parts = dotted.split(".")
+    cur: Any = spec
+    for p in parts[:-1]:
+        if isinstance(cur, list):
+            cur = cur[int(p)]
+        else:
+            cur = cur.setdefault(p, {})
+    last = parts[-1]
+    if isinstance(cur, list):
+        cur[int(last)] = value
+    else:
+        cur[last] = value

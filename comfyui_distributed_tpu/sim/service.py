@@ -1,0 +1,84 @@
+"""Virtual compute: service-time models.
+
+Three shapes, all drawing from an injected Rng stream:
+
+- ``fixed``: ``mean_s`` with optional ``jitter_pct`` uniform noise;
+- ``exp``: exponential with mean ``mean_s`` (the M/M/c workhorse);
+- ``lognormal``: ``mean_s`` + ``sigma`` (heavy-tailed — what real
+  denoise latencies look like once host IO and compile jitter fold in);
+- ``histogram``: inverse-CDF sampling over fitted latency buckets in
+  the telemetry plane's shape — ``buckets`` is
+  ``[[le_seconds, count], ...]`` exactly as
+  ``utils.trace.LatencyHistogram.cumulative()`` reports (cumulative
+  counts, +Inf tail interpolating toward ``max_s``), so a live
+  histogram snapshot drops straight in as a service model.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Tuple
+
+from comfyui_distributed_tpu.utils.clock import Rng
+
+
+class ServiceModel:
+    def __init__(self, spec: Dict[str, Any], rng: Rng):
+        self.model = str(spec.get("model", "exp"))
+        self.mean_s = max(float(spec.get("mean_s", 0.2)), 1e-6)
+        self.sigma = float(spec.get("sigma", 0.5))
+        self.jitter_pct = float(spec.get("jitter_pct", 0.0))
+        self.min_s = max(float(spec.get("min_s", 0.0)), 0.0)
+        self._rng = rng
+        self._buckets: List[Tuple[float, int]] = []
+        self._max_s = float(spec.get("max_s", 0.0))
+        if self.model == "histogram":
+            raw = spec.get("buckets") or []
+            self._buckets = [(float(le), int(n)) for le, n in raw]
+            if not self._buckets or self._buckets[-1][1] <= 0:
+                raise ValueError(
+                    "histogram service model needs cumulative "
+                    "[[le, count], ...] buckets with a positive total")
+
+    def sample(self) -> float:
+        if self.model == "fixed":
+            s = self.mean_s
+            if self.jitter_pct > 0:
+                j = self.jitter_pct / 100.0
+                s *= self._rng.uniform(1.0 - j, 1.0 + j)
+        elif self.model == "lognormal":
+            # parameterized by the DESIRED mean: mu = ln(mean) - s^2/2
+            mu = math.log(self.mean_s) - 0.5 * self.sigma * self.sigma
+            s = self._rng.lognormvariate(mu, self.sigma)
+        elif self.model == "histogram":
+            s = self._sample_histogram()
+        else:  # "exp"
+            s = self._rng.expovariate(1.0 / self.mean_s)
+        return max(s, self.min_s)
+
+    def _sample_histogram(self) -> float:
+        total = self._buckets[-1][1]
+        target = self._rng.random() * total
+        prev_le, prev_cum = 0.0, 0
+        for le, cum in self._buckets:
+            if target <= cum and cum > prev_cum:
+                frac = (target - prev_cum) / (cum - prev_cum)
+                hi = le
+                if math.isinf(le):
+                    # +Inf tail: interpolate toward the observed max
+                    hi = max(self._max_s, prev_le * 2.0, 1e-6)
+                return prev_le + (hi - prev_le) * frac
+            prev_le, prev_cum = le, cum
+        return prev_le
+
+
+def fit_mean_from_artifact(completed_total: int, load_wall_s: float,
+                           avg_workers: float) -> float:
+    """Calibration fit (sim/calibrate.py): the mean per-prompt service
+    time implied by a measured bench artifact — total worker-seconds of
+    capacity over the run divided by prompts completed.  This is the
+    only *measured* (non-config) number a calibration scenario needs;
+    everything else in the fixture is the bench's exact configuration."""
+    if completed_total <= 0 or load_wall_s <= 0 or avg_workers <= 0:
+        raise ValueError("artifact numbers must be positive")
+    return load_wall_s * avg_workers / completed_total

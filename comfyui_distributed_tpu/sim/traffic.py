@@ -1,0 +1,65 @@
+"""Generative traffic models: per-class arrival streams.
+
+Each class draws from its own forked :class:`utils.clock.Rng` stream,
+so the paid process is unperturbed by adding a batch class to the
+scenario.  Non-homogeneous patterns (diurnal, burst) use Lewis-Shedler
+thinning over the pattern's peak rate — the standard exact sampler for
+a non-homogeneous Poisson process, and deterministic under a seeded
+Rng.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Tuple
+
+from comfyui_distributed_tpu.sim.scenario import TrafficSpec
+from comfyui_distributed_tpu.utils.clock import Rng
+
+
+def rate_at(spec: TrafficSpec, t: float) -> float:
+    """Instantaneous arrival rate of this class at virtual time t."""
+    if spec.pattern == "burst":
+        if spec.burst_at <= t < spec.burst_at + spec.burst_dur_s:
+            return spec.rate * max(spec.burst_x, 0.0)
+        return spec.rate
+    if spec.pattern == "diurnal":
+        amp = min(max(spec.amplitude, 0.0), 1.0)
+        phase = 2.0 * math.pi * (t / max(spec.period_s, 1e-9))
+        # peak mid-window: rate * (1 + amp) at period/4
+        return spec.rate * (1.0 + amp * math.sin(phase))
+    return spec.rate
+
+
+def peak_rate(spec: TrafficSpec) -> float:
+    if spec.pattern == "burst":
+        return spec.rate * max(max(spec.burst_x, 0.0), 1.0)
+    if spec.pattern == "diurnal":
+        return spec.rate * (1.0 + min(max(spec.amplitude, 0.0), 1.0))
+    return spec.rate
+
+
+def arrivals(spec: TrafficSpec, rng: Rng,
+             duration_s: float) -> Iterator[Tuple[float, str]]:
+    """Yield ``(t, client_id)`` arrival instants in increasing t over
+    [0, duration).  Thinning: candidates at the pattern's peak rate,
+    each kept with probability rate(t)/peak."""
+    peak = peak_rate(spec)
+    if peak <= 0.0 or duration_s <= 0.0:
+        return
+    n_clients = max(int(spec.clients), 1)
+    t = 0.0
+    k = 0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= duration_s:
+            return
+        keep = rate_at(spec, t) / peak
+        # the thinning draw happens for EVERY candidate (uniform
+        # pattern included) so switching pattern never reshuffles the
+        # downstream client assignment stream
+        u = rng.random()
+        if u <= keep:
+            client = f"{spec.cls}-c{k % n_clients}"
+            k += 1
+            yield t, client

@@ -1,0 +1,84 @@
+"""Injectable time + randomness seam (ISSUE 19).
+
+The control-plane policy classes (AdmissionController, ClusterRegistry,
+WorkLedger, FleetAutoscaler, ShardManager) historically called
+``time.time()``/``time.monotonic()`` directly, which welded every
+policy decision — lease expiry, hedge overdue bars, autoscaler
+cooldowns, token-bucket refill — to the wall clock.  The traffic-twin
+simulator (``comfyui_distributed_tpu/sim``) runs the SAME policy code
+against a virtual clock, so each of those classes now accepts a
+``clock`` and defaults to :data:`WALL` — production behavior is
+bit-identical (the default delegates straight to ``time``), while the
+sim injects ``sim.engine.VirtualClock``.
+
+``Rng`` is the randomness half of the seam: a thin named wrapper over
+``random.Random`` that the sim injects everywhere it needs a draw.
+Code under ``sim/`` may never call ``time.*`` or ``random.*`` directly
+(the ``sim-virtual-time-discipline`` lint rule enforces it) — both
+live HERE, outside the simulator, precisely so the rule can stay
+absolute.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+class Clock:
+    """Wall-clock implementation of the clock seam: ``time()`` (epoch
+    seconds, for human-facing timestamps), ``monotonic()`` (interval
+    arithmetic: leases, cooldowns, overdue bars) and ``sleep()``."""
+
+    def time(self) -> float:
+        return time.time()
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class Rng:
+    """Named random source: a seeded ``random.Random`` behind a stable
+    surface, injectable wherever stochastic behavior must be
+    reproducible.  ``fork(label)`` derives an independent stream from a
+    string label, so subsystems (traffic per class, chaos, service
+    times) draw from decoupled sequences — adding a draw in one never
+    perturbs another."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._r = random.Random(self.seed)
+
+    def fork(self, label: str) -> "Rng":
+        # deterministic child seed from (parent seed, label); Python's
+        # string hash is salted per process, so derive from the bytes
+        child = self.seed
+        for b in str(label).encode():
+            child = (child * 1000003 + b) & 0x7FFFFFFF
+        return Rng(child)
+
+    def random(self) -> float:
+        return self._r.random()
+
+    def uniform(self, a: float, b: float) -> float:
+        return self._r.uniform(a, b)
+
+    def expovariate(self, lambd: float) -> float:
+        return self._r.expovariate(lambd)
+
+    def lognormvariate(self, mu: float, sigma: float) -> float:
+        return self._r.lognormvariate(mu, sigma)
+
+    def randint(self, a: int, b: int) -> int:
+        return self._r.randint(a, b)
+
+    def choice(self, seq):
+        return self._r.choice(seq)
+
+
+# the module-level default every seamed class falls back to: one shared
+# stateless instance, so `clock or WALL` never allocates on the hot path
+WALL = Clock()

@@ -543,6 +543,18 @@ WARMUP_ENV = "DTPU_WARMUP"                  # serve-startup warmup JSON
 MODELS_DIR_ENV = "DTPU_MODELS"              # cli --models-dir default
 MASTER_PID_ENV_NAME = "DTPU_MASTER_PID"     # spawned-worker master watch
 
+# --- traffic twin / deterministic fleet simulator (sim/, ISSUE 19) -----------
+# The discrete-event simulator that runs the real policy code against a
+# virtual clock.  All three knobs are read by sim/ at point of use:
+SIM_SEED_ENV = "DTPU_SIM_SEED"              # overrides the scenario's seed
+SIM_MAX_EVENTS_ENV = "DTPU_SIM_MAX_EVENTS"  # runaway-scenario backstop
+SIM_MAX_EVENTS_DEFAULT = 5_000_000
+SIM_EVENT_LOG_TAIL_ENV = "DTPU_SIM_EVENT_LOG_TAIL"  # human-readable tail
+SIM_EVENT_LOG_TAIL_DEFAULT = 256            # full log feeds the digest
+# calibration gate (bench.py --phase sim): max tolerated mean relative
+# error between simulated and measured bench artifacts
+SIM_CALIBRATION_MAX_ERR = 0.15
+
 # --- span-attribute whitelist (dtpu-lint span-attr) ---------------------------
 # The vocabulary contract between span producers and the trace readers
 # (`cli trace`, the flight-recorder consumers): every literal attr key

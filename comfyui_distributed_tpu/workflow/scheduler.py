@@ -40,6 +40,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
+from comfyui_distributed_tpu.utils import clock as clock_mod
 from comfyui_distributed_tpu.utils import constants as C
 from comfyui_distributed_tpu.utils import trace as trace_mod
 from comfyui_distributed_tpu.workflow.graph import Graph, parse_workflow
@@ -191,7 +192,11 @@ class AdmissionController:
                  shed: Optional[Dict[str, float]] = None,
                  rate: Optional[Dict[str, float]] = None,
                  burst: Optional[Dict[str, float]] = None,
-                 default_class: Optional[str] = None):
+                 default_class: Optional[str] = None,
+                 clock: Optional[Any] = None):
+        # clock seam (ISSUE 19): drives the token buckets' refill; the
+        # wall default makes this exactly the pre-seam behavior
+        self._clock = clock if clock is not None else clock_mod.WALL
         self.classes = C.TENANT_CLASSES
         self.weights = weights if weights is not None else _parse_kv_floats(
             os.environ.get(C.TENANT_WEIGHTS_ENV), C.TENANT_WEIGHTS_DEFAULT)
@@ -270,7 +275,7 @@ class AdmissionController:
                 self._buckets.move_to_end(key)
                 while len(self._buckets) > C.TENANT_BUCKETS_KEPT:
                     self._buckets.popitem(last=False)
-                if not bucket.try_take():
+                if not bucket.try_take(now=self._clock.monotonic()):
                     self.counters[tenant]["shed_rate"] += 1
                     trace_mod.GLOBAL_COUNTERS.bump(
                         f"tenant_shed_rate_{tenant}")

@@ -51,16 +51,16 @@ import pytest  # noqa: E402
 # modules default cheap.  Stable sort keeps intra-module order (and
 # module/class fixture scoping) intact.
 _MODULE_COST_S = {
-    "test_models.py": 790,
+    "test_models.py": 778,
     "test_parallel.py": 300,
-    "test_workflow.py": 210,
+    "test_workflow.py": 160,
     "test_controlnet.py": 190,
     "test_train.py": 100,
     "test_samplers.py": 60,
     "test_server.py": 45,
-    "test_tensor_plane.py": 40,
-    "test_pipeline.py": 35,
-    "test_observability.py": 30,
+    "test_tensor_plane.py": 28,
+    "test_pipeline.py": 21,
+    "test_observability.py": 19,
     # capture plane (PR 18): exporter rotation/retention units are
     # instant; the two ServerState e2e surfaces dominate (~15s total)
     "test_capture_plane.py": 15,
@@ -95,12 +95,15 @@ _MODULE_COST_S = {
     # cross-request compute reuse (PR 13): non-slow share only (the
     # tile-tier bit-exactness proofs and the SSE client-gone acceptance
     # are slow-marked in-file, ~25s together with real refine runs)
-    "test_reuse.py": 25,
+    "test_reuse.py": 15,
     # multi-master shard plane (PR 14): ring math + exec-less loopback
     # forwarding/takeover/router tests run in ~1s; the 3-master
     # kill-mid-upscale acceptance (~32s, real fan-out + absorb) is
     # slow-marked in-file
     "test_shard.py": 2,
+    # traffic twin (PR 19): pure-Python discrete-event sim on a virtual
+    # clock — no device work, whole module <2s
+    "test_sim.py": 1,
 }
 
 
@@ -276,6 +279,29 @@ _SLOW_TESTS = {
     "test_clear_memory_invalidates_and_reports",
     "test_models.py::TestComponentLoadersRound5::"
     "test_clip_loader_op_virtual_and_type_validation",
+    # PR 19 gate-budget replenish (satellite): the nine priciest
+    # non-slow tests from the 2026-08-07 top-10 (15.5s..9.7s, ~108s
+    # total) move out of the timed window to restore >=100s headroom
+    # for the traffic-twin suite and future growth — each is a deep
+    # variant whose cheaper siblings keep the behavior covered (the
+    # tenth, torch-parity clip[sd15], stays: its [tiny] sibling is
+    # already slow-marked and the gate should keep one clip parity
+    # proof); the full `pytest tests/` (README) still runs them all
+    "test_workflow.py::TestRepoFixtures::test_txt2img_fixture",
+    "test_pipeline.py::TestCoalescedExecution::"
+    "test_burst_coalesces_into_one_dispatch",
+    "test_workflow.py::TestImg2ImgE2E::test_side_branch_not_fanned_out",
+    "test_models.py::TestBf16WeightStorage::"
+    "test_flag_casts_unet_clip_not_vae",
+    "test_tensor_plane.py::TestWorkflowTensorPlane::"
+    "test_spine_moves_zero_host_bytes",
+    "test_observability.py::TestServerTraceLifecycle::"
+    "test_single_prompt_trace_tree",
+    "test_workflow.py::TestRegionalTiledUpscale::"
+    "test_regional_spmd_matches_single_device_oracle",
+    "test_reuse.py::TestKillSwitch::test_cache_off_means_zero_lookups",
+    "test_workflow.py::TestPngWorkflowMetadata::"
+    "test_save_image_embeds_and_round_trips",
 }
 
 

@@ -904,8 +904,89 @@ class TestBaselineHygiene:
                                          "wal-fencing",
                                          "route-contract",
                                          "tp-spec-discipline",
-                                         "cb-slot-state-discipline")]
+                                         "cb-slot-state-discipline",
+                                         "sim-virtual-time-discipline")]
         assert bad == []
+
+
+class TestSimVirtualTimeRule:
+    """ISSUE 19 satellite: the traffic twin's determinism ban.  Files
+    under sim/ may never read the wall clock, draw from the global
+    random module, or import jax — the rule is structural (one leak
+    silently un-twins every replay) and NEVER baselined."""
+
+    RULE = "sim-virtual-time-discipline"
+    SIM_FLEET = f"{PKG}/sim/fleet.py"
+
+    def _mutated(self, anchor, inject):
+        full = os.path.join(ROOT, *self.SIM_FLEET.split("/"))
+        with open(full, "r", encoding="utf-8") as f:
+            src = f.read()
+        assert anchor in src, "mutation anchor missing in sim/fleet.py"
+        return src.replace(anchor, anchor + inject, 1)
+
+    def test_shipped_sim_is_clean_and_never_baselined(self):
+        rep = engine.run_lint(root=ROOT, rules=[self.RULE])
+        assert rep.new == [], "\n".join(v.format() for v in rep.new)
+        assert not any(k.startswith(f"{self.RULE}|")
+                       for k in engine.load_baseline(ROOT))
+
+    def test_seeded_time_import_caught(self):
+        src = self._mutated("from __future__ import annotations\n",
+                            "import time\n")
+        rep = engine.run_lint(root=ROOT, rules=[self.RULE],
+                              overrides={self.SIM_FLEET: src})
+        assert any(v.rule == self.RULE and "'time'" in v.message
+                   and v.path == self.SIM_FLEET for v in rep.new)
+
+    def test_seeded_wall_clock_call_caught(self):
+        # no import needed: a smuggled module object (or a stale
+        # global) still reads the wall clock — the call site is banned
+        src = self._mutated(
+            "        now = self.vclock.now\n",
+            "        _leak = time.monotonic()  # type: ignore\n")
+        rep = engine.run_lint(root=ROOT, rules=[self.RULE],
+                              overrides={self.SIM_FLEET: src})
+        assert any(v.rule == self.RULE
+                   and "time.monotonic" in v.message for v in rep.new)
+
+    def test_seeded_global_random_caught(self):
+        src = self._mutated(
+            "        end = self.vclock.now + self._service_sample(jid)",
+            "\n        _jitter = random.random()  # type: ignore\n")
+        rep = engine.run_lint(root=ROOT, rules=[self.RULE],
+                              overrides={self.SIM_FLEET: src})
+        assert any(v.rule == self.RULE
+                   and "random.random" in v.message for v in rep.new)
+
+    def test_seeded_jax_import_caught(self):
+        src = self._mutated("from __future__ import annotations\n",
+                            "import jax.numpy as jnp\n")
+        rep = engine.run_lint(root=ROOT, rules=[self.RULE],
+                              overrides={self.SIM_FLEET: src})
+        assert any(v.rule == self.RULE and "jax" in v.message
+                   for v in rep.new)
+
+    def test_package_imports_stay_legal(self):
+        # the sim imports the real policy modules and utils.clock.Rng;
+        # the rule must not flag package-internal imports
+        src = self._mutated(
+            "from comfyui_distributed_tpu.utils.clock import Rng\n",
+            "from comfyui_distributed_tpu.utils import clock\n")
+        rep = engine.run_lint(root=ROOT, rules=[self.RULE],
+                              overrides={self.SIM_FLEET: src})
+        assert rep.new == []
+
+    def test_rule_scoped_to_sim_package(self):
+        # `import time` is everyday code everywhere else in the repo
+        target = f"{PKG}/runtime/autoscale.py"
+        full = os.path.join(ROOT, *target.split("/"))
+        with open(full, "r", encoding="utf-8") as f:
+            src = f.read()
+        rep = engine.run_lint(
+            root=ROOT, rules=[self.RULE],
+            overrides={target: "import time\nimport random\n" + src})
+        assert rep.new == []
 
 
 # =============================================================================
