@@ -530,6 +530,191 @@ def cmd_trace(args) -> int:
     return emit(rec)
 
 
+def cmd_why(args) -> int:
+    """Latency autopsy for ONE job (`cli why <pid>`): the critical-path
+    blame decomposition — every instant of the end-to-end interval
+    attributed to the deepest covering span's category (queue_wait /
+    admission / dispatch / compute / d2h / encode / upload / blend /
+    park), with the uncovered remainder reported honestly as an
+    unattributed gap instead of silently inflating a category.  Reads
+    the live flight recorder, or durable capture files with
+    --export-dir (post-mortem)."""
+    import urllib.error
+    import urllib.request
+    from comfyui_distributed_tpu.utils import trace_analysis
+    from comfyui_distributed_tpu.utils import trace_export
+    if args.export_dir:
+        rec = trace_export.load_trace(args.export_dir,
+                                      prompt_id=args.prompt_id)
+        if rec is None:
+            print(f"no captured trace for {args.prompt_id!r} in "
+                  f"{args.export_dir}", file=sys.stderr)
+            return 1
+    else:
+        try:
+            with urllib.request.urlopen(
+                    f"{args.url}/distributed/trace/{args.prompt_id}",
+                    timeout=10) as r:
+                rec = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except (ValueError, AttributeError):
+                msg = str(e)
+            print(msg, file=sys.stderr)
+            return 1
+    bd = trace_analysis.critical_path(rec)
+    if args.json:
+        print(json.dumps(bd, indent=2))
+        return 0
+    e2e = bd["e2e_s"]
+    print(f"job {bd['prompt_id']}  trace {bd['trace_id']}  "
+          f"e2e={e2e:.3f}s")
+    if e2e <= 0:
+        print("(empty or zero-length trace — nothing to blame)")
+        return 0
+    print(f"{'category':14s} {'seconds':>9s} {'share':>7s}")
+    for cat, secs in sorted(bd["categories"].items(),
+                            key=lambda kv: -kv[1]):
+        print(f"{cat:14s} {secs:>9.3f} {secs / e2e:>6.1%}")
+    print(f"{'(unattributed)':14s} {bd['unattributed_s']:>9.3f} "
+          f"{bd['unattributed_pct'] / 100:>6.1%}")
+    if bd.get("negative_edges"):
+        print(f"! {bd['negative_edges']} negative parent->child edges "
+              "(cross-process clock skew; is DTPU_SKEW_CORRECTION on?)")
+    print("critical path:")
+    for seg in bd["path"]:
+        who = f"  @{seg['worker']}" if seg.get("worker") else ""
+        print(f"  +{seg['start_s']:>8.3f}s {seg['dur_s']:>8.3f}s  "
+              f"{seg['name']} [{seg['category']}]{who}")
+    return 0
+
+
+def _print_analysis_report(report) -> None:
+    """Shared pretty-printer for `cli analyze` (live route and offline
+    capture dirs produce the same report shape)."""
+    print(f"traces analysed: {report.get('n_traces', 0)}  "
+          f"mean unattributed "
+          f"{report.get('unattributed_pct_mean', 0.0):.1f}%  "
+          f"negative_edges={report.get('negative_edges', 0)}")
+    for group_by, groups in sorted(
+            (report.get("profiles") or {}).items()):
+        print(f"by {group_by}:")
+        for key, prof in sorted(groups.items()):
+            cats = "  ".join(
+                f"{c}={v['mean_s']:.3f}s({v['share_pct']:.0f}%)"
+                for c, v in sorted(
+                    prof.get("categories", {}).items(),
+                    key=lambda kv: -kv[1]["mean_s"])
+                if v["mean_s"] > 0)
+            print(f"  {key}: n={prof['n']} "
+                  f"p50={prof['e2e_p50_s']:.3f}s "
+                  f"p95={prof['e2e_p95_s']:.3f}s  {cats}")
+    sc = report.get("stragglers") or {}
+    workers = sc.get("workers") or {}
+    if workers:
+        print(f"straggler scorecard (fleet compute p95 median "
+              f"{sc.get('fleet_median_p95_s', 0.0):.3f}s, "
+              f"threshold {sc.get('threshold_x')}x):")
+        for w, row in sorted(workers.items()):
+            flag = "  STRAGGLER" if row["straggler"] else ""
+            print(f"  {w}: n={row['n_spans']} "
+                  f"p95={row['compute_p95_s']:.3f}s "
+                  f"{row['vs_fleet_median_x']:.2f}x{flag}")
+    hedging = report.get("hedging_latency_ema_s") or {}
+    if hedging:
+        ema = "  ".join(f"{j}={v}" for j, v in sorted(hedging.items()))
+        print(f"ledger hedging EMA (active jobs): {ema}")
+    skews = report.get("skew") or {}
+    if skews:
+        offs = "  ".join(f"{w}={s['offset_s'] * 1e3:+.1f}ms"
+                         for w, s in sorted(skews.items()))
+        print(f"clock skew: {offs}")
+    live = report.get("live") or {}
+    if live.get("armed"):
+        print(f"anomaly plane armed (baseline {live.get('baseline')}): "
+              f"{live.get('anomalies_total', 0)} anomalies over "
+              f"{live.get('traces_analyzed', 0)} traces")
+
+
+def cmd_analyze(args) -> int:
+    """Cross-trace analytics (`cli analyze`): blame profiles grouped by
+    tenant / structural signature / worker plus the per-worker
+    straggler scorecard, over the live ring (GET /distributed/analysis)
+    or durable capture dirs (--export-dir).  --diff A B runs the
+    anomaly-gated regression diff between two capture dirs (permutation
+    significance test; exit 3 when a regression is flagged);
+    --baseline-out writes the profile JSON that arms the live anomaly
+    plane via DTPU_ANALYSIS_BASELINE."""
+    import urllib.request
+    from comfyui_distributed_tpu.utils import trace_analysis
+    from comfyui_distributed_tpu.utils import trace_export
+
+    def offline_breakdowns(dir_path):
+        stats: dict = {}
+        records = list(trace_export.iter_records(dir_path, stats=stats))
+        bds = trace_analysis.collect_breakdowns(records)
+        skipped = stats.get("torn_lines", 0) \
+            + stats.get("unknown_schema", 0)
+        if skipped or stats.get("io_errors"):
+            print(f"loader: {dir_path}: {stats['records']} records, "
+                  f"{stats['torn_lines']} torn lines, "
+                  f"{stats['unknown_schema']} unknown-schema, "
+                  f"{stats['io_errors']} io errors", file=sys.stderr)
+        return bds
+
+    if args.diff:
+        dir_a, dir_b = args.diff
+        diff = trace_analysis.diff_breakdowns(
+            offline_breakdowns(dir_a), offline_breakdowns(dir_b),
+            seed=args.seed)
+        if args.json:
+            print(json.dumps(diff, indent=2))
+        else:
+            print(f"diff {dir_a} -> {dir_b}  "
+                  f"(n={diff['n_a']} vs {diff['n_b']}, "
+                  f"{diff['n_resamples']} resamples)")
+            print(f"{'category':14s} {'mean_a':>9s} {'mean_b':>9s} "
+                  f"{'delta':>8s} {'p':>6s}")
+            for cat, row in diff["categories"].items():
+                mark = "  REGRESSED" if row["flagged"] else (
+                    "  (significant)" if row["significant"] else "")
+                # delta_pct is None when the category was absent (mean
+                # 0) in arm A -- the relative change is unbounded
+                dp = (f"{row['delta_pct']:>+7.1f}%"
+                      if row["delta_pct"] is not None else f"{'new':>8s}")
+                print(f"{cat:14s} {row['mean_a_s']:>9.3f} "
+                      f"{row['mean_b_s']:>9.3f} "
+                      f"{dp} "
+                      f"{row['p_value']:>6.3f}{mark}")
+            print("verdict: " + ("REGRESSED in "
+                                 + ", ".join(diff["flagged"])
+                                 if diff["regressed"] else "clean"))
+        return 3 if diff["regressed"] else 0
+
+    if args.export_dir:
+        records = [bd["_rec"]
+                   for bd in offline_breakdowns(args.export_dir)]
+        report = trace_analysis.analyze_records(records)
+    else:
+        with urllib.request.urlopen(
+                f"{args.url}/distributed/analysis", timeout=10) as r:
+            report = json.loads(r.read())
+    if args.baseline_out:
+        profile = report.get("fleet_profile")
+        if not profile or not profile.get("n"):
+            print("no traces to build a baseline from", file=sys.stderr)
+            return 1
+        trace_analysis.save_baseline(profile, args.baseline_out)
+        print(f"wrote baseline profile ({profile['n']} traces) to "
+              f"{args.baseline_out}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return 0
+    _print_analysis_report(report)
+    return 0
+
+
 def cmd_slo(args) -> int:
     """SLO burn-rate reader: per-tenant-class objectives, fast/slow
     window burn rates and the remaining slow-window error budget — the
@@ -822,11 +1007,21 @@ def cmd_sim(args) -> int:
                   f"skipped) over {stats['window_s']}s")
             _sim_brief(summary)
         return 0
-    summary = fleet.run_scenario(sc_mod.load_scenario(args.source))
+    sc = sc_mod.load_scenario(args.source)
+    if getattr(args, "capture_dir", None):
+        # capture-schema export (ISSUE 20): the sim emits the same
+        # segment files a real master's trace_export plane writes, so
+        # the whole analytics stack runs on synthetic traffic
+        sc.capture_dir = args.capture_dir
+    summary = fleet.run_scenario(sc)
     if args.json:
         print(json.dumps(summary, indent=1))
     else:
         _sim_brief(summary)
+        cap = summary.get("capture")
+        if cap:
+            print(f"  capture: {cap['exported']} trace(s) -> "
+                  f"{cap['dir']}")
     return 0 if summary["drained"] else 1
 
 
@@ -997,6 +1192,41 @@ def main(argv=None) -> int:
                    help="write --perfetto JSON to FILE instead of stdout")
     p.set_defaults(fn=cmd_trace)
 
+    p = sub.add_parser("why", help="latency autopsy for one job: "
+                                   "critical-path blame per category + "
+                                   "the unattributed gap")
+    p.add_argument("prompt_id", help="prompt id to autopsy")
+    p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.add_argument("--export-dir", default=None, metavar="DIR",
+                   help="read durable capture files from DIR instead of "
+                        "a live server (post-mortem)")
+    p.add_argument("--json", action="store_true",
+                   help="raw breakdown dict instead of the blame table")
+    p.set_defaults(fn=cmd_why)
+
+    p = sub.add_parser("analyze", help="cross-trace analytics: blame "
+                                       "profiles by tenant/signature/"
+                                       "worker, straggler scorecard, "
+                                       "regression diffs")
+    p.add_argument("--url", default="http://127.0.0.1:8288")
+    p.add_argument("--export-dir", default=None, metavar="DIR",
+                   help="analyse durable capture files from DIR instead "
+                        "of the live flight-recorder ring")
+    p.add_argument("--diff", nargs=2, default=None,
+                   metavar=("DIR_A", "DIR_B"),
+                   help="regression diff between two capture dirs "
+                        "(baseline A vs candidate B); exit 3 when a "
+                        "significant regression is flagged")
+    p.add_argument("--baseline-out", default=None, metavar="FILE",
+                   help="write the fleet blame profile as the baseline "
+                        "JSON that arms DTPU_ANALYSIS_BASELINE")
+    p.add_argument("--seed", type=int, default=0,
+                   help="resampling seed for the --diff significance "
+                        "test (deterministic)")
+    p.add_argument("--json", action="store_true",
+                   help="raw report dict instead of the tables")
+    p.set_defaults(fn=cmd_analyze)
+
     p = sub.add_parser("slo", help="SLO burn rates: per-tenant objective "
                                    "status over fast/slow windows, "
                                    "remaining error budget")
@@ -1024,6 +1254,10 @@ def main(argv=None) -> int:
     sp = simsub.add_parser("run", help="run one scenario JSON")
     sp.add_argument("source", metavar="SCENARIO",
                     help="scenario spec (see benchmarks/scenarios/)")
+    sp.add_argument("--capture-dir", default=None, metavar="DIR",
+                    help="emit completed sim jobs as capture-schema "
+                         "segment files into DIR (feeds cli analyze / "
+                         "why --export-dir)")
     sp.add_argument("--json", action="store_true",
                     help="full summary dict instead of the brief")
     sp.set_defaults(fn=cmd_sim, mode="run")

@@ -244,6 +244,71 @@ class ClusterRegistry:
             rec["resources"] = dict(snapshot)
             rec["resources_at"] = self._clock.monotonic()
 
+    def update_skew(self, worker_id: str, offset_s: float) -> None:
+        """Feed one clock-offset sample (ISSUE 20): ``master wall clock
+        at receive − worker wall clock at send`` for a heartbeat or
+        registration round trip.  Each sample is the true offset plus a
+        non-negative uplink delay, so the retained estimate is the
+        MINIMUM over a sliding window (NTP's insight: the least-delayed
+        sample is the most truthful).  Only known ids retain — same
+        phantom guard as :meth:`touch`."""
+        wid = str(worker_id)
+        try:
+            offset = float(offset_s)
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            rec = self._workers.get(wid)
+            if rec is None:
+                return
+            samples = rec.get("skew_samples")
+            if samples is None:
+                samples = rec["skew_samples"] = deque(
+                    maxlen=C.SKEW_SAMPLES_KEPT)
+            samples.append(offset)
+            rec["skew_s"] = min(samples)
+            rec["skew_at"] = self._clock.monotonic()
+
+    def skew(self, worker_id: str) -> float:
+        """Current offset estimate to ADD to a worker's wall-clock
+        timestamps to land them on this master's clock; 0.0 when no
+        estimate exists."""
+        with self._lock:
+            rec = self._workers.get(str(worker_id))
+            if rec is None:
+                return 0.0
+            return float(rec.get("skew_s") or 0.0)
+
+    def skew_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-worker skew estimates with sample counts and age — the
+        /distributed/analysis + prom gauge feed."""
+        now = self._clock.monotonic()
+        with self._lock:
+            out = {}
+            for wid, rec in self._workers.items():
+                if rec.get("skew_s") is None:
+                    continue
+                at = rec.get("skew_at")
+                out[wid] = {
+                    "offset_s": round(float(rec["skew_s"]), 6),
+                    "samples": len(rec.get("skew_samples") or ()),
+                    "age_s": (None if at is None
+                              else round(now - at, 3)),
+                }
+            return out
+
+    def reset_skew(self) -> int:
+        """Drop every skew estimate (POST /distributed/metrics/reset);
+        returns how many workers had one."""
+        with self._lock:
+            n = 0
+            for rec in self._workers.values():
+                if rec.pop("skew_s", None) is not None:
+                    n += 1
+                rec.pop("skew_samples", None)
+                rec.pop("skew_at", None)
+            return n
+
     def resource_snapshots(self) -> Dict[str, Dict[str, Any]]:
         """Latest retained resource snapshot per worker with its age
         and the worker's address/state — the federation merge input."""
@@ -1018,6 +1083,13 @@ class HeartbeatSender:
                 payload["resources"] = res_mod.fleet_sample()
         except Exception as e:  # noqa: BLE001 - liveness > telemetry
             debug_log(f"heartbeat resource snapshot failed: {e}")
+        # the beat carries this worker's wall clock (ISSUE 20): the
+        # master turns (its receive time − sent_at) into a per-worker
+        # clock-offset estimate so shipped worker spans become
+        # timestamp-comparable with master spans.  Stamped LAST — the
+        # resource probe above must not inflate the delay baked into
+        # the sample (the master min-filters, but why waste a sample)
+        payload["sent_at"] = time.time()
         req = urllib.request.Request(
             f"{self.master_url}/distributed/heartbeat",
             data=json.dumps(payload).encode(),

@@ -76,6 +76,7 @@ from comfyui_distributed_tpu.utils import net as net_mod
 from comfyui_distributed_tpu.utils import resource as resource_mod
 from comfyui_distributed_tpu.utils import slo as slo_mod
 from comfyui_distributed_tpu.utils import trace as trace_mod
+from comfyui_distributed_tpu.utils import trace_analysis as analysis_mod
 from comfyui_distributed_tpu.utils import trace_export as trace_export_mod
 from comfyui_distributed_tpu.utils.constants import LOG_TAIL_BYTES
 from comfyui_distributed_tpu.utils.image import decode_png, decode_tensor
@@ -1190,6 +1191,15 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                   # fault counters (all zero unarmed)
                                   "chaos": chaos_mod.get_chaos()
                                   .snapshot(),
+                                  # critical-path analytics plane: live
+                                  # anomaly counters vs the armed
+                                  # baseline profile + per-worker clock
+                                  # skew estimates (ISSUE 20)
+                                  "analysis": {
+                                      **analysis_mod.LIVE.snapshot(),
+                                      "skew": state.cluster
+                                          .skew_snapshot(),
+                                  },
                                   # cross-request compute reuse: per-tier
                                   # hit/miss/eviction counters + byte
                                   # residency, and the preview channel's
@@ -1502,6 +1512,22 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                  [({}, exp_stats["retired_segments"])]),
             ])
         extra.extend(state.slo.prom_families())
+        # critical-path analytics plane: anomaly counter (always
+        # present so dashboards can alert on rate>0 the moment a
+        # baseline is armed) + per-worker clock-skew gauges
+        extra.append(
+            ("dtpu_analysis_anomalies_total", "counter",
+             "Per-trace category blame exceeding the armed baseline "
+             "profile's tolerance.",
+             [({}, analysis_mod.anomalies_total())]))
+        skews = state.cluster.skew_snapshot()
+        if skews:
+            extra.append(
+                ("dtpu_clock_skew_seconds", "gauge",
+                 "Estimated worker-clock offset vs this master "
+                 "(min-filtered heartbeat one-way samples).",
+                 [({"worker_id": w}, s["offset_s"])
+                  for w, s in sorted(skews.items())]))
         # current resource gauges (unlabelled = this process); the
         # worker_id-labelled fleet view lives on /cluster/metrics.prom
         extra.extend(resource_mod.resource_prom_families(
@@ -1534,6 +1560,12 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         await asyncio.get_running_loop().run_in_executor(
             None, trace_export_mod.reset_counters)
         cleared["export_counters"] = True
+        # analytics plane: live profiles + anomaly counters + the
+        # per-worker clock-skew estimates (they re-converge from the
+        # next heartbeats) — ISSUE 20 satellite
+        analysis_mod.reset_live()
+        cleared["analysis"] = True
+        cleared["skew_estimates"] = state.cluster.reset_skew()
         if data.get("include_traces"):
             trace_mod.GLOBAL_TRACES.reset()
             cleared["traces"] = True
@@ -1545,6 +1577,27 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         """SLO burn-rate engine snapshot: per-tenant objectives, window
         stats, burn rates and remaining budget (`cli slo` reads this)."""
         return web.json_response(state.slo.evaluate())
+
+    async def analysis_view(request):
+        """Critical-path analytics over the live flight-recorder ring
+        (`cli analyze` reads this): blame profiles grouped by tenant /
+        structural signature / worker, the per-worker straggler
+        scorecard next to the WorkLedger's hedging latency EMAs, the
+        live anomaly plane and clock-skew estimates (ISSUE 20)."""
+        records = trace_mod.GLOBAL_TRACES.records()
+        # pure-CPU span crunching over up to the whole ring — off the
+        # event loop so a deep ring can't stall heartbeats
+        report = await asyncio.get_running_loop().run_in_executor(
+            None, analysis_mod.analyze_records, records)
+        ledger = state.ledger.snapshot()
+        hedging = {jid: j.get("latency_estimate_s")
+                   for jid, j in ledger.get("active_jobs", {}).items()}
+        return web.json_response({
+            **report,
+            "hedging_latency_ema_s": hedging,
+            "live": analysis_mod.LIVE.snapshot(),
+            "skew": state.cluster.skew_snapshot(),
+        })
 
     async def get_trace(request):
         """Flight recorder: one completed job's full span tree."""
@@ -1731,7 +1784,22 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
                                      status=400)
         info = {k: data[k] for k in ("host", "port", "name") if k in data}
         info.setdefault("host", request.remote)
-        return ok(state.cluster.register(str(wid), info=info))
+        out = state.cluster.register(str(wid), info=info)
+        _feed_skew(str(wid), data)
+        return ok({**out, "master_time": time.time()})
+
+    def _feed_skew(wid: str, data: Dict[str, Any]) -> None:
+        """Clock-skew sample off a heartbeat/register body (ISSUE 20):
+        the payload's ``sent_at`` (the worker's wall clock at send) vs
+        this process's wall clock now.  The registry min-filters — the
+        sample with the least uplink delay wins."""
+        sent = data.get("sent_at")
+        if sent is None:
+            return
+        try:
+            state.cluster.update_skew(wid, time.time() - float(sent))
+        except (TypeError, ValueError):
+            pass
 
     async def cluster_heartbeat(request):
         """Lease renewal (runtime/cluster.HeartbeatSender posts here
@@ -1748,7 +1816,10 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         # latest per worker for the federated metrics endpoints
         if isinstance(data.get("resources"), dict):
             state.cluster.update_resources(str(wid), data["resources"])
-        return ok(out)
+        _feed_skew(str(wid), data)
+        # the reply carries this master's wall clock so a future
+        # worker-side refinement can bound the estimate with the RTT
+        return ok({**out, "master_time": time.time()})
 
     async def fleet_info(request):
         """Elastic-fleet plane (ISSUE 9): autoscaler state + decision
@@ -2115,15 +2186,34 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
         trace: merge the peer's shipped spans (final upload only) and
         record the server-side receive as a child of the sender's span
         named in its traceparent header."""
+        # clock-skew correction (ISSUE 20): shipped spans carry the
+        # WORKER's wall clock; shift them onto this master's clock by
+        # the registry's heartbeat-derived offset estimate before they
+        # land in the ring, so cross-process dispatch edges stop going
+        # negative and critical-path network blame isn't fiction
+        offset = 0.0
+        wid = str(attrs.get("worker") or "")
+        if wid and analysis_mod.skew_correction_enabled():
+            offset = state.cluster.skew(wid)
         spans_field = form.get("spans")
         if spans_field:
             try:
-                trace_mod.GLOBAL_TRACES.ingest(json.loads(spans_field))
+                shipped = json.loads(spans_field)
+                if offset and isinstance(shipped, list):
+                    for s in shipped:
+                        if not isinstance(s, dict):
+                            continue
+                        for k in ("start_s", "end_s"):
+                            if isinstance(s.get(k), (int, float)):
+                                s[k] = s[k] + offset
+                trace_mod.GLOBAL_TRACES.ingest(shipped)
             except (ValueError, TypeError) as e:
                 debug_log(f"bad spans field on {name}: {e}")
         tp = trace_mod.parse_traceparent(
             request.headers.get(C.TRACEPARENT_HEADER))
         if tp is not None:
+            if offset:
+                attrs = {**attrs, "skew_ms": round(offset * 1e3, 3)}
             trace_mod.event_span(name, t_recv, time.time(),
                                  trace_id=tp[0], parent_id=tp[1],
                                  attrs=attrs)
@@ -2632,6 +2722,7 @@ def build_app(state: Optional[ServerState] = None) -> web.Application:
     r.add_get("/distributed/traces", list_traces)
     r.add_get("/distributed/trace/{prompt_id}", get_trace)
     r.add_get("/distributed/slo", slo_view)
+    r.add_get("/distributed/analysis", analysis_view)
     r.add_post("/distributed/warmup", warmup)
     r.add_get("/distributed/ring", ring_info)
     r.add_post("/distributed/ring/gossip", ring_gossip)

@@ -190,6 +190,16 @@ class FleetSim:
         self.finished = False
         self.load_wall_s: Optional[float] = None
 
+        # capture-schema export (ISSUE 20): completed sim jobs stream
+        # through the REAL TraceExporter as schema-1 segment files, so
+        # `cli analyze`/`why --export-dir` and the bench's regression
+        # diff run unchanged on synthetic traffic.  Ids are md5 of
+        # (scenario, seed, job) — deterministic, no wall clock.
+        self.capture = None
+        if sc.capture_dir:
+            from comfyui_distributed_tpu.utils import trace_export
+            self.capture = trace_export.TraceExporter(sc.capture_dir)
+
     # -- construction helpers -------------------------------------------------
 
     def _add_worker(self, wid: str) -> SimWorker:
@@ -253,6 +263,8 @@ class FleetSim:
             # wedged (drain limit hit): report the truth, never a fake
             self.load_wall_s = self.vclock.now
             self._bump("wedged")
+        if self.capture is not None:
+            self.capture.close()
         return self.summary()
 
     # -- arrivals -------------------------------------------------------------
@@ -360,7 +372,8 @@ class FleetSim:
         self.jobs[jid] = {"tenant": item["tenant"],
                           "arrival": item["arrival"],
                           "master": m.mid, "item": item,
-                          "units": n_units, "cancelled": False}
+                          "units": n_units, "cancelled": False,
+                          "dispatched_at": self.vclock.now}
         self.open_jobs += 1
         for u in sorted(assign):
             assign[u].fifo.append((jid, u))
@@ -443,7 +456,8 @@ class FleetSim:
                               "master": m.mid,
                               "item": item,
                               "units": n_units,
-                              "cancelled": False}
+                              "cancelled": False,
+                              "dispatched_at": self.vclock.now}
             self.open_jobs += 1
             for u in units:
                 assign[u].fifo.append((jid, u))
@@ -476,6 +490,11 @@ class FleetSim:
             self._kick(w)
             return
         end = self.vclock.now + self._service_sample(jid)
+        if self.capture is not None:
+            # last kick wins — exactly the newest-wins semantics a
+            # redispatched/hedged unit has in the live recorder
+            job.setdefault("unit_spans", {})[unit] = \
+                [w.wid, self.vclock.now, end, None]
         w.busy = (jid, unit, end, w.epoch)
         self._idle.pop(w.wid, None)
         epoch = w.epoch
@@ -532,6 +551,10 @@ class FleetSim:
             self._bump("duplicate_checkins")
             return
         self.engine.log(f"checkin {jid}/{unit} by {w.wid}")
+        if self.capture is not None:
+            us = job.get("unit_spans", {}).get(unit)
+            if us is not None and us[0] == w.wid:
+                us[3] = self.vclock.now   # delivery landed (upload end)
         done, total = m.ledger.progress(jid)
         if done >= total:
             self._finish_job(m, jid)
@@ -551,10 +574,61 @@ class FleetSim:
         self._bump("hedged_units", int(summary.get("hedged_units", 0)))
         if book != "fanout":
             m.admission.on_complete(tenant)
+        if self.capture is not None:
+            self.capture.export(self._capture_record(jid, job))
         del self.jobs[jid]
         self.open_jobs -= 1
         self.engine.log(f"done {jid} {tenant}")
         self._maybe_finish()
+
+    def _capture_record(self, jid: str,
+                        job: Dict[str, Any]) -> Dict[str, Any]:
+        """One finished sim job as a schema-1 capture record: a root
+        ``job`` span over the whole interval, a ``queue_wait`` child
+        (arrival -> dispatch), per-unit ``dispatch`` / ``compute`` /
+        ``upload`` children on the serving worker's lane.  Virtual-
+        clock timestamps, md5-deterministic ids — byte-stable across
+        runs of the same (scenario, seed)."""
+        import hashlib
+        now = self.vclock.now
+        arrival = float(job["arrival"])
+        trace_id = hashlib.md5(
+            f"{self.sc.name}:{self.sc.seed}:{jid}".encode()).hexdigest()
+        spans: List[Dict[str, Any]] = []
+        sseq = [0]
+
+        def span(name, start, end, parent, attrs=None):
+            sseq[0] += 1
+            sid = hashlib.md5(
+                f"{trace_id}:{sseq[0]}".encode()).hexdigest()[:16]
+            spans.append({
+                "trace_id": trace_id, "span_id": sid,
+                "parent_id": parent, "name": name,
+                "start_s": round(start, 6), "end_s": round(end, 6),
+                "duration_s": round(max(end - start, 0.0), 6),
+                "status": "ok", "attrs": dict(attrs or {})})
+            return sid
+
+        root = span("job", arrival, now, None,
+                    {"prompt_id": jid, "tenant": job["tenant"]})
+        dispatched = min(max(float(job.get("dispatched_at", arrival)),
+                             arrival), now)
+        if dispatched > arrival:
+            span("queue_wait", arrival, dispatched, root)
+        for unit in sorted(job.get("unit_spans", {})):
+            wid, cstart, cend, landed = job["unit_spans"][unit]
+            cstart = max(min(float(cstart), now), arrival)
+            cend = max(min(float(cend), now), cstart)
+            at = {"worker": wid, "tile_idx": unit}
+            if cstart > dispatched:
+                span("dispatch", dispatched, cstart, root, at)
+            span("compute", cstart, cend, root, at)
+            if landed is not None and landed > cend:
+                span("upload", cend, min(float(landed), now), root, at)
+        return {"prompt_id": jid, "trace_id": trace_id,
+                "status": "ok", "root_span_id": root,
+                "duration_s": round(now - arrival, 6),
+                "finished_at": round(now, 6), "spans": spans}
 
     def _maybe_finish(self) -> None:
         if self.finished or self._arrivals_open > 0 \
@@ -869,6 +943,12 @@ class FleetSim:
                 "scale_downs": sum(s.scale_downs for s in scalers),
                 "flaps": sum(s.flaps for s in scalers),
             }
+        if self.capture is not None:
+            st = self.capture.stats()
+            out["capture"] = {"dir": st["dir"],
+                              "exported": st["exported"],
+                              "dropped": st["dropped"],
+                              "bytes_written": st["bytes_written"]}
         if self.multi:
             out["takeover"] = {
                 "takeovers": self.takeovers,

@@ -75,6 +75,11 @@ class Scenario:
     # hard stop: virtual seconds after the arrival window the drain may
     # run before the scenario is declared wedged
     drain_limit_s: float = 600.0
+    # capture-schema export (ISSUE 20): when set, completed jobs are
+    # written as trace_export segment files (virtual-clock timestamps,
+    # md5-deterministic ids) so `cli analyze`/`cli why --export-dir`
+    # run the SAME analytics on synthetic traffic
+    capture_dir: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out = dataclasses.asdict(self)
@@ -123,6 +128,8 @@ def from_dict(spec: Dict[str, Any]) -> Scenario:
         arrivals=([dict(a) for a in spec["arrivals"]]
                   if spec.get("arrivals") else None),
         drain_limit_s=float(spec.get("drain_limit_s", 600.0)),
+        capture_dir=(str(spec["capture_dir"])
+                     if spec.get("capture_dir") else None),
     )
 
 

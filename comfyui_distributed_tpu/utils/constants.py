@@ -555,6 +555,27 @@ SIM_EVENT_LOG_TAIL_DEFAULT = 256            # full log feeds the digest
 # error between simulated and measured bench artifacts
 SIM_CALIBRATION_MAX_ERR = 0.15
 
+# --- critical-path analytics plane (utils/trace_analysis.py, ISSUE 20) ------
+# Turns recorded traces into critical-path blame: per-trace category
+# decomposition with an unattributed-gap residual, cross-trace profiles,
+# regression diffs and baseline-gated anomaly detection.  The live plane
+# is armed by pointing DTPU_ANALYSIS_BASELINE at a committed profile
+# JSON; everything else is on-demand (cli why / cli analyze / the
+# /distributed/analysis route).
+ANALYSIS_BASELINE_ENV = "DTPU_ANALYSIS_BASELINE"   # unset/empty: disarmed
+ANALYSIS_ANOMALY_PCT_ENV = "DTPU_ANALYSIS_ANOMALY_PCT"
+ANALYSIS_ANOMALY_PCT_DEFAULT = 50.0     # per-category regression bar (%)
+ANALYSIS_STRAGGLER_X_ENV = "DTPU_ANALYSIS_STRAGGLER_X"
+ANALYSIS_STRAGGLER_X_DEFAULT = 2.0      # worker p95 vs fleet-median bar
+ANALYSIS_MAX_TRACES_ENV = "DTPU_ANALYSIS_MAX_TRACES"
+ANALYSIS_MAX_TRACES_DEFAULT = 256       # records per aggregation pass
+# clock-skew correction for cross-process edges: heartbeats carry the
+# worker's wall clock, the master min-filters (offset + one-way delay)
+# samples into a per-worker estimate and applies it when ingesting
+# shipped worker spans.  "0" records estimates but never shifts spans.
+SKEW_CORRECTION_ENV = "DTPU_SKEW_CORRECTION"
+SKEW_SAMPLES_KEPT = 16                  # min-filter window per worker
+
 # --- span-attribute whitelist (dtpu-lint span-attr) ---------------------------
 # The vocabulary contract between span producers and the trace readers
 # (`cli trace`, the flight-recorder consumers): every literal attr key
@@ -581,6 +602,9 @@ TRACE_ATTR_WHITELIST = frozenset({
     "cache_hit", "cache_tier", "tiles_skipped",
     # multi-master sharded control plane (ISSUE 14)
     "shard", "ring_epoch", "forwarded_from",
+    # clock-skew-corrected ingest (ISSUE 20): the offset (ms) applied to
+    # a shipped worker span forest, stamped on the receive event
+    "skew_ms",
 })
 
 # --- persistent compilation cache -------------------------------------------

@@ -984,6 +984,10 @@ class FlightRecorder:
         # loop) and outside the recorder lock — the exporter has its own.
         from comfyui_distributed_tpu.utils import trace_export
         trace_export.on_commit(export_rec)
+        # critical-path analytics plane (ISSUE 20): armed only while a
+        # baseline profile is configured; disarmed it costs one env read
+        from comfyui_distributed_tpu.utils import trace_analysis
+        trace_analysis.on_commit(export_rec)
 
     def get(self, prompt_id: str) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -1006,6 +1010,19 @@ class FlightRecorder:
                      "finished_at": rec["finished_at"],
                      "n_spans": len(rec["spans"])}
                     for rec in reversed(self._jobs.values())]
+
+    def records(self) -> List[Dict[str, Any]]:
+        """All committed job records, oldest first, shaped like
+        :meth:`get` (sorted span-dict lists) — the cross-trace
+        analytics plane's bulk read (ISSUE 20)."""
+        with self._lock:
+            out = []
+            for rec in self._jobs.values():
+                r = {k: v for k, v in rec.items() if k != "_ids"}
+                r["spans"] = sorted(rec["spans"],
+                                    key=lambda s: s.get("start_s", 0.0))
+                out.append(r)
+            return out
 
     def breakdown(self, trace_id: str) -> Dict[str, float]:
         """Per-span-name total seconds for one trace — the slow-job log's

@@ -270,10 +270,19 @@ def reset_counters() -> None:
 
 # --- loader ------------------------------------------------------------------
 
-def iter_records(dir_path: str) -> Iterator[Dict[str, Any]]:
+def iter_records(dir_path: str,
+                 stats: Optional[Dict[str, int]] = None,
+                 ) -> Iterator[Dict[str, Any]]:
     """Yield capture records oldest-segment-first; lines that fail to
     parse or carry an unknown schema are skipped (a torn final line
-    after a crash is expected, not fatal)."""
+    after a crash is expected, not fatal).  Pass a dict as ``stats`` to
+    learn how much was skipped — ``torn_lines`` (JSON parse failures),
+    ``unknown_schema`` and ``io_errors`` are accumulated into it so
+    ``cli analyze`` can report loader health instead of silently
+    narrowing the sample (ISSUE 20 satellite)."""
+    if stats is not None:
+        for k in ("records", "torn_lines", "unknown_schema", "io_errors"):
+            stats.setdefault(k, 0)
     for path in segment_paths(dir_path):
         try:
             with open(path, "rb") as fh:
@@ -284,12 +293,20 @@ def iter_records(dir_path: str) -> Iterator[Dict[str, Any]]:
                     try:
                         rec = json.loads(raw)
                     except ValueError:
+                        if stats is not None:
+                            stats["torn_lines"] += 1
                         continue
                     if not isinstance(rec, dict) \
                             or rec.get("schema") != SCHEMA_VERSION:
+                        if stats is not None:
+                            stats["unknown_schema"] += 1
                         continue
+                    if stats is not None:
+                        stats["records"] += 1
                     yield rec
         except OSError:
+            if stats is not None:
+                stats["io_errors"] += 1
             continue
 
 
@@ -321,9 +338,12 @@ def load_forest(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
 def to_perfetto(rec: Dict[str, Any]) -> Dict[str, Any]:
     """One capture/flight-recorder record as Chrome trace-event JSON
     (``chrome://tracing`` / ui.perfetto.dev).  Spans become complete
-    ("X") events; each participant (the span's ``worker`` attr, master
-    when absent) gets its own lane so a fan-out reads as parallel
-    tracks."""
+    ("X") events; zero-duration event spans become instant ("i")
+    markers so they stay visible instead of rendering as invisible
+    slivers.  Each participant (the span's ``worker`` attr, master when
+    absent) gets its own lane, decorated with the shard id and tenant
+    class when the spans carry them, so a fan-out reads as parallel
+    attributable tracks."""
     lanes: Dict[str, int] = {}
     events: List[Dict[str, Any]] = []
     spans = sorted(list(rec.get("spans") or []),
@@ -331,17 +351,28 @@ def to_perfetto(rec: Dict[str, Any]) -> Dict[str, Any]:
     for s in spans:
         attrs = dict(s.get("attrs") or {})
         lane = str(attrs.get("worker") or "master")
+        if attrs.get("shard") is not None:
+            lane += f" shard={attrs['shard']}"
+        if attrs.get("tenant"):
+            lane += f" [{attrs['tenant']}]"
         tid = lanes.setdefault(lane, len(lanes) + 1)
         args: Dict[str, Any] = {"trace_id": s.get("trace_id"),
                                 "span_id": s.get("span_id"),
                                 "status": s.get("status")}
         args.update(attrs)
-        events.append({
+        dur_us = round(float(s.get("duration_s") or 0.0) * 1e6, 3)
+        ev = {
             "name": s.get("name", "?"), "cat": "dtpu", "ph": "X",
             "ts": round(float(s.get("start_s") or 0.0) * 1e6, 3),
-            "dur": round(float(s.get("duration_s") or 0.0) * 1e6, 3),
-            "pid": 1, "tid": tid, "args": args,
-        })
+            "dur": dur_us, "pid": 1, "tid": tid, "args": args,
+        }
+        if dur_us <= 0:
+            # instant event, thread-scoped — perfetto drops "X" slices
+            # with zero duration
+            ev["ph"] = "i"
+            ev["s"] = "t"
+            del ev["dur"]
+        events.append(ev)
     meta: List[Dict[str, Any]] = [{
         "name": "process_name", "ph": "M", "pid": 1,
         "args": {"name": f"dtpu job {rec.get('prompt_id', '?')} "
